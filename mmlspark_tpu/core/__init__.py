@@ -1,0 +1,37 @@
+from mmlspark_tpu.core.dataframe import DataFrame, Row
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    Param,
+    Params,
+)
+from mmlspark_tpu.core.pipeline import (
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    PipelineStage,
+    STAGE_REGISTRY,
+    Transformer,
+    load_stage,
+)
+from mmlspark_tpu.core.schema import ColumnInfo, Schema
+from mmlspark_tpu.core.utils import StopWatch
+
+__all__ = [
+    "DataFrame",
+    "Row",
+    "Param",
+    "ComplexParam",
+    "Params",
+    "PipelineStage",
+    "Transformer",
+    "Estimator",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+    "STAGE_REGISTRY",
+    "load_stage",
+    "ColumnInfo",
+    "Schema",
+    "StopWatch",
+]

@@ -1,0 +1,65 @@
+"""Test harness root.
+
+The "distributed without a cluster" substrate (SURVEY.md §4): force JAX onto
+the host CPU platform with 8 virtual devices so mesh/collective code paths
+run for real in one process — the analogue of the reference testing LightGBM
+/VW socket allreduce between local-mode Spark tasks
+(VerifyLightGBMClassifier.scala:123).
+
+NOTE: this environment registers a TPU-tunnel ("axon") PJRT plugin via
+sitecustomize at interpreter boot; merely listing backends initializes it,
+which needs real hardware. Tests must not touch it, so we drop every
+non-CPU backend factory before the first device query.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(_xb._backend_factories):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name)
+except Exception:  # pragma: no cover - best effort on jax internals drift
+    pass
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    ds = jax.devices()
+    assert len(ds) == 8, f"expected 8 virtual CPU devices, got {len(ds)}"
+    return ds
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
+
+
+def make_tabular_df(n=200, d=6, n_classes=2, num_partitions=3, seed=0):
+    """Synthetic linearly-separable-ish tabular DataFrame with a dense
+    feature matrix column + scalar label column."""
+    from mmlspark_tpu import DataFrame
+
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, d)).astype(np.float32)
+    w = r.normal(size=(d, n_classes))
+    logits = x @ w + 0.5 * r.normal(size=(n, n_classes))
+    y = np.argmax(logits, axis=1).astype(np.int32)
+    return DataFrame.from_dict({"features": x, "label": y}, num_partitions=num_partitions)
+
+
+@pytest.fixture()
+def tabular_df():
+    return make_tabular_df()

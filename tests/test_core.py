@@ -1,0 +1,213 @@
+"""Core runtime tests: params, dataframe, pipeline, persistence."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame, Pipeline, PipelineModel, Transformer, Estimator, Model, load_stage
+from mmlspark_tpu.core.params import ComplexParam, Param, Params, HasInputCol, HasOutputCol
+from mmlspark_tpu.core.schema import find_unused_column
+from mmlspark_tpu.core.utils import StopWatch, buffered_await, retry_with_backoff
+
+
+# -- params -----------------------------------------------------------------
+
+
+class _Thing(Params):
+    alpha = Param("learning rate", default=0.1, type_=float)
+    name = Param("a name", type_=str)
+    payload = ComplexParam("some payload")
+
+
+def test_param_defaults_and_set():
+    t = _Thing()
+    assert t.alpha == 0.1
+    t.alpha = 0.5
+    assert t.alpha == 0.5
+    t.set(name="x")
+    assert t.get("name") == "x"
+    assert not t.is_set("alpha") or t.is_set("alpha")  # both defined states ok
+    assert t.is_defined("alpha")
+
+
+def test_param_validation():
+    t = _Thing()
+    with pytest.raises(TypeError):
+        t.set(name=3)
+    t.set(alpha=2)  # int -> float coercion
+    assert t.alpha == 2.0
+
+
+def test_param_copy_isolated():
+    t = _Thing(alpha=0.3)
+    u = t.copy({"alpha": 0.7})
+    assert t.alpha == 0.3 and u.alpha == 0.7
+
+
+def test_explain_params():
+    assert "learning rate" in _Thing().explain_params()
+
+
+# -- dataframe --------------------------------------------------------------
+
+
+def test_df_basic(tabular_df):
+    assert tabular_df.count() == 200
+    assert tabular_df.num_partitions == 3
+    assert set(tabular_df.columns) == {"features", "label"}
+    assert tabular_df["features"].shape == (200, 6)
+    assert tabular_df.schema["features"].kind == "vector"
+    assert tabular_df.schema["label"].kind == "scalar"
+
+
+def test_df_select_drop_rename(tabular_df):
+    assert tabular_df.select("label").columns == ["label"]
+    assert tabular_df.drop("label").columns == ["features"]
+    assert "y" in tabular_df.rename({"label": "y"}).columns
+
+
+def test_df_with_column(tabular_df):
+    df = tabular_df.with_column("norm", lambda p: np.linalg.norm(p["features"], axis=1))
+    assert df["norm"].shape == (200,)
+    df2 = tabular_df.with_column("const", np.arange(200))
+    assert np.array_equal(df2["const"], np.arange(200))
+
+
+def test_df_filter_and_dropna():
+    df = DataFrame.from_dict(
+        {"x": np.array([1.0, np.nan, 3.0]), "s": ["a", "b", "c"]}, num_partitions=2
+    )
+    assert df.filter(lambda p: ~np.isnan(p["x"])).count() == 2
+    assert df.drop_na(["x"]).count() == 2
+
+
+def test_df_repartition_roundtrip(tabular_df):
+    df = tabular_df.repartition(7)
+    assert df.num_partitions == 7
+    assert df.count() == 200
+    np.testing.assert_allclose(np.sort(df["label"]), np.sort(tabular_df["label"]))
+    c = df.coalesce(2)
+    assert c.num_partitions == 2 and c.count() == 200
+
+
+def test_df_random_split(tabular_df):
+    a, b = tabular_df.random_split([0.8, 0.2], seed=1)
+    assert a.count() + b.count() == 200
+    assert 120 < a.count() < 195
+
+
+def test_df_rows_and_group():
+    df = DataFrame.from_rows([{"k": "a", "v": 1}, {"k": "b", "v": 2}, {"k": "a", "v": 3}])
+    g = df.group_apply("k", lambda k, grp: {"k": k, "s": int(grp["v"].sum())})
+    got = {r.k: r.s for r in g.collect()}
+    assert got == {"a": 4, "b": 2}
+
+
+def test_df_union_sort():
+    d1 = DataFrame.from_dict({"x": [3, 1]})
+    d2 = DataFrame.from_dict({"x": [2]})
+    u = d1.union(d2).sort("x")
+    assert list(u["x"]) == [1, 2, 3]
+
+
+# -- pipeline + persistence -------------------------------------------------
+
+
+class AddOne(Transformer, HasInputCol, HasOutputCol):
+    def transform(self, df):
+        ic, oc = self.get_or_fail("input_col"), self.get_or_fail("output_col")
+        return df.with_column(oc, lambda p: p[ic] + 1)
+
+
+class MeanShift(Estimator, HasInputCol):
+    def fit(self, df):
+        mu = float(df[self.get_or_fail("input_col")].mean())
+        return MeanShiftModel(input_col=self.input_col, mu=mu)
+
+
+class MeanShiftModel(Model, HasInputCol):
+    mu = Param("fitted mean", type_=float)
+
+    def transform(self, df):
+        return df.with_column(self.input_col, lambda p: p[self.input_col] - self.mu)
+
+
+def test_pipeline_fit_transform():
+    df = DataFrame.from_dict({"x": np.arange(10, dtype=np.float64)})
+    pipe = Pipeline([AddOne(input_col="x", output_col="y"), MeanShift(input_col="y")])
+    model = pipe.fit(df)
+    out = model.transform(df)
+    np.testing.assert_allclose(out["y"].mean(), 0.0, atol=1e-9)
+
+
+def test_stage_save_load_roundtrip(tmp_path):
+    t = AddOne(input_col="x", output_col="y")
+    t.save(str(tmp_path / "s"))
+    t2 = load_stage(str(tmp_path / "s"))
+    assert isinstance(t2, AddOne)
+    assert t2.input_col == "x" and t2.output_col == "y"
+
+
+def test_pipeline_model_save_load(tmp_path):
+    df = DataFrame.from_dict({"x": np.arange(10, dtype=np.float64)})
+    model = Pipeline([AddOne(input_col="x", output_col="y"), MeanShift(input_col="y")]).fit(df)
+    model.save(str(tmp_path / "pm"))
+    m2 = PipelineModel.load(str(tmp_path / "pm"))
+    out = m2.transform(df)
+    np.testing.assert_allclose(out["y"].mean(), 0.0, atol=1e-9)
+
+
+class Holder(Model):
+    weights = ComplexParam("weights")
+
+    def transform(self, df):
+        return df
+
+
+def test_complex_param_ndarray_roundtrip(tmp_path):
+    h = Holder()
+    h.set(weights=np.arange(12.0).reshape(3, 4))
+    h.save(str(tmp_path / "h"))
+    h2 = load_stage(str(tmp_path / "h"))
+    np.testing.assert_array_equal(h2.get("weights"), np.arange(12.0).reshape(3, 4))
+
+
+def test_fluent_api(tabular_df):
+    out = tabular_df.ml_transform(AddOne(input_col="label", output_col="l1"))
+    assert "l1" in out.columns
+
+
+# -- utils ------------------------------------------------------------------
+
+
+def test_stopwatch():
+    sw = StopWatch()
+    sw.measure(lambda: sum(range(1000)))
+    assert sw.elapsed_ns > 0
+
+
+def test_buffered_await_order():
+    import time as _t
+
+    def mk(i):
+        def thunk():
+            _t.sleep(0.01 * ((5 - i) % 3))
+            return i
+        return thunk
+
+    assert list(buffered_await([mk(i) for i in range(6)], max_concurrency=3)) == list(range(6))
+
+
+def test_retry_with_backoff():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("boom")
+        return 42
+
+    assert retry_with_backoff(flaky, backoffs_ms=[1, 1, 1]) == 42
+
+
+def test_find_unused_column():
+    assert find_unused_column("x", ["x", "x_1"]) == "x_2"
