@@ -155,15 +155,18 @@ class DataFrame:
 
     @property
     def schema(self) -> Schema:
+        def merged(p: Partition) -> Schema:
+            s = infer_schema(p)
+            for name, info in s.items():
+                md = self._metadata.get(name)
+                if md:
+                    s[name] = ColumnInfo(info.dtype, info.shape, dict(md))
+            return s
+
         for p in self._parts:
             if p and len(next(iter(p.values()))):
-                s = infer_schema(p)
-                for name, info in s.items():
-                    md = self._metadata.get(name)
-                    if md:
-                        s[name] = ColumnInfo(info.dtype, info.shape, dict(md))
-                return s
-        return infer_schema(self._parts[0]) if self._parts[0] else Schema()
+                return merged(p)
+        return merged(self._parts[0]) if self._parts[0] else Schema()
 
     def count(self) -> int:
         return sum(len(next(iter(p.values()))) if p else 0 for p in self._parts)
@@ -229,7 +232,14 @@ class DataFrame:
 
     def _run(self, fn: Callable[[Partition], Partition], parallel: bool = True) -> list:
         live = self._parts
-        if parallel and len(live) > 1:
+        import threading
+
+        # nested map_partitions (a partition fn using DataFrame ops) must not
+        # re-enter the bounded pool: all workers could block waiting for
+        # inner tasks that can never be scheduled -> deadlock. Pool workers
+        # carry the "mml-task" thread-name prefix; inside one, run serially.
+        in_worker = threading.current_thread().name.startswith("mml-task")
+        if parallel and len(live) > 1 and not in_worker:
             return list(_get_pool().map(fn, live))
         return [fn(p) for p in live]
 
@@ -317,9 +327,11 @@ class DataFrame:
             raise ValueError(f"coalesce: n must be >= 1, got {n}")
         if n >= self.num_partitions:
             return self
-        groups: list[list[Partition]] = [[] for _ in range(n)]
-        for i, p in enumerate(self._parts):
-            groups[i % n].append(p)
+        # contiguous runs preserve global row order
+        bounds = np.linspace(0, len(self._parts), n + 1).astype(int)
+        groups: list[list[Partition]] = [
+            self._parts[bounds[i]: bounds[i + 1]] for i in range(n)
+        ]
         parts = []
         for g in groups:
             g = [p for p in g if p]
@@ -332,8 +344,13 @@ class DataFrame:
 
     def union(self, other: "DataFrame") -> "DataFrame":
         my_cols = self.columns or other.columns
+        if other.columns and set(other.columns) != set(my_cols):
+            raise ValueError(
+                f"union: column mismatch {sorted(my_cols)} vs {sorted(other.columns)}"
+            )
         other_parts = [{k: p[k] for k in my_cols} for p in other._parts if p]
-        return DataFrame(self._parts + other_parts, metadata=self._metadata)
+        md = {**other._metadata, **self._metadata}
+        return DataFrame(self._parts + other_parts, metadata=md)
 
     def random_split(self, weights: Sequence[float], seed: int = 0) -> list:
         w = np.asarray(weights, dtype=float)

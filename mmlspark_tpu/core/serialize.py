@@ -65,7 +65,7 @@ def write_dataframe(df: DataFrame, path: str) -> None:
     meta = {"num_partitions": df.num_partitions, "metadata": {}}
     for name, md in ((n, df.column_metadata(n)) for n in df.columns):
         if md:
-            meta["metadata"][name] = md
+            meta["metadata"][name] = _jsonable(md)
     for i, p in enumerate(df.partitions):
         dense = {k: v for k, v in p.items() if v.dtype != object}
         objs = {k: list(v) for k, v in p.items() if v.dtype == object}

@@ -1,0 +1,3 @@
+from mmlspark_tpu.downloader.zoo import ModelDownloader, ModelSchema
+
+__all__ = ["ModelDownloader", "ModelSchema"]

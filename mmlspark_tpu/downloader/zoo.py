@@ -1,0 +1,150 @@
+"""Model zoo — the ModelDownloader analogue.
+
+The reference maintains a repository of CNTK model schemas (uri, hash,
+size, inputNode, layerNames) fetched over HDFS/HTTP
+(downloader/ModelDownloader.scala:27-118, downloader/Schema.scala:54-66).
+Here the repository is a local directory of Flax checkpoints + JSON
+schemas; remote URIs can be registered but this build is egress-free, so
+absent checkpoints are materialized as seeded random inits (weights are
+still content-hashed so cache hits are exact).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.utils import retry_with_backoff
+
+DEFAULT_REPO = os.path.join(
+    os.environ.get("MMLSPARK_TPU_HOME", os.path.expanduser("~/.mmlspark_tpu")), "models"
+)
+
+
+@dataclass
+class ModelSchema:
+    """Metadata for one zoo model (downloader/Schema.scala:54-66 analogue)."""
+
+    name: str
+    variant: str = "ResNet50"
+    num_classes: int = 1000
+    image_size: int = 224
+    small_inputs: bool = False
+    input_node: str = "image"
+    layer_names: list = field(
+        default_factory=lambda: [
+            "logits", "pool", "layer4", "layer3", "layer2", "layer1", "stem",
+        ]
+    )
+    uri: Optional[str] = None
+    sha256: Optional[str] = None
+    seed: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=1)
+
+
+BUILTIN_MODELS = {
+    "ResNet18": ModelSchema(name="ResNet18", variant="ResNet18"),
+    "ResNet34": ModelSchema(name="ResNet34", variant="ResNet34"),
+    "ResNet50": ModelSchema(name="ResNet50", variant="ResNet50"),
+    "ResNet101": ModelSchema(name="ResNet101", variant="ResNet101"),
+    "ResNet50_ImageNet_CIFAR": ModelSchema(
+        name="ResNet50_ImageNet_CIFAR",
+        variant="ResNet50",
+        num_classes=10,
+        image_size=32,
+        small_inputs=True,
+    ),
+}
+
+
+class ModelDownloader:
+    """Local/remote model repository client."""
+
+    def __init__(self, repo_dir: str = DEFAULT_REPO):
+        self.repo_dir = repo_dir
+        os.makedirs(repo_dir, exist_ok=True)
+
+    def list_models(self) -> list:
+        names = set(BUILTIN_MODELS)
+        for f in os.listdir(self.repo_dir):
+            if f.endswith(".schema.json"):
+                names.add(f[: -len(".schema.json")])
+        return sorted(names)
+
+    def _paths(self, name: str) -> tuple:
+        return (
+            os.path.join(self.repo_dir, f"{name}.schema.json"),
+            os.path.join(self.repo_dir, f"{name}.msgpack"),
+        )
+
+    def register(self, schema: ModelSchema, variables: Any) -> None:
+        """Install a model (e.g. converted pretrained weights) into the repo."""
+        from flax import serialization as fser
+
+        spath, wpath = self._paths(schema.name)
+        blob = fser.msgpack_serialize(_to_np(variables))
+        schema.sha256 = hashlib.sha256(blob).hexdigest()
+        with open(wpath, "wb") as f:
+            f.write(blob)
+        with open(spath, "w") as f:
+            f.write(schema.to_json())
+
+    def download_by_name(self, name: str) -> ModelSchema:
+        """Ensure the named model exists locally; return its schema."""
+        spath, wpath = self._paths(name)
+        if os.path.exists(spath) and os.path.exists(wpath):
+            with open(spath) as f:
+                return ModelSchema(**json.load(f))
+        schema = BUILTIN_MODELS.get(name)
+        if schema is None:
+            raise KeyError(f"unknown model {name!r}; known: {self.list_models()}")
+        if schema.uri:  # remote fetch path (with retries); unused offline
+            retry_with_backoff(lambda: self._fetch(schema, wpath))
+        else:
+            from mmlspark_tpu.models.resnet import init_resnet
+
+            _, variables = init_resnet(
+                schema.variant,
+                num_classes=schema.num_classes,
+                image_size=schema.image_size,
+                small_inputs=schema.small_inputs,
+                seed=schema.seed,
+            )
+            self.register(schema, variables)
+        return schema
+
+    def load(self, name: str) -> tuple:
+        """Return (module, variables, schema) ready for XLAModel."""
+        from flax import serialization as fser
+
+        from mmlspark_tpu.models.resnet import RESNETS
+
+        schema = self.download_by_name(name)
+        _, wpath = self._paths(name)
+        with open(wpath, "rb") as f:
+            blob = f.read()
+        if schema.sha256 and hashlib.sha256(blob).hexdigest() != schema.sha256:
+            raise IOError(f"checksum mismatch for model {name}")
+        variables = fser.msgpack_restore(blob)
+        module = RESNETS[schema.variant](
+            num_classes=schema.num_classes, small_inputs=schema.small_inputs
+        )
+        return module, variables, schema
+
+    def _fetch(self, schema: ModelSchema, wpath: str) -> None:
+        import urllib.request
+
+        urllib.request.urlretrieve(schema.uri, wpath)  # noqa: S310
+
+
+def _to_np(tree: Any) -> Any:
+    if isinstance(tree, dict):
+        return {k: _to_np(v) for k, v in tree.items()}
+    return np.asarray(tree)

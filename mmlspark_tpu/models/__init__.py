@@ -1,0 +1,5 @@
+from mmlspark_tpu.models.xla_model import XLAModel
+from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+from mmlspark_tpu.models import resnet
+
+__all__ = ["XLAModel", "ImageFeaturizer", "resnet"]

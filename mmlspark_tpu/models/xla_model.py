@@ -1,0 +1,143 @@
+"""XLAModel — batched model evaluation on TPU (the CNTKModel analogue).
+
+The reference broadcasts a serialized CNTK graph to executors and feeds
+minibatches through the native eval API per partition
+(cntk/CNTKModel.scala:86-138,490-530). The TPU design:
+
+- the "graph" is a jittable ``apply_fn(variables, x)``; XLA HLO is the
+  compiled artifact (compile-once-per-shape replaces broadcast-once).
+- weights are replicated onto the device mesh a single time per transform
+  (the broadcast analogue, cntk/CNTKModel.scala:411-413).
+- partitions are padded to a fixed batch size (FixedMiniBatchTransformer
+  analogue — static shapes are load-bearing on TPU: any new shape is a new
+  XLA compilation) and batch-sharded over the mesh ``data`` axis.
+- multi-output graphs return name->array dicts; ``output_node`` selects one
+  (the ARGUMENT_i/OUTPUT_i resolution analogue,
+  com/microsoft/CNTK/SerializableFunction.scala:115-129). XLA dead-code
+  eliminates the unused heads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, Partition
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasBatchSize,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+)
+from mmlspark_tpu.core.pipeline import Model
+from mmlspark_tpu.parallel.mesh import get_mesh
+from mmlspark_tpu.parallel.sharding import pad_batch, replicate, shard_batch
+
+
+class XLAModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
+    apply_fn = ComplexParam(
+        "jittable function (variables, batch) -> array | dict[name, array]"
+    )
+    variables = ComplexParam("model variables pytree (replicated to the mesh)")
+    output_node = Param(
+        "name of the output to keep when apply_fn returns a dict", type_=str
+    )
+    batch_size = Param(
+        "fixed minibatch size; padded to a multiple of the mesh size",
+        default=64,
+        type_=int,
+    )
+    input_dtype = Param("cast input batches to this dtype", default="float32", type_=str)
+
+    def __init__(self, **kw: Any):
+        super().__init__(**kw)
+        self._jit_cache: dict = {}
+        self._dev_vars: Any = None
+        self._dev_vars_src: Any = None
+
+    @classmethod
+    def from_flax(
+        cls,
+        module: Any,
+        variables: Any,
+        output_node: Optional[str] = None,
+        **kw: Any,
+    ) -> "XLAModel":
+        def apply_fn(vs: Any, x: Any) -> Any:
+            return module.apply(vs, x, train=False)
+
+        m = cls(**kw)
+        m.set(apply_fn=apply_fn, variables=variables)
+        if output_node is not None:
+            m.set(output_node=output_node)
+        return m
+
+    # -- device-side plumbing ----------------------------------------------
+
+    def _effective_batch(self, mesh: Any) -> int:
+        bs = self.get("batch_size")
+        n_dev = mesh.devices.size
+        return ((bs + n_dev - 1) // n_dev) * n_dev
+
+    def _device_variables(self, mesh: Any) -> Any:
+        vs = self.get_or_fail("variables")
+        if self._dev_vars is None or self._dev_vars_src is not vs:
+            self._dev_vars = replicate(vs, mesh)
+            self._dev_vars_src = vs
+        return self._dev_vars
+
+    def _compiled(self, shape: tuple, mesh: Any) -> Callable:
+        key = (shape, id(mesh))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            apply_fn = self.get_or_fail("apply_fn")
+            node = self.get("output_node")
+
+            def run(vs: Any, x: Any) -> Any:
+                out = apply_fn(vs, x)
+                if isinstance(out, dict):
+                    if node is None:
+                        raise ValueError(
+                            f"apply_fn returned outputs {sorted(out)}; set output_node"
+                        )
+                    out = out[node]
+                return out
+
+            fn = jax.jit(run)
+            self._jit_cache[key] = fn
+        return fn
+
+    def apply_batch(self, x: np.ndarray) -> np.ndarray:
+        """Evaluate one host batch (used by transform and by serving)."""
+        mesh = get_mesh()
+        vs = self._device_variables(mesh)
+        bs = self._effective_batch(mesh)
+        x = np.asarray(x, dtype=self.get("input_dtype"))
+        padded, n = pad_batch(x, bs)
+        outs = []
+        fn = self._compiled(padded[:bs].shape, mesh)
+        for i in range(0, padded.shape[0], bs):
+            chunk = shard_batch(padded[i: i + bs], mesh)
+            outs.append(np.asarray(fn(vs, chunk)))
+        return np.concatenate(outs, axis=0)[:n]
+
+    # -- stage interface ----------------------------------------------------
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        ic = self.get_or_fail("input_col")
+        oc = self.get_or_fail("output_col")
+
+        def fn(p: Partition) -> Partition:
+            q = dict(p)
+            x = p[ic]
+            if x.dtype == object:  # ragged rows: stack (must be uniform shape)
+                x = np.stack(list(x))
+            q[oc] = self.apply_batch(x)
+            return q
+
+        # partitions run sequentially: there is one device mesh; overlap
+        # comes from async dispatch inside JAX, not host threads
+        return df.map_partitions(fn, parallel=False)

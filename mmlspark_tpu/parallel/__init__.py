@@ -1,0 +1,30 @@
+from mmlspark_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    cluster_summary,
+    data_sharding,
+    device_count,
+    get_mesh,
+    make_mesh,
+    replicated,
+    set_mesh,
+)
+from mmlspark_tpu.parallel.sharding import pad_batch, replicate, shard_batch
+from mmlspark_tpu.parallel import collectives, distributed
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "get_mesh",
+    "set_mesh",
+    "device_count",
+    "cluster_summary",
+    "data_sharding",
+    "replicated",
+    "pad_batch",
+    "shard_batch",
+    "replicate",
+    "collectives",
+    "distributed",
+]

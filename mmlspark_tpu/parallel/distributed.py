@@ -1,0 +1,70 @@
+"""Multi-host rendezvous and gang launch.
+
+Replaces the reference's driver TCP rendezvous server
+(LightGBMUtils.scala:116-185) and handshake protocol
+(LightGBMConstants.scala:34-40, TrainUtils.scala:453-494) with
+``jax.distributed`` over DCN: one coordinator address, every host calls
+``initialize`` and the JAX runtime forms the global device mesh; SPMD
+launch provides the gang semantics that the reference got from Spark
+barrier execution mode (LightGBMBase.scala:122-131).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host gang. No-ops for single-process runs and when
+    already initialized (so library code can call it unconditionally).
+
+    Environment fallbacks (set by the launcher): MMLSPARK_TPU_COORDINATOR,
+    MMLSPARK_TPU_NUM_PROCESSES, MMLSPARK_TPU_PROCESS_ID.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("MMLSPARK_TPU_COORDINATOR")
+    if coordinator_address is None:
+        _initialized = True  # single-host mode
+        return
+    num_processes = num_processes or int(os.environ.get("MMLSPARK_TPU_NUM_PROCESSES", "1"))
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get("MMLSPARK_TPU_PROCESS_ID", "0"))
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "mmlspark_tpu_barrier") -> None:
+    """Host-level sync point. On multi-host this rides a tiny psum over the
+    global mesh; single-host it is a no-op."""
+    if jax.process_count() == 1:
+        return
+    import jax.numpy as jnp
+
+    # A cross-host collective is the barrier: every host must contribute.
+    jax.block_until_ready(
+        jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+            jnp.ones((jax.local_device_count(),))
+        )
+    )

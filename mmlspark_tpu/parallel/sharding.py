@@ -1,0 +1,51 @@
+"""Batch/param placement helpers for SPMD execution.
+
+Where the reference broadcasts native models to executors and maps rows per
+partition (cntk/CNTKModel.scala:411-413,515-520), here weights are
+*replicated* onto the mesh once and batches are *batch-sharded* over the
+``data`` axis; XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mmlspark_tpu.parallel.mesh import DATA_AXIS, get_mesh
+
+
+def pad_batch(arr: np.ndarray, multiple: int) -> tuple:
+    """Pad axis 0 up to a multiple (fixed shapes avoid XLA recompiles — the
+    load-bearing TPU analogue of FixedMiniBatchTransformer). Returns
+    (padded, real_n)."""
+    n = arr.shape[0]
+    target = max(multiple, ((n + multiple - 1) // multiple) * multiple)
+    if target == n:
+        return arr, n
+    pad_width = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width), n
+
+
+def shard_batch(tree: Any, mesh: Optional[Mesh] = None, axis: str = DATA_AXIS) -> Any:
+    """Place a pytree of host arrays batch-sharded over the mesh.
+
+    Axis-0 of every leaf must divide by the mesh axis size (use
+    ``pad_batch`` first)."""
+    mesh = mesh or get_mesh()
+
+    def put(x: Any) -> Any:
+        x = np.asarray(x)
+        sh = NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
+        return jax.device_put(x, sh)
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def replicate(tree: Any, mesh: Optional[Mesh] = None) -> Any:
+    """Replicate a pytree (weights) across the mesh — the broadcast analogue."""
+    mesh = mesh or get_mesh()
+    sh = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)

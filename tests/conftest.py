@@ -25,9 +25,10 @@ jax.config.update("jax_platforms", "cpu")
 try:
     from jax._src import xla_bridge as _xb
 
-    for _name in list(_xb._backend_factories):
-        if _name != "cpu":
-            _xb._backend_factories.pop(_name)
+    # pop only the axon tunnel factory: its init blocks on hardware; the
+    # stock 'tpu' factory must stay registered (chex/checkify register
+    # lowering rules for the 'tpu' platform name at import time)
+    _xb._backend_factories.pop("axon", None)
 except Exception:  # pragma: no cover - best effort on jax internals drift
     pass
 

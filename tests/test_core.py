@@ -25,8 +25,8 @@ def test_param_defaults_and_set():
     assert t.alpha == 0.5
     t.set(name="x")
     assert t.get("name") == "x"
-    assert not t.is_set("alpha") or t.is_set("alpha")  # both defined states ok
-    assert t.is_defined("alpha")
+    assert t.is_set("alpha")  # was explicitly set above
+    assert not _Thing().is_set("alpha") and _Thing().is_defined("alpha")
 
 
 def test_param_validation():
@@ -87,6 +87,28 @@ def test_df_repartition_roundtrip(tabular_df):
     np.testing.assert_allclose(np.sort(df["label"]), np.sort(tabular_df["label"]))
     c = df.coalesce(2)
     assert c.num_partitions == 2 and c.count() == 200
+
+
+def test_df_coalesce_preserves_order():
+    df = DataFrame.from_dict({"x": np.arange(12)}, num_partitions=6).coalesce(2)
+    assert list(df["x"]) == list(range(12))
+
+
+def test_df_nested_map_partitions_no_deadlock():
+    inner = DataFrame.from_dict({"y": np.arange(4)}, num_partitions=2)
+
+    def fn(p):
+        s = inner.map_partitions(lambda q: {"y": q["y"] * 2}).count()
+        return {**p, "n": np.full(len(p["x"]), s)}
+
+    df = DataFrame.from_dict({"x": np.arange(8)}, num_partitions=4)
+    out = df.map_partitions(fn)
+    assert (out["n"] == 4).all()
+
+
+def test_df_union_mismatch_raises():
+    with pytest.raises(ValueError):
+        DataFrame.from_dict({"x": [1]}).union(DataFrame.from_dict({"x": [1], "z": [2]}))
 
 
 def test_df_random_split(tabular_df):

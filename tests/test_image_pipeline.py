@@ -1,0 +1,160 @@
+"""Image ops + XLAModel + ImageFeaturizer end-to-end (the §3.2 call stack)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.downloader import ModelDownloader
+from mmlspark_tpu.models import ImageFeaturizer, XLAModel
+from mmlspark_tpu.models.resnet import init_resnet
+from mmlspark_tpu.ops import image as im
+
+
+# -- image ops --------------------------------------------------------------
+
+
+def test_resize_and_crop():
+    x = jnp.ones((2, 10, 12, 3))
+    assert im.resize(x, 5, 6).shape == (2, 5, 6, 3)
+    assert im.center_crop(x, 4, 4).shape == (2, 4, 4, 3)
+    assert im.crop(x, 1, 2, 3, 4).shape == (2, 3, 4, 3)
+
+
+def test_flip_and_color():
+    x = jnp.arange(2 * 2 * 2 * 3.0).reshape(2, 2, 2, 3)
+    np.testing.assert_allclose(np.asarray(im.flip(im.flip(x))), np.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray(im.bgr_to_rgb(x))[..., 0], np.asarray(x)[..., 2]
+    )
+    g = im.to_grayscale(x)
+    assert g.shape == (2, 2, 2, 1)
+
+
+def test_blur_threshold():
+    x = jnp.zeros((1, 9, 9, 1)).at[0, 4, 4, 0].set(100.0)
+    b = im.gaussian_blur(x, 3, 1.0)
+    assert float(b[0, 4, 4, 0]) < 100.0
+    assert float(b.sum()) == pytest.approx(100.0, rel=1e-4)
+    t = im.threshold(x, 50.0, 255.0)
+    assert float(t[0, 4, 4, 0]) == 255.0 and float(t.sum()) == 255.0
+
+
+def test_unroll_matches_reference_layout():
+    # CHW plane order, BGR channel order (UnrollImage.scala:40-51)
+    x = np.arange(1 * 2 * 2 * 3, dtype=np.float32).reshape(1, 2, 2, 3)  # RGB HWC
+    v = np.asarray(im.unroll(jnp.asarray(x)))
+    # first plane must be the B channel in row-major HW order
+    np.testing.assert_allclose(v[0, :4], x[0, :, :, 2].ravel())
+    back = np.asarray(im.roll(jnp.asarray(v), 2, 2))
+    np.testing.assert_allclose(back, x)
+
+
+# -- XLAModel ---------------------------------------------------------------
+
+
+def test_xla_model_basic_fn():
+    df = DataFrame.from_dict({"x": np.ones((10, 4), np.float32)}, num_partitions=2)
+    m = XLAModel(input_col="x", output_col="y", batch_size=8)
+    m.set(apply_fn=lambda vs, x: x @ vs["w"], variables={"w": np.full((4, 2), 2.0, np.float32)})
+    out = m.transform(df)
+    assert out["y"].shape == (10, 2)
+    np.testing.assert_allclose(out["y"], 8.0)
+
+
+def test_xla_model_output_node_and_padding():
+    df = DataFrame.from_dict({"x": np.ones((5, 3), np.float32)})
+    m = XLAModel(input_col="x", output_col="y", batch_size=4, output_node="a")
+    m.set(
+        apply_fn=lambda vs, x: {"a": x * 2, "b": x * 3},
+        variables={},
+    )
+    out = m.transform(df)
+    assert out["y"].shape == (5, 3)
+    np.testing.assert_allclose(out["y"], 2.0)
+
+
+def test_xla_model_save_load(tmp_path):
+    df = DataFrame.from_dict({"x": np.ones((4, 4), np.float32)})
+    m = XLAModel(input_col="x", output_col="y", batch_size=4)
+    m.set(apply_fn=_double, variables={"w": np.eye(4, dtype=np.float32)})
+    m.save(str(tmp_path / "m"))
+    m2 = XLAModel.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(m2.transform(df)["y"], 2.0)
+
+
+def _double(vs, x):
+    return (x @ vs["w"]) * 2
+
+
+# -- zoo + featurizer -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_repo(tmp_path_factory):
+    """Zoo with a tiny CIFAR-style ResNet18 so tests stay fast."""
+    from mmlspark_tpu.downloader.zoo import ModelSchema
+
+    repo = ModelDownloader(str(tmp_path_factory.mktemp("zoo")))
+    _, variables = init_resnet("ResNet18", num_classes=10, image_size=32, small_inputs=True)
+    repo.register(
+        ModelSchema(
+            name="TinyResNet", variant="ResNet18", num_classes=10,
+            image_size=32, small_inputs=True,
+        ),
+        variables,
+    )
+    return repo
+
+
+def test_zoo_roundtrip(tiny_repo):
+    module, variables, schema = tiny_repo.load("TinyResNet")
+    assert schema.image_size == 32
+    x = jnp.zeros((2, 32, 32, 3))
+    out = module.apply(variables, x, train=False)
+    assert out["logits"].shape == (2, 10)
+    assert out["pool"].shape[0] == 2
+
+
+def test_zoo_unknown_model(tiny_repo):
+    with pytest.raises(KeyError):
+        tiny_repo.download_by_name("NoSuchNet")
+
+
+def test_image_featurizer_end_to_end(tiny_repo):
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 255, size=(6, 32, 32, 3), dtype=np.uint8)
+    rows = [make_image_row(imgs[i]) for i in range(6)]
+    df = DataFrame.from_dict({"image": rows}, num_partitions=2)
+    feat = ImageFeaturizer(
+        input_col="image", output_col="features", batch_size=4,
+        model_name="TinyResNet", repo_dir=tiny_repo.repo_dir,
+    )
+    out = feat.transform(df)
+    f = out["features"]
+    assert f.shape == (6, 512)  # ResNet18 pool width
+    assert np.isfinite(f).all()
+
+
+def test_image_featurizer_logits_head(tiny_repo):
+    imgs = np.zeros((3, 32, 32, 3), np.uint8)
+    df = DataFrame.from_dict({"image": imgs})  # dense tensor column path
+    feat = ImageFeaturizer(
+        input_col="image", output_col="probs", batch_size=4,
+        model_name="TinyResNet", repo_dir=tiny_repo.repo_dir,
+        cut_output_layers=0,
+    )
+    out = feat.transform(df)
+    assert out["probs"].shape == (3, 10)
+
+
+def test_image_featurizer_drops_bad_rows(tiny_repo):
+    good = make_image_row(np.zeros((32, 32, 3), np.uint8))
+    df = DataFrame.from_dict({"image": [good, b"not-an-image", good]})
+    feat = ImageFeaturizer(
+        input_col="image", output_col="features", batch_size=4,
+        model_name="TinyResNet", repo_dir=tiny_repo.repo_dir,
+    )
+    out = feat.transform(df)
+    assert out.count() == 2

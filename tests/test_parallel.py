@@ -1,0 +1,93 @@
+"""Mesh/collective layer tests on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mmlspark_tpu.parallel import (
+    collectives,
+    distributed,
+    cluster_summary,
+    get_mesh,
+    make_mesh,
+    pad_batch,
+    replicate,
+    set_mesh,
+    shard_batch,
+)
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    set_mesh(None)
+    yield
+    set_mesh(None)
+
+
+def test_make_mesh_default(devices8):
+    m = make_mesh()
+    assert m.axis_names == ("data",)
+    assert m.devices.size == 8
+
+
+def test_make_mesh_2d(devices8):
+    m = make_mesh({"data": -1, "model": 2})
+    assert m.shape["data"] == 4 and m.shape["model"] == 2
+    with pytest.raises(ValueError):
+        make_mesh({"data": 3})
+
+
+def test_cluster_summary(devices8):
+    s = cluster_summary()
+    assert s["num_devices"] == 8 and s["num_hosts"] == 1
+
+
+def test_pad_and_shard(devices8):
+    x = np.arange(10 * 3, dtype=np.float32).reshape(10, 3)
+    padded, n = pad_batch(x, 8)
+    assert padded.shape == (16, 3) and n == 10
+    sharded = shard_batch(padded)
+    assert sharded.shape == (16, 3)
+    np.testing.assert_array_equal(np.asarray(sharded)[:10], x)
+
+
+def test_replicate_and_compute(devices8):
+    w = {"w": np.ones((4, 4), np.float32)}
+    wd = replicate(w)
+    x = shard_batch(np.ones((8, 4), np.float32))
+    y = jax.jit(lambda w, x: x @ w["w"])(wd, x)
+    np.testing.assert_allclose(np.asarray(y), 4.0)
+
+
+def test_collectives_in_shard_map(devices8):
+    mesh = get_mesh()
+    fn = collectives.shard_apply(
+        lambda x: collectives.allreduce_sum(x.sum(keepdims=True))[None],
+        mesh=mesh,
+        in_specs=P("data"),
+        out_specs=P("data"),
+    )
+    x = jnp.ones((8,))
+    out = fn(x)
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+
+
+def test_ring_permute(devices8):
+    mesh = get_mesh()
+    fn = collectives.shard_apply(
+        lambda x: collectives.ring_permute(x),
+        mesh=mesh,
+        in_specs=P("data"),
+        out_specs=P("data"),
+    )
+    x = jnp.arange(8.0)
+    out = np.asarray(fn(x))
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_distributed_initialize_single_host():
+    distributed.initialize()  # no coordinator -> no-op
+    assert distributed.is_coordinator()
+    distributed.barrier()
