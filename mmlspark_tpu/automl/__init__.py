@@ -1,0 +1,21 @@
+from mmlspark_tpu.automl.hyperparams import (
+    DefaultHyperparams,
+    DiscreteHyperParam,
+    GridSpace,
+    HyperparamBuilder,
+    RandomSpace,
+    RangeHyperParam,
+)
+from mmlspark_tpu.automl.tune import FindBestModel, FindBestModelResult, TuneHyperparameters
+
+__all__ = [
+    "TuneHyperparameters",
+    "FindBestModel",
+    "FindBestModelResult",
+    "HyperparamBuilder",
+    "GridSpace",
+    "RandomSpace",
+    "DiscreteHyperParam",
+    "RangeHyperParam",
+    "DefaultHyperparams",
+]

@@ -31,7 +31,8 @@ class PipelineStage(Params):
 
     def __init_subclass__(cls, **kw: Any) -> None:
         super().__init_subclass__(**kw)
-        if not cls.__name__.startswith("_"):
+        # abstract bases in this module are not public stages
+        if not cls.__name__.startswith("_") and cls.__module__ != __name__:
             STAGE_REGISTRY[cls.__name__] = cls
 
     # -- persistence ---------------------------------------------------------

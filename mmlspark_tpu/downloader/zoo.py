@@ -107,6 +107,10 @@ class ModelDownloader:
             raise KeyError(f"unknown model {name!r}; known: {self.list_models()}")
         if schema.uri:  # remote fetch path (with retries); unused offline
             retry_with_backoff(lambda: self._fetch(schema, wpath))
+            with open(wpath, "rb") as f:
+                schema.sha256 = hashlib.sha256(f.read()).hexdigest()
+            with open(spath, "w") as f:
+                f.write(schema.to_json())
         else:
             from mmlspark_tpu.models.resnet import init_resnet
 

@@ -1,0 +1,28 @@
+from mmlspark_tpu.featurize.clean import CleanMissingData, CleanMissingDataModel, DataConversion
+from mmlspark_tpu.featurize.featurize import Featurize, FeaturizeModel
+from mmlspark_tpu.featurize.indexers import (
+    IndexToValue,
+    ValueIndexer,
+    ValueIndexerModel,
+)
+from mmlspark_tpu.featurize.text import (
+    MultiNGram,
+    PageSplitter,
+    TextFeaturizer,
+    TextFeaturizerModel,
+)
+
+__all__ = [
+    "CleanMissingData",
+    "CleanMissingDataModel",
+    "DataConversion",
+    "Featurize",
+    "FeaturizeModel",
+    "ValueIndexer",
+    "ValueIndexerModel",
+    "IndexToValue",
+    "TextFeaturizer",
+    "TextFeaturizerModel",
+    "MultiNGram",
+    "PageSplitter",
+]

@@ -108,8 +108,10 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol, HasBatchSize):
         """image structs / bytes / dense tensors -> ((N,H,W,C) float32, keep mask)."""
         if col.dtype != object:
             x = col.astype(np.float32)
-            if x.ndim == 2:  # unrolled vectors: roll back using image_size
-                size = self.get("image_size") or 224
+            if x.ndim == 2:  # unrolled vectors: roll back using model size
+                size = self.get("image_size") or (
+                    self._schema.image_size if self._schema else 224
+                )
                 x = np.asarray(
                     image_ops.roll(jnp.asarray(x), size, size, bgr=self.get("bgr_input"))
                 )

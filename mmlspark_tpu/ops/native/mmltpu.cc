@@ -1,0 +1,69 @@
+// Native host kernels for mmlspark_tpu.
+//
+// The reference ships its native engines as prebuilt JNI jars
+// (build.sbt:32-39); this library is the equivalent host-side native layer
+// for the TPU framework: hot host loops (hashing, CSV parse, binning) that
+// feed device programs. Built by ops/native_loader.py with g++ -O3.
+
+#include <cstdint>
+#include <cstring>
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6b;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35;
+  h ^= h >> 16;
+  return h;
+}
+
+// Canonical MurmurHash3_x86_32.
+static uint32_t murmur3_32(const uint8_t* data, int32_t len, uint32_t seed) {
+  const int nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51;
+  const uint32_t c2 = 0x1b873593;
+
+  const uint32_t* blocks = (const uint32_t*)(data);
+  for (int i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    memcpy(&k1, blocks + i, 4);
+    k1 *= c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xe6546b64;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= tail[2] << 16; [[fallthrough]];
+    case 2: k1 ^= tail[1] << 8; [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h1 ^= k1;
+  }
+
+  h1 ^= (uint32_t)len;
+  return fmix32(h1);
+}
+
+extern "C" {
+
+void mml_murmur3_batch(const char** strings, const int32_t* lengths,
+                       int64_t n, uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = murmur3_32((const uint8_t*)strings[i], lengths[i], seed);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+"""Native kernel loader — the NativeLoader analogue (core/env/NativeLoader.java:28-62).
+
+The reference extracts platform .so files from jars and ``System.load``s
+them; here the C++ sources live in ``ops/native`` and are compiled on first
+use with g++ into the package build dir, then bound via ctypes. Absence of
+a toolchain degrades gracefully to the numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional["_NativeLib"] = None
+_failed = False
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "native")
+_BUILD_DIR = os.path.join(_SRC_DIR, "build")
+
+
+class _NativeLib:
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.mml_murmur3_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.mml_murmur3_batch.restype = None
+
+    def murmur3_batch(self, toks: list, seed: int) -> np.ndarray:
+        n = len(toks)
+        arr = (ctypes.c_char_p * n)(*toks)
+        lens = np.array([len(t) for t in toks], dtype=np.int32)
+        out = np.empty(n, dtype=np.uint32)
+        self._lib.mml_murmur3_batch(
+            ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n,
+            seed,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+        return out
+
+
+def _build() -> Optional[str]:
+    so_path = os.path.join(_BUILD_DIR, "libmmltpu.so")
+    src = os.path.join(_SRC_DIR, "mmltpu.cc")
+    if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(src):
+        return so_path
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", so_path]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        return None
+    return so_path
+
+
+def try_load() -> Optional[_NativeLib]:
+    """Build+load the native kernel library, or None if unavailable."""
+    global _lib, _failed
+    if _lib is not None:
+        return _lib
+    if _failed or os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _failed = True
+            return None
+        try:
+            _lib = _NativeLib(ctypes.CDLL(so))
+        except Exception:
+            _failed = True
+            return None
+    return _lib

@@ -1,0 +1,52 @@
+from mmlspark_tpu.stages.basic import (
+    Cacher,
+    DropColumns,
+    Explode,
+    Lambda,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    Timer,
+    UDFTransformer,
+    get_value_at,
+    to_vector,
+)
+from mmlspark_tpu.stages.batching import (
+    DynamicMiniBatchTransformer,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    TimeIntervalMiniBatchTransformer,
+)
+from mmlspark_tpu.stages.balance import (
+    ClassBalancer,
+    ClassBalancerModel,
+    EnsembleByKey,
+    StratifiedRepartition,
+)
+from mmlspark_tpu.stages.summarize import SummarizeData
+from mmlspark_tpu.stages.text import TextPreprocessor, UnicodeNormalize
+
+__all__ = [
+    "DropColumns",
+    "SelectColumns",
+    "RenameColumn",
+    "Repartition",
+    "Lambda",
+    "UDFTransformer",
+    "Explode",
+    "Cacher",
+    "Timer",
+    "get_value_at",
+    "to_vector",
+    "FixedMiniBatchTransformer",
+    "DynamicMiniBatchTransformer",
+    "TimeIntervalMiniBatchTransformer",
+    "FlattenBatch",
+    "StratifiedRepartition",
+    "ClassBalancer",
+    "ClassBalancerModel",
+    "EnsembleByKey",
+    "SummarizeData",
+    "TextPreprocessor",
+    "UnicodeNormalize",
+]

@@ -1,0 +1,19 @@
+from mmlspark_tpu.train.train import (
+    TrainClassifier,
+    TrainRegressor,
+    TrainedClassifierModel,
+    TrainedRegressorModel,
+)
+from mmlspark_tpu.train.statistics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+)
+
+__all__ = [
+    "TrainClassifier",
+    "TrainRegressor",
+    "TrainedClassifierModel",
+    "TrainedRegressorModel",
+    "ComputeModelStatistics",
+    "ComputePerInstanceStatistics",
+]

@@ -1,0 +1,124 @@
+"""TrainClassifier / TrainRegressor — auto-featurize + fit any predictor.
+
+Reference: train/TrainClassifier.scala:94-130 (label reindex via
+ValueIndexer, auto Featurize, classifier fit), train/TrainRegressor.scala.
+The model wraps (featurizer, value-indexer, inner model) and exposes
+original label values on output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, HasFeaturesCol, HasLabelCol, Param
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.schema import CATEGORICAL_KEY
+from mmlspark_tpu.featurize import Featurize, ValueIndexer
+from mmlspark_tpu.featurize.featurize import NUM_FEATURES_DEFAULT, NUM_FEATURES_TREE_OR_NN
+
+
+class TrainClassifier(Estimator, HasLabelCol):
+    model = ComplexParam("inner classifier estimator (defaults to LogisticRegression)")
+    number_of_features = Param("hash space for featurization", default=NUM_FEATURES_TREE_OR_NN, type_=int)
+    reindex_label = Param("reindex labels via ValueIndexer", default=True, type_=bool)
+
+    def fit(self, df: DataFrame) -> "TrainedClassifierModel":
+        label = self.get("label_col")
+        inner = self.get("model")
+        if inner is None:
+            from mmlspark_tpu.models.linear import LogisticRegression
+
+            inner = LogisticRegression()
+        levels: Optional[list] = None
+        work = df
+        if self.get("reindex_label"):
+            vi = ValueIndexer(input_col=label, output_col="__label_idx__").fit(df)
+            work = vi.transform(df)
+            levels = vi.get("levels")
+            work = work.drop(label).rename({"__label_idx__": label})
+        feat_cols = [c for c in work.columns if c != label]
+        featurizer = Featurize(
+            input_cols=feat_cols,
+            output_col="features",
+            number_of_features=self.get("number_of_features"),
+        ).fit(work)
+        feats = featurizer.transform(work)
+        if hasattr(inner, "param") and "label_col" in inner.params():
+            inner = inner.copy({"label_col": label})
+        inner_model = inner.fit(feats)
+        m = TrainedClassifierModel(label_col=label)
+        m.set(featurizer=featurizer, inner_model=inner_model)
+        if levels is not None:
+            m.set(levels=levels)
+        return m
+
+
+class TrainedClassifierModel(Model, HasLabelCol):
+    featurizer = ComplexParam("fitted featurizer")
+    inner_model = ComplexParam("fitted classifier model")
+    levels = Param("original label values", type_=list)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        work = df
+        label = self.get("label_col")
+        if label in work.columns and self.get("levels") is not None:
+            # map labels to indices for scoring consistency
+            table = {str(v): i for i, v in enumerate(self.get("levels"))}
+            work = work.with_column(
+                label,
+                lambda p: np.array([table.get(str(v), -1) for v in p[label]], np.int32),
+            )
+        feats = self.get_or_fail("featurizer").transform(work)
+        out = self.get_or_fail("inner_model").transform(feats)
+        levels = self.get("levels")
+        if levels is not None:
+            out = out.with_column_metadata("prediction", {CATEGORICAL_KEY: levels})
+        return out
+
+    def get_scored_labels(self, out: DataFrame, col: str = "scored_labels") -> DataFrame:
+        """Map integer predictions back to original label values."""
+        levels = self.get("levels")
+        if levels is None:
+            return out
+        lv = np.array(levels, dtype=object)
+        return out.with_column(
+            col, lambda p: lv[np.asarray(p["prediction"], np.int64)]
+        )
+
+
+class TrainRegressor(Estimator, HasLabelCol):
+    model = ComplexParam("inner regressor estimator (defaults to LinearRegression)")
+    number_of_features = Param("hash space for featurization", default=NUM_FEATURES_DEFAULT, type_=int)
+
+    def fit(self, df: DataFrame) -> "TrainedRegressorModel":
+        label = self.get("label_col")
+        inner = self.get("model")
+        if inner is None:
+            from mmlspark_tpu.models.linear import LinearRegression
+
+            inner = LinearRegression()
+        feat_cols = [c for c in df.columns if c != label]
+        featurizer = Featurize(
+            input_cols=feat_cols,
+            output_col="features",
+            number_of_features=self.get("number_of_features"),
+        ).fit(df)
+        feats = featurizer.transform(df)
+        if hasattr(inner, "param") and "label_col" in inner.params():
+            inner = inner.copy({"label_col": label})
+        inner_model = inner.fit(feats)
+        m = TrainedRegressorModel(label_col=label)
+        m.set(featurizer=featurizer, inner_model=inner_model)
+        return m
+
+
+class TrainedRegressorModel(Model, HasLabelCol):
+    featurizer = ComplexParam("fitted featurizer")
+    inner_model = ComplexParam("fitted regressor model")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        feats = self.get_or_fail("featurizer").transform(df)
+        return self.get_or_fail("inner_model").transform(feats)

@@ -1,0 +1,229 @@
+"""Fuzzing coverage: every registered stage runs + serialization round-trips.
+
+The TestObject catalog below is the analogue of each suite's
+``testObjects()`` in the reference; test_all_stages_covered is
+FuzzingTest.scala's exhaustiveness gate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu  # noqa: F401 - populate registry
+from mmlspark_tpu import DataFrame, Pipeline, PipelineModel
+from mmlspark_tpu.core.pipeline import STAGE_REGISTRY, Estimator, load_stage
+
+from fuzzing import TestObject, assert_df_equal, run_stage
+
+
+def _num_df(n=20, d=4, parts=2, seed=0):
+    r = np.random.default_rng(seed)
+    return DataFrame.from_dict(
+        {
+            "features": r.normal(size=(n, d)).astype(np.float32),
+            "x": r.normal(size=n),
+            "label": (r.random(n) > 0.5).astype(np.int32),
+            "text": np.array([f"word{i % 5} token{i % 3} filler" for i in range(n)], dtype=object),
+            "cat": np.array([["red", "green", "blue"][i % 3] for i in range(n)], dtype=object),
+        },
+        num_partitions=parts,
+    )
+
+
+def _nan_df():
+    return DataFrame.from_dict({"x": [1.0, np.nan, 3.0, np.nan], "y": [np.nan, 2.0, 2.0, 4.0]})
+
+
+def _array_df():
+    arrs = np.empty(4, dtype=object)
+    for i in range(4):
+        arrs[i] = np.arange(i + 1, dtype=np.float64)
+    return DataFrame.from_dict({"k": ["a", "a", "b", "b"], "arr": arrs, "v": [1.0, 2.0, 3.0, 4.0]})
+
+
+def make_test_objects() -> list:
+    from mmlspark_tpu import stages as S
+    from mmlspark_tpu import featurize as F
+
+    df = _num_df()
+    objs = [
+        TestObject(S.DropColumns(cols=["x"]), df),
+        TestObject(S.SelectColumns(cols=["x", "label"]), df),
+        TestObject(S.RenameColumn(input_col="x", output_col="x2"), df),
+        TestObject(S.Repartition(n=1), df),
+        TestObject(S.Lambda.of(lambda d: d.select("x")), df),
+        TestObject(
+            S.UDFTransformer(input_col="x", output_col="x2").set(udf=lambda v: v * 2), df
+        ),
+        TestObject(
+            S.UDFTransformer(input_col="x", output_col="x2").set(
+                vector_udf=lambda col: np.asarray(col) * 2
+            ),
+            df,
+        ),
+        TestObject(S.Explode(input_col="arr", output_col="el"), _array_df()),
+        TestObject(S.Cacher(), df),
+        TestObject(S.Timer().set(stage=S.DropColumns(cols=["x"])), df),
+        TestObject(S.FixedMiniBatchTransformer(batch_size=8), df),
+        TestObject(S.DynamicMiniBatchTransformer(), df),
+        TestObject(
+            S.TimeIntervalMiniBatchTransformer(interval_ms=10, max_batch_size=4), df
+        ),
+        TestObject(S.StratifiedRepartition(label_col="label", n=2), df),
+        TestObject(S.ClassBalancer(input_col="label"), df),
+        TestObject(
+            S.EnsembleByKey(keys=["k"], cols=["v"], col_names=["mean_v"]), _array_df()
+        ),
+        TestObject(S.SummarizeData(), df.select("x", "label")),
+        TestObject(
+            S.TextPreprocessor(
+                input_col="text", output_col="clean", map={"word1": "ONE"}
+            ),
+            df,
+        ),
+        TestObject(S.UnicodeNormalize(input_col="text", output_col="norm"), df),
+        TestObject(F.CleanMissingData(input_cols=["x", "y"]), _nan_df()),
+        TestObject(
+            F.CleanMissingData(input_cols=["x"], cleaning_mode="Median"), _nan_df()
+        ),
+        TestObject(F.DataConversion(cols=["label"], convert_to="double"), df),
+        TestObject(F.Featurize(input_cols=["x", "cat", "features"]), df),
+        TestObject(F.ValueIndexer(input_col="cat", output_col="cat_idx"), df),
+        TestObject(
+            F.TextFeaturizer(input_col="text", output_col="tf", num_features=64), df
+        ),
+        TestObject(
+            F.TextFeaturizer(
+                input_col="text", output_col="tf", num_features=64,
+                use_ngram=True, use_idf=False,
+            ),
+            df,
+        ),
+        TestObject(
+            F.PageSplitter(
+                input_col="text", output_col="pages",
+                maximum_page_length=10, minimum_page_length=5,
+            ),
+            df,
+        ),
+    ]
+    # batched-then-flattened path
+    batched = S.FixedMiniBatchTransformer(batch_size=8).transform(df)
+    objs.append(TestObject(S.FlattenBatch(), batched))
+    # MultiNGram needs token arrays
+    toks = np.empty(3, dtype=object)
+    for i in range(3):
+        toks[i] = [f"t{j}" for j in range(i + 2)]
+    objs.append(
+        TestObject(
+            F.MultiNGram(input_col="toks", output_col="ngrams", lengths=[1, 2]),
+            DataFrame.from_dict({"toks": toks}),
+        )
+    )
+    # IndexToValue consumes indexed column + metadata
+    vi_df = F.ValueIndexer(input_col="cat", output_col="cat_idx").fit(df).transform(df)
+    objs.append(TestObject(F.IndexToValue(input_col="cat_idx", output_col="cat2"), vi_df))
+
+    # train / automl / linear learners
+    from mmlspark_tpu.models.linear import LinearRegression, LogisticRegression
+    from mmlspark_tpu.train import (
+        ComputeModelStatistics,
+        ComputePerInstanceStatistics,
+        TrainClassifier,
+        TrainRegressor,
+    )
+    from mmlspark_tpu.automl import (
+        DiscreteHyperParam,
+        FindBestModel,
+        HyperparamBuilder,
+        TuneHyperparameters,
+    )
+
+    lin_df = df.select("features", "label")
+    objs += [
+        TestObject(LogisticRegression(max_iter=20), lin_df),
+        TestObject(LinearRegression(), lin_df),
+        TestObject(TrainClassifier(label_col="label"), df.select("x", "cat", "label")),
+        TestObject(TrainRegressor(label_col="x"), df.select("features", "x")),
+    ]
+    scored = LogisticRegression(max_iter=20).fit(lin_df).transform(lin_df)
+    objs += [
+        TestObject(ComputeModelStatistics(label_col="label"), scored),
+        TestObject(ComputePerInstanceStatistics(label_col="label"), scored),
+    ]
+    spaces = HyperparamBuilder().add_hyperparam(
+        "max_iter", DiscreteHyperParam([5, 10])
+    ).build()
+    tuner = TuneHyperparameters(label_col="label")
+    tuner.set(models=[LogisticRegression()], hyperparams=spaces, number_of_runs=2, number_of_folds=2)
+    objs.append(TestObject(tuner, lin_df))
+    fb = FindBestModel()
+    fb.set(models=[LogisticRegression(max_iter=10).fit(lin_df)])
+    objs.append(TestObject(fb, lin_df))
+    return objs
+
+
+TEST_OBJECTS = make_test_objects()
+_ids = [f"{type(o.stage).__name__}_{i}" for i, o in enumerate(TEST_OBJECTS)]
+
+
+@pytest.mark.parametrize("obj", TEST_OBJECTS, ids=_ids)
+def test_experiment_fuzzing(obj):
+    out = run_stage(obj.stage, obj.fit_df, obj.df)
+    assert out.count() >= 0  # materialized without raising
+
+
+@pytest.mark.parametrize("obj", TEST_OBJECTS, ids=_ids)
+def test_serialization_fuzzing(obj, tmp_path):
+    if obj.skip_serialization:
+        pytest.skip("unserializable stage")
+    stage = obj.stage
+    path = str(tmp_path / "stage")
+    stage.save(path)
+    stage2 = load_stage(path)
+    out1 = run_stage(stage, obj.fit_df, obj.df)
+    out2 = run_stage(stage2, obj.fit_df, obj.df)
+    assert_df_equal(out1, out2, atol=obj.atol)
+
+
+@pytest.mark.parametrize("obj", TEST_OBJECTS, ids=_ids)
+def test_pipeline_serialization_fuzzing(obj, tmp_path):
+    if obj.skip_serialization:
+        pytest.skip("unserializable stage")
+    pipe = Pipeline([obj.stage])
+    model = pipe.fit(obj.fit_df)
+    path = str(tmp_path / "pm")
+    model.save(path)
+    m2 = PipelineModel.load(path)
+    assert_df_equal(model.transform(obj.df), m2.transform(obj.df), atol=obj.atol)
+
+
+# Stages that are intentionally not in the TestObject catalog (bases,
+# test-local helpers, stages needing special environments covered in their
+# own test modules).
+EXCLUDED = {
+    # abstract/base-ish
+    "Pipeline", "PipelineModel", "HasMiniBatcher",
+    # covered by dedicated suites with model/zoo setup
+    "XLAModel", "ImageFeaturizer",
+    # fitted-model classes produced by their estimator (estimator is covered)
+    "ClassBalancerModel", "CleanMissingDataModel", "FeaturizeModel",
+    "ValueIndexerModel", "TextFeaturizerModel", "MeanShiftModel",
+    "LogisticRegressionModel", "LinearRegressionModel",
+    "TrainedClassifierModel", "TrainedRegressorModel",
+    "TuneHyperparametersModel", "FindBestModelResult",
+    # test-local helper stages
+    "AddOne", "MeanShift", "Holder", "Scale", "Center", "CenterModel", "T",
+}
+
+
+def test_all_stages_covered():
+    covered = {type(o.stage).__name__ for o in TEST_OBJECTS}
+    missing = []
+    for name in STAGE_REGISTRY:
+        if name in EXCLUDED or name.startswith("_"):
+            continue
+        if name not in covered:
+            missing.append(name)
+    assert not missing, f"stages lacking fuzzing TestObjects: {sorted(missing)}"

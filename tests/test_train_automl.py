@@ -1,0 +1,147 @@
+"""TrainClassifier/Regressor, metrics, AutoML tests (SURVEY §2.11-2.12)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.metrics import MetricConstants, binary_auc, classification_metrics
+from mmlspark_tpu.models.linear import (
+    LinearRegression,
+    LogisticRegression,
+)
+from mmlspark_tpu.train import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    TrainClassifier,
+    TrainRegressor,
+    TrainedClassifierModel,
+)
+from mmlspark_tpu.automl import (
+    DiscreteHyperParam,
+    FindBestModel,
+    HyperparamBuilder,
+    RangeHyperParam,
+    TuneHyperparameters,
+)
+
+from conftest import make_tabular_df
+
+
+def test_logistic_regression_learns(tabular_df):
+    model = LogisticRegression().fit(tabular_df)
+    out = model.transform(tabular_df)
+    acc = (out["prediction"].astype(int) == out["label"]).mean()
+    assert acc > 0.85, acc
+    assert out["probability"].shape == (200, 2)
+    np.testing.assert_allclose(out["probability"].sum(1), 1.0, atol=1e-5)
+
+
+def test_linear_regression_recovers_weights():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(100, 3)).astype(np.float32)
+    w = np.array([1.5, -2.0, 0.5])
+    y = x @ w + 0.3
+    df = DataFrame.from_dict({"features": x, "label": y})
+    m = LinearRegression().fit(df)
+    np.testing.assert_allclose(np.asarray(m.get("weights")), w, atol=1e-2)
+    assert abs(m.get("bias") - 0.3) < 1e-2
+    out = m.transform(df)
+    assert np.abs(out["prediction"] - y).max() < 0.05
+
+
+def test_binary_auc_known_value():
+    y = np.array([0, 0, 1, 1])
+    s = np.array([0.1, 0.4, 0.35, 0.8])
+    assert binary_auc(y, s) == pytest.approx(0.75)
+    assert binary_auc(y, y.astype(float)) == 1.0
+
+
+def test_train_classifier_mixed_types():
+    rng = np.random.default_rng(1)
+    n = 120
+    color = np.array([["red", "blue"][i % 2] for i in range(n)], dtype=object)
+    num = rng.normal(size=n) + (color == "red") * 2.0
+    label = np.array(["yes" if c == "red" else "no" for c in color], dtype=object)
+    df = DataFrame.from_dict({"color": color, "num": num, "label": label}, num_partitions=2)
+    model = TrainClassifier(label_col="label").fit(df)
+    out = model.transform(df)
+    scored = model.get_scored_labels(out)
+    acc = (scored["scored_labels"] == label).mean()
+    assert acc > 0.95
+    assert sorted(model.get("levels")) == ["no", "yes"]
+
+
+def test_train_regressor():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(80, 4))
+    y = x @ np.array([1.0, 2.0, -1.0, 0.5])
+    df = DataFrame.from_dict({"features": x.astype(np.float32), "label": y})
+    model = TrainRegressor(label_col="label").fit(df)
+    out = model.transform(df)
+    assert np.abs(out["prediction"] - y).mean() < 0.1
+
+
+def test_compute_model_statistics_classification(tabular_df):
+    model = LogisticRegression().fit(tabular_df)
+    out = model.transform(tabular_df)
+    stats = ComputeModelStatistics(
+        label_col="label", scored_probabilities_col="probability"
+    ).transform(out)
+    row = stats.collect()[0]
+    assert row[MetricConstants.ACCURACY] > 0.85
+    assert 0.5 < row[MetricConstants.AUC] <= 1.0
+    cm = row["confusion_matrix"]
+    assert cm.shape == (2, 2) and cm.sum() == 200
+
+
+def test_compute_model_statistics_regression():
+    y = np.arange(10.0)
+    df = DataFrame.from_dict({"label": y, "prediction": y + 0.5})
+    row = ComputeModelStatistics(evaluation_metric="regression", label_col="label").transform(df).collect()[0]
+    assert row[MetricConstants.MSE] == pytest.approx(0.25)
+    assert row[MetricConstants.MAE] == pytest.approx(0.5)
+
+
+def test_per_instance_statistics(tabular_df):
+    model = LogisticRegression().fit(tabular_df)
+    out = model.transform(tabular_df)
+    per = ComputePerInstanceStatistics(
+        label_col="label", scored_probabilities_col="probability"
+    ).transform(out)
+    assert "log_loss" in per.columns and (per["log_loss"] >= 0).all()
+
+
+def test_tune_hyperparameters(tabular_df):
+    spaces = (
+        HyperparamBuilder()
+        .add_hyperparam("reg_param", RangeHyperParam(1e-5, 1e-2, log=True))
+        .add_hyperparam("max_iter", DiscreteHyperParam([50, 100]))
+        .build()
+    )
+    tuner = TuneHyperparameters(label_col="label")
+    tuner.set(models=[LogisticRegression()], hyperparams=spaces)
+    tuner.set(number_of_runs=3, number_of_folds=2)
+    model = tuner.fit(tabular_df)
+    assert model.get("best_metric") > 0.8
+    assert len(model.get("all_metrics")) == 3
+    out = model.transform(tabular_df)
+    assert "prediction" in out.columns
+
+
+def test_find_best_model(tabular_df):
+    m1 = LogisticRegression(max_iter=5, learning_rate=0.01).fit(tabular_df)
+    m2 = LogisticRegression(max_iter=200).fit(tabular_df)
+    fb = FindBestModel()
+    fb.set(models=[m1, m2], evaluation_metric=MetricConstants.ACCURACY)
+    best = fb.fit(tabular_df)
+    assert best.get("all_model_metrics")[1] >= best.get("all_model_metrics")[0]
+    assert best.get("best_model_metrics")[MetricConstants.ACCURACY] > 0.8
+
+
+def test_trained_classifier_save_load(tmp_path, tabular_df):
+    model = TrainClassifier(label_col="label").fit(tabular_df)
+    model.save(str(tmp_path / "m"))
+    m2 = TrainedClassifierModel.load(str(tmp_path / "m"))
+    a = model.transform(tabular_df)["prediction"]
+    b = m2.transform(tabular_df)["prediction"]
+    np.testing.assert_array_equal(a, b)
