@@ -2,27 +2,35 @@
 
 North-star config (BASELINE.md): ResNet-50 featurization over a DataFrame at
 >= 8,000 images/sec on v5e-32 => 250 images/sec/chip. ``vs_baseline`` is
-measured-throughput / 250.
+measured images/sec/chip / 250.
 
-Runs on whatever platform JAX resolves (real TPU chip under the driver;
-CPU fallback works but is slow). End-to-end path measured: DataFrame ->
-host staging -> jitted resize+normalize+ResNet50(bf16) -> feature column.
+Structure: the wrapper (``main``) launches the measurement in a child
+process because the TPU-tunnel backend can BLOCK indefinitely inside
+backend init rather than raise; on timeout/failure it reruns the child on
+clean CPU (axon sitecustomize stripped) so the driver always gets its one
+JSON line. End-to-end path measured: DataFrame -> host staging -> jitted
+resize+normalize+ResNet50(bf16) -> feature column, divided by device count.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+INIT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_TIMEOUT", "2400"))
 
-def main() -> None:
+
+def run_bench() -> None:
     import jax
 
     devices = jax.devices()
     platform = devices[0].platform
+    n_dev = len(devices)
 
     from mmlspark_tpu import DataFrame
     from mmlspark_tpu.models import ImageFeaturizer
@@ -58,14 +66,60 @@ def main() -> None:
         dt = time.perf_counter() - t0
         best = max(best, n_rows / dt)
 
+    per_chip = best / n_dev
     result = {
         "metric": "imagefeaturizer_resnet50_throughput",
-        "value": round(best, 2),
-        "unit": f"images/sec/chip ({platform})",
-        "vs_baseline": round(best / 250.0, 3),
+        "value": round(per_chip, 2),
+        "unit": f"images/sec/chip ({platform} x{n_dev})",
+        "vs_baseline": round(per_chip / 250.0, 3),
     }
     print(json.dumps(result))
 
 
+def main() -> None:
+    env = dict(os.environ)
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--child"],
+            env=env,
+            timeout=INIT_TIMEOUT_S,
+            capture_output=True,
+            text=True,
+        )
+        line = _json_line(proc.stdout)
+        if proc.returncode == 0 and line:
+            print(line)
+            return
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench: accelerator init exceeded {INIT_TIMEOUT_S}s; CPU fallback\n")
+    # clean-CPU fallback: drop the axon sitecustomize and force cpu
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, __file__, "--child"],
+        env=env,
+        timeout=INIT_TIMEOUT_S,
+        capture_output=True,
+        text=True,
+    )
+    line = _json_line(proc.stdout)
+    if line:
+        print(line)
+    else:
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
+        raise SystemExit(1)
+
+
+def _json_line(out: str) -> str:
+    for ln in reversed(out.strip().splitlines()):
+        if ln.startswith("{"):
+            return ln
+    return ""
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        run_bench()
+    else:
+        main()

@@ -1,0 +1,22 @@
+"""Drop-in import location matching the reference package layout
+(``com.microsoft.ml.spark.lightgbm`` -> ``mmlspark_tpu.lightgbm``)."""
+
+from mmlspark_tpu.models.gbdt import (
+    Booster as LightGBMBooster,
+    LightGBMClassificationModel,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRankerModel,
+    LightGBMRegressionModel,
+    LightGBMRegressor,
+)
+
+__all__ = [
+    "LightGBMBooster",
+    "LightGBMClassifier",
+    "LightGBMClassificationModel",
+    "LightGBMRegressor",
+    "LightGBMRegressionModel",
+    "LightGBMRanker",
+    "LightGBMRankerModel",
+]

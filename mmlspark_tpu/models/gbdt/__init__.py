@@ -1,0 +1,25 @@
+from mmlspark_tpu.models.gbdt.binning import BinMapper
+from mmlspark_tpu.models.gbdt.booster import Booster, Tree
+from mmlspark_tpu.models.gbdt.train import TrainConfig, train
+from mmlspark_tpu.models.gbdt.estimators import (
+    LightGBMClassificationModel,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRankerModel,
+    LightGBMRegressionModel,
+    LightGBMRegressor,
+)
+
+__all__ = [
+    "BinMapper",
+    "Booster",
+    "Tree",
+    "TrainConfig",
+    "train",
+    "LightGBMClassifier",
+    "LightGBMClassificationModel",
+    "LightGBMRegressor",
+    "LightGBMRegressionModel",
+    "LightGBMRanker",
+    "LightGBMRankerModel",
+]

@@ -1,0 +1,80 @@
+"""Feature quantization for histogram GBDT.
+
+LightGBM's BinMapper equivalent: each feature is quantized to at most
+``max_bin`` bins by (approximate) quantiles; training then operates on the
+uint8 bin matrix. Bin 0 is reserved for missing values (NaN), matching
+LightGBM's missing-bin handling (zero_as_missing=False semantics).
+
+Upper-bound thresholds are kept in original feature space so trained trees
+carry real-valued thresholds and prediction never needs the bin mapper.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+MISSING_BIN = 0
+
+
+@dataclass
+class BinMapper:
+    # uppers[f] has length n_bins[f]-1: upper bound (inclusive) of each
+    # non-missing bin except the last (which is +inf)
+    uppers: list
+    max_bin: int
+
+    @property
+    def num_features(self) -> int:
+        return len(self.uppers)
+
+    @staticmethod
+    def fit(x: np.ndarray, max_bin: int = 255, sample: int = 200_000, seed: int = 0) -> "BinMapper":
+        n, d = x.shape
+        if n > sample:
+            idx = np.random.default_rng(seed).choice(n, sample, replace=False)
+            xs = x[idx]
+        else:
+            xs = x
+        uppers = []
+        for f in range(d):
+            col = xs[:, f]
+            col = col[~np.isnan(col)]
+            uniq = np.unique(col)
+            if len(uniq) <= 1:
+                uppers.append(np.array([], dtype=np.float64))
+                continue
+            if len(uniq) <= max_bin - 1:
+                bounds = (uniq[:-1] + uniq[1:]) / 2.0
+            else:
+                qs = np.linspace(0, 100, max_bin)[1:-1]
+                bounds = np.unique(np.percentile(col, qs, method="linear"))
+            uppers.append(bounds.astype(np.float64))
+        return BinMapper(uppers=uppers, max_bin=max_bin)
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        """(n, d) float -> (n, d) uint8 bins; NaN -> MISSING_BIN(0); real
+        values start at bin 1."""
+        n, d = x.shape
+        out = np.empty((n, d), dtype=np.uint8)
+        for f in range(d):
+            col = x[:, f]
+            b = np.searchsorted(self.uppers[f], col, side="left") + 1
+            b = np.where(np.isnan(col), MISSING_BIN, b)
+            out[:, f] = b.astype(np.uint8)
+        return out
+
+    def num_bins(self, f: int) -> int:
+        return len(self.uppers[f]) + 2  # missing bin + len(uppers)+1 value bins
+
+    def threshold_value(self, f: int, bin_idx: int) -> float:
+        """Upper bound of value-bin ``bin_idx`` (split 'x <= thr')."""
+        u = self.uppers[f]
+        i = int(bin_idx) - 1  # value bins start at 1
+        if i < 0:
+            return -np.inf
+        if i >= len(u):
+            return np.inf
+        return float(u[i])

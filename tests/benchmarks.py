@@ -1,0 +1,38 @@
+"""Golden-metric benchmark harness (core/test/benchmarks/Benchmarks.scala:16-85).
+
+Goldens live in tests/resources/benchmarks/*.csv with the reference's
+semantics: ``name,value,precision,higherIsBetter``; a run fails if the
+measured metric is outside value +/- precision (or below value - precision
+when higherIsBetter)."""
+
+from __future__ import annotations
+
+import csv
+import os
+
+RESOURCE_DIR = os.path.join(os.path.dirname(__file__), "resources", "benchmarks")
+
+
+def load_goldens(name: str) -> dict:
+    path = os.path.join(RESOURCE_DIR, f"{name}.csv")
+    out = {}
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            out[row["name"]] = (
+                float(row["value"]),
+                float(row["precision"]),
+                row.get("higherIsBetter", "true").lower() == "true",
+            )
+    return out
+
+
+def assert_golden(goldens: dict, name: str, measured: float) -> None:
+    value, precision, higher = goldens[name]
+    if higher:
+        assert measured >= value - precision, (
+            f"{name}: measured {measured:.4f} < golden {value:.4f} - {precision}"
+        )
+    else:
+        assert measured <= value + precision, (
+            f"{name}: measured {measured:.4f} > golden {value:.4f} + {precision}"
+        )

@@ -161,6 +161,30 @@ def make_test_objects() -> list:
     fb = FindBestModel()
     fb.set(models=[LogisticRegression(max_iter=10).fit(lin_df)])
     objs.append(TestObject(fb, lin_df))
+
+    # gbdt facades (small configs keep the fuzzing pass fast)
+    from mmlspark_tpu.models.gbdt import (
+        LightGBMClassifier,
+        LightGBMRanker,
+        LightGBMRegressor,
+    )
+
+    qid_df = lin_df.with_column("query", np.arange(20) // 4)
+    objs += [
+        TestObject(
+            LightGBMClassifier(num_iterations=3, num_leaves=4, min_data_in_leaf=2), lin_df
+        ),
+        TestObject(
+            LightGBMRegressor(num_iterations=3, num_leaves=4, min_data_in_leaf=2),
+            df.select("features", "x").rename({"x": "label"}),
+        ),
+        TestObject(
+            LightGBMRanker(
+                group_col="query", num_iterations=2, num_leaves=4, min_data_in_leaf=2
+            ),
+            qid_df,
+        ),
+    ]
     return objs
 
 
@@ -213,6 +237,7 @@ EXCLUDED = {
     "LogisticRegressionModel", "LinearRegressionModel",
     "TrainedClassifierModel", "TrainedRegressorModel",
     "TuneHyperparametersModel", "FindBestModelResult",
+    "LightGBMClassificationModel", "LightGBMRegressionModel", "LightGBMRankerModel",
     # test-local helper stages
     "AddOne", "MeanShift", "Holder", "Scale", "Center", "CenterModel", "T",
 }

@@ -1,0 +1,82 @@
+"""Golden-AUC benchmark suite for the GBDT — the analogue of
+benchmarks_VerifyLightGBMClassifier.csv (dataset x mode -> AUC golden).
+
+Datasets are deterministic synthetic generators (offline build); goldens
+were measured at commit time and guard against quality regressions exactly
+like the reference's committed CSVs.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.metrics import binary_auc
+from mmlspark_tpu.models.gbdt import LightGBMClassifier, LightGBMRegressor
+
+from benchmarks import assert_golden, load_goldens
+
+
+def dataset(name: str):
+    import zlib
+
+    r = np.random.default_rng(zlib.crc32(name.encode()))  # stable across processes
+    if name == "blobs":
+        n, d = 500, 6
+        x = r.normal(size=(n, d))
+        y = (x[:, 0] + x[:, 1] > 0).astype(float)
+        x[:, 0] += 0.5 * r.normal(size=n)
+    elif name == "xor":
+        n, d = 600, 4
+        x = r.normal(size=(n, d))
+        y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(float)
+    elif name == "rings":
+        n, d = 700, 5
+        x = r.normal(size=(n, d))
+        rad = np.sqrt(x[:, 0] ** 2 + x[:, 1] ** 2)
+        y = ((rad > 0.8) & (rad < 1.8)).astype(float)
+    elif name == "sparse_signal":
+        n, d = 2000, 30
+        x = r.normal(size=(n, d))
+        y = (x[:, 7] * x[:, 19] + 0.3 * r.normal(size=n) > 0).astype(float)
+    else:
+        raise KeyError(name)
+    return x.astype(np.float32), y
+
+
+MODES = {
+    "gbdt": {},
+    "bagged": {"bagging_fraction": 0.7, "bagging_freq": 1},
+    "feature_sampled": {"feature_fraction": 0.8},
+}
+
+CASES = [(ds, mode) for ds in ("blobs", "xor", "rings", "sparse_signal") for mode in MODES]
+
+
+@pytest.mark.parametrize("ds,mode", CASES, ids=[f"{d}-{m}" for d, m in CASES])
+def test_classifier_auc_golden(ds, mode):
+    goldens = load_goldens("VerifyLightGBMClassifier")
+    x, y = dataset(ds)
+    split = int(0.7 * len(y))
+    df_train = DataFrame.from_dict({"features": x[:split], "label": y[:split]})
+    df_test = DataFrame.from_dict({"features": x[split:], "label": y[split:]})
+    model = LightGBMClassifier(
+        num_iterations=50, num_leaves=15, min_data_in_leaf=5, seed=7, **MODES[mode]
+    ).fit(df_train)
+    out = model.transform(df_test)
+    auc = binary_auc(y[split:], out["probability"][:, 1])
+    assert_golden(goldens, f"{ds}.{mode}.AUC", auc)
+
+
+def test_regressor_r2_golden():
+    goldens = load_goldens("VerifyLightGBMRegressor")
+    r = np.random.default_rng(11)
+    x = r.normal(size=(800, 8)).astype(np.float32)
+    y = np.sin(x[:, 0]) * 2 + x[:, 1] * x[:, 2] + 0.1 * r.normal(size=800)
+    split = 560
+    model = LightGBMRegressor(num_iterations=80, num_leaves=31, min_data_in_leaf=5, seed=7).fit(
+        DataFrame.from_dict({"features": x[:split], "label": y[:split]})
+    )
+    pred = model.transform(DataFrame.from_dict({"features": x[split:], "label": y[split:]}))["prediction"]
+    resid = y[split:] - pred
+    r2 = 1 - resid.var() / y[split:].var()
+    assert_golden(goldens, "friedman_like.gbdt.R2", r2)
