@@ -1,0 +1,38 @@
+"""VW-equivalent online learning on TPU (SURVEY.md §2.3).
+
+Hashed sparse features -> device SGD (AdaGrad) with per-pass weight
+allreduce over the mesh, replacing VW's native train loop + spanning-tree
+allreduce (vw/VowpalWabbitBase.scala).
+"""
+
+from mmlspark_tpu.vw.contextual_bandit import (
+    ContextualBanditMetrics,
+    VowpalWabbitContextualBandit,
+    VowpalWabbitContextualBanditModel,
+)
+from mmlspark_tpu.vw.estimators import (
+    VowpalWabbitClassificationModel,
+    VowpalWabbitClassifier,
+    VowpalWabbitRegressionModel,
+    VowpalWabbitRegressor,
+)
+from mmlspark_tpu.vw.featurizer import (
+    VowpalWabbitFeaturizer,
+    VowpalWabbitInteractions,
+)
+from mmlspark_tpu.vw.sparse import concat_sparse, make_sparse, pad_sparse_batch
+
+__all__ = [
+    "ContextualBanditMetrics",
+    "VowpalWabbitClassifier",
+    "VowpalWabbitClassificationModel",
+    "VowpalWabbitContextualBandit",
+    "VowpalWabbitContextualBanditModel",
+    "VowpalWabbitFeaturizer",
+    "VowpalWabbitInteractions",
+    "VowpalWabbitRegressor",
+    "VowpalWabbitRegressionModel",
+    "concat_sparse",
+    "make_sparse",
+    "pad_sparse_batch",
+]

@@ -1,0 +1,213 @@
+"""VW-style classifier / regressor estimators and models.
+
+Facade parity with vw/VowpalWabbitClassifier.scala and
+VowpalWabbitRegressor.scala; the distributed training model
+(per-shard online pass + weight allreduce per pass,
+VowpalWabbitBase.scala:313-429) runs in ``vw.learner`` as one SPMD XLA
+program over the mesh. Per-shard training diagnostics mirror
+``TrainingStats`` (VowpalWabbitBase.scala:27-46,431-457).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
+    HasWeightCol,
+    Param,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.vw.featurizer import HasNumBits
+from mmlspark_tpu.vw.learner import (
+    LOSS_LOGISTIC,
+    LOSS_SQUARED,
+    predict_margin,
+    train_sparse_sgd,
+)
+from mmlspark_tpu.vw.sparse import NUM_BITS_META, pad_sparse_batch
+
+
+class _VowpalWabbitBase(
+    Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol, HasNumBits
+):
+    """Shared trainer params (the arg-string builder analogue of
+    VowpalWabbitBase.scala:139-169 — params map 1:1 to VW flags)."""
+
+    num_passes = Param("passes over the data (--passes)", default=1, type_=int)
+    learning_rate = Param("initial learning rate (-l)", default=0.5, type_=float)
+    power_t = Param("lr decay exponent (--power_t)", default=0.5, type_=float)
+    l2 = Param("L2 regularization (--l2)", default=0.0, type_=float)
+    adaptive = Param("AdaGrad per-coordinate rates (--adaptive)", default=True, type_=bool)
+    batch_size = Param("device minibatch size per shard", default=64, type_=int)
+    additional_features = Param(
+        "extra sparse namespace columns concatenated into the example",
+        default=[],
+        type_=list,
+    )
+    initial_model = ComplexParam("continue training from these weights (array)")
+    use_barrier_execution_mode = Param(
+        "gang-launch flag (no-op: SPMD launch is always gang-scheduled)",
+        default=False,
+        type_=bool,
+    )
+
+    _loss = LOSS_LOGISTIC
+
+    def _gather(self, df: DataFrame) -> tuple:
+        fc = self.get("features_col")
+        cols = [fc] + list(self.get("additional_features"))
+        sparse_rows: list = []
+        for c in cols:
+            col = df[c]
+            if len(sparse_rows) == 0:
+                sparse_rows = [dict(r) for r in col]
+            else:
+                for r, cell in enumerate(col):
+                    sparse_rows[r] = {
+                        "i": np.concatenate([sparse_rows[r]["i"], cell["i"]]),
+                        "v": np.concatenate([sparse_rows[r]["v"], cell["v"]]),
+                    }
+        num_bits = df.column_metadata(fc).get(NUM_BITS_META) or self.get("num_bits")
+        idx, val = pad_sparse_batch(sparse_rows)
+        y = df[self.get("label_col")].astype(np.float32)
+        wc = self.get("weight_col")
+        wt = df[wc].astype(np.float32) if wc else None
+        return idx, val, y, wt, int(num_bits)
+
+    def _train_weights(self, df: DataFrame) -> tuple:
+        if df.count() == 0:
+            raise ValueError(f"{type(self).__name__}: empty training dataframe")
+        idx, val, y, wt, num_bits = self._gather(df)
+        if self._loss == LOSS_LOGISTIC:
+            y = np.where(y > 0, 1.0, -1.0).astype(np.float32)
+        t0 = time.perf_counter_ns()
+        w = train_sparse_sgd(
+            idx,
+            val,
+            y,
+            wt,
+            num_bits,
+            loss=self._loss,
+            num_passes=self.get("num_passes"),
+            batch=self.get("batch_size"),
+            lr=self.get("learning_rate"),
+            power_t=self.get("power_t"),
+            l2=self.get("l2"),
+            adaptive=self.get("adaptive"),
+            initial_weights=self.get("initial_model"),
+        )
+        t1 = time.perf_counter_ns()
+        from mmlspark_tpu.parallel.mesh import cluster_summary
+
+        stats = DataFrame.from_dict(
+            {
+                "partition_id": [0],
+                "rows": [int(len(y))],
+                "time_total_ns": [t1 - t0],
+                "time_learn_ns": [t1 - t0],
+                "num_devices": [cluster_summary()["num_devices"]],
+                "passes": [self.get("num_passes")],
+            }
+        )
+        return w, num_bits, stats
+
+    def _apply_common(self, m: "_VowpalWabbitBaseModel", w: np.ndarray, num_bits: int, stats: DataFrame) -> None:
+        m.set(
+            weights=w,
+            num_bits=num_bits,
+            features_col=self.get("features_col"),
+            additional_features=self.get("additional_features"),
+            performance_statistics=stats,
+        )
+
+
+class _VowpalWabbitBaseModel(Model, HasFeaturesCol, HasPredictionCol):
+    """Scoring through the jitted sparse-dot kernel
+    (VowpalWabbitBaseModel.scala:28 analogue)."""
+
+    weights = ComplexParam("(2^num_bits,) learned weights")
+    num_bits = Param("hashed space width", default=18, type_=int)
+    additional_features = Param("extra namespace columns", default=[], type_=list)
+    performance_statistics = ComplexParam("per-shard training diagnostics DataFrame")
+
+    def get_performance_statistics(self) -> DataFrame:
+        return self.get("performance_statistics")
+
+    def get_readable_model(self) -> DataFrame:
+        """Nonzero (index, weight) pairs — the --readable_model analogue."""
+        w = np.asarray(self.get_or_fail("weights"))
+        nz = np.nonzero(w)[0]
+        return DataFrame.from_dict({"index": nz, "weight": w[nz]})
+
+    def _margins(self, df: DataFrame, p: dict) -> np.ndarray:
+        fc = self.get("features_col")
+        cols = [fc] + list(self.get("additional_features"))
+        rows = [dict(r) for r in p[cols[0]]]
+        for c in cols[1:]:
+            for r, cell in enumerate(p[c]):
+                rows[r] = {
+                    "i": np.concatenate([rows[r]["i"], cell["i"]]),
+                    "v": np.concatenate([rows[r]["v"], cell["v"]]),
+                }
+        idx, val = pad_sparse_batch(rows)
+        return predict_margin(idx, val, np.asarray(self.get_or_fail("weights")))
+
+
+class VowpalWabbitClassifier(_VowpalWabbitBase):
+    """Binary classifier, logistic loss (vw/VowpalWabbitClassifier.scala)."""
+
+    _loss = LOSS_LOGISTIC
+
+    def fit(self, df: DataFrame) -> "VowpalWabbitClassificationModel":
+        w, num_bits, stats = self._train_weights(df)
+        m = VowpalWabbitClassificationModel()
+        self._apply_common(m, w, num_bits, stats)
+        return m
+
+
+class VowpalWabbitClassificationModel(
+    _VowpalWabbitBaseModel, HasProbabilityCol, HasRawPredictionCol
+):
+    def transform(self, df: DataFrame) -> DataFrame:
+        def fn(p: dict) -> dict:
+            margin = self._margins(df, p)
+            prob = 1.0 / (1.0 + np.exp(-margin))
+            q = dict(p)
+            q[self.get("raw_prediction_col")] = margin.astype(np.float64)
+            q[self.get("probability_col")] = prob.astype(np.float64)
+            q[self.get("prediction_col")] = (margin > 0).astype(np.float64)
+            return q
+
+        return df.map_partitions(fn, parallel=False)
+
+
+class VowpalWabbitRegressor(_VowpalWabbitBase):
+    """Squared-loss regressor (vw/VowpalWabbitRegressor.scala)."""
+
+    _loss = LOSS_SQUARED
+
+    def fit(self, df: DataFrame) -> "VowpalWabbitRegressionModel":
+        w, num_bits, stats = self._train_weights(df)
+        m = VowpalWabbitRegressionModel()
+        self._apply_common(m, w, num_bits, stats)
+        return m
+
+
+class VowpalWabbitRegressionModel(_VowpalWabbitBaseModel):
+    def transform(self, df: DataFrame) -> DataFrame:
+        def fn(p: dict) -> dict:
+            q = dict(p)
+            q[self.get("prediction_col")] = self._margins(df, p).astype(np.float64)
+            return q
+
+        return df.map_partitions(fn, parallel=False)

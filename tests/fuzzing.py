@@ -69,7 +69,13 @@ def _assert_value_equal(x: Any, y: Any, atol: float) -> None:
             )
         else:
             assert list(xa) == list(ya)
+    elif isinstance(x, dict):
+        assert isinstance(y, dict) and set(x) == set(y)
+        for k in x:
+            _assert_value_equal(x[k], y[k], atol)
     elif isinstance(x, (list, tuple)):
-        assert list(x) == list(y)
+        assert len(x) == len(y)
+        for xi, yi in zip(x, y):
+            _assert_value_equal(xi, yi, atol)
     else:
         assert x == y, (x, y)

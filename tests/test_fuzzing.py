@@ -169,6 +169,44 @@ def make_test_objects() -> list:
         LightGBMRegressor,
     )
 
+    # vw-equivalent stages
+    from mmlspark_tpu import vw as V
+
+    text_df = df.select("text", "label", "x", "features")
+    vw_feat = V.VowpalWabbitFeaturizer(
+        input_cols=[], string_split_input_cols=["text"], num_bits=12
+    )
+    vw_df = vw_feat.transform(text_df)
+    objs += [
+        TestObject(vw_feat, text_df),
+        TestObject(
+            V.VowpalWabbitFeaturizer(input_cols=["x", "features"], num_bits=12), text_df
+        ),
+        TestObject(V.VowpalWabbitClassifier(num_bits=12, num_passes=2), vw_df),
+        TestObject(V.VowpalWabbitRegressor(num_bits=12), vw_df.rename({"label": "y", "x": "label"})),
+    ]
+    vw2 = V.VowpalWabbitFeaturizer(
+        input_cols=["x"], output_col="f2", num_bits=12
+    ).transform(vw_df)
+    objs.append(
+        TestObject(V.VowpalWabbitInteractions(input_cols=["features", "f2"], num_bits=12), vw2)
+    )
+    acts = np.empty(8, dtype=object)
+    shared = np.empty(8, dtype=object)
+    for i in range(8):
+        acts[i] = [V.make_sparse([10 + a], [1.0]) for a in range(2)]
+        shared[i] = V.make_sparse([5], [1.0])
+    cb_df = DataFrame.from_dict(
+        {
+            "shared": shared,
+            "features": acts,
+            "chosen_action": np.ones(8, np.int64) + (np.arange(8) % 2),
+            "probability": np.full(8, 0.5),
+            "label": np.arange(8) % 2 * 1.0,
+        }
+    )
+    objs.append(TestObject(V.VowpalWabbitContextualBandit(num_bits=10), cb_df))
+
     qid_df = lin_df.with_column("query", np.arange(20) // 4)
     objs += [
         TestObject(
@@ -238,6 +276,8 @@ EXCLUDED = {
     "TrainedClassifierModel", "TrainedRegressorModel",
     "TuneHyperparametersModel", "FindBestModelResult",
     "LightGBMClassificationModel", "LightGBMRegressionModel", "LightGBMRankerModel",
+    "VowpalWabbitClassificationModel", "VowpalWabbitRegressionModel",
+    "VowpalWabbitContextualBanditModel",
     # test-local helper stages
     "AddOne", "MeanShift", "Holder", "Scale", "Center", "CenterModel", "T",
 }
