@@ -32,6 +32,10 @@ class BinMapper:
 
     @staticmethod
     def fit(x: np.ndarray, max_bin: int = 255, sample: int = 200_000, seed: int = 0) -> "BinMapper":
+        if not 2 <= max_bin <= 255:
+            # bins live in a uint8 matrix (bin 0 = missing); larger values
+            # would silently wrap mod 256
+            raise ValueError(f"max_bin must be in [2, 255], got {max_bin}")
         n, d = x.shape
         if n > sample:
             idx = np.random.default_rng(seed).choice(n, sample, replace=False)

@@ -37,10 +37,17 @@ class Tree:
         return int(self.active.sum())
 
     def to_dict(self) -> dict:
+        # non-finite thresholds are meaningful (+inf: inactive/"all left",
+        # -inf: split on the missing bin) — keep their signs through JSON
+        def enc(t: float):
+            if np.isfinite(t):
+                return float(t)
+            return "inf" if t > 0 else "-inf"
+
         return {
             "leaf": self.leaf.tolist(),
             "feature": self.feature.tolist(),
-            "threshold": [None if not np.isfinite(t) else float(t) for t in self.threshold],
+            "threshold": [enc(t) for t in self.threshold],
             "active": self.active.astype(int).tolist(),
             "gain": np.asarray(self.gain, dtype=np.float64).tolist(),
             "values": np.asarray(self.values, dtype=np.float64).tolist(),
@@ -49,9 +56,14 @@ class Tree:
 
     @staticmethod
     def from_dict(d: dict) -> "Tree":
-        thr = np.array(
-            [np.inf if t is None else t for t in d["threshold"]], dtype=np.float64
-        )
+        def dec(t) -> float:
+            if t is None or t == "inf":
+                return np.inf
+            if t == "-inf":
+                return -np.inf
+            return float(t)
+
+        thr = np.array([dec(t) for t in d["threshold"]], dtype=np.float64)
         return Tree(
             leaf=np.asarray(d["leaf"], np.int32),
             feature=np.asarray(d["feature"], np.int32),
@@ -71,6 +83,9 @@ class Booster:
     num_features: int = 0
     best_iteration: int = -1
     feature_names: Optional[list] = None
+    # boost_from_average baseline added to every raw score: float, or a
+    # per-class list for multiclass (LightGBM's init score from label avg)
+    base_score: Any = 0.0
 
     # -- serialization ------------------------------------------------------
 
@@ -83,6 +98,11 @@ class Booster:
                 "num_features": self.num_features,
                 "best_iteration": self.best_iteration,
                 "feature_names": self.feature_names,
+                "base_score": (
+                    self.base_score.tolist()
+                    if isinstance(self.base_score, np.ndarray)
+                    else self.base_score
+                ),
                 "trees": [t.to_dict() for t in self.trees],
             }
         )
@@ -97,6 +117,7 @@ class Booster:
             num_features=d["num_features"],
             best_iteration=d.get("best_iteration", -1),
             feature_names=d.get("feature_names"),
+            base_score=d.get("base_score", 0.0),
         )
         return b
 
@@ -109,6 +130,9 @@ class Booster:
             num_class=self.num_class,
             num_features=max(self.num_features, other.num_features),
             feature_names=self.feature_names or other.feature_names,
+            # continued training fit residuals on top of self's predictions,
+            # which already include self's baseline — keep it
+            base_score=self.base_score,
         )
 
     # -- device scoring ------------------------------------------------------
@@ -144,8 +168,11 @@ class Booster:
             num_iteration = self.best_iteration
         stacked = self._stacked(num_iteration)
         k = self.num_class
+        base = np.asarray(self.base_score, np.float32)
         if stacked is None:
-            return np.zeros((n,) if k == 1 else (n, k), np.float32)
+            return np.broadcast_to(
+                base, (n,) if k == 1 else (n, k)
+            ).astype(np.float32).copy()
         rec_leaf, rec_feature, rec_threshold, rec_active, values = stacked
         leaves = np.asarray(
             treegrow.predict_leaves(
@@ -158,12 +185,12 @@ class Booster:
         )  # (n, T)
         per_tree = np.take_along_axis(values[None], leaves[..., None], axis=2)[..., 0]
         if k == 1:
-            return per_tree.sum(axis=1).astype(np.float32)
+            return (per_tree.sum(axis=1) + base).astype(np.float32)
         T = per_tree.shape[1]
         out = np.zeros((n, k), np.float32)
         for c in range(k):
             out[:, c] = per_tree[:, c::k].sum(axis=1)
-        return out
+        return out + base
 
     def predict_leaf(self, x: np.ndarray) -> np.ndarray:
         """(n, d) -> (n, T) leaf index per tree (predictLeaf analogue)."""
@@ -192,6 +219,7 @@ class Booster:
         Saabas is its fast first-order approximation.)"""
         n, d = x.shape
         out = np.zeros((n, d + 1), np.float64)
+        out[:, d] += float(np.sum(np.asarray(self.base_score)))
         for t_i, tree in enumerate(self.trees):
             contrib = _tree_contribs(tree, x)
             out[:, : d + 1] += contrib

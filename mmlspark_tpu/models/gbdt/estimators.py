@@ -52,7 +52,12 @@ class _LightGBMParams(
     lambda_l2 = Param("L2 leaf regularization", default=0.0, type_=float)
     min_gain_to_split = Param("min split gain", default=0.0, type_=float)
     min_data_in_leaf = Param("min rows per leaf", default=20, type_=int)
-    max_bin = Param("histogram bins", default=255, type_=int)
+    max_bin = Param(
+        "histogram bins (max 255: uint8 bin matrix)",
+        default=255,
+        type_=int,
+        validator=lambda v: 2 <= v <= 255,
+    )
     feature_fraction = Param("feature subsample per tree", default=1.0, type_=float)
     bagging_fraction = Param("row subsample", default=1.0, type_=float)
     bagging_freq = Param("bagging frequency (0=off)", default=0, type_=int)
@@ -112,9 +117,14 @@ class _LightGBMParams(
         s = self.get("model_string")
         return Booster.from_model_string(s) if s else None
 
-    def _fit_batches(self, data: dict, make_cfg: Any, **kw: Any) -> Booster:
+    def _fit_batches(
+        self, data: dict, make_cfg: Any, base_score: Any = 0.0, **kw: Any
+    ) -> Booster:
         """numBatches semantics (LightGBMBase.scala:29-50): split rows into
-        k sequential batches, fold the previous booster into each."""
+        k sequential batches, fold the previous booster into each.
+
+        ``base_score`` applies only to the first training segment (later
+        segments continue from a booster whose predictions include it)."""
         nb = self.get("num_batches")
         booster = self._init_booster()
         if nb and nb > 1:
@@ -133,6 +143,7 @@ class _LightGBMParams(
                     init_score=None if data["init"] is None else data["init"][sl],
                     valid_mask=None if data["valid"] is None else data["valid"][sl],
                     init_booster=booster,
+                    base_score=0.0 if booster is not None else base_score,
                     **kw_sl,
                 )
             return booster
@@ -144,6 +155,7 @@ class _LightGBMParams(
             init_score=data["init"],
             valid_mask=data["valid"],
             init_booster=booster,
+            base_score=0.0 if booster is not None else base_score,
             **kw,
         )
 
@@ -160,11 +172,17 @@ class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPr
             objective = "multiclass"
         num_class = n_classes if objective == "multiclass" else 1
         data["y"] = y.astype(np.float64)
-        init = None
-        if self.get("boost_from_average") and objective == "binary" and data["init"] is None:
-            p = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
-            data["init"] = np.full(len(y), np.log(p / (1 - p)), np.float32)
-        booster = self._fit_batches(data, lambda: self._config(objective, num_class))
+        base: Any = 0.0
+        if self.get("boost_from_average") and data["init"] is None and len(y):
+            if objective == "binary":
+                p = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
+                base = float(np.log(p / (1 - p)))
+            else:  # multiclass: per-class log prior
+                priors = np.bincount(y, minlength=num_class) / len(y)
+                base = np.log(np.clip(priors, 1e-6, None)).astype(np.float32)
+        booster = self._fit_batches(
+            data, lambda: self._config(objective, num_class), base_score=base
+        )
         m = LightGBMClassificationModel(
             features_col=self.get("features_col"),
             prediction_col=self.get("prediction_col"),
@@ -229,9 +247,12 @@ class LightGBMRegressor(Estimator, _LightGBMParams, HasPredictionCol):
 
     def fit(self, df: DataFrame) -> "LightGBMRegressionModel":
         data = self._gather(df)
-        if self.get("boost_from_average") and data["init"] is None:
-            data["init"] = np.full(len(data["y"]), float(data["y"].mean()), np.float32)
-        booster = self._fit_batches(data, lambda: self._config("regression"))
+        base = 0.0
+        if self.get("boost_from_average") and data["init"] is None and len(data["y"]):
+            base = float(data["y"].mean())
+        booster = self._fit_batches(
+            data, lambda: self._config("regression"), base_score=base
+        )
         m = LightGBMRegressionModel(
             features_col=self.get("features_col"),
             prediction_col=self.get("prediction_col"),

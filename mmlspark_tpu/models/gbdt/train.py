@@ -115,9 +115,14 @@ def train(
     valid_mask: Optional[np.ndarray] = None,
     group_ids: Optional[np.ndarray] = None,
     init_booster: Optional[Booster] = None,
+    base_score: Any = 0.0,
     shard: bool = True,
 ) -> Booster:
-    """Fit a booster on dense (n, d) features."""
+    """Fit a booster on dense (n, d) features.
+
+    ``base_score``: boost_from_average baseline (scalar, or (k,) for
+    multiclass) — added to the initial scores AND stored on the booster so
+    prediction replays it."""
     n, d = x.shape
     k = cfg.num_class if cfg.objective == "multiclass" else 1
     mapper = BinMapper.fit(x, max_bin=cfg.max_bin, seed=cfg.seed)
@@ -159,15 +164,21 @@ def train(
         y_onehot = np.eye(k, dtype=np.float32)[y.astype(np.int64)]
     else:
         scores = np.zeros(n, np.float32)
+    scores = scores + np.asarray(base_score, np.float32)
     if init_score is not None:
         scores = scores + init_score.astype(scores.dtype)
     if init_booster is not None and init_booster.trees:
-        prev = init_booster.predict_raw(x)
+        # score with ALL trees (not the best_iteration prefix predict_raw
+        # would default to): merge() replays every init tree, so residuals
+        # must be fit against exactly that
+        all_iters = len(init_booster.trees) // init_booster.num_class
+        prev = init_booster.predict_raw(x, num_iteration=all_iters)
         scores = scores + prev.astype(scores.dtype)
 
     rng = np.random.default_rng(cfg.seed)
     booster = Booster(
-        trees=[], objective=cfg.objective, num_class=k, num_features=d
+        trees=[], objective=cfg.objective, num_class=k, num_features=d,
+        base_score=base_score,
     )
 
     best_val = None
@@ -255,7 +266,12 @@ def train(
     if valid_mask is not None and best_iter > 0 and booster.best_iteration < 0:
         booster.best_iteration = best_iter
     if init_booster is not None and init_booster.trees:
+        new_best = booster.best_iteration
+        init_iters = len(init_booster.trees) // init_booster.num_class
         booster = init_booster.merge(booster)
+        if new_best > 0:
+            # best iteration counts from the front of the merged tree list
+            booster.best_iteration = init_iters + new_best
     return booster
 
 

@@ -74,27 +74,35 @@ def grow_tree(
     cnt_w = row_weight
 
     feat_offset = (jnp.arange(d, dtype=jnp.int32) * B)[None, :]  # (1, d)
+    plane_idx = feat_offset + bins  # (n, d) indices into one (d*B,) leaf plane
+    # (n, d, 3) stacked per-row stats: one fused scatter builds g/h/count
+    stats = jnp.stack(
+        [
+            jnp.broadcast_to(g[:, None], (n, d)),
+            jnp.broadcast_to(h[:, None], (n, d)),
+            jnp.broadcast_to(cnt_w[:, None], (n, d)),
+        ],
+        axis=-1,
+    )
 
-    def hist_for(row_leaf: jnp.ndarray) -> tuple:
-        # flat (n, d) scatter indices into the (L*d*B,) cube
-        idx = row_leaf[:, None] * (d * B) + feat_offset + bins
-        hg = jnp.zeros((L * d * B,), jnp.float32).at[idx].add(
-            g[:, None] * jnp.ones((1, d), jnp.float32), mode="drop"
+    def plane_hist(mask: jnp.ndarray) -> jnp.ndarray:
+        """Histogram of the rows selected by ``mask`` -> (d*B, 3)."""
+        return (
+            jnp.zeros((d * B, 3), jnp.float32)
+            .at[plane_idx]
+            .add(stats * mask[:, None, None], mode="drop")
         )
-        hh = jnp.zeros((L * d * B,), jnp.float32).at[idx].add(
-            h[:, None] * jnp.ones((1, d), jnp.float32), mode="drop"
-        )
-        hc = jnp.zeros((L * d * B,), jnp.float32).at[idx].add(
-            cnt_w[:, None] * jnp.ones((1, d), jnp.float32), mode="drop"
-        )
-        shape = (L, d, B)
-        return hg.reshape(shape), hh.reshape(shape), hc.reshape(shape)
 
     def step(k: int, state: tuple) -> tuple:
-        (row_leaf, leaf_depth, done,
+        (hist, row_leaf, leaf_depth, done,
          rec_leaf, rec_feature, rec_bin, rec_active, rec_gain) = state
 
-        hg, hh, hc = hist_for(row_leaf)
+        # hist is carried incrementally: (L, d*B, 3) cube, only the two
+        # children of the previous split changed (LightGBM's
+        # parent-minus-child trick — one plane scatter per step instead of
+        # rebuilding every leaf's histogram from all rows)
+        cube = hist.reshape(L, d, B, 3)
+        hg, hh, hc = cube[..., 0], cube[..., 1], cube[..., 2]
         # per-(leaf,f): cumulative left stats over threshold bins
         cg = jnp.cumsum(hg, axis=2)
         ch = jnp.cumsum(hh, axis=2)
@@ -133,7 +141,14 @@ def grow_tree(
         new_id = jnp.int32(k + 1)
         in_leaf = row_leaf == bl
         goes_right = in_leaf & (bins[:, bf] > bb)
-        row_leaf = jnp.where(do_split & goes_right, new_id, row_leaf)
+        moved = do_split & goes_right
+        row_leaf = jnp.where(moved, new_id, row_leaf)
+        # incremental histogram update: scatter only the moved rows into the
+        # right child's plane; the parent keeps (old - right)
+        right_plane = plane_hist(moved.astype(jnp.float32))
+        hist = hist.at[new_id].set(right_plane).at[bl].add(
+            jnp.where(do_split, -right_plane, 0.0)
+        )
         child_depth = leaf_depth[bl] + 1
         leaf_depth = jnp.where(
             do_split,
@@ -146,10 +161,17 @@ def grow_tree(
         rec_active = rec_active.at[k].set(do_split)
         rec_gain = rec_gain.at[k].set(jnp.where(do_split, best_gain, 0.0))
         done = done | ~do_split
-        return (row_leaf, leaf_depth, done,
+        return (hist, row_leaf, leaf_depth, done,
                 rec_leaf, rec_feature, rec_bin, rec_active, rec_gain)
 
+    # root histogram: the only full-data cube write of the whole tree
+    hist0 = (
+        jnp.zeros((L, d * B, 3), jnp.float32)
+        .at[0]
+        .set(plane_hist(jnp.ones((n,), jnp.float32)))
+    )
     init = (
+        hist0,
         jnp.zeros((n,), jnp.int32),
         jnp.zeros((L,), jnp.int32),
         jnp.asarray(False),
@@ -159,7 +181,7 @@ def grow_tree(
         jnp.zeros((L - 1,), bool),
         jnp.zeros((L - 1,), jnp.float32),
     )
-    (row_leaf, _, _, rec_leaf, rec_feature, rec_bin, rec_active, rec_gain) = (
+    (_, row_leaf, _, _, rec_leaf, rec_feature, rec_bin, rec_active, rec_gain) = (
         jax.lax.fori_loop(0, L - 1, step, init)
     )
 

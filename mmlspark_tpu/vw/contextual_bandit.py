@@ -107,20 +107,19 @@ class VowpalWabbitContextualBanditModel(Model, HasFeaturesCol, HasPredictionCol)
             n = len(actions)
             # flatten (row, action) pairs into one padded batch -> one kernel call
             flat: list = []
-            row_of: list = []
+            counts = np.zeros(n, np.int64)
             for r in range(n):
                 for a in actions[r]:
                     parts = [a] if shared is None else [shared[r], a]
                     flat.append(concat_sparse(parts))
-                    row_of.append(r)
+                counts[r] = len(actions[r])
             scores_out = np.empty(n, dtype=object)
             pred = np.zeros(n, np.float64)
             if flat:
                 idx, val = pad_sparse_batch(flat)
                 margins = predict_margin(idx, val, w)
-                row_of_a = np.asarray(row_of)
-                for r in range(n):
-                    s = margins[row_of_a == r]
+                # flat is row-major: one linear split regroups per row
+                for r, s in enumerate(np.split(margins, np.cumsum(counts)[:-1])):
                     scores_out[r] = s.astype(np.float64)
                     pred[r] = float(np.argmin(s)) + 1 if len(s) else 0.0
             q = dict(p)

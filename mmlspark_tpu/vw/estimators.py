@@ -27,7 +27,7 @@ from mmlspark_tpu.core.params import (
     Param,
 )
 from mmlspark_tpu.core.pipeline import Estimator, Model
-from mmlspark_tpu.vw.featurizer import HasNumBits
+from mmlspark_tpu.vw.featurizer import HasNumBits, combine_namespaces
 from mmlspark_tpu.vw.learner import (
     LOSS_LOGISTIC,
     LOSS_SQUARED,
@@ -66,17 +66,7 @@ class _VowpalWabbitBase(
     def _gather(self, df: DataFrame) -> tuple:
         fc = self.get("features_col")
         cols = [fc] + list(self.get("additional_features"))
-        sparse_rows: list = []
-        for c in cols:
-            col = df[c]
-            if len(sparse_rows) == 0:
-                sparse_rows = [dict(r) for r in col]
-            else:
-                for r, cell in enumerate(col):
-                    sparse_rows[r] = {
-                        "i": np.concatenate([sparse_rows[r]["i"], cell["i"]]),
-                        "v": np.concatenate([sparse_rows[r]["v"], cell["v"]]),
-                    }
+        sparse_rows = combine_namespaces(df.to_dict(), cols)
         num_bits = df.column_metadata(fc).get(NUM_BITS_META) or self.get("num_bits")
         idx, val = pad_sparse_batch(sparse_rows)
         y = df[self.get("label_col")].astype(np.float32)
@@ -149,17 +139,9 @@ class _VowpalWabbitBaseModel(Model, HasFeaturesCol, HasPredictionCol):
         nz = np.nonzero(w)[0]
         return DataFrame.from_dict({"index": nz, "weight": w[nz]})
 
-    def _margins(self, df: DataFrame, p: dict) -> np.ndarray:
-        fc = self.get("features_col")
-        cols = [fc] + list(self.get("additional_features"))
-        rows = [dict(r) for r in p[cols[0]]]
-        for c in cols[1:]:
-            for r, cell in enumerate(p[c]):
-                rows[r] = {
-                    "i": np.concatenate([rows[r]["i"], cell["i"]]),
-                    "v": np.concatenate([rows[r]["v"], cell["v"]]),
-                }
-        idx, val = pad_sparse_batch(rows)
+    def _margins(self, p: dict) -> np.ndarray:
+        cols = [self.get("features_col")] + list(self.get("additional_features"))
+        idx, val = pad_sparse_batch(combine_namespaces(p, cols))
         return predict_margin(idx, val, np.asarray(self.get_or_fail("weights")))
 
 
@@ -180,7 +162,7 @@ class VowpalWabbitClassificationModel(
 ):
     def transform(self, df: DataFrame) -> DataFrame:
         def fn(p: dict) -> dict:
-            margin = self._margins(df, p)
+            margin = self._margins(p)
             prob = 1.0 / (1.0 + np.exp(-margin))
             q = dict(p)
             q[self.get("raw_prediction_col")] = margin.astype(np.float64)
@@ -207,7 +189,7 @@ class VowpalWabbitRegressionModel(_VowpalWabbitBaseModel):
     def transform(self, df: DataFrame) -> DataFrame:
         def fn(p: dict) -> dict:
             q = dict(p)
-            q[self.get("prediction_col")] = self._margins(df, p).astype(np.float64)
+            q[self.get("prediction_col")] = self._margins(p).astype(np.float64)
             return q
 
         return df.map_partitions(fn, parallel=False)

@@ -112,7 +112,7 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol, HasNumBits
                     if isinstance(cell, str):
                         toks = cell.split() if is_split else [f"{c}={cell}"]
                         for t in toks:
-                            names.append(t if is_split else t)
+                            names.append(t)
                             row_of.append(r)
                             vals.append(1.0)
                     elif isinstance(cell, dict):
@@ -195,11 +195,14 @@ class VowpalWabbitInteractions(Transformer, HasInputCols, HasOutputCol, HasNumBi
         )
 
 
-def combine_namespaces(p: Partition, cols: list) -> np.ndarray:
+def combine_namespaces(columns: dict, cols: list) -> np.ndarray:
     """Row-wise concatenation of several sparse columns (the VW example =
-    all namespaces of the row)."""
-    n = len(p[cols[0]])
+    all namespaces of the row). ``columns`` maps column name -> object array
+    of sparse rows; single-column requests pass through untouched."""
+    if len(cols) == 1:
+        return columns[cols[0]]
+    n = len(columns[cols[0]])
     out = np.empty(n, dtype=object)
     for r in range(n):
-        out[r] = concat_sparse([p[c][r] for c in cols])
+        out[r] = concat_sparse([columns[c][r] for c in cols])
     return out

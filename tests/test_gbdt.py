@@ -17,6 +17,7 @@ from mmlspark_tpu.models.gbdt import (
     LightGBMClassifier,
     LightGBMClassificationModel,
     LightGBMRanker,
+    LightGBMRegressionModel,
     LightGBMRegressor,
     TrainConfig,
     train,
@@ -242,3 +243,78 @@ def test_data_parallel_matches_single_device(devices8):
     np.testing.assert_allclose(
         b_sharded.predict_raw(x), b_local.predict_raw(x), atol=1e-4
     )
+
+
+# -- regression tests for review findings ----------------------------------
+
+
+def test_regressor_baseline_replayed_at_prediction():
+    # boost_from_average baseline must be part of predictions (not only
+    # training): a shifted target must come back with its mean intact
+    r = np.random.default_rng(3)
+    x = r.normal(size=(300, 4)).astype(np.float32)
+    y = 100.0 + x[:, 0]
+    df = DataFrame.from_dict({"features": x, "label": y})
+    m = LightGBMRegressor(num_iterations=20, num_leaves=7, min_data_in_leaf=5).fit(df)
+    pred = m.transform(df)["prediction"]
+    assert abs(pred.mean() - 100.0) < 1.0, pred.mean()
+    # and it must survive the model-string round trip
+    m2 = LightGBMRegressionModel(features_col="features")
+    m2.set(model_string=m.get("model_string"))
+    np.testing.assert_allclose(m2.transform(df)["prediction"], pred, atol=1e-5)
+
+
+def test_classifier_baseline_imbalanced_classes():
+    r = np.random.default_rng(4)
+    x = r.normal(size=(500, 4)).astype(np.float32)
+    y = (r.random(500) < 0.9).astype(np.float64)  # 90/10 imbalance
+    df = DataFrame.from_dict({"features": x, "label": y})
+    # features carry no signal -> probabilities should sit near the prior
+    m = LightGBMClassifier(num_iterations=2, learning_rate=0.01, num_leaves=4).fit(df)
+    p1 = m.transform(df)["probability"][:, 1]
+    assert abs(p1.mean() - 0.9) < 0.05, p1.mean()
+
+
+def test_tree_threshold_neg_inf_roundtrip():
+    from mmlspark_tpu.models.gbdt.booster import Tree
+
+    t = Tree(
+        leaf=np.array([0], np.int32),
+        feature=np.array([0], np.int32),
+        threshold=np.array([-np.inf]),
+        active=np.array([True]),
+        gain=np.array([1.0], np.float32),
+        values=np.array([0.5, -0.5], np.float32),
+        counts=np.array([3, 3], np.int32),
+    )
+    t2 = Tree.from_dict(json.loads(json.dumps(t.to_dict())))
+    assert t2.threshold[0] == -np.inf
+    # -inf split: missing (NaN) goes left, everything real goes right
+    b = Booster(trees=[t2], objective="regression", num_class=1, num_features=1)
+    x = np.array([[np.nan], [5.0]], np.float32)
+    raw = b.predict_raw(x)
+    assert raw[0] == pytest.approx(0.5) and raw[1] == pytest.approx(-0.5)
+
+
+def test_best_iteration_survives_merge():
+    x, y = make_binary(n=600, noise=2.0)
+    valid = np.zeros(600, bool)
+    valid[::3] = True
+    df = DataFrame.from_dict({"features": x, "label": y, "isVal": valid})
+    m1 = LightGBMClassifier(num_iterations=5, num_leaves=7).fit(df)
+    m2 = LightGBMClassifier(
+        num_iterations=200, num_leaves=31, min_data_in_leaf=2,
+        validation_indicator_col="isVal", early_stopping_round=5,
+        model_string=m1.get("model_string"), boost_from_average=False,
+    ).fit(df)
+    b = m2.booster
+    if b.best_iteration > 0:  # early stopping fired in the continued phase
+        assert b.best_iteration > 5  # counts from the merged front
+        assert b.best_iteration <= len(b.trees)
+
+
+def test_max_bin_over_255_rejected():
+    with pytest.raises(ValueError):
+        LightGBMClassifier(max_bin=1000)
+    with pytest.raises(ValueError):
+        BinMapper.fit(np.zeros((10, 2), np.float32), max_bin=300)
