@@ -1,0 +1,49 @@
+"""IO layer: HTTP-on-dataframes, binary/image ingestion, writers.
+
+Rebuilds the reference's ``io/`` package (SURVEY.md §2.6): HTTP request/
+response schema structs, async bounded-concurrency clients with retry,
+`HTTPTransformer`/`SimpleHTTPTransformer`, JSON parsers,
+`PartitionConsolidator`, `SharedVariable`, binary file ingestion and the
+PowerBI-style POST writer.
+"""
+
+from mmlspark_tpu.io.http_schema import (
+    HTTPRequestData,
+    HTTPResponseData,
+    string_to_response,
+)
+from mmlspark_tpu.io.shared import SharedSingleton, SharedVariable
+from mmlspark_tpu.io.clients import AdvancedHandler, BasicHandler, send_request
+from mmlspark_tpu.io.parsers import (
+    CustomInputParser,
+    CustomOutputParser,
+    JSONInputParser,
+    JSONOutputParser,
+    StringOutputParser,
+)
+from mmlspark_tpu.io.http_transformer import HTTPTransformer, SimpleHTTPTransformer
+from mmlspark_tpu.io.consolidator import PartitionConsolidator
+from mmlspark_tpu.io.binary import read_binary_files, read_images
+from mmlspark_tpu.io.powerbi import PowerBIWriter
+
+__all__ = [
+    "HTTPRequestData",
+    "HTTPResponseData",
+    "string_to_response",
+    "SharedVariable",
+    "SharedSingleton",
+    "BasicHandler",
+    "AdvancedHandler",
+    "send_request",
+    "JSONInputParser",
+    "JSONOutputParser",
+    "StringOutputParser",
+    "CustomInputParser",
+    "CustomOutputParser",
+    "HTTPTransformer",
+    "SimpleHTTPTransformer",
+    "PartitionConsolidator",
+    "read_binary_files",
+    "read_images",
+    "PowerBIWriter",
+]

@@ -1,0 +1,111 @@
+"""Binary & image file ingestion.
+
+BinaryFileFormat/BinaryFileReader analogue (io/binary/BinaryFileFormat.
+scala:112-149): walk a directory (or zip archives inside it), emit
+``{path, bytes}`` rows with optional subsampling; ``read_images`` further
+decodes into image rows ({height,width,channels,mode,data}; the reference's
+Spark image schema, io/image/ImageUtils.scala).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.core.utils import zip_iterator
+
+
+def _iter_files(path: str, recursive: bool):
+    if os.path.isfile(path):
+        yield path
+        return
+    if recursive:
+        for root, _, files in os.walk(path):
+            for f in sorted(files):
+                yield os.path.join(root, f)
+    else:
+        for f in sorted(os.listdir(path)):
+            fp = os.path.join(path, f)
+            if os.path.isfile(fp):
+                yield fp
+
+
+def read_binary_files(
+    path: str,
+    recursive: bool = True,
+    sample_ratio: float = 1.0,
+    seed: int = 0,
+    pattern: Optional[str] = None,
+    inspect_zip: bool = True,
+    num_partitions: int = 1,
+) -> DataFrame:
+    """Directory/zip -> DataFrame[path, bytes]."""
+    rng = np.random.default_rng(seed)
+    paths, blobs = [], []
+
+    def keep() -> bool:
+        return sample_ratio >= 1.0 or rng.random() < sample_ratio
+
+    for fp in _iter_files(path, recursive):
+        if inspect_zip and fp.endswith(".zip"):
+            for name, data in zip_iterator(fp, sample_ratio=sample_ratio, seed=seed):
+                if pattern and not fnmatch.fnmatch(name.split("::")[-1], pattern):
+                    continue
+                paths.append(name)
+                blobs.append(data)
+            continue
+        if pattern and not fnmatch.fnmatch(os.path.basename(fp), pattern):
+            continue
+        if not keep():
+            continue
+        with open(fp, "rb") as f:
+            blobs.append(f.read())
+        paths.append(fp)
+
+    data = np.empty(len(blobs), dtype=object)
+    for i, b in enumerate(blobs):
+        data[i] = b
+    return DataFrame.from_dict(
+        {"path": np.array(paths, dtype=object), "bytes": data},
+        num_partitions=max(1, num_partitions),
+    )
+
+
+def read_images(
+    path: str,
+    recursive: bool = True,
+    sample_ratio: float = 1.0,
+    seed: int = 0,
+    drop_invalid: bool = True,
+    num_partitions: int = 1,
+) -> DataFrame:
+    """Directory -> DataFrame[path, image] with decoded image rows."""
+    from mmlspark_tpu.ops.image import decode_image
+
+    df = read_binary_files(
+        path, recursive=recursive, sample_ratio=sample_ratio, seed=seed,
+        num_partitions=num_partitions,
+    )
+
+    def decode_part(p: dict) -> dict:
+        imgs, keep = [], []
+        for i, b in enumerate(p["bytes"]):
+            arr = decode_image(b)
+            if arr is None:
+                if not drop_invalid:
+                    imgs.append(None)
+                    keep.append(i)
+                continue
+            imgs.append(make_image_row(arr, origin=p["path"][i]))
+            keep.append(i)
+        col = np.empty(len(imgs), dtype=object)
+        for i, v in enumerate(imgs):
+            col[i] = v
+        return {"path": p["path"][keep], "image": col}
+
+    return df.map_partitions(decode_part)

@@ -1,0 +1,94 @@
+"""HTTP send + handler strategies.
+
+Rebuilds the reference's client stack (io/http/Clients.scala:48-63,
+HTTPClients.scala:64-150): a raw ``send_request``, a ``BasicHandler`` that
+sends once, and an ``AdvancedHandler`` with retry/backoff on retryable
+status codes. Concurrency comes from the caller (HTTPTransformer fans a
+partition out over a bounded thread pool — AsyncClient analogue).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional, Sequence
+
+from mmlspark_tpu.io.http_schema import HTTPResponseData
+
+Handler = Callable[[dict], dict]
+
+
+def send_request(request: dict, timeout: float = 60.0) -> dict:
+    """Send one request dict, return a response dict. Network errors become
+    status_code=0 responses (the reference surfaces nulls/errors as rows,
+    never exceptions mid-partition)."""
+    req = urllib.request.Request(
+        request["url"],
+        data=request.get("entity"),
+        headers=request.get("headers") or {},
+        method=request.get("method", "GET"),
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return HTTPResponseData(
+                resp.status, resp.read(), getattr(resp, "reason", ""), dict(resp.headers)
+            )
+    except urllib.error.HTTPError as e:  # non-2xx still has a response body
+        return HTTPResponseData(e.code, e.read(), str(e.reason), dict(e.headers or {}))
+    except (urllib.error.URLError, socket.timeout, ConnectionError, OSError) as e:
+        return HTTPResponseData(0, b"", f"{type(e).__name__}: {e}")
+
+
+def BasicHandler(timeout: float = 60.0) -> Handler:
+    """HandlingUtils.basic analogue — single attempt."""
+    return lambda request: send_request(request, timeout=timeout)
+
+
+def AdvancedHandler(
+    retry_codes: Sequence[int] = (0, 429, 500, 502, 503, 504),
+    backoffs_ms: Sequence[int] = (100, 500, 1000),
+    timeout: float = 60.0,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Handler:
+    """HandlingUtils.advancedUDF analogue (HTTPClients.scala:64-150):
+    retries retryable codes with the given backoff schedule; honors
+    Retry-After when present."""
+
+    def handle(request: dict) -> dict:
+        resp = send_request(request, timeout=timeout)
+        for backoff in backoffs_ms:
+            if resp["status_code"] not in retry_codes:
+                return resp
+            retry_after = (resp.get("headers") or {}).get("Retry-After")
+            try:
+                # RFC 7231 allows delta-seconds or an HTTP-date; fall back to
+                # the schedule for dates rather than parsing them
+                delay = float(retry_after) if retry_after else backoff / 1000.0
+            except ValueError:
+                delay = backoff / 1000.0
+            sleep(delay)
+            resp = send_request(request, timeout=timeout)
+        return resp
+
+    return handle
+
+
+class HeartbeatClient:
+    """Wait until an HTTP endpoint answers (used by serving tests and the
+    PowerBI writer to verify liveness)."""
+
+    def __init__(self, url: str, timeout_s: float = 10.0, interval_s: float = 0.05):
+        self.url = url
+        self.timeout_s = timeout_s
+        self.interval_s = interval_s
+
+    def wait(self) -> bool:
+        deadline = time.monotonic() + self.timeout_s
+        while time.monotonic() < deadline:
+            resp = send_request({"url": self.url, "method": "GET"}, timeout=1.0)
+            if resp["status_code"] != 0:
+                return True
+            time.sleep(self.interval_s)
+        return False

@@ -1,0 +1,23 @@
+"""PartitionConsolidator (io/http/PartitionConsolidator.scala:19-132 analogue).
+
+Funnels many partitions' rows through a bounded number of workers — the
+pattern for rate-limited external services: regardless of upstream
+parallelism, at most ``num_workers`` partitions exist downstream, so at most
+``num_workers * concurrency`` requests are ever in flight.
+"""
+
+from __future__ import annotations
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+class PartitionConsolidator(Transformer):
+    num_workers = Param(
+        "number of consolidated partitions (chosen workers)", default=1, type_=int,
+        validator=lambda v: v >= 1,
+    )
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.coalesce(self.get("num_workers"))

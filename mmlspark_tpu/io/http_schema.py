@@ -1,0 +1,71 @@
+"""HTTP request/response structs carried in DataFrame columns.
+
+The reference models these as case classes with ``SparkBindings`` codecs
+(io/http/HTTPSchema.scala:26-240). Here they are plain dicts (object
+columns) with typed constructors — the columnar substrate stores them
+directly, and JSON round-trips trivially for persistence.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional, Union
+
+
+def HTTPRequestData(
+    url: str,
+    method: str = "GET",
+    headers: Optional[dict] = None,
+    entity: Union[bytes, str, None] = None,
+) -> dict:
+    """Build a request row (HTTPSchema.scala HTTPRequestData analogue)."""
+    if isinstance(entity, str):
+        entity = entity.encode("utf-8")
+    return {
+        "url": url,
+        "method": method.upper(),
+        "headers": dict(headers or {}),
+        "entity": entity,
+    }
+
+
+def HTTPResponseData(
+    status_code: int,
+    entity: Union[bytes, str, None] = None,
+    reason: str = "",
+    headers: Optional[dict] = None,
+) -> dict:
+    """Build a response row (HTTPSchema.scala HTTPResponseData analogue)."""
+    if isinstance(entity, str):
+        entity = entity.encode("utf-8")
+    return {
+        "status_code": int(status_code),
+        "reason": reason,
+        "headers": dict(headers or {}),
+        "entity": entity,
+    }
+
+
+def string_to_response(text: str, code: int = 200, reason: str = "OK") -> dict:
+    """HTTPSchema.string_to_response analogue (HTTPSchema.scala:191-199)."""
+    return HTTPResponseData(code, text, reason, {"Content-Type": "text/plain"})
+
+
+def json_to_request(obj: Any, url: str, headers: Optional[dict] = None) -> dict:
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    return HTTPRequestData(url, "POST", h, json.dumps(obj))
+
+
+def entity_to_string(row: Optional[dict]) -> Optional[str]:
+    if row is None:
+        return None
+    e = row.get("entity")
+    if e is None:
+        return None
+    return e.decode("utf-8") if isinstance(e, (bytes, bytearray)) else str(e)
+
+
+def response_to_json(row: Optional[dict]) -> Any:
+    s = entity_to_string(row)
+    return None if s is None or not s.strip() else json.loads(s)

@@ -66,7 +66,7 @@ class _VowpalWabbitBase(
     def _gather(self, df: DataFrame) -> tuple:
         fc = self.get("features_col")
         cols = [fc] + list(self.get("additional_features"))
-        sparse_rows = combine_namespaces(df.to_dict(), cols)
+        sparse_rows = combine_namespaces({c: df[c] for c in cols}, cols)
         num_bits = df.column_metadata(fc).get(NUM_BITS_META) or self.get("num_bits")
         idx, val = pad_sparse_batch(sparse_rows)
         y = df[self.get("label_col")].astype(np.float32)

@@ -207,6 +207,36 @@ def make_test_objects() -> list:
     )
     objs.append(TestObject(V.VowpalWabbitContextualBandit(num_bits=10), cb_df))
 
+    # io layer (network-bound stages are covered against a live localhost
+    # server in test_io.py; parsers/consolidator fuzz offline)
+    from mmlspark_tpu import io as IO
+    from mmlspark_tpu.io.http_schema import HTTPRequestData, HTTPResponseData
+
+    resps = np.empty(4, dtype=object)
+    for i in range(4):
+        resps[i] = HTTPResponseData(200, f'{{"v": {i}}}')
+    resp_df = DataFrame.from_dict({"resp": resps})
+    objs += [
+        TestObject(
+            IO.JSONInputParser(input_col="x", output_col="req", url="http://h/p"), df
+        ),
+        TestObject(
+            IO.CustomInputParser(input_col="x", output_col="req").set_udf(
+                lambda v: HTTPRequestData("http://h/p", "POST", entity=str(v))
+            ),
+            df,
+        ),
+        TestObject(IO.JSONOutputParser(input_col="resp", output_col="out"), resp_df),
+        TestObject(IO.StringOutputParser(input_col="resp", output_col="out"), resp_df),
+        TestObject(
+            IO.CustomOutputParser(input_col="resp", output_col="out").set_udf(
+                lambda r: r["status_code"]
+            ),
+            resp_df,
+        ),
+        TestObject(IO.PartitionConsolidator(num_workers=1), df),
+    ]
+
     qid_df = lin_df.with_column("query", np.arange(20) // 4)
     objs += [
         TestObject(
@@ -269,6 +299,8 @@ EXCLUDED = {
     "Pipeline", "PipelineModel", "HasMiniBatcher",
     # covered by dedicated suites with model/zoo setup
     "XLAModel", "ImageFeaturizer",
+    # network-bound: fuzzed against a live localhost server in test_io.py
+    "HTTPTransformer", "SimpleHTTPTransformer",
     # fitted-model classes produced by their estimator (estimator is covered)
     "ClassBalancerModel", "CleanMissingDataModel", "FeaturizeModel",
     "ValueIndexerModel", "TextFeaturizerModel", "MeanShiftModel",

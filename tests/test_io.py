@@ -1,0 +1,218 @@
+"""IO layer tests: HTTP transformers against a real localhost server
+(the reference's io/split2 suites start real servers too), parsers,
+binary/image readers, PowerBI writer."""
+
+from __future__ import annotations
+
+import json
+import threading
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.io import (
+    CustomOutputParser,
+    HTTPRequestData,
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    PartitionConsolidator,
+    PowerBIWriter,
+    SimpleHTTPTransformer,
+    StringOutputParser,
+    read_binary_files,
+    read_images,
+)
+from mmlspark_tpu.io.clients import AdvancedHandler, send_request
+from mmlspark_tpu.io.shared import SharedSingleton, SharedVariable
+
+
+class _Handler(BaseHTTPRequestHandler):
+    flaky_state = {"remaining": 0}
+    seen = []
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def do_GET(self):
+        self._reply(200, b'{"ok": true}')
+
+    def do_POST(self):
+        body = self._body()
+        type(self).seen.append(body)
+        if self.path == "/echo":
+            obj = json.loads(body or b"null")
+            self._reply(200, json.dumps({"echo": obj}).encode())
+        elif self.path == "/double":
+            obj = json.loads(body)
+            self._reply(200, json.dumps({"value": obj["x"] * 2}).encode())
+        elif self.path == "/flaky":
+            st = type(self).flaky_state
+            if st["remaining"] > 0:
+                st["remaining"] -= 1
+                self._reply(503, b"try later")
+            else:
+                self._reply(200, b'{"ok": true}')
+        elif self.path == "/fail":
+            self._reply(400, b"bad request")
+        elif self.path == "/rows":
+            self._reply(200, b'{"accepted": true}')
+        else:
+            self._reply(404, b"nope")
+
+    def _reply(self, code, body):
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_send_request_and_error(server):
+    resp = send_request({"url": server + "/echo", "method": "POST",
+                         "headers": {}, "entity": b'{"a": 1}'})
+    assert resp["status_code"] == 200
+    assert json.loads(resp["entity"]) == {"echo": {"a": 1}}
+    # connection refused -> status 0, no raise
+    resp = send_request({"url": "http://127.0.0.1:9/x", "method": "GET"}, timeout=0.5)
+    assert resp["status_code"] == 0
+
+
+def test_advanced_handler_retries(server):
+    _Handler.flaky_state["remaining"] = 2
+    handler = AdvancedHandler(backoffs_ms=[10, 10, 10])
+    resp = handler(HTTPRequestData(server + "/flaky", "POST", entity=b"{}"))
+    assert resp["status_code"] == 200
+
+
+def test_http_transformer(server):
+    reqs = np.empty(6, dtype=object)
+    for i in range(6):
+        reqs[i] = HTTPRequestData(
+            server + "/double", "POST",
+            {"Content-Type": "application/json"}, json.dumps({"x": i}),
+        )
+    df = DataFrame.from_dict({"req": reqs, "i": np.arange(6)}, num_partitions=2)
+    out = HTTPTransformer(input_col="req", output_col="resp").transform(df)
+    vals = [json.loads(r["entity"])["value"] for r in out["resp"]]
+    assert vals == [0, 2, 4, 6, 8, 10]
+
+
+def test_simple_http_transformer(server):
+    df = DataFrame.from_dict({"x": np.arange(5, dtype=np.int64)}, num_partitions=2)
+    t = SimpleHTTPTransformer(
+        input_col="x", output_col="out", url=server + "/echo", concurrency=4
+    )
+    out = t.transform(df)
+    assert [o["echo"] for o in out["out"]] == list(range(5))
+    assert all(e is None for e in out["out_error"])
+
+
+def test_simple_http_transformer_error_split(server):
+    df = DataFrame.from_dict({"x": [1, 2]})
+    t = SimpleHTTPTransformer(
+        input_col="x", output_col="out", url=server + "/fail",
+        use_advanced_handler=False,
+    )
+    out = t.transform(df)
+    assert all(o is None for o in out["out"])
+    assert all(e is not None and e["status_code"] == 400 for e in out["out_error"])
+
+
+def test_parsers_standalone(server):
+    df = DataFrame.from_dict({"x": [{"a": 1}, {"a": 2}]})
+    req_df = JSONInputParser(
+        input_col="x", output_col="req", url=server + "/echo"
+    ).transform(df)
+    out = HTTPTransformer(input_col="req", output_col="resp").transform(req_df)
+    txt = StringOutputParser(input_col="resp", output_col="s").transform(out)
+    assert all(isinstance(s, str) and "echo" in s for s in txt["s"])
+    parsed = JSONOutputParser(input_col="resp", output_col="j").transform(out)
+    assert [p["echo"]["a"] for p in parsed["j"]] == [1, 2]
+    custom = CustomOutputParser(input_col="resp", output_col="code").set_udf(
+        lambda r: r["status_code"]
+    ).transform(out)
+    assert list(custom["code"]) == [200, 200]
+
+
+def test_partition_consolidator():
+    df = DataFrame.from_dict({"x": np.arange(10)}, num_partitions=5)
+    out = PartitionConsolidator(num_workers=2).transform(df)
+    assert out.num_partitions == 2
+    assert list(out["x"]) == list(range(10))
+
+
+def test_shared_variable_and_singleton():
+    calls = []
+    sv = SharedVariable(lambda: calls.append(1) or "value")
+    assert sv.get() == "value" and sv.get() == "value"
+    assert len(calls) == 1
+    import pickle
+
+    # constructor must be picklable for closures shipped to partitions;
+    # use a module-level fn
+    sv2 = SharedVariable(dict)
+    assert pickle.loads(pickle.dumps(sv2)).get() == {}
+
+    SharedSingleton.invalidate("k")
+    a = SharedSingleton("k", list).get()
+    b = SharedSingleton("k", list).get()
+    assert a is b
+
+
+def test_read_binary_files_and_zip(tmp_path):
+    (tmp_path / "a.bin").write_bytes(b"alpha")
+    (tmp_path / "b.txt").write_bytes(b"beta")
+    with zipfile.ZipFile(tmp_path / "c.zip", "w") as z:
+        z.writestr("inner/one.bin", b"one")
+        z.writestr("two.bin", b"two")
+    df = read_binary_files(str(tmp_path))
+    got = {r["path"].split("/")[-1].split("::")[-1]: r["bytes"] for r in df.collect()}
+    assert got.get("a.bin") == b"alpha"
+    assert got.get("b.txt") == b"beta"
+    assert b"one" in got.values() and b"two" in got.values()
+    # pattern filter
+    df2 = read_binary_files(str(tmp_path), pattern="*.bin")
+    names = [r["path"] for r in df2.collect()]
+    assert all(n.endswith(".bin") for n in names)
+    assert len(names) == 3
+
+
+def test_read_images(tmp_path):
+    # P6 PPM, decodable by the hermetic fallback as well as PIL
+    w, h = 4, 3
+    pix = bytes(range(w * h * 3))
+    (tmp_path / "img.ppm").write_bytes(b"P6\n%d %d\n255\n" % (w, h) + pix)
+    (tmp_path / "junk.bin").write_bytes(b"not an image")
+    df = read_images(str(tmp_path))
+    rows = df.collect()
+    assert len(rows) == 1
+    img = rows[0]["image"]
+    assert img["height"] == h and img["width"] == w and img["nChannels"] == 3
+
+
+def test_powerbi_writer(server):
+    _Handler.seen.clear()
+    df = DataFrame.from_dict({"a": np.arange(7), "b": np.arange(7) * 1.5})
+    resps = PowerBIWriter.write(df, server + "/rows", minibatch_size=3)
+    assert len(resps) == 3
+    sent = [json.loads(s) for s in _Handler.seen]
+    assert sum(len(b) for b in sent) == 7
+    with pytest.raises(RuntimeError):
+        PowerBIWriter.write(df, server + "/fail", minibatch_size=10)
