@@ -64,8 +64,10 @@ def AdvancedHandler(
             retry_after = (resp.get("headers") or {}).get("Retry-After")
             try:
                 # RFC 7231 allows delta-seconds or an HTTP-date; fall back to
-                # the schedule for dates rather than parsing them
+                # the schedule for dates rather than parsing them. Clamp so a
+                # hostile/buggy server can't park a partition thread for hours.
                 delay = float(retry_after) if retry_after else backoff / 1000.0
+                delay = min(delay, max(30.0, backoff / 1000.0))
             except ValueError:
                 delay = backoff / 1000.0
             sleep(delay)
@@ -73,22 +75,3 @@ def AdvancedHandler(
         return resp
 
     return handle
-
-
-class HeartbeatClient:
-    """Wait until an HTTP endpoint answers (used by serving tests and the
-    PowerBI writer to verify liveness)."""
-
-    def __init__(self, url: str, timeout_s: float = 10.0, interval_s: float = 0.05):
-        self.url = url
-        self.timeout_s = timeout_s
-        self.interval_s = interval_s
-
-    def wait(self) -> bool:
-        deadline = time.monotonic() + self.timeout_s
-        while time.monotonic() < deadline:
-            resp = send_request({"url": self.url, "method": "GET"}, timeout=1.0)
-            if resp["status_code"] != 0:
-                return True
-            time.sleep(self.interval_s)
-        return False

@@ -162,5 +162,25 @@ class SimpleHTTPTransformer(Transformer, _HasHandler, HasInputCol, HasOutputCol)
         if self.get("flatten_output"):
             from mmlspark_tpu.stages.batching import FlattenBatch
 
-            out = FlattenBatch().transform(out)
+            # per-batch scalars (the error column, or a None output for a
+            # failed batch) must be expanded to per-row values before
+            # FlattenBatch concatenates
+            def expand(p: dict) -> dict:
+                q = dict(p)
+                lens = [
+                    len(v) if hasattr(v, "__len__") else 1 for v in p[in_col]
+                ]
+                for col in (out_col, err_col):
+                    vals = np.empty(len(lens), dtype=object)
+                    for i, (v, n) in enumerate(zip(p[col], lens)):
+                        is_rowwise = (
+                            col == out_col
+                            and isinstance(v, (list, np.ndarray))
+                            and len(v) == n
+                        )
+                        vals[i] = v if is_rowwise else [v] * n
+                    q[col] = vals
+                return q
+
+            out = FlattenBatch().transform(out.map_partitions(expand))
         return out

@@ -1,0 +1,37 @@
+"""Low-latency model serving (Spark Serving analogue, SURVEY.md §2.7).
+
+The reference serves models from Structured Streaming: per-executor HTTP
+servers feed epoch-keyed request queues, replies are routed back by request
+id on the same machine, and crashed partitions replay their queue history
+(HTTPSourceV2.scala:457-675). This package rebuilds that design TPU-first:
+
+- :class:`WorkerServer` — asyncio HTTP ingress with epoch-keyed queues,
+  request-id routing table, history replay and commit pruning. A request
+  never leaves its host: ingress -> batch -> TPU -> reply is machine-local,
+  which is what makes the reference's sub-millisecond claim achievable.
+- :class:`ServingQuery` — couples a server to a Transformer/function:
+  *continuous* mode batches whatever is queued (up to ``max_batch_size`` /
+  ``max_wait_ms``) and replies immediately; *micro-batch* mode advances
+  epochs on a timer. Batches are padded to fixed shapes so the jitted model
+  never recompiles (the load-bearing TPU detail).
+- :class:`DriverRegistry` — the driver-side registration service workers
+  report their ``ServiceInfo`` to (DriverServiceUtils analogue).
+- ``make_reply`` / ``request_to_row`` — ServingUDFs analogues.
+"""
+
+from mmlspark_tpu.serving.server import CachedRequest, ServiceInfo, WorkerServer
+from mmlspark_tpu.serving.query import ServingQuery, serve_transformer
+from mmlspark_tpu.serving.registry import DriverRegistry
+from mmlspark_tpu.serving.udfs import make_reply, request_to_json, request_to_text
+
+__all__ = [
+    "WorkerServer",
+    "CachedRequest",
+    "ServiceInfo",
+    "ServingQuery",
+    "serve_transformer",
+    "DriverRegistry",
+    "make_reply",
+    "request_to_json",
+    "request_to_text",
+]

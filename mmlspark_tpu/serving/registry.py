@@ -1,0 +1,86 @@
+"""DriverRegistry: the driver-side service-registration endpoint.
+
+DriverServiceUtils analogue (HTTPSourceV2.scala:113-173): each host's
+WorkerServer reports its ServiceInfo here once at startup; clients (or a
+load balancer) query the roster. In a multi-host TPU deployment this runs
+on the coordinator host next to ``jax.distributed``'s rendezvous.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from mmlspark_tpu.io.clients import send_request
+from mmlspark_tpu.io.http_schema import HTTPRequestData
+from mmlspark_tpu.serving.server import ServiceInfo
+
+
+class DriverRegistry:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self._services: dict[str, list] = {}
+        self._lock = threading.Lock()
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                info = json.loads(self.rfile.read(n))
+                with registry._lock:
+                    registry._services.setdefault(info["name"], []).append(info)
+                body = b'{"registered": true}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                with registry._lock:
+                    body = json.dumps(registry._services).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="driver-registry", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def services(self, name: Optional[str] = None) -> list:
+        with self._lock:
+            if name is not None:
+                return list(self._services.get(name, ()))
+            return [s for infos in self._services.values() for s in infos]
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(5.0)
+
+    @staticmethod
+    def register(registry_url: str, info: ServiceInfo) -> bool:
+        """Worker-side: report a ServiceInfo to the driver registry."""
+        resp = send_request(
+            HTTPRequestData(
+                registry_url, "POST", {"Content-Type": "application/json"},
+                json.dumps({
+                    "name": info.name, "host": info.host,
+                    "port": info.port, "path": info.path,
+                }),
+            ),
+            timeout=10.0,
+        )
+        return resp["status_code"] == 200

@@ -1,0 +1,314 @@
+"""WorkerServer: machine-local HTTP ingress for model serving.
+
+Rebuilds the continuous-serving server of the reference
+(HTTPSourceV2.scala:457-675) without the JVM: an asyncio event loop on one
+thread parses HTTP/1.1 (keep-alive) and enqueues :class:`CachedRequest`s
+into epoch-keyed queues; a routing table maps request id -> connection so
+replies from the dispatcher thread land on the originating socket
+(replyTo, :516-533); uncommitted epochs are kept in ``history`` and can be
+replayed after a crash (:470-487); ``commit`` prunes them (:535-547).
+
+The ingress thread does no model work — batching and TPU dispatch live in
+:class:`~mmlspark_tpu.serving.query.ServingQuery` — so request queuing
+stays O(µs) and the end-to-end budget is spent on the XLA call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found", 500: "Internal Server Error",
+             503: "Service Unavailable"}
+
+
+@dataclass
+class CachedRequest:
+    id: str
+    epoch: int
+    method: str
+    path: str
+    headers: dict
+    body: bytes
+    arrival_ns: int = 0
+    attempt: int = 0
+
+
+@dataclass
+class ServiceInfo:
+    """What a worker reports to the driver registry
+    (HTTPSourceV2.scala ServiceInfo :649-655)."""
+
+    name: str
+    host: str
+    port: int
+    path: str = "/"
+
+
+class WorkerServer:
+    """Epoch-queued HTTP ingress with reply routing and history replay."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        api_path: str = "/",
+        name: str = "serving",
+        max_queue: int = 100_000,
+    ):
+        self.name = name
+        self.host = host
+        self.api_path = api_path.rstrip("/") or "/"
+        self._requested_port = port
+        self.port: int = 0
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._aserver: Optional[asyncio.AbstractServer] = None
+        self._started = threading.Event()
+        self._max_queue = max_queue
+
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._epoch = 0
+        self._queue: deque[CachedRequest] = deque()
+        # epoch -> [CachedRequest] for replay-on-failure (historyQueues)
+        self._history: dict[int, list[CachedRequest]] = {}
+        # request id -> (writer, keep_alive) — pending replies (routingTable)
+        self._routing: dict[str, tuple] = {}
+        self.requests_seen = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> ServiceInfo:
+        self._thread = threading.Thread(
+            target=self._run_loop, name=f"{self.name}-ingress", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(10.0):
+            raise RuntimeError("WorkerServer failed to start")
+        return ServiceInfo(self.name, self.host, self.port, self.api_path)
+
+    def _run_loop(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def boot() -> None:
+            self._aserver = await asyncio.start_server(
+                self._handle_conn, self.host, self._requested_port
+            )
+            self.port = self._aserver.sockets[0].getsockname()[1]
+            self._started.set()
+
+        loop.run_until_complete(boot())
+        try:
+            loop.run_forever()
+        finally:
+            loop.close()
+
+    def stop(self) -> None:
+        loop = self._loop
+        if loop is None:
+            return
+
+        def _shutdown() -> None:
+            if self._aserver is not None:
+                self._aserver.close()
+            for task in asyncio.all_tasks(loop):
+                task.cancel()
+            loop.stop()
+
+        loop.call_soon_threadsafe(_shutdown)
+        if self._thread is not None:
+            self._thread.join(5.0)
+        with self._not_empty:
+            self._not_empty.notify_all()
+
+    # -- ingress (loop thread) -----------------------------------------------
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    method, path, version = line.decode("latin1").split()
+                except ValueError:
+                    return
+                headers: dict = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                n = int(headers.get("content-length") or 0)
+                body = await reader.readexactly(n) if n else b""
+                keep = headers.get("connection", "keep-alive").lower() != "close"
+                prefix = self.api_path.rstrip("/")
+                path_only = path.split("?", 1)[0]
+                on_path = (
+                    not prefix
+                    or path_only == prefix
+                    or path_only.startswith(prefix + "/")
+                )
+                if not on_path:
+                    self._write_response(writer, 404, b"not found", keep)
+                    if not keep:
+                        return
+                    continue
+                req = CachedRequest(
+                    id=uuid.uuid4().hex,
+                    epoch=self._epoch,
+                    method=method,
+                    path=path,
+                    headers=headers,
+                    body=body,
+                    arrival_ns=time.perf_counter_ns(),
+                )
+                replied = asyncio.Event()
+                with self._not_empty:
+                    if len(self._queue) >= self._max_queue:
+                        self._write_response(writer, 503, b"queue full", keep)
+                        if not keep:
+                            return
+                        continue
+                    self._routing[req.id] = (writer, keep, replied)
+                    self._queue.append(req)
+                    self._history.setdefault(req.epoch, []).append(req)
+                    self.requests_seen += 1
+                    self._not_empty.notify()
+                # wait for the reply before reading the next request on this
+                # connection (no HTTP/1.1 pipelining needed)
+                await replied.wait()
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    @staticmethod
+    def _write_response(
+        writer: asyncio.StreamWriter, code: int, body: bytes, keep: bool,
+        headers: Optional[dict] = None,
+    ) -> None:
+        reason = _REASONS.get(code, "")
+        head = [f"HTTP/1.1 {code} {reason}"]
+        hdrs = {"Content-Length": str(len(body)),
+                "Connection": "keep-alive" if keep else "close"}
+        hdrs.update(headers or {})
+        head += [f"{k}: {v}" for k, v in hdrs.items()]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin1") + body)
+
+    # -- consumption (dispatcher thread) --------------------------------------
+
+    def get_next_batch(
+        self, max_n: int, timeout_s: float = 0.1, min_n: int = 1
+    ) -> list:
+        """Pop up to ``max_n`` queued requests; blocks up to ``timeout_s``
+        for the first ``min_n`` (getNextRequest analogue, :588-623)."""
+        deadline = time.monotonic() + timeout_s
+        with self._not_empty:
+            while len(self._queue) < min_n:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._not_empty.wait(remaining)
+            out = []
+            while self._queue and len(out) < max_n:
+                out.append(self._queue.popleft())
+            return out
+
+    # -- replies (any thread) --------------------------------------------------
+
+    def reply_to(
+        self, request_id: str, body: bytes, code: int = 200,
+        headers: Optional[dict] = None,
+    ) -> bool:
+        """Write the response on the originating connection. Idempotent:
+        second reply for the same id is a no-op (routing-table removal,
+        HTTPSourceV2.scala:516-527)."""
+        with self._lock:
+            entry = self._routing.pop(request_id, None)
+        if entry is None or self._loop is None:
+            return False
+        writer, keep, replied = entry
+
+        def _send() -> None:
+            try:
+                self._write_response(writer, code, body, keep, headers)
+            except Exception:
+                pass
+            finally:
+                replied.set()
+
+        try:
+            self._loop.call_soon_threadsafe(_send)
+        except RuntimeError:  # loop already closed (server stopped first)
+            return False
+        return True
+
+    # -- epochs / recovery -----------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def new_epoch(self) -> int:
+        """Advance the epoch (micro-batch mode boundary)."""
+        with self._lock:
+            self._epoch += 1
+            return self._epoch
+
+    def commit(self, epoch: int) -> None:
+        """Acknowledge an epoch fully replied: prune its replay history
+        (:535-547)."""
+        with self._lock:
+            for e in [e for e in self._history if e <= epoch]:
+                del self._history[e]
+
+    def auto_commit(self) -> None:
+        """Prune history below the oldest live (queued or unanswered)
+        request — the continuous-mode commit policy."""
+        with self._lock:
+            live = {r.epoch for r in self._queue}
+            for e, reqs in self._history.items():
+                if any(r.id in self._routing for r in reqs):
+                    live.add(e)
+            floor = (min(live) if live else self._epoch + 1) - 1
+            for e in [e for e in self._history if e <= floor]:
+                del self._history[e]
+
+    def replay(self, epoch: int) -> int:
+        """Re-enqueue uncommitted requests of ``epoch`` whose replies never
+        happened — the re-registration recovery path (:470-487). Returns the
+        number of requests rehydrated."""
+        with self._not_empty:
+            reqs = [
+                r for r in self._history.get(epoch, ())
+                if r.id in self._routing  # unanswered only
+            ]
+            for r in reqs:
+                r.attempt += 1
+            # remove any still-queued instances to avoid double delivery
+            queued = {r.id for r in reqs}
+            self._queue = deque(r for r in self._queue if r.id not in queued)
+            self._queue.extendleft(reversed(reqs))
+            self._not_empty.notify()
+            return len(reqs)
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)

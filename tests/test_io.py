@@ -151,6 +151,22 @@ def test_parsers_standalone(server):
     assert list(custom["code"]) == [200, 200]
 
 
+def test_simple_http_transformer_flatten(server):
+    from mmlspark_tpu.stages.batching import FixedMiniBatchTransformer
+
+    df = DataFrame.from_dict({"x": np.arange(7, dtype=np.int64)})
+    t = SimpleHTTPTransformer(
+        input_col="x", output_col="out", url=server + "/echo",
+        flatten_output=True,
+    ).set(mini_batcher=FixedMiniBatchTransformer(batch_size=3))
+    out = t.transform(df)
+    assert out.count() == 7
+    # /echo wraps the posted batch list; each flattened row carries the
+    # batch's parsed response, errors are all None
+    assert all(e is None for e in out["out_error"])
+    assert all(o is not None for o in out["out"])
+
+
 def test_partition_consolidator():
     df = DataFrame.from_dict({"x": np.arange(10)}, num_partitions=5)
     out = PartitionConsolidator(num_workers=2).transform(df)
