@@ -1,0 +1,66 @@
+"""Cognitive-service-style REST enrichment transformers (SURVEY.md §2.8).
+
+The reference ships ~20 transformers that call Azure Cognitive Services
+REST APIs from DataFrame columns (cognitive/, CognitiveServiceBase.scala:
+258-330). The service *catalog* — text analytics, vision, face, anomaly
+detection, speech, search — is the capability; Azure specifics are not.
+Each transformer here speaks the same wire format against any base URL
+(self-hosted, proxy, or Azure), with:
+
+- :class:`ServiceParam` value-or-column duality (HasServiceParams,
+  CognitiveServiceBase.scala:29-150)
+- bounded-concurrency async sends with retry/backoff (RESTHelpers analogue
+  via the io layer's AdvancedHandler)
+- typed response projection into an output column + error column
+"""
+
+from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
+from mmlspark_tpu.cognitive.text import (
+    EntityDetector,
+    KeyPhraseExtractor,
+    LanguageDetector,
+    TextSentiment,
+)
+from mmlspark_tpu.cognitive.vision import (
+    AnalyzeImage,
+    DescribeImage,
+    GenerateThumbnails,
+    OCR,
+    RecognizeDomainSpecificContent,
+    TagImage,
+)
+from mmlspark_tpu.cognitive.face import (
+    DetectFace,
+    FindSimilarFace,
+    GroupFaces,
+    IdentifyFaces,
+    VerifyFaces,
+)
+from mmlspark_tpu.cognitive.anomaly import DetectAnomalies, DetectLastAnomaly
+from mmlspark_tpu.cognitive.speech import SpeechToText
+from mmlspark_tpu.cognitive.search import AzureSearchWriter, BingImageSearch
+
+__all__ = [
+    "CognitiveServiceBase",
+    "ServiceParam",
+    "TextSentiment",
+    "LanguageDetector",
+    "EntityDetector",
+    "KeyPhraseExtractor",
+    "AnalyzeImage",
+    "OCR",
+    "RecognizeDomainSpecificContent",
+    "GenerateThumbnails",
+    "TagImage",
+    "DescribeImage",
+    "DetectFace",
+    "VerifyFaces",
+    "IdentifyFaces",
+    "GroupFaces",
+    "FindSimilarFace",
+    "DetectAnomalies",
+    "DetectLastAnomaly",
+    "SpeechToText",
+    "BingImageSearch",
+    "AzureSearchWriter",
+]

@@ -1,0 +1,154 @@
+"""Cognitive-service transformer base + ServiceParam.
+
+CognitiveServicesBase analogue (cognitive/CognitiveServiceBase.scala:
+258-330). A subclass declares ServiceParams and implements
+``_build_request(vals) -> request dict | None`` (None rows are skipped —
+the reference's ``shouldSkip``); the base transform resolves every
+ServiceParam per row (literal or column), fans requests out with the io
+layer's retrying handler, and parses JSON into the output column with
+non-2xx responses in the error column.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _futures
+import json
+from typing import Any, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import HasOutputCol, Param
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.io.clients import AdvancedHandler, BasicHandler
+from mmlspark_tpu.io.http_schema import response_to_json
+
+
+class ServiceParam(Param):
+    """Value-or-column param (HasServiceParams, CognitiveServiceBase.scala:
+    29-150): holds either ``{"value": v}`` or ``{"col": name}``; resolved
+    per row at transform time."""
+
+    def validate(self, value: Any) -> Any:
+        if isinstance(value, dict) and set(value) in ({"value"}, {"col"}):
+            return value
+        return {"value": super().validate(value)}
+
+
+class _HasServiceParams:
+    def set_col(self, name: str, col: str) -> "CognitiveServiceBase":
+        """Bind ServiceParam ``name`` to a column instead of a literal."""
+        p = self.param(name)
+        if not isinstance(p, ServiceParam):
+            raise TypeError(f"{name} is not a ServiceParam")
+        self._paramMap[name] = {"col": col}
+        return self
+
+    def _resolve(self, name: str, row_vals: dict) -> Any:
+        v = self.get(name)
+        if isinstance(v, dict) and "col" in v:
+            return row_vals.get(v["col"])
+        if isinstance(v, dict) and "value" in v:
+            return v["value"]
+        return v
+
+    def _service_cols(self) -> list:
+        cols = []
+        for pname in self.params():
+            v = self.get(pname)
+            if isinstance(v, dict) and "col" in v:
+                cols.append(v["col"])
+        return cols
+
+
+class CognitiveServiceBase(Transformer, _HasServiceParams, HasOutputCol):
+    url = Param("service endpoint URL", type_=str)
+    subscription_key = ServiceParam("api key sent as Ocp-Apim-Subscription-Key")
+    error_col = Param("column for failed responses", default="", type_=str)
+    concurrency = Param("max in-flight requests per partition", default=8, type_=int)
+    timeout = Param("per-request timeout seconds", default=60.0, type_=float)
+    backoffs_ms = Param("retry backoff schedule (ms)", default=[100, 500, 1000], type_=list)
+    use_advanced_handler = Param("retry 429/5xx with backoff", default=True, type_=bool)
+
+    # -- subclass surface ----------------------------------------------------
+
+    # subclasses returning non-JSON payloads (e.g. thumbnail bytes) set this
+    _binary_response = False
+
+    def _build_request(self, vals: dict) -> Optional[dict]:
+        """Row-resolved ServiceParam values -> request dict (None = skip)."""
+        raise NotImplementedError
+
+    def _project_response(self, obj: Any) -> Any:
+        """Parsed JSON -> output value; default identity."""
+        return obj
+
+    # -- shared helpers ------------------------------------------------------
+
+    def _headers(self, vals: dict, content_type: str = "application/json") -> dict:
+        headers = {"Content-Type": content_type}
+        key = self._resolve("subscription_key", vals)
+        if key:
+            headers["Ocp-Apim-Subscription-Key"] = key
+        return headers
+
+    def _post_json(self, vals: dict, body: Any, path: str = "", query: str = "") -> dict:
+        from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+        url = self.get_or_fail("url").rstrip("/") + path + (f"?{query}" if query else "")
+        return HTTPRequestData(url, "POST", self._headers(vals), json.dumps(body))
+
+    # -- transform -----------------------------------------------------------
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        out_col = self.get_or_fail("output_col")
+        err_col = self.get("error_col") or f"{out_col}_error"
+        handler_fn = (
+            AdvancedHandler(backoffs_ms=self.get("backoffs_ms"), timeout=self.get("timeout"))
+            if self.get("use_advanced_handler")
+            else BasicHandler(timeout=self.get("timeout"))
+        )
+        concurrency = self.get("concurrency")
+        param_names = list(self.params())
+
+        def fn(p: dict) -> dict:
+            n = len(next(iter(p.values()))) if p else 0
+            reqs = []
+            for i in range(n):
+                row_vals = {k: v[i] for k, v in p.items()}
+                vals = {
+                    name: self._resolve(name, row_vals) for name in param_names
+                }
+                reqs.append(self._build_request(vals))
+            resps: list = [None] * n
+            live = [(i, r) for i, r in enumerate(reqs) if r is not None]
+            if live:
+                with _futures.ThreadPoolExecutor(max_workers=concurrency) as pool:
+                    results = pool.map(lambda ir: (ir[0], handler_fn(ir[1])), live)
+                    for i, resp in results:
+                        resps[i] = resp
+            outs = np.empty(n, dtype=object)
+            errs = np.empty(n, dtype=object)
+            for i, resp in enumerate(resps):
+                if resp is None:
+                    continue
+                if resp["status_code"] // 100 == 2:
+                    try:
+                        outs[i] = (
+                            resp["entity"]
+                            if self._binary_response
+                            else self._project_response(response_to_json(resp))
+                        )
+                    except (ValueError, KeyError, TypeError) as e:
+                        errs[i] = {"status_code": resp["status_code"],
+                                   "reason": f"parse error: {e}"}
+                else:
+                    errs[i] = {"status_code": resp["status_code"],
+                               "reason": resp["reason"],
+                               "entity": resp["entity"]}
+            q = dict(p)
+            q[out_col] = outs
+            q[err_col] = errs
+            return q
+
+        return df.map_partitions(fn)

@@ -1,0 +1,38 @@
+"""Speech-to-text transformer (cognitive/SpeechToText.scala analogue).
+
+Wire format: Speech REST v1 — POST raw audio bytes (wav) with language in
+the query; response JSON carries ``DisplayText``/``RecognitionStatus``.
+(The reference's continuous Speech-SDK variant, SpeechToTextSDK.scala, is a
+streaming session against the same service; the REST form covers the
+capability offline.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
+from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+
+class SpeechToText(CognitiveServiceBase):
+    audio_data = ServiceParam("raw audio bytes (value or column)")
+    language = ServiceParam("recognition language", default={"value": "en-US"})
+    format = ServiceParam("'simple' or 'detailed'", default={"value": "simple"})
+    profanity = ServiceParam("masked|removed|raw", default={"value": "masked"})
+
+    def _build_request(self, vals: dict) -> Optional[dict]:
+        audio = vals.get("audio_data")
+        if audio is None:
+            return None
+        query = (
+            f"language={vals.get('language') or 'en-US'}"
+            f"&format={vals.get('format') or 'simple'}"
+            f"&profanity={vals.get('profanity') or 'masked'}"
+        )
+        url = (
+            self.get_or_fail("url").rstrip("/")
+            + "/speech/recognition/conversation/cognitiveservices/v1?" + query
+        )
+        headers = self._headers(vals, content_type="audio/wav; codecs=audio/pcm")
+        return HTTPRequestData(url, "POST", headers, bytes(audio))

@@ -1,0 +1,66 @@
+"""Text-analytics transformers (cognitive/TextAnalytics.scala analogue).
+
+Wire format: Text Analytics v3 — POST ``{"documents": [{"id", "language",
+"text"}]}``; response ``{"documents": [...], "errors": [...]}``. One
+document per row; the projected output is the row's document object.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
+
+
+class _TextAnalyticsBase(CognitiveServiceBase):
+    text = ServiceParam("input text (value or column)")
+    language = ServiceParam("ISO language hint", default={"value": "en"})
+
+    _path = ""
+
+    def _build_request(self, vals: dict) -> Optional[dict]:
+        text = vals.get("text")
+        if text is None:
+            return None
+        body = {
+            "documents": [
+                {"id": "0", "language": vals.get("language") or "en", "text": str(text)}
+            ]
+        }
+        return self._post_json(vals, body, path=self._path)
+
+    def _project_response(self, obj: Any) -> Any:
+        docs = (obj or {}).get("documents") or []
+        return docs[0] if docs else None
+
+
+class TextSentiment(_TextAnalyticsBase):
+    """Sentiment per document (TextSentiment.scala; /sentiment)."""
+
+    _path = "/text/analytics/v3.0/sentiment"
+
+
+class LanguageDetector(_TextAnalyticsBase):
+    """Detected language (LanguageDetector; /languages). The v3 wire format
+    nests text only, no language hint."""
+
+    _path = "/text/analytics/v3.0/languages"
+
+    def _build_request(self, vals: dict) -> Optional[dict]:
+        text = vals.get("text")
+        if text is None:
+            return None
+        body = {"documents": [{"id": "0", "text": str(text)}]}
+        return self._post_json(vals, body, path=self._path)
+
+
+class EntityDetector(_TextAnalyticsBase):
+    """Named-entity recognition (EntityDetector; /entities/recognition/general)."""
+
+    _path = "/text/analytics/v3.0/entities/recognition/general"
+
+
+class KeyPhraseExtractor(_TextAnalyticsBase):
+    """Key-phrase extraction (KeyPhraseExtractor; /keyPhrases)."""
+
+    _path = "/text/analytics/v3.0/keyPhrases"

@@ -1,0 +1,126 @@
+"""Computer-vision transformers (cognitive/ComputerVision.scala analogue).
+
+Wire format: Computer Vision v2 — POST an image by URL (JSON ``{"url"}``)
+or raw bytes (``application/octet-stream``), feature selection via query
+string.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
+from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+
+class _VisionBase(CognitiveServiceBase):
+    image_url = ServiceParam("image URL (value or column)")
+    image_bytes = ServiceParam("raw image bytes (value or column)")
+
+    _path = ""
+
+    def _query(self, vals: dict) -> str:
+        return ""
+
+    def _image_request(self, vals: dict, path: Optional[str] = None) -> Optional[dict]:
+        query = self._query(vals)
+        url = self.get_or_fail("url").rstrip("/") + (
+            self._path if path is None else path
+        ) + (f"?{query}" if query else "")
+        data = vals.get("image_bytes")
+        if data is not None:
+            return HTTPRequestData(
+                url, "POST",
+                self._headers(vals, content_type="application/octet-stream"),
+                bytes(data),
+            )
+        img_url = vals.get("image_url")
+        if img_url is None:
+            return None
+        return HTTPRequestData(
+            url, "POST", self._headers(vals), json.dumps({"url": str(img_url)})
+        )
+
+    def _build_request(self, vals: dict) -> Optional[dict]:
+        return self._image_request(vals)
+
+
+class AnalyzeImage(_VisionBase):
+    """Tags/categories/description/faces for an image (AnalyzeImage;
+    /vision/v2.0/analyze)."""
+
+    _path = "/vision/v2.0/analyze"
+    visual_features = ServiceParam(
+        "features to compute", default={"value": ["Categories", "Tags", "Description"]}
+    )
+    details = ServiceParam("detail domains (Celebrities/Landmarks)")
+    language = ServiceParam("response language", default={"value": "en"})
+
+    def _query(self, vals: dict) -> str:
+        parts = []
+        if vals.get("visual_features"):
+            parts.append("visualFeatures=" + ",".join(vals["visual_features"]))
+        if vals.get("details"):
+            parts.append("details=" + ",".join(vals["details"]))
+        parts.append("language=" + (vals.get("language") or "en"))
+        return "&".join(parts)
+
+
+class OCR(_VisionBase):
+    """Printed-text OCR (OCR.scala; /vision/v2.0/ocr)."""
+
+    _path = "/vision/v2.0/ocr"
+    detect_orientation = ServiceParam("detect text orientation", default={"value": True})
+    language = ServiceParam("BCP-47 language", default={"value": "unk"})
+
+    def _query(self, vals: dict) -> str:
+        return (
+            f"language={vals.get('language') or 'unk'}"
+            f"&detectOrientation={str(bool(vals.get('detect_orientation'))).lower()}"
+        )
+
+
+class RecognizeDomainSpecificContent(_VisionBase):
+    """Domain model analysis (celebrities/landmarks)
+    (RecognizeDomainSpecificContent; /vision/v2.0/models/{model}/analyze)."""
+
+    model = ServiceParam("domain model name", default={"value": "celebrities"})
+
+    def _build_request(self, vals: dict) -> Optional[dict]:
+        return self._image_request(
+            vals, path=f"/vision/v2.0/models/{vals.get('model')}/analyze"
+        )
+
+
+class GenerateThumbnails(_VisionBase):
+    """Smart-cropped thumbnail bytes (GenerateThumbnails;
+    /vision/v2.0/generateThumbnail)."""
+
+    _path = "/vision/v2.0/generateThumbnail"
+    _binary_response = True
+    width = ServiceParam("thumbnail width", default={"value": 64})
+    height = ServiceParam("thumbnail height", default={"value": 64})
+    smart_cropping = ServiceParam("smart cropping", default={"value": True})
+
+    def _query(self, vals: dict) -> str:
+        return (
+            f"width={int(vals.get('width') or 64)}&height={int(vals.get('height') or 64)}"
+            f"&smartCropping={str(bool(vals.get('smart_cropping'))).lower()}"
+        )
+
+
+class TagImage(_VisionBase):
+    """Image tags (TagImage; /vision/v2.0/tag)."""
+
+    _path = "/vision/v2.0/tag"
+
+
+class DescribeImage(_VisionBase):
+    """Natural-language captions (DescribeImage; /vision/v2.0/describe)."""
+
+    _path = "/vision/v2.0/describe"
+    max_candidates = ServiceParam("number of caption candidates", default={"value": 1})
+
+    def _query(self, vals: dict) -> str:
+        return f"maxCandidates={int(vals.get('max_candidates') or 1)}"

@@ -67,7 +67,9 @@ def AdvancedHandler(
                 # the schedule for dates rather than parsing them. Clamp so a
                 # hostile/buggy server can't park a partition thread for hours.
                 delay = float(retry_after) if retry_after else backoff / 1000.0
-                delay = min(delay, max(30.0, backoff / 1000.0))
+                delay = min(max(delay, 0.0), max(30.0, backoff / 1000.0))
+                if delay != delay:  # NaN
+                    delay = backoff / 1000.0
             except ValueError:
                 delay = backoff / 1000.0
             sleep(delay)

@@ -2,9 +2,9 @@
 
 Continuous mode mirrors the reference's ContinuousReader path
 (HTTPSourceV2.scala:52-69, 693-706): a dispatcher thread drains whatever is
-queued (bounded by ``max_batch_size``, waiting at most ``max_wait_ms`` for
-the first request), runs the handler, and replies immediately — latency is
-ingress + one XLA call. Micro-batch mode advances an epoch on a timer and
+queued (bounded by ``max_batch_size``; ``max_wait_ms`` optionally holds the
+batch open for stragglers — 0 dispatches immediately), runs the handler,
+and replies — latency is ingress + one XLA call. Micro-batch mode advances an epoch on a timer and
 processes whole epochs (getBatch/addBatch semantics), committing each after
 its replies are sent.
 
@@ -37,7 +37,7 @@ class ServingQuery:
         handler: Handler,
         mode: str = "continuous",
         max_batch_size: int = 64,
-        max_wait_ms: float = 2.0,
+        max_wait_ms: float = 0.0,
         epoch_interval_ms: float = 100.0,
     ):
         if mode not in ("continuous", "microbatch"):
@@ -97,8 +97,13 @@ class ServingQuery:
                     self._process(chunk)  # honor max_batch_size per XLA call
                 self.server.commit(epoch)
             else:
+                # idle wait is long (bounds stop() responsiveness only —
+                # enqueue notifies the condition, so arrival latency doesn't
+                # depend on it); max_wait_ms governs batch accumulation once
+                # the first request is in
                 reqs = self.server.get_next_batch(
-                    self.max_batch_size, timeout_s=self.max_wait_ms / 1000.0
+                    self.max_batch_size, timeout_s=0.25,
+                    accumulate_s=self.max_wait_ms / 1000.0,
                 )
                 if not reqs:
                     continue
@@ -161,7 +166,7 @@ def serve_transformer(
     api_path: str = "/",
     mode: str = "continuous",
     max_batch_size: int = 64,
-    max_wait_ms: float = 2.0,
+    max_wait_ms: float = 0.0,
     epoch_interval_ms: float = 100.0,
     name: str = "serving",
 ) -> ServingQuery:

@@ -30,10 +30,21 @@ class DriverRegistry:
                 pass
 
             def do_POST(self):
-                n = int(self.headers.get("Content-Length") or 0)
-                info = json.loads(self.rfile.read(n))
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    info = json.loads(self.rfile.read(n))
+                    name = info["name"]
+                except (ValueError, KeyError, TypeError):
+                    self.send_response(400)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 with registry._lock:
-                    registry._services.setdefault(info["name"], []).append(info)
+                    # re-registration replaces the same host (a restarted
+                    # worker's stale port must not linger in the roster)
+                    entries = registry._services.setdefault(name, [])
+                    entries[:] = [e for e in entries if e.get("host") != info.get("host")]
+                    entries.append(info)
                 body = b'{"registered": true}'
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))

@@ -150,7 +150,14 @@ class WorkerServer:
                         break
                     k, _, v = h.decode("latin1").partition(":")
                     headers[k.strip().lower()] = v.strip()
-                n = int(headers.get("content-length") or 0)
+                try:
+                    n = int(headers.get("content-length") or 0)
+                except ValueError:
+                    self._write_response(writer, 400, b"bad Content-Length", False)
+                    return
+                if n < 0:
+                    self._write_response(writer, 400, b"bad Content-Length", False)
+                    return
                 body = await reader.readexactly(n) if n else b""
                 keep = headers.get("connection", "keep-alive").lower() != "close"
                 prefix = self.api_path.rstrip("/")
@@ -215,10 +222,13 @@ class WorkerServer:
     # -- consumption (dispatcher thread) --------------------------------------
 
     def get_next_batch(
-        self, max_n: int, timeout_s: float = 0.1, min_n: int = 1
+        self, max_n: int, timeout_s: float = 0.1, min_n: int = 1,
+        accumulate_s: float = 0.0,
     ) -> list:
         """Pop up to ``max_n`` queued requests; blocks up to ``timeout_s``
-        for the first ``min_n`` (getNextRequest analogue, :588-623)."""
+        for the first ``min_n`` (getNextRequest analogue, :588-623).
+        ``accumulate_s > 0`` then waits that long for more arrivals (batch
+        accumulation window) unless ``max_n`` is already reached."""
         deadline = time.monotonic() + timeout_s
         with self._not_empty:
             while len(self._queue) < min_n:
@@ -226,6 +236,13 @@ class WorkerServer:
                 if remaining <= 0:
                     break
                 self._not_empty.wait(remaining)
+            if self._queue and accumulate_s > 0:
+                acc_deadline = time.monotonic() + accumulate_s
+                while len(self._queue) < max_n:
+                    remaining = acc_deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._not_empty.wait(remaining)
             out = []
             while self._queue and len(out) < max_n:
                 out.append(self._queue.popleft())

@@ -1,0 +1,284 @@
+"""Cognitive-service transformers against a mock localhost service that
+speaks the Azure wire formats (the catalog is the capability; no cloud)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.cognitive import (
+    AnalyzeImage,
+    AzureSearchWriter,
+    BingImageSearch,
+    DetectAnomalies,
+    DetectFace,
+    DetectLastAnomaly,
+    GenerateThumbnails,
+    KeyPhraseExtractor,
+    LanguageDetector,
+    OCR,
+    SpeechToText,
+    TextSentiment,
+    VerifyFaces,
+)
+
+
+class _Mock(BaseHTTPRequestHandler):
+    log = []
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, body, ctype="application/json"):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if "/images/search" in self.path:
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(self.path).query)["q"][0]
+            self._send(200, {"value": [{"name": f"{q}-img", "contentUrl": "http://x"}]})
+        else:
+            self._send(404, {})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n)
+        type(self).log.append((self.path, dict(self.headers), raw))
+        if self.headers.get("Ocp-Apim-Subscription-Key") == "bad-key":
+            self._send(401, {"error": "bad key"})
+            return
+        path = self.path.split("?")[0]
+        if path.endswith("/sentiment"):
+            doc = json.loads(raw)["documents"][0]
+            sent = "positive" if "good" in doc["text"] else "negative"
+            self._send(200, {"documents": [{"id": "0", "sentiment": sent}], "errors": []})
+        elif path.endswith("/languages"):
+            self._send(200, {"documents": [
+                {"id": "0", "detectedLanguage": {"iso6391Name": "en"}}], "errors": []})
+        elif path.endswith("/keyPhrases"):
+            self._send(200, {"documents": [
+                {"id": "0", "keyPhrases": ["tpu", "framework"]}], "errors": []})
+        elif path.endswith("/analyze"):
+            self._send(200, {"tags": [{"name": "cat", "confidence": 0.9}],
+                             "description": {"captions": []}})
+        elif path.endswith("/ocr"):
+            self._send(200, {"language": "en", "regions": [
+                {"lines": [{"words": [{"text": "HELLO"}]}]}]})
+        elif path.endswith("/generateThumbnail"):
+            self._send(200, b"\x89PNGthumbnail", ctype="application/octet-stream")
+        elif path.endswith("/detect"):
+            if "timeseries" in path:
+                body = json.loads(raw)
+                k = len(body["series"])
+                if "last" in path:
+                    self._send(200, {"isAnomaly": True, "expectedValue": 1.0})
+                else:
+                    self._send(200, {"isAnomaly": [False] * (k - 1) + [True]})
+            else:  # face detect
+                self._send(200, [{"faceId": "f-1",
+                                  "faceRectangle": {"top": 1, "left": 2}}])
+        elif path.endswith("/general"):
+            self._send(200, {"documents": [
+                {"id": "0", "entities": [{"text": "TPU", "category": "Product"}]}],
+                "errors": []})
+        elif path.endswith("/tag"):
+            self._send(200, {"tags": [{"name": "chip", "confidence": 0.8}]})
+        elif path.endswith("/describe"):
+            self._send(200, {"description": {"captions": [{"text": "a tpu"}]}})
+        elif path.endswith("/identify"):
+            self._send(200, [{"faceId": "f-1", "candidates": [
+                {"personId": "p-9", "confidence": 0.95}]}])
+        elif path.endswith("/group"):
+            self._send(200, {"groups": [["f-1", "f-2"]], "messyGroup": []})
+        elif path.endswith("/findsimilars"):
+            self._send(200, [{"faceId": "f-2", "confidence": 0.7}])
+        elif path.endswith("/verify"):
+            body = json.loads(raw)
+            same = body["faceId1"] == body["faceId2"]
+            self._send(200, {"isIdentical": same, "confidence": 1.0 if same else 0.1})
+        elif path.endswith("/v1") or "recognition" in path:
+            self._send(200, {"RecognitionStatus": "Success", "DisplayText": "hello world"})
+        elif path.endswith("/docs/index"):
+            docs = json.loads(raw)["value"]
+            self._send(200, {"value": [
+                {"key": str(i), "status": True} for i in range(len(docs))]})
+        else:
+            self._send(404, {"error": "unknown path " + self.path})
+
+
+@pytest.fixture(scope="module")
+def svc():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Mock)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def _texts():
+    return DataFrame.from_dict(
+        {"text": np.array(["good day", "awful day"], dtype=object)}, num_partitions=2
+    )
+
+
+def test_text_sentiment_column(svc):
+    t = TextSentiment(url=svc, output_col="sentiment").set_col("text", "text")
+    out = t.transform(_texts())
+    assert [o["sentiment"] for o in out["sentiment"]] == ["positive", "negative"]
+    assert all(e is None for e in out["sentiment_error"])
+
+
+def test_text_sentiment_literal_and_key(svc):
+    t = TextSentiment(url=svc, output_col="s", subscription_key="k-123").set(
+        text="good stuff"
+    )
+    out = t.transform(DataFrame.from_dict({"i": [1, 2, 3]}))
+    assert [o["sentiment"] for o in out["s"]] == ["positive"] * 3
+    # key rode the header
+    assert any(
+        h.get("Ocp-Apim-Subscription-Key") == "k-123" for _, h, _ in _Mock.log
+    )
+
+
+def test_bad_key_goes_to_error_col(svc):
+    t = TextSentiment(
+        url=svc, output_col="s", subscription_key="bad-key",
+        use_advanced_handler=False,
+    ).set_col("text", "text")
+    out = t.transform(_texts())
+    assert all(o is None for o in out["s"])
+    assert all(e["status_code"] == 401 for e in out["s_error"])
+
+
+def test_none_rows_skipped(svc):
+    df = DataFrame.from_dict({"text": np.array(["good", None], dtype=object)})
+    out = TextSentiment(url=svc, output_col="s").set_col("text", "text").transform(df)
+    assert out["s"][0] is not None and out["s"][1] is None
+    assert out["s_error"][1] is None  # skipped, not errored
+
+
+def test_language_and_keyphrases(svc):
+    df = _texts()
+    lang = LanguageDetector(url=svc, output_col="lang").set_col("text", "text").transform(df)
+    assert lang["lang"][0]["detectedLanguage"]["iso6391Name"] == "en"
+    kp = KeyPhraseExtractor(url=svc, output_col="kp").set_col("text", "text").transform(df)
+    assert kp["kp"][0]["keyPhrases"] == ["tpu", "framework"]
+
+
+def test_analyze_image_and_ocr(svc):
+    df = DataFrame.from_dict(
+        {"url": np.array(["http://img/1.jpg"], dtype=object)}
+    )
+    ai = AnalyzeImage(url=svc, output_col="a").set_col("image_url", "url").transform(df)
+    assert ai["a"][0]["tags"][0]["name"] == "cat"
+    ocr = OCR(url=svc, output_col="o").set_col("image_url", "url").transform(df)
+    assert ocr["o"][0]["regions"][0]["lines"][0]["words"][0]["text"] == "HELLO"
+    # bytes path
+    bdf = DataFrame.from_dict({"img": np.array([b"rawjpegbytes"], dtype=object)})
+    ai2 = AnalyzeImage(url=svc, output_col="a").set_col("image_bytes", "img").transform(bdf)
+    assert ai2["a"][0]["tags"][0]["name"] == "cat"
+
+
+def test_thumbnail_binary(svc):
+    df = DataFrame.from_dict({"url": np.array(["http://img/1.jpg"], dtype=object)})
+    th = GenerateThumbnails(
+        url=svc, output_col="t", width=32, height=32
+    ).set_col("image_url", "url").transform(df)
+    assert th["t"][0].startswith(b"\x89PNG")
+
+
+def test_face_detect_and_verify(svc):
+    df = DataFrame.from_dict({"url": np.array(["http://img/f.jpg"], dtype=object)})
+    det = DetectFace(url=svc, output_col="faces").set_col("image_url", "url").transform(df)
+    assert det["faces"][0][0]["faceId"] == "f-1"
+    vdf = DataFrame.from_dict(
+        {"a": np.array(["f-1", "f-1"], dtype=object),
+         "b": np.array(["f-1", "f-2"], dtype=object)}
+    )
+    ver = VerifyFaces(url=svc, output_col="v").set_col("face_id1", "a").set_col(
+        "face_id2", "b"
+    ).transform(vdf)
+    assert [v["isIdentical"] for v in ver["v"]] == [True, False]
+
+
+def test_entities_tags_describe_domain(svc):
+    from mmlspark_tpu.cognitive import (
+        DescribeImage,
+        EntityDetector,
+        RecognizeDomainSpecificContent,
+        TagImage,
+    )
+
+    df = _texts()
+    ent = EntityDetector(url=svc, output_col="e").set_col("text", "text").transform(df)
+    assert ent["e"][0]["entities"][0]["category"] == "Product"
+    idf = DataFrame.from_dict({"url": np.array(["http://img/1.jpg"], dtype=object)})
+    tags = TagImage(url=svc, output_col="t").set_col("image_url", "url").transform(idf)
+    assert tags["t"][0]["tags"][0]["name"] == "chip"
+    desc = DescribeImage(url=svc, output_col="d").set_col("image_url", "url").transform(idf)
+    assert desc["d"][0]["description"]["captions"][0]["text"] == "a tpu"
+    dom = RecognizeDomainSpecificContent(url=svc, output_col="c").set_col(
+        "image_url", "url"
+    ).transform(idf)
+    assert dom["c"][0] is not None
+
+
+def test_identify_group_findsimilar(svc):
+    from mmlspark_tpu.cognitive import FindSimilarFace, GroupFaces, IdentifyFaces
+
+    ids = np.empty(1, dtype=object)
+    ids[0] = ["f-1", "f-2"]
+    df = DataFrame.from_dict({"ids": ids, "fid": np.array(["f-1"], dtype=object)})
+    ident = IdentifyFaces(url=svc, output_col="p", person_group_id="g").set_col(
+        "face_ids", "ids"
+    ).transform(df)
+    assert ident["p"][0][0]["candidates"][0]["personId"] == "p-9"
+    grp = GroupFaces(url=svc, output_col="g").set_col("face_ids", "ids").transform(df)
+    assert grp["g"][0]["groups"] == [["f-1", "f-2"]]
+    sim = FindSimilarFace(url=svc, output_col="s").set_col("face_id", "fid").set_col(
+        "face_ids", "ids"
+    ).transform(df)
+    assert sim["s"][0][0]["faceId"] == "f-2"
+
+
+def test_anomaly_detection(svc):
+    series = [{"timestamp": f"2026-01-0{i+1}T00:00:00Z", "value": float(i)} for i in range(4)]
+    col = np.empty(1, dtype=object)
+    col[0] = series
+    df = DataFrame.from_dict({"series": col})
+    last = DetectLastAnomaly(url=svc, output_col="la").set_col("series", "series").transform(df)
+    assert last["la"][0]["isAnomaly"] is True
+    ent = DetectAnomalies(url=svc, output_col="ea").set_col("series", "series").transform(df)
+    assert ent["ea"][0]["isAnomaly"] == [False, False, False, True]
+
+
+def test_speech_to_text(svc):
+    df = DataFrame.from_dict({"audio": np.array([b"RIFFfakewav"], dtype=object)})
+    out = SpeechToText(url=svc, output_col="txt").set_col("audio_data", "audio").transform(df)
+    assert out["txt"][0]["DisplayText"] == "hello world"
+
+
+def test_bing_image_search(svc):
+    df = DataFrame.from_dict({"q": np.array(["tpu chip"], dtype=object)})
+    out = BingImageSearch(url=svc, output_col="imgs").set_col("query", "q").transform(df)
+    assert out["imgs"][0][0]["name"] == "tpu chip-img"
+
+
+def test_azure_search_writer(svc):
+    df = DataFrame.from_dict({"id": ["1", "2"], "score": [0.5, 0.9]})
+    resps = AzureSearchWriter.write(df, svc, "myindex", key="k", batch_size=10)
+    assert len(resps) == 1
+    sent = json.loads(_Mock.log[-1][2])
+    assert sent["value"][0]["@search.action"] == "upload"
+    assert {d["id"] for d in sent["value"]} == {"1", "2"}

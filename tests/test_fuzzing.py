@@ -237,6 +237,46 @@ def make_test_objects() -> list:
         TestObject(IO.PartitionConsolidator(num_workers=1), df),
     ]
 
+    # cognitive stages: fuzz offline against an unreachable endpoint (rows
+    # land deterministically in the error column; live-wire coverage is in
+    # test_cognitive.py)
+    from mmlspark_tpu import cognitive as C
+
+    dead = "http://127.0.0.1:9"
+    no_retry = {"use_advanced_handler": False}
+    tiny = DataFrame.from_dict(
+        {"text": np.array(["alpha"], dtype=object),
+         "url": np.array(["http://img/x.jpg"], dtype=object),
+         "blob": np.array([b"bytes"], dtype=object)}
+    )
+    ids_df_col = np.empty(1, dtype=object)
+    ids_df_col[0] = ["f-1", "f-2"]
+    series_col = np.empty(1, dtype=object)
+    series_col[0] = [{"timestamp": "2026-01-01T00:00:00Z", "value": 1.0}]
+    tiny = tiny.with_column("ids", ids_df_col).with_column("series", series_col)
+    cog_stages = [
+        C.TextSentiment(url=dead, output_col="o", **no_retry).set_col("text", "text"),
+        C.LanguageDetector(url=dead, output_col="o", **no_retry).set_col("text", "text"),
+        C.EntityDetector(url=dead, output_col="o", **no_retry).set_col("text", "text"),
+        C.KeyPhraseExtractor(url=dead, output_col="o", **no_retry).set_col("text", "text"),
+        C.AnalyzeImage(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
+        C.OCR(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
+        C.RecognizeDomainSpecificContent(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
+        C.GenerateThumbnails(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
+        C.TagImage(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
+        C.DescribeImage(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
+        C.DetectFace(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
+        C.VerifyFaces(url=dead, output_col="o", face_id1="a", face_id2="b", **no_retry),
+        C.IdentifyFaces(url=dead, output_col="o", person_group_id="g", **no_retry).set_col("face_ids", "ids"),
+        C.GroupFaces(url=dead, output_col="o", **no_retry).set_col("face_ids", "ids"),
+        C.FindSimilarFace(url=dead, output_col="o", face_id="f-1", **no_retry).set_col("face_ids", "ids"),
+        C.DetectAnomalies(url=dead, output_col="o", **no_retry).set_col("series", "series"),
+        C.DetectLastAnomaly(url=dead, output_col="o", **no_retry).set_col("series", "series"),
+        C.SpeechToText(url=dead, output_col="o", **no_retry).set_col("audio_data", "blob"),
+        C.BingImageSearch(url=dead, output_col="o", **no_retry).set_col("query", "text"),
+    ]
+    objs += [TestObject(s, tiny) for s in cog_stages]
+
     qid_df = lin_df.with_column("query", np.arange(20) // 4)
     objs += [
         TestObject(
@@ -296,7 +336,7 @@ def test_pipeline_serialization_fuzzing(obj, tmp_path):
 # own test modules).
 EXCLUDED = {
     # abstract/base-ish
-    "Pipeline", "PipelineModel", "HasMiniBatcher",
+    "Pipeline", "PipelineModel", "HasMiniBatcher", "CognitiveServiceBase",
     # covered by dedicated suites with model/zoo setup
     "XLAModel", "ImageFeaturizer",
     # network-bound: fuzzed against a live localhost server in test_io.py
