@@ -1,0 +1,22 @@
+"""Nearest-neighbor search (reference: nn/, SURVEY.md §2.13).
+
+TPU-first design: the hot query path is a brute-force max-inner-product
+matmul + ``lax.top_k`` on device (the MXU eats the (B, N) score matrix the
+reference's JVM ball tree walks pointer-by-pointer). A serializable host
+:class:`BallTree` / :class:`ConditionalBallTree` is kept for exact parity
+with the reference's data structure (BallTree.scala:32-99) and for hosts
+without an accelerator.
+"""
+
+from mmlspark_tpu.nn.balltree import BallTree, BestMatch, ConditionalBallTree
+from mmlspark_tpu.nn.knn import KNN, ConditionalKNN, ConditionalKNNModel, KNNModel
+
+__all__ = [
+    "BallTree",
+    "ConditionalBallTree",
+    "BestMatch",
+    "KNN",
+    "KNNModel",
+    "ConditionalKNN",
+    "ConditionalKNNModel",
+]

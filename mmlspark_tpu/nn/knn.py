@@ -1,0 +1,206 @@
+"""KNN / ConditionalKNN pipeline stages.
+
+Reference semantics (nn/KNN.scala, nn/ConditionalKNN.scala:68-102): ``fit``
+captures the dataset (features + payload values + labels); the model
+broadcasts the index and answers per-row top-k max-inner-product queries,
+emitting an array of ``{value, distance[, label]}`` structs.
+
+TPU-first: the index lives on device as one dense (N, d) matrix; a query
+batch is a single ``scores = Q @ X.T`` matmul (MXU) + ``lax.top_k``. The
+conditional variant masks scores with a per-row allowed-label mask before
+top_k — branchless, so the whole batch stays one compiled program. When N
+exceeds ``index_chunk_size`` the index is processed in chunks whose per-chunk
+top-k results are merged by a final top-k, bounding the live (B, N) score
+matrix in HBM. ``algorithm='balltree'`` falls back to the exact host tree
+(mmlspark_tpu.nn.balltree).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, HasFeaturesCol, HasLabelCol, HasOutputCol, Param
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.nn.balltree import BallTree, ConditionalBallTree
+
+_NEG_INF = np.float32(-np.inf)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _topk_scores(q: jnp.ndarray, x: jnp.ndarray, k: int) -> tuple:
+    scores = q @ x.T
+    return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _topk_scores_masked(q: jnp.ndarray, x: jnp.ndarray, mask: jnp.ndarray, k: int) -> tuple:
+    scores = jnp.where(mask, q @ x.T, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def _chunked_topk(
+    q: np.ndarray, x: np.ndarray, k: int, chunk: int, mask: Optional[np.ndarray] = None
+) -> tuple:
+    """Top-k over the index in chunks; merges chunk winners with a final
+    top-k so only (B, chunk) scores are ever live on device."""
+    qd = jnp.asarray(q)
+    all_sc, all_ix = [], []
+    for lo in range(0, len(x), chunk):
+        xc = jnp.asarray(x[lo : lo + chunk])
+        kc = min(k, len(x[lo : lo + chunk]))
+        if mask is None:
+            sc, ix = _topk_scores(qd, xc, kc)
+        else:
+            sc, ix = _topk_scores_masked(qd, xc, jnp.asarray(mask[:, lo : lo + chunk]), kc)
+        all_sc.append(np.asarray(sc))
+        all_ix.append(np.asarray(ix) + lo)
+    sc = np.concatenate(all_sc, axis=1)
+    ix = np.concatenate(all_ix, axis=1)
+    order = np.argsort(-sc, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(sc, order, 1), np.take_along_axis(ix, order, 1)
+
+
+class _KNNParams(HasFeaturesCol, HasOutputCol):
+    values_col = Param("payload column returned with each match", default="values")
+    k = Param("number of matches", default=5, type_=int, validator=lambda v: v > 0)
+    leaf_size = Param("ball tree leaf size (host algorithm)", default=50, type_=int)
+    index_chunk_size = Param(
+        "max index rows scored per device call (bounds HBM)", default=1 << 20, type_=int
+    )
+    algorithm = Param(
+        "'brute' = device matmul top-k; 'balltree' = exact host tree",
+        default="brute",
+        validator=lambda v: v in ("brute", "balltree"),
+    )
+
+    def __init__(self, **kw: Any):
+        super().__init__(**kw)
+        if "output_col" not in self._paramMap:
+            self.set(output_col="matches")
+
+
+class _HasConditionerCol(HasLabelCol):
+    conditioner_col = Param("column of per-row allowed-label collections", default="conditioner")
+
+
+class KNN(Estimator, _KNNParams):
+    """Fit = capture the index; see module docstring."""
+
+    def fit(self, df: DataFrame) -> "KNNModel":
+        feats = np.asarray(df[self.get("features_col")], np.float32)
+        values = df[self.get("values_col")] if self.get("values_col") in df.columns else None
+        m = KNNModel(**{k: v for k, v in self._paramMap.items()})
+        m.set(index_features=feats)
+        if values is not None:
+            m.set(index_values=np.asarray(values))
+        return m
+
+
+class KNNModel(Model, _KNNParams):
+    index_features = ComplexParam("(N, d) index matrix")
+    index_values = ComplexParam("(N,) payload values", default=None)
+
+    _tree_cache: Any = None  # (id(features), tree) — rebuilt if index changes
+
+    def _tree(self, conditional: bool = False) -> Any:
+        x = self.get_or_fail("index_features")
+        key = (id(x), conditional)
+        if self._tree_cache is None or self._tree_cache[0] != key:
+            if conditional:
+                tree = ConditionalBallTree(
+                    x, self.get_or_fail("index_labels"), self.get("leaf_size")
+                )
+            else:
+                tree = BallTree(x, self.get("leaf_size"))
+            self._tree_cache = (key, tree)
+        return self._tree_cache[1]
+
+    def _query(self, q: np.ndarray, k: int) -> tuple:
+        """Return (scores, indices) each (B, k)."""
+        x = self.get_or_fail("index_features")
+        k = min(k, len(x))
+        if len(q) == 0 or k == 0:
+            return np.zeros((len(q), 0), np.float32), np.zeros((len(q), 0), np.int64)
+        if self.get("algorithm") == "balltree":
+            tree = self._tree()
+            idx = np.zeros((len(q), k), np.int64)
+            sc = np.zeros((len(q), k), np.float32)
+            for i, row in enumerate(q):
+                ms = tree.find_maximum_inner_products(row, k)
+                idx[i] = [m.index for m in ms]
+                sc[i] = [m.distance for m in ms]
+            return sc, idx
+        return _chunked_topk(q, x, k, self.get("index_chunk_size"))
+
+    def _emit(self, df: DataFrame, scores: Any, indices: Any, labels: Any = None) -> DataFrame:
+        values = self.get("index_values")
+        out = np.empty(len(scores), dtype=object)
+        for i, (sc, ix) in enumerate(zip(scores, indices)):
+            row = []
+            for s, j in zip(sc, ix):
+                if not np.isfinite(s):
+                    continue  # masked-out candidate (conditional variant)
+                match = {"distance": float(s)}
+                if values is not None:
+                    match["value"] = values[j]
+                if labels is not None:
+                    match["label"] = labels[j]
+                row.append(match)
+            out[i] = row
+        return df.with_column(self.get("output_col"), out)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        q = np.asarray(df[self.get("features_col")], np.float32)
+        scores, indices = self._query(q, self.get("k"))
+        return self._emit(df, scores, indices)
+
+
+class ConditionalKNN(Estimator, _KNNParams, _HasConditionerCol):
+    """KNN whose queries restrict candidates to per-row allowed labels
+    (ConditionalKNN.scala:68-102)."""
+
+    def fit(self, df: DataFrame) -> "ConditionalKNNModel":
+        feats = np.asarray(df[self.get("features_col")], np.float32)
+        labels = np.asarray(df[self.get("label_col")])
+        m = ConditionalKNNModel(**{k: v for k, v in self._paramMap.items()})
+        m.set(index_features=feats, index_labels=labels)
+        if self.get("values_col") in df.columns:
+            m.set(index_values=np.asarray(df[self.get("values_col")]))
+        return m
+
+
+class ConditionalKNNModel(KNNModel, _HasConditionerCol):
+    index_labels = ComplexParam("(N,) index labels")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        q = np.asarray(df[self.get("features_col")], np.float32)
+        labels = self.get_or_fail("index_labels")
+        x = self.get_or_fail("index_features")
+        k = min(self.get("k"), len(x))
+        if len(q) == 0 or k == 0:
+            return self._emit(
+                df,
+                np.zeros((len(q), 0), np.float32),
+                np.zeros((len(q), 0), np.int64),
+                labels=labels,
+            )
+        conditioners = df[self.get("conditioner_col")]
+
+        if self.get("algorithm") == "balltree":
+            tree = self._tree(conditional=True)
+            scores = np.full((len(q), k), _NEG_INF, np.float32)
+            indices = np.zeros((len(q), k), np.int64)
+            for i, row in enumerate(q):
+                ms = tree.find_maximum_inner_products(row, k, conditioners[i])
+                for j, m in enumerate(ms):
+                    scores[i, j], indices[i, j] = m.distance, m.index
+        else:
+            mask = np.stack([np.isin(labels, np.asarray(list(c))) for c in conditioners])
+            scores, indices = _chunked_topk(q, x, k, self.get("index_chunk_size"), mask)
+        return self._emit(df, scores, indices, labels=labels)

@@ -293,6 +293,26 @@ def make_test_objects() -> list:
             qid_df,
         ),
     ]
+
+    from mmlspark_tpu.nn import KNN, ConditionalKNN
+
+    rng = np.random.RandomState(11)
+    knn_feats = rng.randn(12, 4).astype(np.float32)
+    conds = np.empty(12, dtype=object)
+    for i in range(12):
+        conds[i] = [i % 2]
+    knn_df = DataFrame.from_dict(
+        {
+            "features": knn_feats,
+            "values": np.arange(12),
+            "label": np.arange(12) % 2,
+            "conditioner": conds,
+        }
+    )
+    objs += [
+        TestObject(KNN(k=2), knn_df),
+        TestObject(ConditionalKNN(k=2, label_col="label"), knn_df),
+    ]
     return objs
 
 
@@ -350,6 +370,7 @@ EXCLUDED = {
     "LightGBMClassificationModel", "LightGBMRegressionModel", "LightGBMRankerModel",
     "VowpalWabbitClassificationModel", "VowpalWabbitRegressionModel",
     "VowpalWabbitContextualBanditModel",
+    "KNNModel", "ConditionalKNNModel",
     # test-local helper stages
     "AddOne", "MeanShift", "Holder", "Scale", "Center", "CenterModel", "T",
 }

@@ -1,0 +1,130 @@
+"""nn/ package tests: ball tree exactness + KNN/ConditionalKNN stages."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.nn import (
+    KNN,
+    BallTree,
+    ConditionalBallTree,
+    ConditionalKNN,
+)
+
+
+def _rand(n: int, d: int, seed: int = 0) -> np.ndarray:
+    return np.random.RandomState(seed).randn(n, d).astype(np.float32)
+
+
+class TestBallTree:
+    def test_matches_bruteforce(self):
+        x = _rand(500, 16)
+        tree = BallTree(x, leaf_size=10)
+        q = _rand(20, 16, seed=1)
+        for row in q:
+            got = tree.find_maximum_inner_products(row, k=7)
+            scores = x @ row
+            want = np.argsort(-scores)[:7]
+            assert [m.index for m in got] == list(want)
+            np.testing.assert_allclose(
+                [m.distance for m in got], scores[want], rtol=1e-5
+            )
+
+    def test_pickle_roundtrip(self):
+        import pickle
+
+        x = _rand(200, 8)
+        tree = BallTree(x, leaf_size=16)
+        tree2 = pickle.loads(pickle.dumps(tree))
+        q = _rand(1, 8, seed=3)[0]
+        a = tree.find_maximum_inner_products(q, 5)
+        b = tree2.find_maximum_inner_products(q, 5)
+        assert [m.index for m in a] == [m.index for m in b]
+
+    def test_conditional(self):
+        x = _rand(300, 8)
+        labels = np.arange(300) % 3
+        tree = ConditionalBallTree(x, labels, leaf_size=20)
+        q = _rand(1, 8, seed=2)[0]
+        got = tree.find_maximum_inner_products(q, k=5, conditioner=[1])
+        assert all(m.label == 1 for m in got)
+        scores = np.where(labels == 1, x @ q, -np.inf)
+        want = np.argsort(-scores)[:5]
+        assert [m.index for m in got] == list(want)
+
+    def test_empty_and_small(self):
+        assert BallTree(np.zeros((0, 4))).find_maximum_inner_products(np.ones(4), 3) == []
+        t = BallTree(_rand(2, 4))
+        assert len(t.find_maximum_inner_products(np.ones(4), 5)) == 2
+
+
+class TestKNNStages:
+    @pytest.mark.parametrize("algorithm", ["brute", "balltree"])
+    def test_knn(self, algorithm):
+        x = _rand(100, 8)
+        df = DataFrame.from_dict(
+            {"features": x, "values": np.array([f"v{i}" for i in range(100)])},
+            num_partitions=2,
+        )
+        model = KNN(k=3, algorithm=algorithm).fit(df)
+        qx = _rand(10, 8, seed=5)
+        out = model.transform(DataFrame.from_dict({"features": qx}))
+        matches = out["matches"]
+        assert len(matches) == 10
+        scores = qx @ x.T
+        for i, row in enumerate(matches):
+            assert len(row) == 3
+            want = np.argsort(-scores[i])[:3]
+            assert [m["value"] for m in row] == [f"v{j}" for j in want]
+            assert row[0]["distance"] >= row[1]["distance"] >= row[2]["distance"]
+
+    @pytest.mark.parametrize("algorithm", ["brute", "balltree"])
+    def test_conditional_knn(self, algorithm):
+        x = _rand(120, 8)
+        labels = np.arange(120) % 4
+        df = DataFrame.from_dict(
+            {
+                "features": x,
+                "values": np.arange(120),
+                "label": labels,
+            }
+        )
+        model = ConditionalKNN(k=4, algorithm=algorithm, label_col="label").fit(df)
+        qx = _rand(6, 8, seed=7)
+        conds = np.empty(6, dtype=object)
+        for i in range(6):
+            conds[i] = [i % 4]
+        out = model.transform(
+            DataFrame.from_dict({"features": qx, "conditioner": conds})
+        )
+        for i, row in enumerate(out["matches"]):
+            assert len(row) == 4
+            assert all(m["label"] == i % 4 for m in row)
+            scores = np.where(labels == i % 4, qx[i] @ x.T, -np.inf)
+            want = set(np.argsort(-scores)[:4])
+            assert {m["value"] for m in row} == want
+
+    def test_conditioner_excludes_everything(self):
+        x = _rand(20, 4)
+        df = DataFrame.from_dict({"features": x, "values": np.arange(20), "label": np.zeros(20)})
+        model = ConditionalKNN(k=3, label_col="label").fit(df)
+        conds = np.empty(1, dtype=object)
+        conds[0] = [99]  # no index rows carry this label
+        out = model.transform(DataFrame.from_dict({"features": x[:1], "conditioner": conds}))
+        assert out["matches"][0] == []
+
+    def test_save_load(self, tmp_path):
+        x = _rand(50, 4)
+        df = DataFrame.from_dict({"features": x, "values": np.arange(50)})
+        model = KNN(k=2).fit(df)
+        p = str(tmp_path / "knn")
+        model.save(p)
+        from mmlspark_tpu import load_stage
+
+        loaded = load_stage(p)
+        q = DataFrame.from_dict({"features": x[:5]})
+        a, b = model.transform(q)["matches"], loaded.transform(q)["matches"]
+        for ra, rb in zip(a, b):
+            assert [m["value"] for m in ra] == [m["value"] for m in rb]
