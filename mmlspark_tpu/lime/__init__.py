@@ -1,0 +1,25 @@
+"""Model interpretability — LIME (reference: lime/, SURVEY.md §2.13).
+
+The reference explains predictions by sampling perturbed inputs, scoring
+them with the model, and fitting a local sparse linear surrogate per row
+(LIME.scala:30-41, LassoUtils.lasso at BreezeUtils.scala:112). Here the
+whole local problem is device-resident: mask/sample generation, image
+censoring, and the lasso solve are jitted (the lasso is ISTA under
+``lax.scan``, vmappable over explanation rows); only the inner model call
+crosses back through the pipeline API.
+"""
+
+from mmlspark_tpu.lime.lasso import lasso, batched_lasso
+from mmlspark_tpu.lime.superpixel import Superpixel, SuperpixelTransformer, slic
+from mmlspark_tpu.lime.lime import ImageLIME, TabularLIME, TabularLIMEModel
+
+__all__ = [
+    "lasso",
+    "batched_lasso",
+    "slic",
+    "Superpixel",
+    "SuperpixelTransformer",
+    "TabularLIME",
+    "TabularLIMEModel",
+    "ImageLIME",
+]

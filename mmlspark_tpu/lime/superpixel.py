@@ -1,0 +1,126 @@
+"""SLIC superpixel clustering + transformer stage.
+
+Reference: ``Superpixel`` (lime/Superpixel.scala) does SLIC-style
+clustering in the JVM, one pixel-walk at a time; ``SuperpixelTransformer``
+attaches the clustering as a column. TPU version: fixed-iteration SLIC as
+one jitted program — grid-seeded centers, joint (position, color) distance,
+``segment_sum`` center updates — so every pixel-to-center distance rides
+the VPU/MXU and the loop is ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import HasInputCol, HasOutputCol, Param
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def slic(
+    image: jnp.ndarray, n_segments: int = 64, compactness: float = 10.0, iters: int = 10
+) -> jnp.ndarray:
+    """SLIC over one (H, W, C) image -> (H, W) int32 label map.
+
+    Joint feature = [compactness/S * (y, x), channels]; centers seeded on a
+    sqrt(n_segments) grid; `iters` rounds of assign + segment-mean update.
+    """
+    h, w, c = image.shape
+    img = image.astype(jnp.float32)
+    gy = int(np.sqrt(n_segments))
+    gx = int(np.ceil(n_segments / gy))
+    k = gy * gx
+    s = float(np.sqrt(h * w / k))  # nominal superpixel spacing
+
+    yy, xx = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32), jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    spatial_scale = compactness / s
+    feats = jnp.concatenate(
+        [
+            (yy * spatial_scale)[..., None],
+            (xx * spatial_scale)[..., None],
+            img,
+        ],
+        axis=-1,
+    ).reshape(h * w, c + 2)
+
+    cy = (jnp.arange(gy, dtype=jnp.float32) + 0.5) * (h / gy)
+    cx = (jnp.arange(gx, dtype=jnp.float32) + 0.5) * (w / gx)
+    cyy, cxx = jnp.meshgrid(cy, cx, indexing="ij")
+    ci = jnp.clip(cyy.reshape(-1).astype(jnp.int32), 0, h - 1)
+    cj = jnp.clip(cxx.reshape(-1).astype(jnp.int32), 0, w - 1)
+    centers = feats.reshape(h, w, c + 2)[ci, cj]  # (k, c+2)
+
+    def step(centers: jnp.ndarray, _: Any) -> tuple:
+        # ||f - c||^2 via the matmul expansion: (P, k) memory, MXU compute
+        d2 = (
+            (feats**2).sum(-1)[:, None]
+            + (centers**2).sum(-1)[None, :]
+            - 2.0 * feats @ centers.T
+        )
+        assign = jnp.argmin(d2, axis=1)
+        sums = jax.ops.segment_sum(feats, assign, num_segments=k)
+        counts = jax.ops.segment_sum(jnp.ones((h * w,), jnp.float32), assign, num_segments=k)
+        new_centers = sums / jnp.maximum(counts, 1.0)[:, None]
+        # empty clusters keep their previous center
+        new_centers = jnp.where((counts > 0)[:, None], new_centers, centers)
+        return new_centers, assign
+
+    centers, assigns = jax.lax.scan(step, centers, None, length=iters)
+    return assigns[-1].reshape(h, w).astype(jnp.int32)
+
+
+class Superpixel:
+    """Host-facing helper mirroring the reference's Superpixel object:
+    cluster one image and mask it by per-cluster on/off states."""
+
+    @staticmethod
+    def cluster(
+        image: np.ndarray, n_segments: int = 64, compactness: float = 10.0, iters: int = 10
+    ) -> np.ndarray:
+        return np.asarray(slic(jnp.asarray(image), n_segments, compactness, iters))
+
+    @staticmethod
+    def mask_image(image: np.ndarray, labels: np.ndarray, states: np.ndarray) -> np.ndarray:
+        """Keep pixels whose superpixel state is on; censor the rest to 0
+        (the reference blacks out off clusters)."""
+        on = np.asarray(states, bool)[np.asarray(labels)]
+        return np.where(on[..., None], image, 0).astype(image.dtype)
+
+
+class SuperpixelTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Attach an (H, W) superpixel label map for each image row
+    (lime/SuperpixelTransformer in the reference)."""
+
+    cell_size = Param("approximate superpixel diameter in pixels", default=16.0, type_=float)
+    compactness = Param("SLIC compactness (spatial vs color weight)", default=10.0, type_=float)
+    iters = Param("SLIC iterations", default=10, type_=int)
+
+    def __init__(self, **kw: Any):
+        super().__init__(**kw)
+        if "output_col" not in self._paramMap:
+            self.set(output_col="superpixels")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        ic, oc = self.get_or_fail("input_col"), self.get("output_col")
+        cell = self.get("cell_size")
+
+        def fn(p: dict) -> dict:
+            images = p[ic]
+            out = np.empty(len(images), dtype=object)
+            for i, img in enumerate(images):
+                img = np.asarray(img)
+                n_seg = max(1, int((img.shape[0] * img.shape[1]) / (cell * cell)))
+                out[i] = Superpixel.cluster(
+                    img, n_seg, self.get("compactness"), self.get("iters")
+                )
+            q = dict(p)
+            q[oc] = out
+            return q
+
+        return df.map_partitions(fn, parallel=False)

@@ -106,19 +106,26 @@ class KNNModel(Model, _KNNParams):
     index_features = ComplexParam("(N, d) index matrix")
     index_values = ComplexParam("(N,) payload values", default=None)
 
-    _tree_cache: Any = None  # (id(features), tree) — rebuilt if index changes
+    _tree_cache: Any = None  # (conditional_flag, tree); cleared whenever index params change
+
+    def set(self, *args: Any, **kw: Any) -> Any:
+        names = set(kw)
+        if args:
+            names.add(args[0])
+        if names & {"index_features", "index_labels", "leaf_size"}:
+            self._tree_cache = None
+        return super().set(*args, **kw)
 
     def _tree(self, conditional: bool = False) -> Any:
         x = self.get_or_fail("index_features")
-        key = (id(x), conditional)
-        if self._tree_cache is None or self._tree_cache[0] != key:
+        if self._tree_cache is None or self._tree_cache[0] != conditional:
             if conditional:
                 tree = ConditionalBallTree(
                     x, self.get_or_fail("index_labels"), self.get("leaf_size")
                 )
             else:
                 tree = BallTree(x, self.get("leaf_size"))
-            self._tree_cache = (key, tree)
+            self._tree_cache = (conditional, tree)
         return self._tree_cache[1]
 
     def _query(self, q: np.ndarray, k: int) -> tuple:
@@ -146,7 +153,7 @@ class KNNModel(Model, _KNNParams):
             for s, j in zip(sc, ix):
                 if not np.isfinite(s):
                     continue  # masked-out candidate (conditional variant)
-                match = {"distance": float(s)}
+                match = {"distance": float(s), "index": int(j)}
                 if values is not None:
                     match["value"] = values[j]
                 if labels is not None:

@@ -17,7 +17,18 @@ from typing import Any, Optional
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import HasInputCol as _HasInputCol
+from mmlspark_tpu.core.params import HasPredictionCol as _HasPredictionCol
 from mmlspark_tpu.core.pipeline import Estimator, PipelineStage, Transformer
+
+
+class ImageMean(Transformer, _HasInputCol, _HasPredictionCol):
+    """Importable trivial image model (pred = pixel mean) for LIME fuzzing."""
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        ims = df[self.get_or_fail("input_col")]
+        preds = np.array([np.asarray(im).mean() for im in ims], np.float32)
+        return df.with_column(self.get("prediction_col"), preds)
 
 
 @dataclass

@@ -313,6 +313,35 @@ def make_test_objects() -> list:
         TestObject(KNN(k=2), knn_df),
         TestObject(ConditionalKNN(k=2, label_col="label"), knn_df),
     ]
+
+    from mmlspark_tpu.lime import ImageLIME, SuperpixelTransformer, TabularLIME
+    from mmlspark_tpu.models.linear import LinearRegression
+
+    lime_x = rng.randn(30, 3).astype(np.float32)
+    lime_df = DataFrame.from_dict(
+        {"features": lime_x, "label": (lime_x @ np.array([1.0, -1.0, 0.0])).astype(np.float32)}
+    )
+    lime_inner = LinearRegression().fit(lime_df)
+    tiny_imgs = np.empty(2, dtype=object)
+    for i in range(2):
+        tiny_imgs[i] = rng.rand(16, 16, 3).astype(np.float32)
+    img_df = DataFrame.from_dict({"image": tiny_imgs})
+
+    from fuzzing import ImageMean
+
+    objs += [
+        TestObject(
+            TabularLIME(input_col="features", model=lime_inner, n_samples=32,
+                        prediction_col="prediction"),
+            lime_df,
+        ),
+        TestObject(
+            ImageLIME(input_col="image", model=ImageMean(input_col="image"),
+                      n_samples=16, cell_size=8.0),
+            img_df,
+        ),
+        TestObject(SuperpixelTransformer(input_col="image", cell_size=8.0), img_df),
+    ]
     return objs
 
 
@@ -370,7 +399,8 @@ EXCLUDED = {
     "LightGBMClassificationModel", "LightGBMRegressionModel", "LightGBMRankerModel",
     "VowpalWabbitClassificationModel", "VowpalWabbitRegressionModel",
     "VowpalWabbitContextualBanditModel",
-    "KNNModel", "ConditionalKNNModel",
+    "KNNModel", "ConditionalKNNModel", "TabularLIMEModel",
+    "ImageMean",  # test-local inner model for ImageLIME fuzzing
     # test-local helper stages
     "AddOne", "MeanShift", "Holder", "Scale", "Center", "CenterModel", "T",
 }

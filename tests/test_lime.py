@@ -1,0 +1,150 @@
+"""lime/ tests: lasso correctness, SLIC sanity, LIME recovers known models."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import HasInputCol, HasPredictionCol, Param
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.lime import (
+    ImageLIME,
+    Superpixel,
+    SuperpixelTransformer,
+    TabularLIME,
+    batched_lasso,
+    lasso,
+    slic,
+)
+
+
+class TestLasso:
+    def test_recovers_sparse_signal(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(200, 10).astype(np.float32)
+        true = np.zeros(10, np.float32)
+        true[[2, 7]] = [3.0, -2.0]
+        y = x @ true + 0.01 * rng.randn(200).astype(np.float32)
+        b = np.asarray(lasso(jnp.asarray(x), jnp.asarray(y), 0.01))
+        assert abs(b[2] - 3.0) < 0.1 and abs(b[7] + 2.0) < 0.1
+        assert np.abs(b[[0, 1, 3, 4, 5, 6, 8, 9]]).max() < 0.05
+
+    def test_strong_reg_zeroes_out(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(100, 5).astype(np.float32)
+        y = rng.randn(100).astype(np.float32)
+        b = np.asarray(lasso(jnp.asarray(x), jnp.asarray(y), 100.0))
+        assert np.abs(b).max() < 1e-6
+
+    def test_batched(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 50, 6).astype(np.float32)
+        beta = rng.randn(4, 6).astype(np.float32)
+        y = np.einsum("bnd,bd->bn", x, beta)
+        b = np.asarray(batched_lasso(jnp.asarray(x), jnp.asarray(y), 0.001, 300))
+        assert b.shape == (4, 6)
+        np.testing.assert_allclose(b, beta, atol=0.15)
+
+
+class TestSuperpixel:
+    def test_slic_partitions_image(self):
+        img = np.zeros((32, 32, 3), np.float32)
+        img[:, 16:] = 255.0  # two clear halves
+        labels = np.asarray(slic(jnp.asarray(img), 4, compactness=10.0))
+        assert labels.shape == (32, 32)
+        # left and right halves should not share labels
+        assert not (set(labels[:, :14].ravel()) & set(labels[:, 18:].ravel()))
+
+    def test_mask_image(self):
+        img = np.ones((8, 8, 3), np.float32)
+        labels = np.zeros((8, 8), np.int64)
+        labels[4:] = 1
+        out = Superpixel.mask_image(img, labels, np.array([1, 0]))
+        assert out[:4].all() and not out[4:].any()
+
+    def test_transformer(self):
+        imgs = np.empty(2, dtype=object)
+        for i in range(2):
+            imgs[i] = np.random.RandomState(i).rand(24, 24, 3).astype(np.float32)
+        df = DataFrame.from_dict({"image": imgs})
+        out = SuperpixelTransformer(input_col="image", cell_size=8.0).transform(df)
+        sp = out["superpixels"]
+        assert sp[0].shape == (24, 24)
+        assert len(np.unique(sp[0])) > 1
+
+
+class _LinearModel(Transformer, HasInputCol, HasPredictionCol):
+    """Deterministic inner model: pred = x @ w (w fixed)."""
+
+    w_list = Param("weights as list", default=None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        w = np.asarray(self.get("w_list"), np.float32)
+        x = np.asarray(df[self.get_or_fail("input_col")], np.float32)
+        return df.with_column(self.get("prediction_col"), x @ w)
+
+
+class _SegmentSumModel(Transformer, HasInputCol, HasPredictionCol):
+    """Image model whose score is the mean of one image quadrant —
+    LIME should attribute importance to that quadrant's superpixels."""
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        imgs = df[self.get_or_fail("input_col")]
+        preds = np.array([np.asarray(im)[:12, :12].mean() for im in imgs], np.float32)
+        return df.with_column(self.get("prediction_col"), preds)
+
+
+class TestTabularLIME:
+    def test_recovers_linear_weights(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(100, 4).astype(np.float32)
+        df = DataFrame.from_dict({"features": x})
+        inner = _LinearModel(input_col="features", w_list=[2.0, -1.0, 0.0, 0.5])
+        limed = TabularLIME(
+            input_col="features", model=inner, n_samples=2048, regularization=0.0003
+        ).fit(df)
+        out = limed.transform(DataFrame.from_dict({"features": x[:3]}))
+        stds = x.std(axis=0)  # states are standardized: coefficients = w * std
+        for wrow in out["weights"]:
+            np.testing.assert_allclose(
+                np.asarray(wrow) / stds, [2.0, -1.0, 0.0, 0.5], atol=0.2
+            )
+
+    def test_save_load(self, tmp_path):
+        x = np.random.RandomState(0).randn(50, 3).astype(np.float32)
+        df = DataFrame.from_dict({"features": x})
+        inner = _LinearModel(input_col="features", w_list=[1.0, 0.0, -1.0])
+        model = TabularLIME(input_col="features", model=inner, n_samples=64).fit(df)
+        p = str(tmp_path / "lime")
+        model.save(p)
+        from mmlspark_tpu import load_stage
+
+        m2 = load_stage(p)
+        a = model.transform(DataFrame.from_dict({"features": x[:2]}))["weights"]
+        b = m2.transform(DataFrame.from_dict({"features": x[:2]}))["weights"]
+        for ra, rb in zip(a, b):
+            np.testing.assert_allclose(ra, rb, atol=1e-5)
+
+
+class TestImageLIME:
+    def test_attributes_active_quadrant(self):
+        img = np.full((24, 24, 3), 128.0, np.float32)
+        imgs = np.empty(1, dtype=object)
+        imgs[0] = img
+        df = DataFrame.from_dict({"image": imgs})
+        inner = _SegmentSumModel(input_col="image")
+        out = ImageLIME(
+            input_col="image",
+            model=inner,
+            n_samples=256,
+            cell_size=12.0,
+            regularization=0.0001,
+            seed=3,
+        ).transform(df)
+        weights, labels = out["weights"][0], out["superpixels"][0]
+        active = set(labels[:12, :12].ravel())  # quadrant the model looks at
+        inactive = set(labels.ravel()) - active
+        w_active = max(weights[list(active)])
+        w_inactive = max(abs(weights[j]) for j in inactive) if inactive else 0.0
+        assert w_active > 5 * max(w_inactive, 1e-6)
