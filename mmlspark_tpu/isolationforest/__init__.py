@@ -1,0 +1,13 @@
+"""Isolation Forest anomaly detection (reference: isolationforest/, SURVEY.md §2.15).
+
+The reference wraps ``com.linkedin.isolation-forest``
+(IsolationForest.scala:17-60). This is a native rebuild: trees are grown on
+the host (cheap: T×psi subsamples), stored as dense perfect-binary-tree
+arrays, and scored on device — path traversal is a fixed-depth ``lax.scan``
+over gathers vmapped across trees, so scoring N rows × T trees is one
+jitted program with no per-row Python.
+"""
+
+from mmlspark_tpu.isolationforest.forest import IsolationForest, IsolationForestModel
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

@@ -62,7 +62,12 @@ class _LIMEParams(HasInputCol, HasOutputCol, HasPredictionCol):
         """Run the wrapped model; reduce its prediction column to (n,) floats."""
         inner = self.get_or_fail("model")
         scored = inner.transform(samples_df)
-        pred = np.asarray(scored[self.get("prediction_col")])
+        # follow the wrapped model's own prediction column unless overridden
+        if self.is_set("prediction_col"):
+            pc = self.get("prediction_col")
+        else:
+            pc = inner.get("prediction_col") or self.get("prediction_col")
+        pred = np.asarray(scored[pc])
         if pred.ndim == 2:  # probability vector: explain class 1 like the reference
             pred = pred[:, min(1, pred.shape[1] - 1)]
         return pred.astype(np.float32)
@@ -80,16 +85,12 @@ class TabularLIME(Estimator, _LIMEParams):
     def fit(self, df: DataFrame) -> "TabularLIMEModel":
         x = np.asarray(df[self.get_or_fail("input_col")], np.float64)
         m = TabularLIMEModel(**{k: v for k, v in self._paramMap.items()})
-        m.set(
-            feature_means=x.mean(axis=0).astype(np.float32),
-            feature_stds=(x.std(axis=0) + 1e-9).astype(np.float32),
-        )
+        m.set(feature_stds=(x.std(axis=0) + 1e-9).astype(np.float32))
         return m
 
 
 class TabularLIMEModel(Model, _LIMEParams):
-    feature_means = ComplexParam("(d,) train-set feature means")
-    feature_stds = ComplexParam("(d,) train-set feature stds")
+    feature_stds = ComplexParam("(d,) train-set feature stds (sampling scale)")
 
     def transform(self, df: DataFrame) -> DataFrame:
         ic = self.get_or_fail("input_col")

@@ -1,0 +1,29 @@
+"""Recommenders + ranking evaluation (reference: recommendation/, SURVEY.md §2.14).
+
+SAR ("smart adaptive recommendations"): item-item co-occurrence similarity
+with jaccard/lift variants + time-decayed user-item affinity
+(SAR.scala:66-119). TPU-first: the co-occurrence count is one boolean
+matmul ``A.T @ A`` on the MXU, scoring is ``affinity @ similarity`` +
+``lax.top_k`` — the reference's per-user Spark joins become two device
+matmuls.
+"""
+
+from mmlspark_tpu.recommendation.indexer import (
+    RecommendationIndexer,
+    RecommendationIndexerModel,
+)
+from mmlspark_tpu.recommendation.sar import SAR, SARModel
+from mmlspark_tpu.recommendation.evaluator import RankingEvaluator
+from mmlspark_tpu.recommendation.adapter import RankingAdapter, RankingAdapterModel
+from mmlspark_tpu.recommendation.split import RankingTrainValidationSplit
+
+__all__ = [
+    "RecommendationIndexer",
+    "RecommendationIndexerModel",
+    "SAR",
+    "SARModel",
+    "RankingEvaluator",
+    "RankingAdapter",
+    "RankingAdapterModel",
+    "RankingTrainValidationSplit",
+]

@@ -342,6 +342,45 @@ def make_test_objects() -> list:
         ),
         TestObject(SuperpixelTransformer(input_col="image", cell_size=8.0), img_df),
     ]
+
+    from mmlspark_tpu.recommendation import (
+        SAR,
+        RankingAdapter,
+        RankingTrainValidationSplit,
+        RecommendationIndexer,
+    )
+
+    rec_raw = DataFrame.from_dict(
+        {
+            "user": np.array(["a", "a", "b", "b", "c", "c"], dtype=object),
+            "item": np.array(["x", "y", "x", "z", "y", "z"], dtype=object),
+            "rating": np.ones(6, np.float32),
+        }
+    )
+    rec_df = DataFrame.from_dict(
+        {
+            "user_idx": np.array([0, 0, 1, 1, 2, 2], np.int64),
+            "item_idx": np.array([0, 1, 0, 2, 1, 2], np.int64),
+            "rating": np.ones(6, np.float32),
+        }
+    )
+    from mmlspark_tpu.isolationforest import IsolationForest
+
+    objs += [
+        TestObject(
+            IsolationForest(num_estimators=5, max_samples=16),
+            DataFrame.from_dict({"features": rng.randn(40, 3).astype(np.float32)}),
+        ),
+        TestObject(RecommendationIndexer(), rec_raw),
+        TestObject(SAR(support_threshold=1), rec_df),
+        TestObject(RankingAdapter(recommender=SAR(support_threshold=1), k=2), rec_df),
+        TestObject(
+            RankingTrainValidationSplit(
+                estimator=SAR(support_threshold=1), k=2, min_ratings_per_user=2
+            ),
+            rec_df,
+        ),
+    ]
     return objs
 
 
@@ -400,6 +439,8 @@ EXCLUDED = {
     "VowpalWabbitClassificationModel", "VowpalWabbitRegressionModel",
     "VowpalWabbitContextualBanditModel",
     "KNNModel", "ConditionalKNNModel", "TabularLIMEModel",
+    "RecommendationIndexerModel", "SARModel", "RankingAdapterModel",
+    "RankingTrainValidationSplitModel", "IsolationForestModel",
     "ImageMean",  # test-local inner model for ImageLIME fuzzing
     # test-local helper stages
     "AddOne", "MeanShift", "Holder", "Scale", "Center", "CenterModel", "T",

@@ -1,0 +1,69 @@
+"""Isolation forest tests: outlier separation, contamination, persistence."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.isolationforest import IsolationForest
+
+
+def _data(n_inliers=300, n_outliers=10, seed=0):
+    rng = np.random.RandomState(seed)
+    inliers = rng.randn(n_inliers, 4).astype(np.float32)
+    outliers = rng.randn(n_outliers, 4).astype(np.float32) * 0.5 + 8.0
+    x = np.vstack([inliers, outliers])
+    y = np.concatenate([np.zeros(n_inliers), np.ones(n_outliers)])
+    return x, y
+
+
+class TestIsolationForest:
+    def test_outliers_score_higher(self):
+        x, y = _data()
+        df = DataFrame.from_dict({"features": x})
+        model = IsolationForest(num_estimators=50, random_seed=3).fit(df)
+        out = model.transform(df)
+        scores = out["outlierScore"]
+        assert scores.min() >= 0.0 and scores.max() <= 1.0
+        assert scores[y == 1].mean() > scores[y == 0].mean() + 0.15
+        # every outlier scores above the median inlier
+        assert scores[y == 1].min() > np.median(scores[y == 0])
+
+    def test_contamination_threshold(self):
+        x, y = _data(300, 15)
+        df = DataFrame.from_dict({"features": x})
+        frac = 15 / 315
+        model = IsolationForest(
+            num_estimators=50, contamination=frac, random_seed=0
+        ).fit(df)
+        out = model.transform(df)
+        preds = out["prediction"]
+        # roughly the right number flagged, and mostly the true outliers
+        assert 8 <= preds.sum() <= 25
+        assert preds[y == 1].mean() > 0.8
+
+    def test_uniform_data_scores_mid(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(256, 3).astype(np.float32)
+        model = IsolationForest(num_estimators=30).fit(DataFrame.from_dict({"features": x}))
+        scores = model.transform(DataFrame.from_dict({"features": x}))["outlierScore"]
+        assert 0.3 < scores.mean() < 0.6
+
+    def test_save_load(self, tmp_path):
+        x, _ = _data(100, 5)
+        df = DataFrame.from_dict({"features": x})
+        model = IsolationForest(num_estimators=20).fit(df)
+        p = str(tmp_path / "iforest")
+        model.save(p)
+        from mmlspark_tpu import load_stage
+
+        m2 = load_stage(p)
+        np.testing.assert_allclose(
+            model.transform(df)["outlierScore"], m2.transform(df)["outlierScore"], atol=1e-6
+        )
+
+    def test_empty_partition(self):
+        x, _ = _data(50, 2)
+        model = IsolationForest(num_estimators=10).fit(DataFrame.from_dict({"features": x}))
+        empty = DataFrame.from_dict({"features": np.zeros((0, 4), np.float32)})
+        assert model.transform(empty).count() == 0

@@ -1,0 +1,166 @@
+"""recommendation/ tests: SAR similarity math, indexer, metrics, TVS."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.recommendation import (
+    SAR,
+    RankingAdapter,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    RecommendationIndexer,
+)
+from mmlspark_tpu.recommendation.split import per_user_split
+
+
+def _ratings_df() -> DataFrame:
+    # users 0,1 share items 0,1; user 2 likes items 2,3 — two taste clusters
+    users = np.array([0, 0, 0, 1, 1, 1, 2, 2, 3, 3], np.int64)
+    items = np.array([0, 1, 2, 0, 1, 3, 2, 3, 0, 2], np.int64)
+    rating = np.ones(10, np.float32)
+    return DataFrame.from_dict({"user_idx": users, "item_idx": items, "rating": rating})
+
+
+class TestIndexer:
+    def test_roundtrip(self):
+        df = DataFrame.from_dict(
+            {
+                "user": np.array(["alice", "bob", "alice"], dtype=object),
+                "item": np.array(["x", "y", "y"], dtype=object),
+                "rating": np.array([1.0, 2.0, 3.0]),
+            }
+        )
+        model = RecommendationIndexer().fit(df)
+        out = model.transform(df)
+        assert out["user_idx"].tolist() == [0, 1, 0]
+        assert out["item_idx"].tolist() == [0, 1, 1]
+        assert model.recover_user([0, 1]).tolist() == ["alice", "bob"]
+        assert model.recover_item([1]).tolist() == ["y"]
+
+
+class TestSAR:
+    def test_cooccurrence_counts(self):
+        model = SAR(similarity_function="cooccurrence", support_threshold=1).fit(_ratings_df())
+        sim = model.get("item_similarity")
+        # items 0,1 co-occur for users 0 and 1 -> count 2
+        assert sim[0, 1] == 2.0
+        # diagonal = item occurrence count (item 0 seen by users 0,1,3)
+        assert sim[0, 0] == 3.0
+
+    def test_jaccard_range_and_symmetry(self):
+        model = SAR(similarity_function="jaccard", support_threshold=1).fit(_ratings_df())
+        sim = model.get("item_similarity")
+        assert (sim >= 0).all() and (sim <= 1.0 + 1e-6).all()
+        np.testing.assert_allclose(sim, sim.T, atol=1e-6)
+        # jaccard(0,1) = 2 / (3 + 2 - 2)
+        np.testing.assert_allclose(sim[0, 1], 2.0 / 3.0, atol=1e-6)
+
+    def test_support_threshold_zeroes(self):
+        model = SAR(similarity_function="cooccurrence", support_threshold=2).fit(_ratings_df())
+        sim = model.get("item_similarity")
+        assert sim[1, 3] == 0.0  # co-occurs only once (user 1)
+
+    def test_recommendations_exclude_seen(self):
+        model = SAR(similarity_function="jaccard", support_threshold=1).fit(_ratings_df())
+        recs = model.recommend_for_all_users(2)
+        assert recs.count() == 4
+        seen = {0: {0, 1, 2}, 1: {0, 1, 3}, 2: {2, 3}, 3: {0, 2}}
+        for u, rec in zip(recs["user_idx"], recs["recommendations"]):
+            assert not (set(rec) & seen[int(u)])
+
+    def test_pair_scoring(self):
+        model = SAR(similarity_function="jaccard", support_threshold=1).fit(_ratings_df())
+        pairs = DataFrame.from_dict(
+            {"user_idx": np.array([0, 2], np.int64), "item_idx": np.array([3, 0], np.int64)}
+        )
+        out = model.transform(pairs)
+        assert out["prediction"].shape == (2,)
+        assert (out["prediction"] >= 0).all()
+
+    def test_time_decay(self):
+        users = np.array([0, 0, 1, 1], np.int64)
+        items = np.array([0, 1, 0, 1], np.int64)
+        t = np.array([0.0, 30 * 86400.0, 30 * 86400.0, 30 * 86400.0])
+        df = DataFrame.from_dict(
+            {"user_idx": users, "item_idx": items,
+             "rating": np.ones(4, np.float32), "t": t}
+        )
+        model = SAR(time_col="t", time_decay_coeff=30.0, support_threshold=1).fit(df)
+        aff = model.get("user_affinity")
+        # user 0's item-0 event is one half-life old -> affinity 0.5 vs 1.0
+        np.testing.assert_allclose(aff[0, 0], 0.5, atol=1e-6)
+        np.testing.assert_allclose(aff[0, 1], 1.0, atol=1e-6)
+
+
+class TestRankingEvaluator:
+    def _df(self, recs, truth):
+        r = np.empty(1, dtype=object)
+        r[0] = recs
+        t = np.empty(1, dtype=object)
+        t[0] = truth
+        return DataFrame.from_dict({"recommendations": r, "label": t})
+
+    def test_perfect_ranking(self):
+        df = self._df([1, 2, 3], [1, 2, 3])
+        ev = RankingEvaluator(k=3)
+        m = ev.evaluate_all(df)
+        assert m["ndcgAt"] == pytest.approx(1.0)
+        assert m["map"] == pytest.approx(1.0)
+        assert m["recallAtK"] == pytest.approx(1.0)
+        assert m["precisionAtk"] == pytest.approx(1.0)
+
+    def test_no_hits(self):
+        m = RankingEvaluator(k=3).evaluate_all(self._df([4, 5, 6], [1, 2, 3]))
+        assert all(v == 0.0 for v in m.values())
+
+    def test_partial(self):
+        ev = RankingEvaluator(k=2, metric_name="precisionAtk")
+        # first rec hits, second misses
+        assert ev.evaluate(self._df([1, 9], [1, 2])) == pytest.approx(0.5)
+
+    def test_ndcg_position_sensitivity(self):
+        ev = RankingEvaluator(k=3, metric_name="ndcgAt")
+        early = ev.evaluate(self._df([1, 8, 9], [1]))
+        late = ev.evaluate(self._df([8, 9, 1], [1]))
+        assert early > late
+
+
+class TestSplitAndTVS:
+    def test_per_user_split(self):
+        df = _ratings_df()
+        train, val = per_user_split(df, "user_idx", train_ratio=0.5, min_ratings=2, seed=1)
+        assert train.count() + val.count() == df.count()
+        # every user still present in train
+        assert set(train["user_idx"]) == {0, 1, 2, 3}
+
+    def test_adapter_and_tvs(self):
+        df = _ratings_df()
+        tvs = RankingTrainValidationSplit(
+            estimator=SAR(support_threshold=1),
+            estimator_param_maps=[
+                {"similarity_function": "jaccard"},
+                {"similarity_function": "cooccurrence"},
+            ],
+            k=2,
+            min_ratings_per_user=2,
+        )
+        model = tvs.fit(df)
+        assert len(model.get("validation_metrics")) == 2
+        recs = model.recommend_for_all_users(2)
+        assert recs.count() == 4
+
+    def test_adapter_save_load(self, tmp_path):
+        df = _ratings_df()
+        adapter = RankingAdapter(recommender=SAR(support_threshold=1), k=2)
+        model = adapter.fit(df)
+        p = str(tmp_path / "adapter")
+        model.save(p)
+        from mmlspark_tpu import load_stage
+
+        m2 = load_stage(p)
+        a, b = model.transform(df), m2.transform(df)
+        for ra, rb in zip(a["recommendations"], b["recommendations"]):
+            assert list(ra) == list(rb)
