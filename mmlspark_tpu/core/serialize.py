@@ -51,7 +51,11 @@ def _import_class(name: str) -> type:
 
 def _is_pytree_of_arrays(v: Any) -> bool:
     if isinstance(v, dict):
-        return all(_is_pytree_of_arrays(x) for x in v.values())
+        # msgpack strict_map_key only round-trips str keys; other key types
+        # (ints, tuples, numpy scalars) must take the pickle path
+        return all(
+            isinstance(k, str) and _is_pytree_of_arrays(x) for k, x in v.items()
+        )
     if isinstance(v, (list, tuple)):
         return all(_is_pytree_of_arrays(x) for x in v)
     return isinstance(v, (np.ndarray, float, int)) or type(v).__module__.startswith("jax")
@@ -154,7 +158,11 @@ def write_complex_value(value: Any, path: str) -> None:
 
 def _np_tree(v: Any) -> Any:
     if isinstance(v, dict):
-        return {k: _np_tree(x) for k, x in v.items()}
+        # msgpack strict_map_key rejects numpy scalar keys; use python scalars
+        return {
+            (k.item() if isinstance(k, np.generic) else k): _np_tree(x)
+            for k, x in v.items()
+        }
     if isinstance(v, (list, tuple)):
         return [_np_tree(x) for x in v]
     if type(v).__module__.startswith("jax"):

@@ -1,0 +1,28 @@
+"""CyberML — access-anomaly detection (reference: src/main/python/mmlspark/cyber/,
+SURVEY.md §2.16, Python-only in the reference).
+
+``AccessAnomaly``: per-tenant collaborative filtering over user→resource
+access counts (reference: collaborative_filtering.py:1-988 on Spark ALS);
+unusual accesses score high because the factor model assigns them low
+predicted affinity. TPU-first: ALS itself is rebuilt as batched
+least-squares solves on device (mmlspark_tpu.cyber.als) — each alternating
+half-step is one jitted program of stacked (F, F) solves, not a Spark job.
+"""
+
+from mmlspark_tpu.cyber.als import als_train, als_predict
+from mmlspark_tpu.cyber.anomaly import AccessAnomaly, AccessAnomalyModel
+from mmlspark_tpu.cyber.complement import ComplementSampler, complement_sample
+from mmlspark_tpu.cyber.dataset import synthetic_access_df
+from mmlspark_tpu.cyber.scalers import LinearScalarScaler, StandardScalarScaler
+
+__all__ = [
+    "als_train",
+    "als_predict",
+    "AccessAnomaly",
+    "AccessAnomalyModel",
+    "ComplementSampler",
+    "complement_sample",
+    "synthetic_access_df",
+    "StandardScalarScaler",
+    "LinearScalarScaler",
+]

@@ -1,0 +1,146 @@
+"""AccessAnomaly estimator (reference: cyber/anomaly/collaborative_filtering.py).
+
+Per-tenant pipeline: index users/resources, optionally add complement
+samples, factorize access likelihoods with device ALS, then standardize
+predicted affinities per tenant so transform can emit
+``anomaly_score = -(affinity - mean) / std`` — high score = the factor
+model did not expect this user to touch this resource.
+
+Unseen users/resources at transform time get score 0 (no evidence),
+matching the reference's neutral handling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.cyber.als import als_predict, als_train
+from mmlspark_tpu.cyber.complement import complement_sample
+
+
+class _AccessAnomalyParams:
+    tenant_col = Param("tenant column", default="tenant")
+    user_col = Param("user column", default="user")
+    res_col = Param("resource column", default="res")
+    likelihood_col = Param("access count/likelihood column", default="likelihood")
+    output_col = Param("anomaly score output column", default="anomaly_score")
+    rank = Param("ALS factor rank", default=10, type_=int)
+    max_iter = Param("ALS iterations", default=10, type_=int)
+    reg_param = Param("ALS regularization", default=0.1, type_=float)
+    implicit = Param("implicit-feedback ALS (confidence weights)", default=False, type_=bool)
+    alpha = Param("implicit confidence scale", default=40.0, type_=float)
+    complement_factor = Param(
+        "complement samples per observed row (explicit mode)", default=2.0, type_=float
+    )
+    seed = Param("rng seed", default=0, type_=int)
+
+
+class AccessAnomaly(Estimator, _AccessAnomalyParams):
+    def fit(self, df: DataFrame) -> "AccessAnomalyModel":
+        tc = self.get("tenant_col")
+        tenants = (
+            df[tc] if tc in df.columns else np.zeros(df.count(), np.int64)
+        )
+        users_raw = df[self.get("user_col")]
+        res_raw = df[self.get("res_col")]
+        lc = self.get("likelihood_col")
+        likes = (
+            np.asarray(df[lc], np.float64)
+            if lc in df.columns
+            else np.ones(df.count(), np.float64)
+        )
+
+        per_tenant: dict = {}
+        for t in np.unique(tenants) if len(tenants) else []:
+            sel = np.asarray(tenants == t)
+            u_labels = sorted(set(np.asarray(users_raw)[sel].tolist()))
+            r_labels = sorted(set(np.asarray(res_raw)[sel].tolist()))
+            u_map = {v: i for i, v in enumerate(u_labels)}
+            r_map = {v: i for i, v in enumerate(r_labels)}
+            u_idx = np.array([u_map[v] for v in np.asarray(users_raw)[sel]], np.int64)
+            r_idx = np.array([r_map[v] for v in np.asarray(res_raw)[sel]], np.int64)
+            vals = likes[sel]
+
+            ratings = np.zeros((len(u_labels), len(r_labels)), np.float32)
+            np.add.at(ratings, (u_idx, r_idx), vals)
+            mask = (ratings != 0).astype(np.float32)
+            if not self.get("implicit") and self.get("complement_factor") > 0:
+                cu, ci = complement_sample(
+                    u_idx, r_idx, len(u_labels), len(r_labels),
+                    self.get("complement_factor"), self.get("seed"),
+                )
+                mask[cu, ci] = 1.0  # observed zeros
+
+            uf, rf = als_train(
+                ratings,
+                mask=mask,
+                rank=min(self.get("rank"), max(1, min(ratings.shape) - 1) or 1),
+                iters=self.get("max_iter"),
+                reg=self.get("reg_param"),
+                implicit=self.get("implicit"),
+                alpha=self.get("alpha"),
+                seed=self.get("seed"),
+            )
+            # standardization stats over the OBSERVED pairs' affinities
+            obs_aff = als_predict(uf, rf, u_idx, r_idx)
+            mean = float(obs_aff.mean()) if len(obs_aff) else 0.0
+            std = float(obs_aff.std()) if len(obs_aff) > 1 else 1.0
+            per_tenant[t] = {
+                "user_labels": u_labels,
+                "res_labels": r_labels,
+                "user_factors": uf,
+                "res_factors": rf,
+                "mean": mean,
+                "std": std if std > 0 else 1.0,
+            }
+
+        m = AccessAnomalyModel(**{k: v for k, v in self._paramMap.items()})
+        m.set(tenant_models=per_tenant)
+        return m
+
+
+class AccessAnomalyModel(Model, _AccessAnomalyParams):
+    tenant_models = ComplexParam("{tenant: factors + index maps + stats}")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        models = self.get_or_fail("tenant_models")
+        tc = self.get("tenant_col")
+        # label->index maps built once per transform, shared by all partitions
+        maps = {
+            t: (
+                {v: i for i, v in enumerate(tm["user_labels"])},
+                {v: i for i, v in enumerate(tm["res_labels"])},
+            )
+            for t, tm in models.items()
+        }
+
+        def fn(p: dict) -> dict:
+            n = len(next(iter(p.values()))) if p else 0
+            users = p[self.get("user_col")]
+            res = p[self.get("res_col")]
+            tenants = p[tc] if tc in p else np.zeros(n, np.int64)
+            scores = np.zeros(n, np.float64)
+            for t in set(tenants.tolist()) if n else set():
+                tm = models.get(t)
+                if tm is None:
+                    continue  # unknown tenant: neutral 0
+                u_map, r_map = maps[t]
+                sel = np.where(np.asarray(tenants == t))[0]
+                ui = np.array([u_map.get(users[pos], -1) for pos in sel], np.int64)
+                ri = np.array([r_map.get(res[pos], -1) for pos in sel], np.int64)
+                ok = (ui >= 0) & (ri >= 0)  # unseen entities stay neutral 0
+                if ok.any():
+                    aff = als_predict(
+                        tm["user_factors"], tm["res_factors"], ui[ok], ri[ok]
+                    )
+                    scores[sel[ok]] = -(aff - tm["mean"]) / tm["std"]
+            q = dict(p)
+            q[self.get("output_col")] = scores
+            return q
+
+        return df.map_partitions(fn)

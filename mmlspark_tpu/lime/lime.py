@@ -66,7 +66,10 @@ class _LIMEParams(HasInputCol, HasOutputCol, HasPredictionCol):
         if self.is_set("prediction_col"):
             pc = self.get("prediction_col")
         else:
-            pc = inner.get("prediction_col") or self.get("prediction_col")
+            try:
+                pc = inner.get("prediction_col") or self.get("prediction_col")
+            except KeyError:  # inner stage declares no prediction_col param
+                pc = self.get("prediction_col")
         pred = np.asarray(scored[pc])
         if pred.ndim == 2:  # probability vector: explain class 1 like the reference
             pred = pred[:, min(1, pred.shape[1] - 1)]

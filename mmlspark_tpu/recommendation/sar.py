@@ -115,15 +115,23 @@ class SARModel(Model, _SARParams):
     prediction_col = Param("output column for pair scores / recommendations", default="prediction")
 
     def transform(self, df: DataFrame) -> DataFrame:
-        """Score (user, item) pairs — rating-prediction mode."""
+        """Score (user, item) pairs — rating-prediction mode. Pairs whose
+        user/item index was never seen at fit score NaN (cold start), rather
+        than silently clamping to another entity's row."""
         sim = jnp.asarray(self.get_or_fail("item_similarity"))
         aff = jnp.asarray(self.get_or_fail("user_affinity"))
         users = np.asarray(df[self.get("user_col")], np.int64)
         items = np.asarray(df[self.get("item_col")], np.int64)
+        known = (
+            (users >= 0) & (users < aff.shape[0]) & (items >= 0) & (items < sim.shape[0])
+        )
+        u_safe = np.where(known, users, 0)
+        i_safe = np.where(known, items, 0)
         # per-pair dot product: O(n*I) — no (n, I) score matrix materialized
         pair_scores = np.asarray(
-            jnp.einsum("ni,ni->n", aff[users], sim[:, items].T)
+            jnp.einsum("ni,ni->n", aff[u_safe], sim[:, i_safe].T)
         ).astype(np.float64)
+        pair_scores[~known] = np.nan
         return df.with_column(self.get("prediction_col"), pair_scores)
 
     def recommend_for_all_users(self, k: int) -> DataFrame:

@@ -381,6 +381,34 @@ def make_test_objects() -> list:
             rec_df,
         ),
     ]
+
+    from mmlspark_tpu.cyber import (
+        AccessAnomaly,
+        ComplementSampler,
+        LinearScalarScaler,
+        StandardScalarScaler,
+        synthetic_access_df,
+    )
+
+    access_df = synthetic_access_df(
+        n_departments=2, users_per_dept=3, resources_per_dept=3, accesses_per_user=5
+    )
+    scaler_df = DataFrame.from_dict(
+        {"tenant": np.array([0, 0, 1, 1]), "v": np.array([1.0, 2.0, 3.0, 5.0])}
+    )
+    comp_df = DataFrame.from_dict(
+        {
+            "user_idx": np.array([0, 1], np.int64),
+            "res_idx": np.array([0, 1], np.int64),
+            "rating": np.ones(2),
+        }
+    )
+    objs += [
+        TestObject(AccessAnomaly(rank=2, max_iter=3), access_df),
+        TestObject(StandardScalarScaler(input_col="v", partition_key="tenant"), scaler_df),
+        TestObject(LinearScalarScaler(input_col="v", partition_key="tenant"), scaler_df),
+        TestObject(ComplementSampler(factor=1.0), comp_df),
+    ]
     return objs
 
 
@@ -441,6 +469,7 @@ EXCLUDED = {
     "KNNModel", "ConditionalKNNModel", "TabularLIMEModel",
     "RecommendationIndexerModel", "SARModel", "RankingAdapterModel",
     "RankingTrainValidationSplitModel", "IsolationForestModel",
+    "AccessAnomalyModel", "StandardScalarScalerModel", "LinearScalarScalerModel",
     "ImageMean",  # test-local inner model for ImageLIME fuzzing
     # test-local helper stages
     "AddOne", "MeanShift", "Holder", "Scale", "Center", "CenterModel", "T",
