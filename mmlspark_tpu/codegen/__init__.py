@@ -1,0 +1,183 @@
+"""Binding/codegen layer (reference: codegen/, SURVEY.md §2.17).
+
+The reference reflects over every ``Wrappable`` stage in the jar to
+generate PySpark/SparklyR wrapper classes and wrapper smoke tests
+(WrapperGenerator.scala:22-117). This framework is Python-native, so the
+equivalent deliverables are:
+
+- :func:`reflect_stage` / :func:`generate_manifest` — a machine-readable
+  API surface (stage -> module, kind, params with docs/defaults/types),
+  the wrapper-metadata analogue, consumed by doc generation and smoke
+  tests and exported for external binding writers.
+- :func:`generate_api_docs` — per-package markdown API reference.
+- :func:`generate_smoke_tests` — a pytest file instantiating every
+  registered stage with defaults and asserting param integrity (the
+  PySparkWrapperTest analogue).
+
+Like the reference (which runs codegen inside the build), these run in the
+test suite: tests/test_codegen.py regenerates everything and asserts the
+registry is fully covered.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import json
+import os
+import pkgutil
+from typing import Any, Optional
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import (
+    STAGE_REGISTRY,
+    Estimator,
+    Model,
+    PipelineStage,
+    Transformer,
+)
+
+
+def import_all_packages() -> None:
+    """Import every mmlspark_tpu module so STAGE_REGISTRY is complete."""
+    import mmlspark_tpu
+
+    root = os.path.dirname(mmlspark_tpu.__file__)
+    for mod in pkgutil.walk_packages([root], prefix="mmlspark_tpu."):
+        name = mod.name
+        if ".native" in name or name.endswith("__main__"):
+            continue
+        try:
+            importlib.import_module(name)
+        except Exception:
+            # optional modules (native toolchains etc.) must not break codegen
+            pass
+
+
+def _stage_kind(cls: type) -> str:
+    if issubclass(cls, Model):
+        return "model"
+    if issubclass(cls, Estimator):
+        return "estimator"
+    if issubclass(cls, Transformer):
+        return "transformer"
+    return "stage"
+
+
+def reflect_stage(cls: type) -> dict:
+    """One stage's wrapper metadata."""
+    params = {}
+    for name, p in cls.params().items():
+        params[name] = {
+            "doc": p.doc,
+            "complex": bool(p.is_complex),
+            "type": p.type_.__name__ if p.type_ is not None else None,
+            "has_default": p.has_default(),
+            "default": (
+                p.default
+                if p.has_default() and isinstance(p.default, (int, float, str, bool, type(None), list))
+                else ("<complex>" if p.has_default() else None)
+            ),
+        }
+    return {
+        "name": cls.__name__,
+        "module": cls.__module__,
+        "kind": _stage_kind(cls),
+        "doc": inspect.getdoc(cls) or "",
+        "params": params,
+    }
+
+
+def generate_manifest() -> dict:
+    """Full API manifest over the (fully imported) stage registry."""
+    import_all_packages()
+    stages = {
+        name: reflect_stage(cls)
+        for name, cls in sorted(STAGE_REGISTRY.items())
+        # library stages only — the registry may also hold test-local stages
+        if not name.startswith("_") and cls.__module__.startswith("mmlspark_tpu.")
+    }
+    from mmlspark_tpu.version import __version__
+
+    return {"version": __version__, "stages": stages}
+
+
+def generate_api_docs(out_dir: str, manifest: Optional[dict] = None) -> list:
+    """Write one markdown file per package; returns written paths."""
+    manifest = manifest or generate_manifest()
+    by_pkg: dict[str, list] = {}
+    for info in manifest["stages"].values():
+        pkg = info["module"].split(".")[1] if "." in info["module"] else info["module"]
+        by_pkg.setdefault(pkg, []).append(info)
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for pkg, stages in sorted(by_pkg.items()):
+        path = os.path.join(out_dir, f"{pkg}.md")
+        lines = [f"# `mmlspark_tpu.{pkg}`", ""]
+        for info in sorted(stages, key=lambda s: s["name"]):
+            lines.append(f"## {info['name']}  *({info['kind']})*")
+            lines.append("")
+            if info["doc"]:
+                lines.append(info["doc"])
+                lines.append("")
+            if info["params"]:
+                lines.append("| param | type | default | doc |")
+                lines.append("|---|---|---|---|")
+                for pname, p in sorted(info["params"].items()):
+                    t = p["type"] or ("complex" if p["complex"] else "any")
+                    d = repr(p["default"]) if p["has_default"] else "required"
+                    doc = (p["doc"] or "").replace("|", "\\|")
+                    lines.append(f"| `{pname}` | {t} | {d} | {doc} |")
+                lines.append("")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        written.append(path)
+    index = os.path.join(out_dir, "README.md")
+    with open(index, "w") as f:
+        f.write("# mmlspark_tpu API reference (generated)\n\n")
+        f.write(f"{len(manifest['stages'])} stages.\n\n")
+        for pkg in sorted(by_pkg):
+            f.write(f"- [{pkg}]({pkg}.md) ({len(by_pkg[pkg])} stages)\n")
+    written.append(index)
+    return written
+
+
+def generate_smoke_tests(out_path: str, manifest: Optional[dict] = None) -> str:
+    """Emit a pytest module that default-constructs every stage and checks
+    params round-trip through explain_params (PySparkWrapperTest analogue)."""
+    manifest = manifest or generate_manifest()
+    lines = [
+        '"""GENERATED by mmlspark_tpu.codegen - do not edit."""',
+        "import importlib",
+        "import pytest",
+        "",
+        "CASES = [",
+    ]
+    for name, info in sorted(manifest["stages"].items()):
+        lines.append(f"    ({info['module']!r}, {name!r}),")
+    lines += [
+        "]",
+        "",
+        "",
+        "@pytest.mark.parametrize('module,name', CASES)",
+        "def test_stage_surface(module, name):",
+        "    cls = getattr(importlib.import_module(module), name)",
+        "    stage = cls()  # every stage must be default-constructible",
+        "    assert stage.explain_params() is not None",
+        "    for pname, p in cls.params().items():",
+        "        assert p.name == pname",
+        "",
+    ]
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines))
+    return out_path
+
+
+def write_manifest(out_path: str, manifest: Optional[dict] = None) -> str:
+    manifest = manifest or generate_manifest()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(manifest, f, indent=1, default=str)
+    return out_path

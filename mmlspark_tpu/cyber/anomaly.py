@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import zlib
+
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
@@ -72,14 +74,16 @@ class AccessAnomaly(Estimator, _AccessAnomalyParams):
             if not self.get("implicit") and self.get("complement_factor") > 0:
                 cu, ci = complement_sample(
                     u_idx, r_idx, len(u_labels), len(r_labels),
-                    self.get("complement_factor"), self.get("seed"),
+                    self.get("complement_factor"),
+                    # independent complement draws per tenant
+                    self.get("seed") + (zlib.crc32(str(t).encode()) % (1 << 20)),
                 )
                 mask[cu, ci] = 1.0  # observed zeros
 
             uf, rf = als_train(
                 ratings,
                 mask=mask,
-                rank=min(self.get("rank"), max(1, min(ratings.shape) - 1) or 1),
+                rank=min(self.get("rank"), max(1, min(ratings.shape) - 1)),
                 iters=self.get("max_iter"),
                 reg=self.get("reg_param"),
                 implicit=self.get("implicit"),

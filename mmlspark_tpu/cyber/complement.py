@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import zlib
+
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
@@ -25,11 +27,20 @@ def complement_sample(
     n_items: int,
     factor: float = 2.0,
     seed: int = 0,
+    user_pool: Optional[np.ndarray] = None,
+    item_pool: Optional[np.ndarray] = None,
 ) -> tuple:
-    """Sample ~factor * len(users) (u, i) pairs NOT present in the input set."""
+    """Sample ~factor * len(users) (u, i) pairs NOT present in the input set.
+
+    The sampling universe is ``user_pool × item_pool`` when given (use the
+    tenant's own observed entities for globally-indexed multi-tenant data),
+    else ``range(n_users) × range(n_items)``.
+    """
+    upool = np.asarray(user_pool if user_pool is not None else np.arange(n_users), np.int64)
+    ipool = np.asarray(item_pool if item_pool is not None else np.arange(n_items), np.int64)
     seen = set(zip(users.tolist(), items.tolist()))
     target = int(factor * len(users))
-    total_free = n_users * n_items - len(seen)
+    total_free = len(upool) * len(ipool) - len(seen)
     target = min(target, max(total_free, 0))
     rng = np.random.RandomState(seed)
     out_u, out_i = [], []
@@ -37,8 +48,8 @@ def complement_sample(
     # rejection sampling; dense fallback when the complement is tiny
     attempts = 0
     while len(out_u) < target and attempts < 50 * max(target, 1):
-        u = int(rng.randint(0, n_users))
-        i = int(rng.randint(0, n_items))
+        u = int(upool[rng.randint(0, len(upool))])
+        i = int(ipool[rng.randint(0, len(ipool))])
         attempts += 1
         if (u, i) in seen or (u, i) in picked:
             continue
@@ -46,8 +57,8 @@ def complement_sample(
         out_u.append(u)
         out_i.append(i)
     if len(out_u) < target:  # dense enumeration of what's left
-        for u in range(n_users):
-            for i in range(n_items):
+        for u in upool.tolist():
+            for i in ipool.tolist():
                 if len(out_u) >= target:
                     break
                 if (u, i) not in seen and (u, i) not in picked:
@@ -82,9 +93,12 @@ class ComplementSampler(Transformer):
             sel = tenants == t
             tu, ti = users[sel], items[sel]
             cu, ci = complement_sample(
-                tu, ti, int(tu.max()) + 1 if len(tu) else 0,
-                int(ti.max()) + 1 if len(ti) else 0,
-                self.get("factor"), self.get("seed"),
+                tu, ti, 0, 0,
+                self.get("factor"),
+                # independent draws per tenant
+                self.get("seed") + (zlib.crc32(str(t).encode()) % (1 << 20)),
+                user_pool=np.unique(tu),
+                item_pool=np.unique(ti),
             )
             if not len(cu):
                 continue

@@ -28,8 +28,9 @@ def synthetic_access_df(
             for u in range(users_per_dept):
                 user = f"t{t}_d{d}_u{u}"
                 for _ in range(accesses_per_user):
-                    if rng.rand() < cross_dept_prob:
-                        od = rng.choice([x for x in range(n_departments) if x != d])
+                    others = [x for x in range(n_departments) if x != d]
+                    if others and rng.rand() < cross_dept_prob:
+                        od = rng.choice(others)
                     else:
                         od = d
                     r = rng.randint(0, resources_per_dept)

@@ -1,0 +1,23 @@
+"""Image pipeline stages (reference: opencv/ + image/, SURVEY.md §2.5).
+
+The reference drives OpenCV through JNI for decode/resize/crop/flip/blur;
+here every pixel op is a batched jitted program from
+``mmlspark_tpu.ops.image`` — images with a common shape inside a partition
+are stacked and processed as one (N, H, W, C) device batch.
+"""
+
+from mmlspark_tpu.image.transformer import (
+    ImageSetAugmenter,
+    ImageTransformer,
+    ResizeImageTransformer,
+    UnrollBinaryImage,
+    UnrollImage,
+)
+
+__all__ = [
+    "ImageTransformer",
+    "UnrollImage",
+    "UnrollBinaryImage",
+    "ResizeImageTransformer",
+    "ImageSetAugmenter",
+]

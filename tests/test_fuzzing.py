@@ -403,6 +403,27 @@ def make_test_objects() -> list:
             "rating": np.ones(2),
         }
     )
+    from mmlspark_tpu.image import (
+        ImageSetAugmenter,
+        ImageTransformer,
+        ResizeImageTransformer,
+        UnrollBinaryImage,
+        UnrollImage,
+    )
+
+    png_blob = (
+        b"\x89PNG\r\n\x1a\n" + b"\x00" * 8  # sentinel: decode fails -> 1x1 fallback
+    )
+    blobs = np.empty(1, dtype=object)
+    blobs[0] = png_blob
+    objs += [
+        TestObject(ImageTransformer().resize(6, 6).flip(), img_df),
+        TestObject(UnrollImage(), img_df),
+        TestObject(UnrollBinaryImage(), DataFrame.from_dict({"image": blobs})),
+        TestObject(ResizeImageTransformer(height=6, width=6), img_df),
+        TestObject(ImageSetAugmenter(), img_df),
+    ]
+
     objs += [
         TestObject(AccessAnomaly(rank=2, max_iter=3), access_df),
         TestObject(StandardScalarScaler(input_col="v", partition_key="tenant"), scaler_df),

@@ -1,0 +1,116 @@
+"""image/ stage tests: op pipeline, unroll layout parity, augmentation."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.image import (
+    ImageSetAugmenter,
+    ImageTransformer,
+    ResizeImageTransformer,
+    UnrollImage,
+)
+
+
+def _img_df(shapes, seed=0):
+    rng = np.random.RandomState(seed)
+    imgs = np.empty(len(shapes), dtype=object)
+    for i, (h, w) in enumerate(shapes):
+        imgs[i] = (rng.rand(h, w, 3) * 255).astype(np.float32)
+    return DataFrame.from_dict({"image": imgs, "id": np.arange(len(shapes))})
+
+
+class TestImageTransformer:
+    def test_resize_then_flip(self):
+        df = _img_df([(20, 30), (14, 10)])
+        t = ImageTransformer().resize(8, 8).flip()
+        out = t.transform(df)["image"]
+        assert out[0].shape == (8, 8, 3) and out[1].shape == (8, 8, 3)
+        # flip is horizontal: flipping again restores
+        t2 = ImageTransformer().resize(8, 8)
+        base = t2.transform(df)["image"]
+        np.testing.assert_allclose(out[0][:, ::-1], base[0], atol=1e-4)
+
+    def test_crop(self):
+        df = _img_df([(16, 16)])
+        out = ImageTransformer().crop(2, 4, 8, 6).transform(df)["image"]
+        assert out[0].shape == (8, 6, 3)
+
+    def test_grayscale(self):
+        df = _img_df([(8, 8)])
+        out = ImageTransformer().color_format("gray").transform(df)["image"]
+        assert out[0].shape == (8, 8, 1)
+
+    def test_threshold(self):
+        df = _img_df([(8, 8)])
+        out = ImageTransformer().threshold(128.0, 255.0).transform(df)["image"]
+        assert set(np.unique(out[0])) <= {0.0, 255.0}
+
+    def test_blur_preserves_mean(self):
+        df = _img_df([(16, 16)])
+        out = ImageTransformer().blur(5, 2.0).transform(df)["image"]
+        inp = df["image"][0]
+        # interior mean roughly preserved by blurring
+        assert abs(out[0][4:-4].mean() - inp[4:-4].mean()) < 10.0
+
+    def test_mixed_shapes_grouped(self):
+        df = _img_df([(12, 12), (20, 8), (12, 12)])
+        out = ImageTransformer().resize(6, 6).transform(df)["image"]
+        assert all(o.shape == (6, 6, 3) for o in out)
+
+    def test_save_load(self, tmp_path):
+        t = ImageTransformer().resize(8, 8).blur(3, 1.0)
+        t.save(str(tmp_path / "it"))
+        from mmlspark_tpu import load_stage
+
+        t2 = load_stage(str(tmp_path / "it"))
+        df = _img_df([(10, 10)])
+        np.testing.assert_allclose(
+            t.transform(df)["image"][0], t2.transform(df)["image"][0], atol=1e-5
+        )
+
+
+class TestUnroll:
+    def test_chw_bgr_layout(self):
+        img = np.zeros((2, 2, 3), np.float32)
+        img[..., 0] = 1.0  # R plane
+        img[..., 2] = 3.0  # B plane
+        img[..., 1] = 2.0
+        imgs = np.empty(1, dtype=object)
+        imgs[0] = img
+        df = DataFrame.from_dict({"image": imgs})
+        out = UnrollImage().transform(df)["unrolled"]
+        vec = np.asarray(out[0] if out.dtype == object else out[0])
+        # BGR plane order: first 4 entries = B plane (3.0)
+        np.testing.assert_allclose(vec[:4], 3.0)
+        np.testing.assert_allclose(vec[4:8], 2.0)
+        np.testing.assert_allclose(vec[8:], 1.0)
+
+    def test_uniform_stacks_dense(self):
+        df = _img_df([(6, 6), (6, 6)])
+        out = UnrollImage().transform(df)["unrolled"]
+        assert out.dtype != object and out.shape == (2, 108)
+
+
+class TestResizeTransformer:
+    def test_resize(self):
+        df = _img_df([(32, 16), (8, 24)])
+        out = ResizeImageTransformer(height=10, width=12).transform(df)["image"]
+        assert out.shape == (2, 10, 12, 3)
+
+
+class TestAugmenter:
+    def test_doubles_rows(self):
+        df = _img_df([(8, 8), (8, 8)])
+        out = ImageSetAugmenter(flip_left_right=True).transform(df)
+        assert out.count() == 4
+        assert out["id"].tolist() == [0, 1, 0, 1]
+        np.testing.assert_allclose(
+            np.asarray(out["image"][2]), np.asarray(df["image"][0])[:, ::-1], atol=1e-5
+        )
+
+    def test_both_flips_triple(self):
+        df = _img_df([(8, 8)])
+        out = ImageSetAugmenter(flip_left_right=True, flip_up_down=True).transform(df)
+        assert out.count() == 3
