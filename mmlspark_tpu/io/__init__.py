@@ -24,6 +24,7 @@ from mmlspark_tpu.io.parsers import (
 from mmlspark_tpu.io.http_transformer import HTTPTransformer, SimpleHTTPTransformer
 from mmlspark_tpu.io.consolidator import PartitionConsolidator
 from mmlspark_tpu.io.binary import read_binary_files, read_images
+from mmlspark_tpu.io.csv import read_csv
 from mmlspark_tpu.io.powerbi import PowerBIWriter
 
 __all__ = [
@@ -46,4 +47,5 @@ __all__ = [
     "read_binary_files",
     "read_images",
     "PowerBIWriter",
+    "read_csv",
 ]

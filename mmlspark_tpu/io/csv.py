@@ -1,0 +1,110 @@
+"""CSV data loader backed by the native parser.
+
+The reference delegates tabular ingestion to Spark's readers (JVM/native);
+this is the framework's own loader: numeric matrices parse in C++
+(ops/native/mmltpu.cc ``mml_parse_csv``), mixed-type files fall back to
+Python's csv module. Output is a partitioned DataFrame sized for device
+feeding.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io as _io
+from typing import Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.ops import native_loader
+
+
+def _parse_numeric(data: bytes) -> Optional[np.ndarray]:
+    lib = native_loader.try_load()
+    if lib is None:
+        return None
+    return lib.parse_csv(data)
+
+
+def read_csv(
+    path: str,
+    header: bool = True,
+    columns: Optional[Sequence[str]] = None,
+    num_partitions: int = 1,
+    numeric_only: Optional[bool] = None,
+) -> DataFrame:
+    """Load a CSV file into a DataFrame.
+
+    numeric_only=True forces the native fast path (bad fields become NaN);
+    None auto-detects by probing the first data line.
+    """
+    with open(path, "rb") as f:
+        raw = f.read()
+
+    body = raw
+    names = list(columns) if columns else None
+    if header:
+        nl = raw.find(b"\n")
+        head_line = raw[: nl if nl >= 0 else len(raw)].decode("utf-8", "replace").strip()
+        if names is None:
+            names = [c.strip() for c in head_line.split(",")]
+        body = raw[nl + 1 :] if nl >= 0 else b""
+
+    if numeric_only is None:
+        probe_end = body.find(b"\n")
+        probe = body[: probe_end if probe_end >= 0 else len(body)]
+        numeric_only = _line_is_numeric(probe)
+
+    if numeric_only:
+        mat = _parse_numeric(body)
+        if mat is None:  # no native toolchain: numpy fallback
+            mat = np.genfromtxt(
+                _io.BytesIO(body), delimiter=",", dtype=np.float64, ndmin=2
+            )
+            if mat.size == 0:
+                mat = mat.reshape(0, len(names) if names else 0)
+        if names is None:
+            names = [f"c{i}" for i in range(mat.shape[1] if mat.ndim == 2 else 0)]
+        # more data columns than header names: synthesize names, never drop
+        names = list(names) + [f"c{i}" for i in range(len(names), mat.shape[1])]
+        cols = {names[i]: mat[:, i] for i in range(mat.shape[1])}
+        return DataFrame.from_dict(cols, num_partitions=num_partitions)
+
+    # mixed types: python csv, column-wise type inference
+    text = body.decode("utf-8", "replace")
+    rows = [r for r in _csv.reader(_io.StringIO(text)) if r]
+    if names is None:
+        names = [f"c{i}" for i in range(len(rows[0]) if rows else 0)]
+    cols_raw: list[list] = [[] for _ in names]
+    for r in rows:
+        for i in range(len(names)):
+            cols_raw[i].append(r[i] if i < len(r) else "")
+    out = {}
+    for name, vals in zip(names, cols_raw):
+        arr = _infer_column(vals)
+        out[name] = arr
+    return DataFrame.from_dict(out, num_partitions=num_partitions)
+
+
+def _line_is_numeric(line: bytes) -> bool:
+    if not line.strip():
+        return False
+    for field in line.decode("utf-8", "replace").split(","):
+        field = field.strip()
+        if field == "":
+            continue
+        try:
+            float(field)
+        except ValueError:
+            return False
+    return True
+
+
+def _infer_column(vals: list) -> np.ndarray:
+    try:
+        return np.array([float(v) if v.strip() else np.nan for v in vals], np.float64)
+    except ValueError:
+        arr = np.empty(len(vals), dtype=object)
+        for i, v in enumerate(vals):
+            arr[i] = v
+        return arr

@@ -61,6 +61,14 @@ class BinMapper:
     def transform(self, x: np.ndarray) -> np.ndarray:
         """(n, d) float -> (n, d) uint8 bins; NaN -> MISSING_BIN(0); real
         values start at bin 1."""
+        from mmlspark_tpu.ops import native_loader
+
+        # bin at float32 on BOTH paths so results are identical with and
+        # without the native toolchain (the native kernel takes float32)
+        x = np.asarray(x, np.float32)
+        lib = native_loader.try_load()
+        if lib is not None:
+            return lib.bin_features(x, self.uppers)
         n, d = x.shape
         out = np.empty((n, d), dtype=np.uint8)
         for f in range(d):

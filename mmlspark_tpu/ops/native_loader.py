@@ -35,6 +35,30 @@ class _NativeLib:
             ctypes.POINTER(ctypes.c_uint32),
         ]
         lib.mml_murmur3_batch.restype = None
+        lib.mml_bin_features.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.mml_bin_features.restype = None
+        lib.mml_parse_csv.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+        ]
+        lib.mml_parse_csv.restype = ctypes.c_int64
+        lib.mml_csv_dims.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mml_csv_dims.restype = None
 
     def murmur3_batch(self, toks: list, seed: int) -> np.ndarray:
         n = len(toks)
@@ -50,6 +74,44 @@ class _NativeLib:
         )
         return out
 
+    def bin_features(self, x: np.ndarray, uppers: list) -> np.ndarray:
+        """(n, d) float32 -> uint8 bins via per-feature edge search (threaded)."""
+        x = np.ascontiguousarray(x, np.float32)
+        n, d = x.shape
+        offsets = np.zeros(d + 1, np.int64)
+        for f, u in enumerate(uppers):
+            offsets[f + 1] = offsets[f] + len(u)
+        edges = (
+            np.concatenate([np.asarray(u, np.float64) for u in uppers])
+            if offsets[-1]
+            else np.zeros(0, np.float64)
+        )
+        out = np.empty((n, d), np.uint8)
+        self._lib.mml_bin_features(
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n,
+            d,
+            edges.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return out
+
+    def parse_csv(self, data: bytes) -> np.ndarray:
+        """Numeric CSV bytes -> (rows, cols) float64 (bad fields = NaN)."""
+        n_rows = ctypes.c_int64()
+        n_cols = ctypes.c_int64()
+        self._lib.mml_csv_dims(data, len(data), ctypes.byref(n_rows), ctypes.byref(n_cols))
+        out = np.empty((n_rows.value, n_cols.value), np.float64)
+        got = self._lib.mml_parse_csv(
+            data,
+            len(data),
+            n_cols.value,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            n_rows.value,
+        )
+        return out[:got]
+
 
 def _build() -> Optional[str]:
     so_path = os.path.join(_BUILD_DIR, "libmmltpu.so")
@@ -57,7 +119,7 @@ def _build() -> Optional[str]:
     if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(src):
         return so_path
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", so_path]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", so_path]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except Exception:
