@@ -51,18 +51,27 @@ def read_csv(
         body = raw[nl + 1 :] if nl >= 0 else b""
 
     if numeric_only is None:
-        probe_end = body.find(b"\n")
-        probe = body[: probe_end if probe_end >= 0 else len(body)]
-        numeric_only = _line_is_numeric(probe)
+        # probe a prefix of data lines, not just the first — a leading row
+        # of empty/numeric fields must not send string columns to NaN
+        probed = 0
+        numeric_only = True
+        for line in body.split(b"\n"):
+            if not line.strip():
+                continue
+            if not _line_is_numeric(line):
+                numeric_only = False
+                break
+            probed += 1
+            if probed >= 20:
+                break
+        if probed == 0 and numeric_only:
+            numeric_only = False  # no data lines
 
     if numeric_only:
         mat = _parse_numeric(body)
-        if mat is None:  # no native toolchain: numpy fallback
-            mat = np.genfromtxt(
-                _io.BytesIO(body), delimiter=",", dtype=np.float64, ndmin=2
-            )
-            if mat.size == 0:
-                mat = mat.reshape(0, len(names) if names else 0)
+        if mat is None:  # no native toolchain: python fallback (NaN-padded
+            # like the native parser, tolerating ragged rows)
+            mat = _py_parse_numeric(body)
         if names is None:
             names = [f"c{i}" for i in range(mat.shape[1] if mat.ndim == 2 else 0)]
         # more data columns than header names: synthesize names, never drop
@@ -73,8 +82,11 @@ def read_csv(
     # mixed types: python csv, column-wise type inference
     text = body.decode("utf-8", "replace")
     rows = [r for r in _csv.reader(_io.StringIO(text)) if r]
+    width = max((len(r) for r in rows), default=len(names) if names else 0)
     if names is None:
-        names = [f"c{i}" for i in range(len(rows[0]) if rows else 0)]
+        names = [f"c{i}" for i in range(width)]
+    # rows wider than the header: synthesize names, never drop fields
+    names = list(names) + [f"c{i}" for i in range(len(names), width)]
     cols_raw: list[list] = [[] for _ in names]
     for r in rows:
         for i in range(len(names)):
@@ -84,6 +96,25 @@ def read_csv(
         arr = _infer_column(vals)
         out[name] = arr
     return DataFrame.from_dict(out, num_partitions=num_partitions)
+
+
+def _py_parse_numeric(body: bytes) -> np.ndarray:
+    """Pure-python numeric parse matching the native parser's semantics:
+    NaN for empty/bad fields, short rows padded, extra fields dropped."""
+    lines = [ln for ln in body.decode("utf-8", "replace").splitlines() if ln.strip()]
+    if not lines:
+        return np.zeros((0, 0), np.float64)
+    n_cols = lines[0].count(",") + 1
+    out = np.full((len(lines), n_cols), np.nan, np.float64)
+    for r, ln in enumerate(lines):
+        for c, field in enumerate(ln.split(",")[:n_cols]):
+            field = field.strip()
+            if field:
+                try:
+                    out[r, c] = float(field)
+                except ValueError:
+                    pass
+    return out
 
 
 def _line_is_numeric(line: bytes) -> bool:

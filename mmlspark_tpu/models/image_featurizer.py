@@ -98,6 +98,9 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol, HasBatchSize):
             input_col="__pixels__",
             output_col=self.get_or_fail("output_col"),
             batch_size=self.get("batch_size"),
+            # keep host dtype: uint8 pixel batches transfer 4x less and the
+            # program's leading resize casts to f32 on device anyway
+            input_dtype=None,
         )
         self._inner.set(apply_fn=full_fn, variables=variables)
         return self._inner
@@ -107,13 +110,20 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol, HasBatchSize):
     def _coerce_images(self, col: np.ndarray) -> tuple:
         """image structs / bytes / dense tensors -> ((N,H,W,C) float32, keep mask)."""
         if col.dtype != object:
-            x = col.astype(np.float32)
+            # uint8 pixel tensors stay uint8 (device-side cast; cheaper copy)
+            x = col if col.dtype == np.uint8 else col.astype(np.float32)
             if x.ndim == 2:  # unrolled vectors: roll back using model size
                 size = self.get("image_size") or (
                     self._schema.image_size if self._schema else 224
                 )
+                # unrolled layout is always reference CHW/BGR. With
+                # bgr_input=False, convert to RGB here (roll bgr=True);
+                # with bgr_input=True keep BGR planes (roll bgr=False) so
+                # full_fn's single bgr_to_rgb flip lands on RGB — never two.
                 x = np.asarray(
-                    image_ops.roll(jnp.asarray(x), size, size, bgr=self.get("bgr_input"))
+                    image_ops.roll(
+                        jnp.asarray(x), size, size, bgr=not self.get("bgr_input")
+                    )
                 )
             return x, np.ones(len(x), bool)
         rows = []
@@ -128,10 +138,14 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol, HasBatchSize):
         keep = np.array([a is not None for a in rows], dtype=bool)
         if not keep.all() and not self.get("drop_na"):
             raise ValueError("undecodable image rows present and drop_na=False")
-        good = [np.asarray(a, np.float32) for a in rows if a is not None]
+        good = [np.asarray(a) for a in rows if a is not None]
         if not good:
             return np.zeros((0, 1, 1, 3), np.float32), keep
-        return np.stack(good), keep
+        # decoded JPEG/PNG arrive uint8 — keep them uint8 so the batch ships
+        # to the device at 1 byte/px (the program casts on device)
+        if all(a.dtype == np.uint8 for a in good):
+            return np.stack(good), keep
+        return np.stack([a.astype(np.float32) for a in good]), keep
 
     def transform(self, df: DataFrame) -> DataFrame:
         ic = self.get_or_fail("input_col")

@@ -50,7 +50,13 @@ class XLAModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
         default=64,
         type_=int,
     )
-    input_dtype = Param("cast input batches to this dtype", default="float32", type_=str)
+    input_dtype = Param(
+        "cast input batches to this dtype; None = keep the host dtype "
+        "(e.g. ship uint8 pixels and cast on device: 4x less host->device "
+        "traffic when the program starts with a cast anyway)",
+        default="float32",
+        type_=str,
+    )
 
     def __init__(self, **kw: Any):
         super().__init__(**kw)
@@ -110,18 +116,28 @@ class XLAModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
             self._jit_cache[key] = fn
         return fn
 
+    # how many minibatches may be in flight on device at once: JAX's async
+    # dispatch then overlaps host staging of batch i+1..i+k with compute of
+    # batch i, while bounding live HBM for inputs+outputs
+    _MAX_IN_FLIGHT = 4
+
     def apply_batch(self, x: np.ndarray) -> np.ndarray:
         """Evaluate one host batch (used by transform and by serving)."""
         mesh = get_mesh()
         vs = self._device_variables(mesh)
         bs = self._effective_batch(mesh)
-        x = np.asarray(x, dtype=self.get("input_dtype"))
+        dt = self.get("input_dtype")
+        x = np.asarray(x, dtype=dt) if dt else np.asarray(x)
         padded, n = pad_batch(x, bs)
-        outs = []
         fn = self._compiled(padded[:bs].shape, mesh)
+        outs = []
+        in_flight: list = []
         for i in range(0, padded.shape[0], bs):
             chunk = shard_batch(padded[i: i + bs], mesh)
-            outs.append(np.asarray(fn(vs, chunk)))
+            in_flight.append(fn(vs, chunk))  # async dispatch, no host sync
+            if len(in_flight) >= self._MAX_IN_FLIGHT:
+                outs.append(np.asarray(in_flight.pop(0)))
+        outs.extend(np.asarray(r) for r in in_flight)
         return np.concatenate(outs, axis=0)[:n]
 
     # -- stage interface ----------------------------------------------------
