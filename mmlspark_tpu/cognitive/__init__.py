@@ -37,7 +37,7 @@ from mmlspark_tpu.cognitive.face import (
     VerifyFaces,
 )
 from mmlspark_tpu.cognitive.anomaly import DetectAnomalies, DetectLastAnomaly
-from mmlspark_tpu.cognitive.speech import SpeechToText
+from mmlspark_tpu.cognitive.speech import SpeechToText, SpeechToTextSDK
 from mmlspark_tpu.cognitive.search import AzureSearchWriter, BingImageSearch
 
 __all__ = [
@@ -61,6 +61,7 @@ __all__ = [
     "DetectAnomalies",
     "DetectLastAnomaly",
     "SpeechToText",
+    "SpeechToTextSDK",
     "BingImageSearch",
     "AzureSearchWriter",
 ]

@@ -1,0 +1,101 @@
+"""Audio pull-stream helpers for SDK-style continuous recognition.
+
+Reference: cognitive/AudioStreams.scala — ``WavStream`` parses the RIFF
+header and exposes fixed-size PCM frame pulls; ``CompressedStream`` passes
+opaque compressed bytes through untouched. These feed
+:class:`mmlspark_tpu.cognitive.speech.SpeechToTextSDK`'s windowed
+continuous-recognition loop (SpeechToTextSDK.scala:204-249).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+
+@dataclass
+class WavFormat:
+    channels: int
+    sample_rate: int
+    bits_per_sample: int
+
+    @property
+    def bytes_per_second(self) -> int:
+        return self.sample_rate * self.channels * (self.bits_per_sample // 8)
+
+
+class WavStream:
+    """Parse a PCM WAV blob; iterate raw PCM in fixed-duration windows."""
+
+    def __init__(self, data: bytes):
+        self.format, self.pcm = self._parse(bytes(data))
+
+    @staticmethod
+    def _parse(data: bytes) -> tuple:
+        if len(data) < 12 or data[:4] != b"RIFF" or data[8:12] != b"WAVE":
+            raise ValueError("not a RIFF/WAVE stream")
+        pos = 12
+        fmt: Optional[WavFormat] = None
+        pcm = b""
+        while pos + 8 <= len(data):
+            chunk_id = data[pos : pos + 4]
+            (size,) = struct.unpack_from("<I", data, pos + 4)
+            body = data[pos + 8 : pos + 8 + size]
+            if chunk_id == b"fmt ":
+                try:
+                    audio_fmt, channels, rate = struct.unpack_from("<HHI", body, 0)
+                    bits = struct.unpack_from("<H", body, 14)[0]
+                except struct.error as e:  # truncated fmt chunk
+                    raise ValueError(f"malformed WAV fmt chunk: {e}") from e
+                if audio_fmt not in (1, 0xFFFE):  # PCM / extensible
+                    raise ValueError(f"unsupported WAV audio format {audio_fmt}")
+                if channels < 1 or rate < 1 or bits < 8:
+                    raise ValueError(
+                        f"invalid WAV format: channels={channels} rate={rate} bits={bits}"
+                    )
+                fmt = WavFormat(channels, rate, bits)
+            elif chunk_id == b"data":
+                pcm = body
+            pos += 8 + size + (size & 1)  # chunks are word-aligned
+        if fmt is None:
+            raise ValueError("WAV stream has no fmt chunk")
+        return fmt, pcm
+
+    @property
+    def duration_seconds(self) -> float:
+        return len(self.pcm) / max(self.format.bytes_per_second, 1)
+
+    def windows(self, window_seconds: float = 15.0) -> Iterator[bytes]:
+        """Yield PCM windows re-wrapped as standalone WAV blobs (the REST
+        endpoint consumes whole files; sample-aligned, no torn frames)."""
+        step = int(self.format.bytes_per_second * window_seconds)
+        frame = self.format.channels * (self.format.bits_per_sample // 8)
+        step -= step % max(frame, 1)
+        step = max(step, frame)
+        for lo in range(0, len(self.pcm), step):
+            yield wrap_wav(self.pcm[lo : lo + step], self.format)
+
+
+class CompressedStream:
+    """Opaque compressed audio: single pull of the whole payload
+    (CompressedStream in the reference defers decode to the service)."""
+
+    def __init__(self, data: bytes):
+        self.data = bytes(data)
+
+    def windows(self, window_seconds: float = 15.0) -> Iterator[bytes]:
+        yield self.data
+
+
+def wrap_wav(pcm: bytes, fmt: WavFormat) -> bytes:
+    """Minimal RIFF/WAVE envelope around raw PCM."""
+    byte_rate = fmt.bytes_per_second
+    block_align = fmt.channels * (fmt.bits_per_sample // 8)
+    hdr = b"RIFF" + struct.pack("<I", 36 + len(pcm)) + b"WAVE"
+    hdr += b"fmt " + struct.pack(
+        "<IHHIIHH", 16, 1, fmt.channels, fmt.sample_rate, byte_rate, block_align,
+        fmt.bits_per_sample,
+    )
+    hdr += b"data" + struct.pack("<I", len(pcm))
+    return hdr + pcm

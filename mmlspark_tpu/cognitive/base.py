@@ -79,9 +79,43 @@ class CognitiveServiceBase(Transformer, _HasServiceParams, HasOutputCol):
         """Row-resolved ServiceParam values -> request dict (None = skip)."""
         raise NotImplementedError
 
+    def _build_requests(self, vals: dict) -> list:
+        """Multi-request rows override this (e.g. windowed audio); default =
+        the single ``_build_request`` wrapped in a list."""
+        r = self._build_request(vals)
+        return [] if r is None else [r]
+
     def _project_response(self, obj: Any) -> Any:
         """Parsed JSON -> output value; default identity."""
         return obj
+
+    def _row_output(self, resps: list) -> tuple:
+        """Ordered per-request responses for one row -> (out, err).
+
+        Default: single-request semantics on the first response. Multi-
+        request subclasses override to merge.
+        """
+        resp = resps[0] if resps else None
+        if resp is None:
+            return None, None
+        if resp["status_code"] // 100 == 2:
+            try:
+                out = (
+                    resp["entity"]
+                    if self._binary_response
+                    else self._project_response(response_to_json(resp))
+                )
+                return out, None
+            except (ValueError, KeyError, TypeError) as e:
+                return None, {
+                    "status_code": resp["status_code"],
+                    "reason": f"parse error: {e}",
+                }
+        return None, {
+            "status_code": resp["status_code"],
+            "reason": resp["reason"],
+            "entity": resp["entity"],
+        }
 
     # -- shared helpers ------------------------------------------------------
 
@@ -113,39 +147,38 @@ class CognitiveServiceBase(Transformer, _HasServiceParams, HasOutputCol):
 
         def fn(p: dict) -> dict:
             n = len(next(iter(p.values()))) if p else 0
-            reqs = []
+            # each row may expand to several requests (windowed audio etc.):
+            # flatten, fan out once, regroup in request order per row
+            row_reqs: list = []
+            jobs: list = []  # (row, idx_within_row, request)
             for i in range(n):
                 row_vals = {k: v[i] for k, v in p.items()}
                 vals = {
                     name: self._resolve(name, row_vals) for name in param_names
                 }
-                reqs.append(self._build_request(vals))
-            resps: list = [None] * n
-            live = [(i, r) for i, r in enumerate(reqs) if r is not None]
-            if live:
+                try:
+                    reqs = self._build_requests(vals)
+                except ValueError as e:  # bad row input: error, not a crash
+                    reqs = [{"__input_error__": str(e)}]
+                row_reqs.append(reqs)
+                for w, r in enumerate(reqs):
+                    if "__input_error__" not in r:
+                        jobs.append((i, w, r))
+            results: dict = {}
+            if jobs:
                 with _futures.ThreadPoolExecutor(max_workers=concurrency) as pool:
-                    results = pool.map(lambda ir: (ir[0], handler_fn(ir[1])), live)
-                    for i, resp in results:
-                        resps[i] = resp
+                    for (i, w), resp in pool.map(
+                        lambda j: ((j[0], j[1]), handler_fn(j[2])), jobs
+                    ):
+                        results[(i, w)] = resp
             outs = np.empty(n, dtype=object)
             errs = np.empty(n, dtype=object)
-            for i, resp in enumerate(resps):
-                if resp is None:
+            for i, reqs in enumerate(row_reqs):
+                if reqs and "__input_error__" in reqs[0]:
+                    errs[i] = {"status_code": 0, "reason": reqs[0]["__input_error__"]}
                     continue
-                if resp["status_code"] // 100 == 2:
-                    try:
-                        outs[i] = (
-                            resp["entity"]
-                            if self._binary_response
-                            else self._project_response(response_to_json(resp))
-                        )
-                    except (ValueError, KeyError, TypeError) as e:
-                        errs[i] = {"status_code": resp["status_code"],
-                                   "reason": f"parse error: {e}"}
-                else:
-                    errs[i] = {"status_code": resp["status_code"],
-                               "reason": resp["reason"],
-                               "entity": resp["entity"]}
+                resps = [results.get((i, w)) for w in range(len(reqs))]
+                outs[i], errs[i] = self._row_output(resps)
             q = dict(p)
             q[out_col] = outs
             q[err_col] = errs

@@ -1,18 +1,26 @@
-"""Speech-to-text transformer (cognitive/SpeechToText.scala analogue).
+"""Speech-to-text transformers (cognitive/SpeechToText.scala +
+SpeechToTextSDK.scala analogues).
 
-Wire format: Speech REST v1 — POST raw audio bytes (wav) with language in
-the query; response JSON carries ``DisplayText``/``RecognitionStatus``.
-(The reference's continuous Speech-SDK variant, SpeechToTextSDK.scala, is a
-streaming session against the same service; the REST form covers the
-capability offline.)
+``SpeechToText``: one-shot REST v1 — POST raw audio bytes (wav) with
+language in the query; response JSON carries
+``DisplayText``/``RecognitionStatus``.
+
+``SpeechToTextSDK``: continuous recognition over audio streams. The
+reference runs a Speech-SDK session fed by ``WavStream``/
+``CompressedStream`` pull streams (SpeechToTextSDK.scala:204-249,367);
+here the stream is windowed host-side (cognitive/audio.py), each
+sample-aligned window is recognized via the same REST wire format, and
+the per-row output is the ordered list of segment results.
 """
 
 from __future__ import annotations
 
 from typing import Any, Optional
 
+from mmlspark_tpu.cognitive.audio import CompressedStream, WavStream
 from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
-from mmlspark_tpu.io.http_schema import HTTPRequestData
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.io.http_schema import HTTPRequestData, response_to_json
 
 
 class SpeechToText(CognitiveServiceBase):
@@ -36,3 +44,59 @@ class SpeechToText(CognitiveServiceBase):
         )
         headers = self._headers(vals, content_type="audio/wav; codecs=audio/pcm")
         return HTTPRequestData(url, "POST", headers, bytes(audio))
+
+
+class SpeechToTextSDK(SpeechToText):
+    """Continuous recognition: window the audio stream, recognize each
+    window, emit the ordered segment list (see module docstring). Failed
+    windows keep their position as ``None`` placeholders so transcripts
+    never look complete when audio was lost; every window's error is kept.
+    """
+
+    window_seconds = Param("recognition window length", default=15.0, type_=float)
+    stream_format = Param(
+        "'wav' (parsed + sample-aligned windows) or 'compressed' (opaque)",
+        default="wav",
+        validator=lambda v: v in ("wav", "compressed"),
+    )
+
+    def _segments(self, audio: Any) -> list:
+        if audio is None:
+            return []
+        data = bytes(audio)
+        if self.get("stream_format") == "wav":
+            try:
+                stream: Any = WavStream(data)
+            except ValueError:
+                stream = CompressedStream(data)  # not RIFF: pass through
+        else:
+            stream = CompressedStream(data)
+        return list(stream.windows(self.get("window_seconds")))
+
+    def _build_requests(self, vals: dict) -> list:
+        reqs = []
+        for window in self._segments(vals.get("audio_data")):
+            r = self._build_request({**vals, "audio_data": window})
+            if r is not None:
+                reqs.append(r)
+        return reqs
+
+    def _row_output(self, resps: list) -> tuple:
+        segs: list = []
+        errors: list = []
+        for w, resp in enumerate(resps):
+            if resp is None:
+                segs.append(None)
+                continue
+            if resp["status_code"] // 100 == 2:
+                try:
+                    segs.append(response_to_json(resp))
+                    continue
+                except (ValueError, KeyError, TypeError) as e:
+                    errors.append({"window": w, "status_code": resp["status_code"],
+                                   "reason": f"parse error: {e}"})
+            else:
+                errors.append({"window": w, "status_code": resp["status_code"],
+                               "reason": resp["reason"], "entity": resp["entity"]})
+            segs.append(None)  # placeholder keeps window positions aligned
+        return segs, (errors or None)

@@ -25,6 +25,7 @@ from mmlspark_tpu.io.http_transformer import HTTPTransformer, SimpleHTTPTransfor
 from mmlspark_tpu.io.consolidator import PartitionConsolidator
 from mmlspark_tpu.io.binary import read_binary_files, read_images
 from mmlspark_tpu.io.csv import read_csv
+from mmlspark_tpu.io.port_forwarding import PortForwarding, build_forward_command
 from mmlspark_tpu.io.powerbi import PowerBIWriter
 
 __all__ = [
@@ -48,4 +49,6 @@ __all__ = [
     "read_images",
     "PowerBIWriter",
     "read_csv",
+    "PortForwarding",
+    "build_forward_command",
 ]

@@ -1,0 +1,129 @@
+"""Audio stream parsing + SpeechToTextSDK windowed recognition tests."""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.cognitive.audio import CompressedStream, WavFormat, WavStream, wrap_wav
+from mmlspark_tpu.cognitive.speech import SpeechToTextSDK
+
+
+def make_wav(seconds: float, rate: int = 8000, channels: int = 1, bits: int = 16) -> bytes:
+    fmt = WavFormat(channels, rate, bits)
+    n = int(rate * seconds) * channels * (bits // 8)
+    return wrap_wav(b"\x01\x02" * (n // 2), fmt)
+
+
+class TestWavStream:
+    def test_parse_roundtrip(self):
+        blob = make_wav(2.0)
+        s = WavStream(blob)
+        assert s.format.sample_rate == 8000
+        assert s.format.channels == 1
+        assert abs(s.duration_seconds - 2.0) < 0.01
+
+    def test_windows_cover_all_pcm(self):
+        s = WavStream(make_wav(3.5))
+        wins = list(s.windows(window_seconds=1.0))
+        assert len(wins) == 4  # 3 full + 1 partial
+        total_pcm = sum(len(WavStream(w).pcm) for w in wins)
+        assert total_pcm == len(s.pcm)
+        for w in wins:  # each window is itself a valid WAV
+            WavStream(w)
+
+    def test_windows_sample_aligned(self):
+        s = WavStream(make_wav(1.0, channels=2, bits=16))
+        for w in s.windows(0.25):
+            assert len(WavStream(w).pcm) % 4 == 0  # 2ch x 2B frames
+
+    def test_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            WavStream(b"not audio at all")
+
+    def test_compressed_passthrough(self):
+        data = b"\xff\xfbOGGOPUS"
+        wins = list(CompressedStream(data).windows(1.0))
+        assert wins == [data]
+
+
+class _SpeechHandler(BaseHTTPRequestHandler):
+    calls: list = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        type(self).calls.append(body)
+        out = json.dumps(
+            {"RecognitionStatus": "Success", "DisplayText": f"seg{len(type(self).calls)}"}
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture()
+def speech_server():
+    _SpeechHandler.calls = []
+    srv = HTTPServer(("127.0.0.1", 0), _SpeechHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+class TestSpeechToTextSDK:
+    def test_windowed_recognition(self, speech_server):
+        blob = np.empty(1, dtype=object)
+        blob[0] = make_wav(2.5)
+        df = DataFrame.from_dict({"audio": blob})
+        stage = SpeechToTextSDK(
+            url=speech_server,
+            output_col="text",
+            window_seconds=1.0,
+            use_advanced_handler=False,
+            concurrency=1,
+        ).set_col("audio_data", "audio")
+        out = stage.transform(df)
+        segs = out["text"][0]
+        assert [s["DisplayText"] for s in segs] == ["seg1", "seg2", "seg3"]
+        # each POST body was a valid standalone WAV
+        for body in _SpeechHandler.calls:
+            WavStream(body)
+
+    def test_compressed_single_window(self, speech_server):
+        blob = np.empty(1, dtype=object)
+        blob[0] = b"\x00opaque-compressed"
+        df = DataFrame.from_dict({"audio": blob})
+        stage = SpeechToTextSDK(
+            url=speech_server,
+            output_col="text",
+            stream_format="compressed",
+            use_advanced_handler=False,
+        ).set_col("audio_data", "audio")
+        out = stage.transform(df)
+        assert len(out["text"][0]) == 1
+
+    def test_error_column(self):
+        blob = np.empty(1, dtype=object)
+        blob[0] = make_wav(0.5)
+        df = DataFrame.from_dict({"audio": blob})
+        stage = SpeechToTextSDK(
+            url="http://127.0.0.1:9",  # dead endpoint
+            output_col="text",
+            use_advanced_handler=False,
+        ).set_col("audio_data", "audio")
+        out = stage.transform(df)
+        errs = out["text_error"][0]
+        assert errs and errs[0]["window"] == 0
+        assert out["text"][0] == [None]  # placeholder keeps alignment

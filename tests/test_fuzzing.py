@@ -273,6 +273,7 @@ def make_test_objects() -> list:
         C.DetectAnomalies(url=dead, output_col="o", **no_retry).set_col("series", "series"),
         C.DetectLastAnomaly(url=dead, output_col="o", **no_retry).set_col("series", "series"),
         C.SpeechToText(url=dead, output_col="o", **no_retry).set_col("audio_data", "blob"),
+        C.SpeechToTextSDK(url=dead, output_col="o", **no_retry).set_col("audio_data", "blob"),
         C.BingImageSearch(url=dead, output_col="o", **no_retry).set_col("query", "text"),
     ]
     objs += [TestObject(s, tiny) for s in cog_stages]

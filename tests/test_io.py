@@ -232,3 +232,29 @@ def test_powerbi_writer(server):
     assert sum(len(b) for b in sent) == 7
     with pytest.raises(RuntimeError):
         PowerBIWriter.write(df, server + "/fail", minibatch_size=10)
+
+
+class TestPortForwarding:
+    def test_command_construction(self):
+        from mmlspark_tpu.io import build_forward_command
+
+        cmd = build_forward_command(
+            "gw.example.com", 8888, 9999, user="svc", key_file="/k.pem",
+            ssh_options={"ServerAliveInterval": "10"},
+        )
+        assert cmd[0] == "ssh" and "-N" in cmd and "-R" in cmd
+        assert "8888:127.0.0.1:9999" in cmd
+        assert "svc@gw.example.com" == cmd[-1]
+        assert "-i" in cmd and "/k.pem" in cmd
+        assert "-o" in cmd and "ServerAliveInterval=10" in " ".join(cmd)
+
+    def test_failed_tunnel_raises(self):
+        from mmlspark_tpu.io import PortForwarding
+
+        # ProxyCommand=false makes the connection fail deterministically fast
+        pf = PortForwarding("127.0.0.1", 1, 2, ProxyCommand="false", BatchMode="yes")
+        import pytest as _pytest
+
+        with _pytest.raises((RuntimeError, FileNotFoundError)):
+            pf.start(settle_seconds=1.5)
+        assert not pf.running
