@@ -158,7 +158,7 @@ class CognitiveServiceBase(Transformer, _HasServiceParams, HasOutputCol):
                 }
                 try:
                     reqs = self._build_requests(vals)
-                except ValueError as e:  # bad row input: error, not a crash
+                except (ValueError, TypeError) as e:  # bad row input: error, not a crash
                     reqs = [{"__input_error__": str(e)}]
                 row_reqs.append(reqs)
                 for w, r in enumerate(reqs):

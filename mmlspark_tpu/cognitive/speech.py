@@ -53,7 +53,10 @@ class SpeechToTextSDK(SpeechToText):
     never look complete when audio was lost; every window's error is kept.
     """
 
-    window_seconds = Param("recognition window length", default=15.0, type_=float)
+    window_seconds = Param(
+        "recognition window length", default=15.0, type_=float,
+        validator=lambda v: v > 0,
+    )
     stream_format = Param(
         "'wav' (parsed + sample-aligned windows) or 'compressed' (opaque)",
         default="wav",

@@ -1,0 +1,69 @@
+"""Profiling/tracing (SURVEY.md §5.1: the reference has StopWatch timers +
+the Timer pipeline stage; the TPU equivalent adds device-level tracing).
+
+- :func:`trace` wraps ``jax.profiler.trace`` — XLA/TPU timeline capture
+  viewable in TensorBoard/Perfetto.
+- :func:`annotate` marks host spans so stage boundaries show up inside the
+  device trace (the log-per-stage analogue of stages/Timer.scala:57-92).
+- :class:`ProfiledRun` collects per-stage wall times for a pipeline the
+  way VW's TrainingStats DataFrame reports per-partition timings.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+
+from mmlspark_tpu.core.dataframe import DataFrame
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Capture a device+host profiler trace into ``log_dir``."""
+    with jax.profiler.trace(log_dir, create_perfetto_link=create_perfetto_link):
+        yield
+
+
+def annotate(name: str) -> Any:
+    """Named host span that nests into the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class ProfiledRun:
+    """Time each stage of a pipeline transform; emit a stats DataFrame.
+
+    >>> prof = ProfiledRun()
+    >>> out = prof.transform(pipeline_model, df)
+    >>> prof.stats().head()   # stage, seconds
+    """
+
+    def __init__(self) -> None:
+        self.records: list = []
+
+    def transform(self, pipeline_model: Any, df: DataFrame) -> DataFrame:
+        stages = (
+            pipeline_model.get("stages")
+            if "stages" in type(pipeline_model).params()
+            else [pipeline_model]
+        )
+        cur = df
+        for stage in stages:
+            name = type(stage).__name__
+            t0 = time.perf_counter_ns()
+            with annotate(name):
+                cur = stage.transform(cur)
+            self.records.append((name, time.perf_counter_ns() - t0))
+        return cur
+
+    def stats(self) -> DataFrame:
+        import numpy as np
+
+        return DataFrame.from_dict(
+            {
+                "stage": np.array([r[0] for r in self.records], dtype=object),
+                "seconds": np.array([r[1] / 1e9 for r in self.records]),
+            }
+        )

@@ -77,9 +77,24 @@ class PortForwarding:
             err = (self._proc.stderr.read() if self._proc.stderr else b"").decode(
                 "utf-8", "replace"
             )
+            self._proc = None
             raise RuntimeError(
                 f"ssh forward failed ({shlex.join(self.command)}): {err.strip()}"
             )
+        # long-lived tunnel: drain stderr in the background so a chatty ssh
+        # (keepalive warnings, -v) can never fill the pipe and block forwarding
+        stderr = self._proc.stderr
+
+        def _drain() -> None:
+            try:
+                while stderr.read(65536):
+                    pass
+            except (OSError, ValueError):
+                pass
+
+        import threading
+
+        threading.Thread(target=_drain, name="ssh-stderr-drain", daemon=True).start()
         return self
 
     def stop(self) -> None:

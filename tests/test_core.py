@@ -233,3 +233,27 @@ def test_retry_with_backoff():
 
 def test_find_unused_column():
     assert find_unused_column("x", ["x", "x_1"]) == "x_2"
+
+
+class TestProfiling:
+    def test_profiled_run_times_stages(self):
+        import numpy as np
+
+        from mmlspark_tpu import DataFrame, Pipeline
+        from mmlspark_tpu.core.profiling import ProfiledRun, annotate
+        from mmlspark_tpu.stages import DropColumns, RenameColumn
+
+        df = DataFrame.from_dict({"a": np.arange(5), "b": np.arange(5)})
+        pm = Pipeline([RenameColumn(input_col="a", output_col="x"), DropColumns(cols=["b"])]).fit(df)
+        prof = ProfiledRun()
+        out = prof.transform(pm, df)
+        assert out.columns == ["x"]
+        stats = prof.stats()
+        assert stats["stage"].tolist() == ["RenameColumn", "DropColumns"]
+        assert (stats["seconds"] >= 0).all()
+
+    def test_annotate_nests(self):
+        from mmlspark_tpu.core.profiling import annotate
+
+        with annotate("span"):
+            pass  # no-op outside an active trace
