@@ -1,3 +1,3 @@
-from mmlspark_tpu.downloader.zoo import ModelDownloader, ModelSchema
+from mmlspark_tpu.downloader.zoo import ModelDownloader, ModelSchema, RemoteRepository
 
-__all__ = ["ModelDownloader", "ModelSchema"]
+__all__ = ["ModelDownloader", "ModelSchema", "RemoteRepository"]

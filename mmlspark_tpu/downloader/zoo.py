@@ -148,6 +148,75 @@ class ModelDownloader:
         urllib.request.urlretrieve(schema.uri, wpath)  # noqa: S310
 
 
+class RemoteRepository:
+    """HTTP model repository (the remote ``Repository[ModelSchema]`` of
+    ModelDownloader.scala:55-118): a base URL serving ``index.json`` (list
+    of schema dicts) and one ``<name>.msgpack`` weight blob per model.
+    ``sync`` mirrors models into a local ModelDownloader repo, verifying
+    checksums, with retry/backoff (FaultToleranceUtils analogue)."""
+
+    _NAME_OK = __import__("re").compile(r"^[A-Za-z0-9._-]+$")
+
+    def __init__(self, base_url: str, local: Optional[ModelDownloader] = None):
+        self.base_url = base_url.rstrip("/")
+        self.local = local or ModelDownloader()
+
+    def _get(self, path: str) -> bytes:
+        import urllib.error
+        import urllib.request
+
+        def pull() -> bytes:
+            with urllib.request.urlopen(f"{self.base_url}/{path}") as r:  # noqa: S310
+                return r.read()
+
+        def retryable(e: Exception) -> bool:
+            # 4xx can never succeed on retry; everything else (5xx, network)
+            # gets the backoff schedule
+            return not (
+                isinstance(e, urllib.error.HTTPError) and 400 <= e.code < 500
+            )
+
+        return retry_with_backoff(pull, retryable=retryable)
+
+    def list_models(self) -> list:
+        index = json.loads(self._get("index.json"))
+        return [ModelSchema(**s) for s in index]
+
+    def _checked_name(self, name: str) -> str:
+        # remote-controlled names become local file paths: allow only plain
+        # identifiers so a hostile index cannot traverse out of repo_dir
+        if not self._NAME_OK.match(name) or ".." in name:
+            raise ValueError(f"illegal remote model name {name!r}")
+        return name
+
+    def download(self, schema: ModelSchema) -> ModelSchema:
+        """Fetch one model's weights into the local repo."""
+        name = self._checked_name(schema.name)
+        blob = self._get(f"{name}.msgpack")
+        if schema.sha256 and hashlib.sha256(blob).hexdigest() != schema.sha256:
+            raise IOError(f"checksum mismatch downloading {name}")
+        spath, wpath = self.local._paths(name)
+        with open(wpath, "wb") as f:
+            f.write(blob)
+        if not schema.sha256:
+            schema.sha256 = hashlib.sha256(blob).hexdigest()
+        with open(spath, "w") as f:
+            f.write(schema.to_json())
+        return schema
+
+    def download_by_name(self, name: str) -> ModelSchema:
+        """Fetch schema + weights into the local repo; returns the schema."""
+        schema = next((s for s in self.list_models() if s.name == name), None)
+        if schema is None:
+            raise KeyError(f"model {name!r} not in remote index")
+        return self.download(schema)
+
+    def sync(self) -> list:
+        """Mirror every remote model locally; returns the schemas.
+        The index is fetched once (not per model)."""
+        return [self.download(s) for s in self.list_models()]
+
+
 def _to_np(tree: Any) -> Any:
     if isinstance(tree, dict):
         return {k: _to_np(v) for k, v in tree.items()}

@@ -158,3 +158,75 @@ def test_image_featurizer_drops_bad_rows(tiny_repo):
     )
     out = feat.transform(df)
     assert out.count() == 2
+
+
+class TestRemoteRepository:
+    def test_sync_from_http(self, tmp_path):
+        """Serve a repo over local HTTP; sync it into a fresh local repo."""
+        import hashlib
+        import json as _json
+        import threading
+        from functools import partial
+        from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+        import numpy as np
+        from flax import serialization as fser
+
+        from mmlspark_tpu.downloader import ModelDownloader, ModelSchema, RemoteRepository
+
+        # build the remote side: one tiny model + index.json
+        remote_dir = tmp_path / "remote"
+        remote_dir.mkdir()
+        weights = {"params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+        blob = fser.msgpack_serialize(weights)
+        (remote_dir / "TinyNet.msgpack").write_bytes(blob)
+        schema = ModelSchema(name="TinyNet", variant="ResNet18",
+                             sha256=hashlib.sha256(blob).hexdigest())
+        from dataclasses import asdict
+        (remote_dir / "index.json").write_text(_json.dumps([asdict(schema)]))
+
+        handler = partial(SimpleHTTPRequestHandler, directory=str(remote_dir))
+        srv = HTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            local = ModelDownloader(str(tmp_path / "local"))
+            repo = RemoteRepository(f"http://127.0.0.1:{srv.server_port}", local)
+            assert [s.name for s in repo.list_models()] == ["TinyNet"]
+            synced = repo.sync()
+            assert synced[0].sha256 == schema.sha256
+            assert "TinyNet" in local.list_models()
+            # weights round-trip through the local repo files
+            spath, wpath = local._paths("TinyNet")
+            got = fser.msgpack_restore(open(wpath, "rb").read())
+            np.testing.assert_allclose(got["params"]["w"], weights["params"]["w"])
+        finally:
+            srv.shutdown()
+
+    def test_checksum_mismatch_raises(self, tmp_path):
+        import json as _json
+        import threading
+        from dataclasses import asdict
+        from functools import partial
+        from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+        from mmlspark_tpu.downloader import ModelDownloader, ModelSchema, RemoteRepository
+
+        remote_dir = tmp_path / "remote"
+        remote_dir.mkdir()
+        (remote_dir / "Bad.msgpack").write_bytes(b"tampered")
+        schema = ModelSchema(name="Bad", sha256="0" * 64)
+        (remote_dir / "index.json").write_text(_json.dumps([asdict(schema)]))
+        handler = partial(SimpleHTTPRequestHandler, directory=str(remote_dir))
+        srv = HTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            repo = RemoteRepository(
+                f"http://127.0.0.1:{srv.server_port}",
+                ModelDownloader(str(tmp_path / "local")),
+            )
+            import pytest as _pytest
+
+            with _pytest.raises(IOError):
+                repo.download_by_name("Bad")
+        finally:
+            srv.shutdown()

@@ -1,0 +1,41 @@
+"""Plot helper tests (rendered to Agg, assertions on artists/data)."""
+
+from __future__ import annotations
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+
+from mmlspark_tpu.plot import confusion_matrix, feature_importance, roc_curve
+
+
+class TestPlots:
+    def test_confusion_matrix(self):
+        ax = confusion_matrix([0, 0, 1, 1], [0, 1, 1, 1])
+        assert ax.get_xlabel() == "predicted"
+        # annotated texts include the count 2 (two correct 1s)
+        texts = {t.get_text() for t in ax.texts}
+        assert "2" in texts and "1" in texts
+
+    def test_confusion_matrix_normalized(self):
+        ax = confusion_matrix([0, 1], [0, 1], normalize=True)
+        assert "1.00" in {t.get_text() for t in ax.texts}
+
+    def test_feature_importance_orders_topn(self):
+        ax = feature_importance([0.1, 5.0, 2.0], ["a", "b", "c"], top_n=2)
+        labels = [t.get_text() for t in ax.get_yticklabels()]
+        assert labels == ["c", "b"]  # ascending bars: top feature last
+
+    def test_roc_auc_perfect(self):
+        ax = roc_curve([0, 0, 1, 1], [0.1, 0.2, 0.8, 0.9])
+        assert "AUC = 1.000" in ax.get_legend().get_texts()[0].get_text()
+
+    def test_roc_auc_random(self):
+        rng = np.random.RandomState(0)
+        y = rng.randint(0, 2, 2000)
+        s = rng.rand(2000)
+        ax = roc_curve(y, s)
+        auc = float(ax.get_legend().get_texts()[0].get_text().split("= ")[1])
+        assert 0.45 < auc < 0.55
