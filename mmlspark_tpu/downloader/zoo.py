@@ -14,6 +14,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 from dataclasses import asdict, dataclass, field
 from typing import Any, Optional
 
@@ -84,17 +85,25 @@ class ModelDownloader:
             os.path.join(self.repo_dir, f"{name}.msgpack"),
         )
 
-    def register(self, schema: ModelSchema, variables: Any) -> None:
-        """Install a model (e.g. converted pretrained weights) into the repo."""
-        from flax import serialization as fser
-
+    def install_blob(self, schema: ModelSchema, blob: bytes) -> ModelSchema:
+        """Write a serialized-weights blob + schema into the repo (single
+        place that knows the on-disk layout); fills sha256 if absent."""
+        if not schema.sha256:
+            schema.sha256 = hashlib.sha256(blob).hexdigest()
         spath, wpath = self._paths(schema.name)
-        blob = fser.msgpack_serialize(_to_np(variables))
-        schema.sha256 = hashlib.sha256(blob).hexdigest()
         with open(wpath, "wb") as f:
             f.write(blob)
         with open(spath, "w") as f:
             f.write(schema.to_json())
+        return schema
+
+    def register(self, schema: ModelSchema, variables: Any) -> None:
+        """Install a model (e.g. converted pretrained weights) into the repo."""
+        from flax import serialization as fser
+
+        blob = fser.msgpack_serialize(_to_np(variables))
+        schema.sha256 = hashlib.sha256(blob).hexdigest()
+        self.install_blob(schema, blob)
 
     def download_by_name(self, name: str) -> ModelSchema:
         """Ensure the named model exists locally; return its schema."""
@@ -108,9 +117,9 @@ class ModelDownloader:
         if schema.uri:  # remote fetch path (with retries); unused offline
             retry_with_backoff(lambda: self._fetch(schema, wpath))
             with open(wpath, "rb") as f:
-                schema.sha256 = hashlib.sha256(f.read()).hexdigest()
-            with open(spath, "w") as f:
-                f.write(schema.to_json())
+                blob = f.read()
+            schema.sha256 = hashlib.sha256(blob).hexdigest()
+            self.install_blob(schema, blob)
         else:
             from mmlspark_tpu.models.resnet import init_resnet
 
@@ -155,18 +164,28 @@ class RemoteRepository:
     ``sync`` mirrors models into a local ModelDownloader repo, verifying
     checksums, with retry/backoff (FaultToleranceUtils analogue)."""
 
-    _NAME_OK = __import__("re").compile(r"^[A-Za-z0-9._-]+$")
+    _NAME_OK = re.compile(r"[A-Za-z0-9._-]+")
 
-    def __init__(self, base_url: str, local: Optional[ModelDownloader] = None):
+    def __init__(
+        self,
+        base_url: str,
+        local: Optional[ModelDownloader] = None,
+        timeout_s: float = 60.0,
+    ):
         self.base_url = base_url.rstrip("/")
         self.local = local or ModelDownloader()
+        self.timeout_s = timeout_s
 
     def _get(self, path: str) -> bytes:
         import urllib.error
         import urllib.request
 
         def pull() -> bytes:
-            with urllib.request.urlopen(f"{self.base_url}/{path}") as r:  # noqa: S310
+            # explicit timeout: a stalled server must raise into the backoff
+            # schedule, not hang sync() (retryWithTimeout semantics)
+            with urllib.request.urlopen(  # noqa: S310
+                f"{self.base_url}/{path}", timeout=self.timeout_s
+            ) as r:
                 return r.read()
 
         def retryable(e: Exception) -> bool:
@@ -185,7 +204,7 @@ class RemoteRepository:
     def _checked_name(self, name: str) -> str:
         # remote-controlled names become local file paths: allow only plain
         # identifiers so a hostile index cannot traverse out of repo_dir
-        if not self._NAME_OK.match(name) or ".." in name:
+        if not self._NAME_OK.fullmatch(name) or ".." in name:
             raise ValueError(f"illegal remote model name {name!r}")
         return name
 
@@ -195,14 +214,7 @@ class RemoteRepository:
         blob = self._get(f"{name}.msgpack")
         if schema.sha256 and hashlib.sha256(blob).hexdigest() != schema.sha256:
             raise IOError(f"checksum mismatch downloading {name}")
-        spath, wpath = self.local._paths(name)
-        with open(wpath, "wb") as f:
-            f.write(blob)
-        if not schema.sha256:
-            schema.sha256 = hashlib.sha256(blob).hexdigest()
-        with open(spath, "w") as f:
-            f.write(schema.to_json())
-        return schema
+        return self.local.install_blob(schema, blob)
 
     def download_by_name(self, name: str) -> ModelSchema:
         """Fetch schema + weights into the local repo; returns the schema."""

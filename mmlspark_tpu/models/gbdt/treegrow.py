@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-NUM_BINS = 256  # uint8 bin space; bin 0 = missing
+from mmlspark_tpu.ops.histogram import NUM_BINS  # uint8 bin space; bin 0 = missing
 
 
 class GrownTree(NamedTuple):
@@ -73,25 +73,16 @@ def grow_tree(
     h = hess * row_weight
     cnt_w = row_weight
 
-    feat_offset = (jnp.arange(d, dtype=jnp.int32) * B)[None, :]  # (1, d)
-    plane_idx = feat_offset + bins  # (n, d) indices into one (d*B,) leaf plane
-    # (n, d, 3) stacked per-row stats: one fused scatter builds g/h/count
-    stats = jnp.stack(
-        [
-            jnp.broadcast_to(g[:, None], (n, d)),
-            jnp.broadcast_to(h[:, None], (n, d)),
-            jnp.broadcast_to(cnt_w[:, None], (n, d)),
-        ],
-        axis=-1,
-    )
+    # per-row (g, h, count) stats; the histogram op picks its lowering
+    # (Pallas one-hot matmul on single-chip TPU, GSPMD-partitioned scatter
+    # under sharded meshes / CPU) — see ops/histogram.py
+    from mmlspark_tpu.ops.histogram import plane_histogram
+
+    row_stats = jnp.stack([g, h, cnt_w], axis=-1)  # (n, 3)
 
     def plane_hist(mask: jnp.ndarray) -> jnp.ndarray:
         """Histogram of the rows selected by ``mask`` -> (d*B, 3)."""
-        return (
-            jnp.zeros((d * B, 3), jnp.float32)
-            .at[plane_idx]
-            .add(stats * mask[:, None, None], mode="drop")
-        )
+        return plane_histogram(bins, row_stats, mask)
 
     def step(k: int, state: tuple) -> tuple:
         (hist, row_leaf, leaf_depth, done,

@@ -32,14 +32,18 @@ def confusion_matrix(
     y_pred = np.asarray(y_pred)
     if labels is None:
         labels = np.unique(np.concatenate([y_true, y_pred]))
-    idx = {v: i for i, v in enumerate(labels)}
     k = len(labels)
+    label_arr = np.asarray(labels)
+    # vectorized lookup that respects the caller's label ORDER: search the
+    # sorted view, then map positions back through the sorter
+    sorter = np.argsort(label_arr, kind="stable")
+    sl = label_arr[sorter]
+    tpos = np.clip(np.searchsorted(sl, y_true), 0, k - 1)
+    ppos = np.clip(np.searchsorted(sl, y_pred), 0, k - 1)
+    # pairs outside the explicit label list are skipped (sklearn behavior)
+    ok = (sl[tpos] == y_true) & (sl[ppos] == y_pred)
     cm = np.zeros((k, k), np.float64)
-    for t, p in zip(y_true, y_pred):
-        ti, pi = idx.get(t), idx.get(p)
-        if ti is None or pi is None:
-            continue  # pair outside the explicit label list (sklearn behavior)
-        cm[ti, pi] += 1
+    np.add.at(cm, (sorter[tpos][ok], sorter[ppos][ok]), 1.0)
     if normalize:
         cm = cm / np.maximum(cm.sum(axis=1, keepdims=True), 1)
 
