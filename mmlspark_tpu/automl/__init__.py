@@ -6,7 +6,12 @@ from mmlspark_tpu.automl.hyperparams import (
     RandomSpace,
     RangeHyperParam,
 )
-from mmlspark_tpu.automl.tune import FindBestModel, FindBestModelResult, TuneHyperparameters
+from mmlspark_tpu.automl.tune import (
+    EvaluationUtils,
+    FindBestModel,
+    FindBestModelResult,
+    TuneHyperparameters,
+)
 
 __all__ = [
     "TuneHyperparameters",
@@ -18,4 +23,5 @@ __all__ = [
     "DiscreteHyperParam",
     "RangeHyperParam",
     "DefaultHyperparams",
+    "EvaluationUtils",
 ]

@@ -22,6 +22,27 @@ from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
 from mmlspark_tpu.automl.hyperparams import RandomSpace
 
 
+class EvaluationUtils:
+    """automl/EvaluationUtils.scala analogue: metric resolution helpers
+    shared by TuneHyperparameters / FindBestModel."""
+
+    @staticmethod
+    def is_higher_better(metric: str) -> bool:
+        return metric in MetricConstants.HIGHER_IS_BETTER
+
+    @staticmethod
+    def default_metric(task: str) -> str:
+        return (
+            MetricConstants.ACCURACY
+            if task in ("classification", "classifier")
+            else MetricConstants.RMSE
+        )
+
+    @staticmethod
+    def evaluate(df: DataFrame, label_col: str, metric: str) -> float:
+        return _evaluate(df, label_col, metric)
+
+
 def _evaluate(df: DataFrame, label_col: str, metric: str) -> float:
     y = df[label_col]
     pred = df["prediction"]
