@@ -44,6 +44,8 @@ class GrownTree(NamedTuple):
     leaf_values: jnp.ndarray   # (L,) float32 (shrinkage applied)
     leaf_counts: jnp.ndarray   # (L,) int32
     row_leaf: jnp.ndarray      # (n,) int32 final leaf of every row
+    rec_is_cat: jnp.ndarray    # (L-1,) bool: categorical subset split
+    rec_catmask: jnp.ndarray   # (L-1, B) bool: bins going LEFT (cat splits)
 
 
 @functools.partial(
@@ -64,11 +66,15 @@ def grow_tree(
     feature_mask: jnp.ndarray,    # (d,) f32 1/0 (feature_fraction)
     max_depth: int = -1,
     min_data_in_leaf: int = 20,
+    categorical_mask: Optional[jnp.ndarray] = None,  # (d,) bool
 ) -> GrownTree:
     n, d = bins.shape
     L = num_leaves
     B = NUM_BINS
     bins = bins.astype(jnp.int32)
+    if categorical_mask is None:
+        categorical_mask = jnp.zeros((d,), bool)
+    cat_f = categorical_mask.astype(bool)
     g = grad * row_weight
     h = hess * row_weight
     cnt_w = row_weight
@@ -86,7 +92,8 @@ def grow_tree(
 
     def step(k: int, state: tuple) -> tuple:
         (hist, row_leaf, leaf_depth, done,
-         rec_leaf, rec_feature, rec_bin, rec_active, rec_gain) = state
+         rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+         rec_is_cat, rec_catmask) = state
 
         # hist is carried incrementally: (L, d*B, 3) cube, only the two
         # children of the previous split changed (LightGBM's
@@ -104,9 +111,26 @@ def grow_tree(
         GL, HL, CL = cg, ch, cc
         GR, HR, CR = G - GL, H - HL, C - CL
         lam = lambda_l2
-        gain = (
+        gain_num = (
             GL * GL / (HL + lam)
             + GR * GR / (HR + lam)
+            - G * G / (H + lam)
+        )
+        # categorical subset split (LightGBM's sorted-by-ratio scan: order
+        # category bins by G/H, then the best LEFT set is some prefix —
+        # Fisher's optimal-partition result for convex losses). ``bb`` for a
+        # categorical split is the PREFIX LENGTH in this order, not a bin.
+        ratio = jnp.where(hc > 0, hg / (hh + 1e-12), -jnp.inf)
+        order = jnp.argsort(-ratio, axis=2)  # (L, d, B) bin ids, best first
+        sgs = jnp.take_along_axis(hg, order, 2)
+        shs = jnp.take_along_axis(hh, order, 2)
+        scs = jnp.take_along_axis(hc, order, 2)
+        cgs = jnp.cumsum(sgs, axis=2)
+        chs = jnp.cumsum(shs, axis=2)
+        ccs = jnp.cumsum(scs, axis=2)
+        gain_cat = (
+            cgs * cgs / (chs + lam)
+            + (G - cgs) ** 2 / (H - chs + lam)
             - G * G / (H + lam)
         )
         num_active = k + 1
@@ -114,13 +138,24 @@ def grow_tree(
         leaf_ok = (leaf_ids < num_active)[:, None, None]
         if max_depth > 0:
             leaf_ok = leaf_ok & (leaf_depth < max_depth)[:, None, None]
-        valid = (
-            leaf_ok
+        base_ok = leaf_ok & (feature_mask[None, :, None] > 0)
+        valid_num = (
+            base_ok
+            & ~cat_f[None, :, None]
             & (CL >= min_data_in_leaf)
             & (CR >= min_data_in_leaf)
-            & (feature_mask[None, :, None] > 0)
         )
-        gain = jnp.where(valid, gain, -jnp.inf)
+        valid_cat = (
+            base_ok
+            & cat_f[None, :, None]
+            & (ccs >= min_data_in_leaf)
+            & ((C - ccs) >= min_data_in_leaf)
+        )
+        gain = jnp.where(
+            cat_f[None, :, None],
+            jnp.where(valid_cat, gain_cat, -jnp.inf),
+            jnp.where(valid_num, gain_num, -jnp.inf),
+        )
         flat = gain.reshape(-1)
         best = jnp.argmax(flat)
         best_gain = flat[best]
@@ -129,9 +164,18 @@ def grow_tree(
         bb = (best % B).astype(jnp.int32)
 
         do_split = (~done) & (best_gain > min_gain) & jnp.isfinite(best_gain)
+        is_cat_split = cat_f[bf]
+        # left-set membership per bin for the chosen (leaf, feature):
+        # rank[bin] = position of bin in the sorted order; prefix <= bb
+        order_sel = order[bl, bf]                 # (B,)
+        rank = jnp.argsort(order_sel)             # inverse permutation
+        catmask = rank <= bb                      # (B,) bool: LEFT bins
         new_id = jnp.int32(k + 1)
         in_leaf = row_leaf == bl
-        goes_right = in_leaf & (bins[:, bf] > bb)
+        row_bins = bins[:, bf]
+        goes_right = in_leaf & jnp.where(
+            is_cat_split, ~catmask[row_bins], row_bins > bb
+        )
         moved = do_split & goes_right
         row_leaf = jnp.where(moved, new_id, row_leaf)
         # incremental histogram update: scatter only the moved rows into the
@@ -151,9 +195,14 @@ def grow_tree(
         rec_bin = rec_bin.at[k].set(jnp.where(do_split, bb, -1))
         rec_active = rec_active.at[k].set(do_split)
         rec_gain = rec_gain.at[k].set(jnp.where(do_split, best_gain, 0.0))
+        rec_is_cat = rec_is_cat.at[k].set(do_split & is_cat_split)
+        rec_catmask = rec_catmask.at[k].set(
+            jnp.where(do_split & is_cat_split, catmask, False)
+        )
         done = done | ~do_split
         return (hist, row_leaf, leaf_depth, done,
-                rec_leaf, rec_feature, rec_bin, rec_active, rec_gain)
+                rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+                rec_is_cat, rec_catmask)
 
     # root histogram: the only full-data cube write of the whole tree
     hist0 = (
@@ -171,8 +220,11 @@ def grow_tree(
         jnp.full((L - 1,), -1, jnp.int32),
         jnp.zeros((L - 1,), bool),
         jnp.zeros((L - 1,), jnp.float32),
+        jnp.zeros((L - 1,), bool),
+        jnp.zeros((L - 1, B), bool),
     )
-    (_, row_leaf, _, _, rec_leaf, rec_feature, rec_bin, rec_active, rec_gain) = (
+    (_, row_leaf, _, _, rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+     rec_is_cat, rec_catmask) = (
         jax.lax.fori_loop(0, L - 1, step, init)
     )
 
@@ -185,6 +237,7 @@ def grow_tree(
     return GrownTree(
         rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
         leaf_values, Cl.astype(jnp.int32), row_leaf,
+        rec_is_cat, rec_catmask,
     )
 
 
@@ -198,28 +251,63 @@ def predict_leaves(
     rec_feature: jnp.ndarray,  # (T, S) int32
     rec_threshold: jnp.ndarray,  # (T, S) float32 (real-valued; <= goes left)
     rec_active: jnp.ndarray,   # (T, S) bool
+    rec_is_cat: Optional[jnp.ndarray] = None,   # (T, S) bool
+    rec_catmask: Optional[jnp.ndarray] = None,  # (T, S, B) bool; index = value+1
 ) -> jnp.ndarray:
     """Replay split logs for all trees at once -> (n, T) leaf indices.
 
-    NaN features always go LEFT (missing bin semantics of the trainer)."""
+    Numerical: NaN goes LEFT (missing-bin semantics). Categorical splits
+    route by set membership — a category value v looks up catmask[v + 1]
+    (identity binning; NaN -> slot 0, the missing category)."""
     n = x.shape[0]
     T, S = rec_leaf.shape
+    B = NUM_BINS
     row_leaf = jnp.zeros((n, T), jnp.int32)
+    if rec_is_cat is None:
+        rec_is_cat = jnp.zeros((T, S), bool)
+    if rec_catmask is None:
+        rec_catmask = jnp.zeros((T, S, B), bool)
 
     # scan over split steps: right child id of step k is k+1
     def body(row_leaf: jnp.ndarray, inputs: tuple) -> tuple:
-        k, leaf, feat, thr, active = inputs
+        k, leaf, feat, thr, active, is_cat, catmask = inputs
         vals = jnp.take_along_axis(
             x, jnp.broadcast_to(jnp.clip(feat, 0, x.shape[1] - 1)[None, :], (n, T)), axis=1
         )
         in_leaf = row_leaf == leaf[None, :]
-        goes_right = in_leaf & (vals > thr[None, :]) & ~jnp.isnan(vals) & active[None, :]
+        right_num = (vals > thr[None, :]) & ~jnp.isnan(vals)
+        # categorical: value -> bin slot (identity + missing at 0)
+        vbin = jnp.where(
+            jnp.isnan(vals),
+            0,
+            # clip in float first: huge values must not overflow the int cast
+            jnp.round(jnp.clip(vals, -1.0, float(B))).astype(jnp.int32) + 1,
+        )
+        vbin = jnp.clip(vbin, 0, B - 1)  # (n, T)
+        left_cat = jnp.take_along_axis(
+            jnp.broadcast_to(catmask[None], (n, T, B)), vbin[..., None], axis=2
+        )[..., 0]
+        goes_right = (
+            in_leaf
+            & active[None, :]
+            & jnp.where(is_cat[None, :], ~left_cat, right_num)
+        )
         row_leaf = jnp.where(goes_right, jnp.int32(k + 1), row_leaf)
         return row_leaf, None
 
     ks = jnp.arange(S, dtype=jnp.int32)
     row_leaf, _ = jax.lax.scan(
-        body, row_leaf, (ks, rec_leaf.T, rec_feature.T, rec_threshold.T, rec_active.T)
+        body,
+        row_leaf,
+        (
+            ks,
+            rec_leaf.T,
+            rec_feature.T,
+            rec_threshold.T,
+            rec_active.T,
+            rec_is_cat.T,
+            jnp.moveaxis(rec_catmask, 1, 0),
+        ),
     )
     return row_leaf
 
@@ -232,9 +320,13 @@ def predict_scores(
     rec_threshold: jnp.ndarray,
     rec_active: jnp.ndarray,
     leaf_values: jnp.ndarray,  # (T, L) float32
+    rec_is_cat: Optional[jnp.ndarray] = None,
+    rec_catmask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Sum of tree outputs -> (n,) raw score."""
-    leaves = predict_leaves(x, rec_leaf, rec_feature, rec_threshold, rec_active)
+    leaves = predict_leaves(
+        x, rec_leaf, rec_feature, rec_threshold, rec_active, rec_is_cat, rec_catmask
+    )
     per_tree = jnp.take_along_axis(
         jnp.broadcast_to(leaf_values[None], (x.shape[0], *leaf_values.shape)),
         leaves[..., None],
