@@ -36,7 +36,10 @@ def read_csv(
     """Load a CSV file into a DataFrame.
 
     numeric_only=True forces the native fast path (bad fields become NaN);
-    None auto-detects by probing the first data line.
+    None auto-detects by probing the first 20 data lines. A file whose
+    string values first appear after the probe window is still caught: if
+    the fast path leaves a column entirely NaN, auto-detection re-parses
+    with the mixed-type parser.
     """
     with open(path, "rb") as f:
         raw = f.read()
@@ -50,6 +53,7 @@ def read_csv(
             names = [c.strip() for c in head_line.split(",")]
         body = raw[nl + 1 :] if nl >= 0 else b""
 
+    auto_detected = numeric_only is None
     if numeric_only is None:
         # probe a prefix of data lines, not just the first — a leading row
         # of empty/numeric fields must not send string columns to NaN
@@ -72,12 +76,24 @@ def read_csv(
         if mat is None:  # no native toolchain: python fallback (NaN-padded
             # like the native parser, tolerating ragged rows)
             mat = _py_parse_numeric(body)
-        if names is None:
-            names = [f"c{i}" for i in range(mat.shape[1] if mat.ndim == 2 else 0)]
-        # more data columns than header names: synthesize names, never drop
-        names = list(names) + [f"c{i}" for i in range(len(names), mat.shape[1])]
-        cols = {names[i]: mat[:, i] for i in range(mat.shape[1])}
-        return DataFrame.from_dict(cols, num_partitions=num_partitions)
+        # auto-detection guard: a column that parsed entirely NaN may mean
+        # the probe window missed late-appearing strings. Re-parse with the
+        # mixed-type parser only if such a column really holds unparseable
+        # text (a legitimately empty numeric column keeps the fast path).
+        suspects = (
+            set(np.flatnonzero(np.isnan(mat).all(axis=0)))
+            if auto_detected and mat.size
+            else set()
+        )
+        if suspects and _columns_have_text(body, suspects):
+            numeric_only = False
+        else:
+            if names is None:
+                names = [f"c{i}" for i in range(mat.shape[1] if mat.ndim == 2 else 0)]
+            # more data columns than header names: synthesize names, never drop
+            names = list(names) + [f"c{i}" for i in range(len(names), mat.shape[1])]
+            cols = {names[i]: mat[:, i] for i in range(mat.shape[1])}
+            return DataFrame.from_dict(cols, num_partitions=num_partitions)
 
     # mixed types: python csv, column-wise type inference
     text = body.decode("utf-8", "replace")
@@ -96,6 +112,24 @@ def read_csv(
         arr = _infer_column(vals)
         out[name] = arr
     return DataFrame.from_dict(out, num_partitions=num_partitions)
+
+
+def _columns_have_text(body: bytes, col_idx: set) -> bool:
+    """True if any of the given column indices holds a non-empty field that
+    does not parse as a float (i.e. real text, not just missing values)."""
+    for line in body.split(b"\n"):
+        if not line.strip():
+            continue
+        fields = line.decode("utf-8", "replace").split(",")
+        for i in col_idx:
+            if i < len(fields):
+                field = fields[i].strip()
+                if field:
+                    try:
+                        float(field)
+                    except ValueError:
+                        return True
+    return False
 
 
 def _py_parse_numeric(body: bytes) -> np.ndarray:

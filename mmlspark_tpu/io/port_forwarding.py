@@ -29,7 +29,10 @@ def build_forward_command(
     spec = f"{bind_address}:{remote_port}:127.0.0.1:{local_port}" if bind_address else f"{remote_port}:127.0.0.1:{local_port}"
     cmd = ["ssh", "-N", "-R", spec]
     opts = {
-        "StrictHostKeyChecking": "no",
+        # trust-on-first-use: record unseen host keys, refuse changed ones.
+        # Needs OpenSSH >= 7.6; on older clients (or to opt out) pass
+        # ssh_options={"StrictHostKeyChecking": "no"}.
+        "StrictHostKeyChecking": "accept-new",
         "ExitOnForwardFailure": "yes",
         "ServerAliveInterval": "30",
     }

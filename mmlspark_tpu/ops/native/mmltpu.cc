@@ -11,6 +11,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <locale.h>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -132,18 +133,24 @@ static locale_t c_locale() {
 }
 
 // Parse one bounded field [fs, fe) as a double; whitespace-only or
-// non-numeric -> NaN. Copies into a stack buffer so strtod can never walk
-// past the field (newlines, next row).
+// non-numeric -> NaN. Copies into a stack buffer (heap for over-long
+// fields) so strtod can never walk past the field (newlines, next row)
+// and long numeric literals parse exactly like the Python fallback.
 static double parse_field(const char* fs, const char* fe) {
   char buf[64];
   size_t flen = (size_t)(fe - fs);
   if (flen == 0) return NAN;
-  if (flen >= sizeof(buf)) flen = sizeof(buf) - 1;
-  memcpy(buf, fs, flen);
-  buf[flen] = '\0';
   char* fend = nullptr;
-  double v = strtod_l(buf, &fend, c_locale());
-  if (fend == buf) return NAN;
+  if (flen < sizeof(buf)) {
+    memcpy(buf, fs, flen);
+    buf[flen] = '\0';
+    double v = strtod_l(buf, &fend, c_locale());
+    if (fend == buf) return NAN;
+    return v;
+  }
+  std::string big(fs, flen);
+  double v = strtod_l(big.c_str(), &fend, c_locale());
+  if (fend == big.c_str()) return NAN;
   return v;
 }
 

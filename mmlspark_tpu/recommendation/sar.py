@@ -66,6 +66,13 @@ class _SARParams:
     )
     support_threshold = Param("min co-occurrence count kept", default=4, type_=int)
     time_decay_coeff = Param("affinity half-life in days", default=30.0, type_=float)
+    reference_time = Param(
+        "decay reference time (unix seconds; reference SAR.scala 'startTime' "
+        "analogue). None decays relative to the latest training event, which "
+        "keeps offline runs reproducible but does NOT age a stale dataset "
+        "relative to now — pass time.time() for that.",
+        default=None,
+    )
     allow_seen_items = Param("keep already-seen items in recommendations", default=False, type_=bool)
 
 
@@ -87,7 +94,9 @@ class SAR(Estimator, _SARParams):
         if tc and tc in df.columns:
             t = np.asarray(df[tc], np.float64)
             half_life_s = self.get("time_decay_coeff") * 86400.0
-            decay = np.exp2(-(t.max() - t) / half_life_s)
+            ref = self.get("reference_time")
+            t_ref = float(ref) if ref is not None else t.max()
+            decay = np.exp2(-(t_ref - t) / half_life_s)
             weights = ratings * decay.astype(np.float32)
 
         # binarized interactions for similarity; decayed sums for affinity

@@ -70,6 +70,20 @@ class TestParseCSV:
         out = lib.parse_csv(b"1,abc\n2,3\n")
         assert np.isnan(out[0, 1]) and out[1, 1] == 3.0
 
+    def test_long_fields_parse_exactly(self, lib):
+        # >=64-char numeric literal whose exponent sits past the old stack
+        # buffer: truncation would parse to a drastically wrong value
+        long_num = "1" * 70 + "e-60"
+        long_frac = "0." + "9" * 75
+        data = f"{long_num},{long_frac}\n".encode()
+        out = lib.parse_csv(data)
+        np.testing.assert_allclose(out[0, 0], float(long_num), rtol=0)
+        np.testing.assert_allclose(out[0, 1], float(long_frac), rtol=0)
+
+    def test_long_garbage_field_is_nan(self, lib):
+        out = lib.parse_csv(("x" * 100 + ",2\n").encode())
+        assert np.isnan(out[0, 0]) and out[0, 1] == 2.0
+
 
 class TestReadCSV:
     def test_numeric_with_header(self, tmp_path):
@@ -108,3 +122,38 @@ class TestReadCSV:
         monkeypatch.setattr(csv_mod.native_loader, "try_load", lambda: None)
         df = csv_mod.read_csv(str(p))
         np.testing.assert_allclose(df["a"], [1.0, 3.0])
+
+    def test_strings_past_probe_window_fall_back(self, tmp_path):
+        # column 'a' is empty through the 20-line auto-detect window and
+        # only shows its (string) values later; the fast path would turn it
+        # into an all-NaN column — the guard must reroute to mixed parsing
+        lines = ["a,b"] + [f",{i}" for i in range(25)] + ["hello,99"]
+        p = tmp_path / "late.csv"
+        p.write_text("\n".join(lines) + "\n")
+        from mmlspark_tpu.io.csv import read_csv
+
+        df = read_csv(str(p))
+        assert df["a"].dtype == object  # mixed parse kept the strings
+        assert df["a"].tolist()[-1] == "hello"
+        np.testing.assert_allclose(np.asarray(df["b"], np.float64)[-1], 99.0)
+
+    def test_empty_numeric_column_keeps_fast_path(self, tmp_path):
+        # a legitimately never-populated column must NOT trigger the
+        # mixed-parser reroute (or a full second parse of the file)
+        lines = ["a,b"] + [f",{i}" for i in range(25)]
+        p = tmp_path / "emptycol.csv"
+        p.write_text("\n".join(lines) + "\n")
+        from mmlspark_tpu.io.csv import read_csv
+
+        df = read_csv(str(p))
+        a = np.asarray(df["a"], np.float64)
+        assert a.dtype == np.float64 and np.isnan(a).all()
+
+    def test_forced_numeric_only_keeps_fast_path(self, tmp_path):
+        lines = ["a,b"] + [f",{i}" for i in range(25)] + ["hello,99"]
+        p = tmp_path / "late2.csv"
+        p.write_text("\n".join(lines) + "\n")
+        from mmlspark_tpu.io.csv import read_csv
+
+        df = read_csv(str(p), numeric_only=True)
+        assert np.isnan(np.asarray(df["a"], np.float64)).all()

@@ -94,6 +94,25 @@ class TestSAR:
         np.testing.assert_allclose(aff[0, 0], 0.5, atol=1e-6)
         np.testing.assert_allclose(aff[0, 1], 1.0, atol=1e-6)
 
+    def test_reference_time_param(self):
+        # explicit reference_time one half-life past the latest event halves
+        # EVERY affinity vs the default t.max() reference (startTime analogue)
+        users = np.array([0, 0], np.int64)
+        items = np.array([0, 1], np.int64)
+        t = np.array([0.0, 30 * 86400.0])
+        df = DataFrame.from_dict(
+            {"user_idx": users, "item_idx": items,
+             "rating": np.ones(2, np.float32), "t": t}
+        )
+        base = SAR(time_col="t", time_decay_coeff=30.0, support_threshold=1).fit(df)
+        aged = SAR(
+            time_col="t", time_decay_coeff=30.0, support_threshold=1,
+            reference_time=60 * 86400.0,
+        ).fit(df)
+        np.testing.assert_allclose(
+            aged.get("user_affinity"), base.get("user_affinity") * 0.5, atol=1e-6
+        )
+
 
 class TestRankingEvaluator:
     def _df(self, recs, truth):
