@@ -116,11 +116,16 @@ def read_csv(
 
 def _columns_have_text(body: bytes, col_idx: set) -> bool:
     """True if any of the given column indices holds a non-empty field that
-    does not parse as a float (i.e. real text, not just missing values)."""
+    does not parse as a float (i.e. real text, not just missing values).
+
+    Stays on bytes (no per-line decode) and splits only as far as the last
+    suspect column, so the common refutation scan is cheap even for large
+    files with one legitimately empty column."""
+    max_idx = max(col_idx)
     for line in body.split(b"\n"):
         if not line.strip():
             continue
-        fields = line.decode("utf-8", "replace").split(",")
+        fields = line.split(b",", max_idx + 1)
         for i in col_idx:
             if i < len(fields):
                 field = fields[i].strip()

@@ -136,6 +136,13 @@ static locale_t c_locale() {
 // non-numeric -> NaN. Copies into a stack buffer (heap for over-long
 // fields) so strtod can never walk past the field (newlines, next row)
 // and long numeric literals parse exactly like the Python fallback.
+// strtod accepted a prefix; the whole field must be consumed (bar
+// trailing whitespace) or it's not a number — matches float() semantics.
+static inline bool only_ws_after(const char* p) {
+  while (*p == ' ' || *p == '\t' || *p == '\r') p++;
+  return *p == '\0';
+}
+
 static double parse_field(const char* fs, const char* fe) {
   char buf[64];
   size_t flen = (size_t)(fe - fs);
@@ -145,12 +152,12 @@ static double parse_field(const char* fs, const char* fe) {
     memcpy(buf, fs, flen);
     buf[flen] = '\0';
     double v = strtod_l(buf, &fend, c_locale());
-    if (fend == buf) return NAN;
+    if (fend == buf || !only_ws_after(fend)) return NAN;
     return v;
   }
   std::string big(fs, flen);
   double v = strtod_l(big.c_str(), &fend, c_locale());
-  if (fend == big.c_str()) return NAN;
+  if (fend == big.c_str() || !only_ws_after(fend)) return NAN;
   return v;
 }
 

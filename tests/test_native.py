@@ -84,6 +84,16 @@ class TestParseCSV:
         out = lib.parse_csv(("x" * 100 + ",2\n").encode())
         assert np.isnan(out[0, 0]) and out[0, 1] == 2.0
 
+    def test_trailing_garbage_is_nan(self, lib):
+        # strtod partial parses must be rejected ('1.5abc' is not a number),
+        # matching float() / the pure-Python fallback; whitespace is fine
+        out = lib.parse_csv(b"1.5abc, 2.5 ,3\n")
+        assert np.isnan(out[0, 0])
+        np.testing.assert_allclose(out[0, 1:], [2.5, 3.0])
+        long_garbage = "1" * 70 + "junk"
+        out = lib.parse_csv(f"{long_garbage},1\n".encode())
+        assert np.isnan(out[0, 0]) and out[0, 1] == 1.0
+
 
 class TestReadCSV:
     def test_numeric_with_header(self, tmp_path):
