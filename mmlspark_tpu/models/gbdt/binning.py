@@ -31,7 +31,21 @@ class BinMapper:
         return len(self.uppers)
 
     @staticmethod
-    def fit(x: np.ndarray, max_bin: int = 255, sample: int = 200_000, seed: int = 0) -> "BinMapper":
+    def fit(
+        x: np.ndarray,
+        max_bin: int = 255,
+        sample: int = 200_000,
+        seed: int = 0,
+        categorical_features: tuple = (),
+    ) -> "BinMapper":
+        """``categorical_features``: feature indices binned by IDENTITY
+        (category value v -> bin v+1, via half-integer bounds) instead of
+        quantiles, so a trained categorical split's bin set corresponds 1:1
+        to category values at prediction time. Categorical values must be
+        integers in [0, max_bin-2]; out-of-range training values raise (a
+        silent collapse would make training and prediction route the same
+        row differently). Categories unseen at fit time route to the right
+        child at prediction, like LightGBM's other-category default."""
         if not 2 <= max_bin <= 255:
             # bins live in a uint8 matrix (bin 0 = missing); larger values
             # would silently wrap mod 256
@@ -42,8 +56,23 @@ class BinMapper:
             xs = x[idx]
         else:
             xs = x
+        cat = set(int(f) for f in categorical_features)
         uppers = []
         for f in range(d):
+            if f in cat:
+                # full column, not the sample: hi must cover every category
+                # actually present or training bins and prediction's
+                # identity mapping would diverge for the unsampled tail
+                col = x[:, f]
+                col = col[~np.isnan(col)]
+                if len(col) and (col.min() < 0 or col.max() > max_bin - 2):
+                    raise ValueError(
+                        f"categorical feature {f} has values outside "
+                        f"[0, {max_bin - 2}] — re-index categories first"
+                    )
+                hi = int(col.max()) if len(col) else 0
+                uppers.append(np.arange(hi, dtype=np.float64) + 0.5)
+                continue
             col = xs[:, f]
             col = col[~np.isnan(col)]
             uniq = np.unique(col)

@@ -31,10 +31,19 @@ class Tree:
     gain: np.ndarray        # (S,) float32
     values: np.ndarray      # (L,) float32
     counts: np.ndarray      # (L,) int32
+    # categorical subset splits (LightGBM cat_threshold analogue): for split
+    # k with is_cat[k], routing is by membership — category value v (bin
+    # v+1) goes LEFT iff catmask[k, v+1]. None = all-numerical tree.
+    is_cat: Optional[np.ndarray] = None     # (S,) bool
+    catmask: Optional[np.ndarray] = None    # (S, B) bool
 
     @property
     def num_splits(self) -> int:
         return int(self.active.sum())
+
+    @property
+    def has_categorical(self) -> bool:
+        return self.is_cat is not None and bool(np.any(self.is_cat))
 
     def to_dict(self) -> dict:
         # non-finite thresholds are meaningful (+inf: inactive/"all left",
@@ -44,7 +53,7 @@ class Tree:
                 return float(t)
             return "inf" if t > 0 else "-inf"
 
-        return {
+        out = {
             "leaf": self.leaf.tolist(),
             "feature": self.feature.tolist(),
             "threshold": [enc(t) for t in self.threshold],
@@ -53,6 +62,13 @@ class Tree:
             "values": np.asarray(self.values, dtype=np.float64).tolist(),
             "counts": self.counts.tolist(),
         }
+        if self.has_categorical:
+            # compact: only active categorical splits, as left-bin id lists
+            out["cat_splits"] = {
+                str(k): np.flatnonzero(self.catmask[k]).tolist()
+                for k in np.flatnonzero(self.is_cat)
+            }
+        return out
 
     @staticmethod
     def from_dict(d: dict) -> "Tree":
@@ -64,6 +80,17 @@ class Tree:
             return float(t)
 
         thr = np.array([dec(t) for t in d["threshold"]], dtype=np.float64)
+        is_cat = catmask = None
+        if d.get("cat_splits"):
+            from mmlspark_tpu.ops.histogram import NUM_BINS
+
+            S = len(d["leaf"])
+            is_cat = np.zeros(S, bool)
+            catmask = np.zeros((S, NUM_BINS), bool)
+            for k_str, left_bins in d["cat_splits"].items():
+                k = int(k_str)
+                is_cat[k] = True
+                catmask[k, np.asarray(left_bins, np.int64)] = True
         return Tree(
             leaf=np.asarray(d["leaf"], np.int32),
             feature=np.asarray(d["feature"], np.int32),
@@ -72,6 +99,8 @@ class Tree:
             gain=np.asarray(d["gain"], np.float32),
             values=np.asarray(d["values"], np.float32),
             counts=np.asarray(d["counts"], np.int32),
+            is_cat=is_cat,
+            catmask=catmask,
         )
 
 
@@ -157,7 +186,17 @@ class Booster:
         )
         rec_active = np.stack([pad(t.active, S, False) for t in trees])
         values = np.stack([pad(t.values, L, np.float32(0)) for t in trees])
-        return rec_leaf, rec_feature, rec_threshold, rec_active, values
+        rec_is_cat = rec_catmask = None
+        if any(t.has_categorical for t in trees):
+            from mmlspark_tpu.ops.histogram import NUM_BINS
+
+            rec_is_cat = np.zeros((T, S), bool)
+            rec_catmask = np.zeros((T, S, NUM_BINS), bool)
+            for i, t in enumerate(trees):
+                if t.is_cat is not None:
+                    rec_is_cat[i, : len(t.is_cat)] = t.is_cat
+                    rec_catmask[i, : t.catmask.shape[0]] = t.catmask
+        return rec_leaf, rec_feature, rec_threshold, rec_active, values, rec_is_cat, rec_catmask
 
     def predict_raw(self, x: np.ndarray, num_iteration: Optional[int] = None) -> np.ndarray:
         """(n, d) -> (n,) raw scores (binary/regression) or (n, k) multiclass."""
@@ -173,7 +212,7 @@ class Booster:
             return np.broadcast_to(
                 base, (n,) if k == 1 else (n, k)
             ).astype(np.float32).copy()
-        rec_leaf, rec_feature, rec_threshold, rec_active, values = stacked
+        rec_leaf, rec_feature, rec_threshold, rec_active, values, is_cat, catmask = stacked
         leaves = np.asarray(
             treegrow.predict_leaves(
                 jnp.asarray(x, jnp.float32),
@@ -181,6 +220,8 @@ class Booster:
                 jnp.asarray(rec_feature),
                 jnp.asarray(rec_threshold),
                 jnp.asarray(rec_active),
+                jnp.asarray(is_cat) if is_cat is not None else None,
+                jnp.asarray(catmask) if catmask is not None else None,
             )
         )  # (n, T)
         per_tree = np.take_along_axis(values[None], leaves[..., None], axis=2)[..., 0]
@@ -199,7 +240,7 @@ class Booster:
         stacked = self._stacked()
         if stacked is None:
             return np.zeros((x.shape[0], 0), np.int32)
-        rec_leaf, rec_feature, rec_threshold, rec_active, _ = stacked
+        rec_leaf, rec_feature, rec_threshold, rec_active, _, is_cat, catmask = stacked
         return np.asarray(
             treegrow.predict_leaves(
                 jnp.asarray(x, jnp.float32),
@@ -207,6 +248,8 @@ class Booster:
                 jnp.asarray(rec_feature),
                 jnp.asarray(rec_threshold),
                 jnp.asarray(rec_active),
+                jnp.asarray(is_cat) if is_cat is not None else None,
+                jnp.asarray(catmask) if catmask is not None else None,
             )
         )
 
@@ -281,7 +324,13 @@ def _tree_contribs(tree: Tree, x: np.ndarray) -> np.ndarray:
         thr = tree.threshold[k]
         in_leaf = row_leaf == parent
         vals = x[:, f]
-        goes_right = in_leaf & (vals > thr) & ~np.isnan(vals)
+        if tree.is_cat is not None and tree.is_cat[k]:
+            # categorical subset routing: the shared value->bin encoding
+            # (treegrow.category_bin_slot), membership in the left set
+            vbin = treegrow.category_bin_slot(vals, tree.catmask.shape[1], np)
+            goes_right = in_leaf & ~tree.catmask[k][vbin]
+        else:
+            goes_right = in_leaf & (vals > thr) & ~np.isnan(vals)
         stays_left = in_leaf & ~goes_right
         before = exp_steps[k][parent]
         # after this split the row is at (parent|right); its new expectation

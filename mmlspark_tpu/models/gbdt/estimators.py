@@ -72,6 +72,12 @@ class _LightGBMParams(
     use_barrier_execution_mode = Param("parity no-op (SPMD is the gang)", default=False, type_=bool)
     top_k = Param("voting_parallel K (parity)", default=20, type_=int)
     boost_from_average = Param("init score from label average", default=True, type_=bool)
+    categorical_slot_indexes = Param(
+        "feature indices treated as categorical (subset splits; "
+        "LightGBMParams categoricalSlotIndexes analogue). Values must be "
+        "non-negative integers < max_bin-1.",
+        default=None,
+    )
     model_string = Param("initial model for continued training", default="", type_=str)
     num_batches = Param("fold training into k sequential batches", default=0, type_=int)
     seed = Param("rng seed", default=0, type_=int)
@@ -98,6 +104,7 @@ class _LightGBMParams(
             parallelism=self.get("parallelism"),
             top_k=self.get("top_k"),
             verbosity=self.get("verbosity"),
+            categorical_features=tuple(self.get("categorical_slot_indexes") or ()),
         )
 
     def _gather(self, df: DataFrame) -> dict:

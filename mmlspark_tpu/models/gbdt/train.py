@@ -52,19 +52,26 @@ class TrainConfig:
     parallelism: str = "data_parallel"  # accepted for parity
     top_k: int = 20                     # voting_parallel K (parity)
     verbosity: int = -1
+    # feature indices treated as categorical (LightGBM categoricalSlotIndexes
+    # analogue): identity-binned, split by subset membership
+    categorical_features: tuple = ()
 
 
 def _tree_from_device(grown: Any, mapper: BinMapper) -> Tree:
     rec_leaf = np.asarray(grown.rec_leaf)
     rec_feature = np.asarray(grown.rec_feature)
     rec_bin = np.asarray(grown.rec_bin)
+    is_cat = np.asarray(grown.rec_is_cat)
     thr = np.array(
         [
-            mapper.threshold_value(int(f), int(b)) if f >= 0 else np.inf
-            for f, b in zip(rec_feature, rec_bin)
+            # categorical splits route by catmask, never by threshold:
+            # +inf keeps any accidental numeric comparison all-left
+            mapper.threshold_value(int(f), int(b)) if (f >= 0 and not c) else np.inf
+            for f, b, c in zip(rec_feature, rec_bin, is_cat)
         ],
         dtype=np.float64,
     )
+    has_cat = bool(is_cat.any())
     return Tree(
         leaf=rec_leaf,
         feature=rec_feature,
@@ -73,6 +80,8 @@ def _tree_from_device(grown: Any, mapper: BinMapper) -> Tree:
         gain=np.asarray(grown.rec_gain),
         values=np.asarray(grown.leaf_values),
         counts=np.asarray(grown.leaf_counts),
+        is_cat=is_cat if has_cat else None,
+        catmask=np.asarray(grown.rec_catmask) if has_cat else None,
     )
 
 
@@ -125,8 +134,16 @@ def train(
     prediction replays it."""
     n, d = x.shape
     k = cfg.num_class if cfg.objective == "multiclass" else 1
-    mapper = BinMapper.fit(x, max_bin=cfg.max_bin, seed=cfg.seed)
+    cat_features = tuple(int(f) for f in (cfg.categorical_features or ()))
+    mapper = BinMapper.fit(
+        x, max_bin=cfg.max_bin, seed=cfg.seed, categorical_features=cat_features
+    )
     bins_host = mapper.transform(x)
+    cat_mask_dev = None
+    if cat_features:
+        cat_mask_host = np.zeros(d, bool)
+        cat_mask_host[list(cat_features)] = True
+        cat_mask_dev = jnp.asarray(cat_mask_host)
 
     train_mask = (
         ~valid_mask if valid_mask is not None else np.ones(n, bool)
@@ -233,6 +250,7 @@ def train(
                 feature_mask=fm_dev,
                 max_depth=int(cfg.max_depth),
                 min_data_in_leaf=int(cfg.min_data_in_leaf),
+                categorical_mask=cat_mask_dev,
             )
             tree = _tree_from_device(grown, mapper)
             booster.trees.append(tree)

@@ -48,12 +48,6 @@ class GrownTree(NamedTuple):
     rec_catmask: jnp.ndarray   # (L-1, B) bool: bins going LEFT (cat splits)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "num_leaves", "max_depth", "min_data_in_leaf",
-    ),
-)
 def grow_tree(
     bins: jnp.ndarray,            # (n, d) uint8/int32
     grad: jnp.ndarray,            # (n,) f32
@@ -68,13 +62,48 @@ def grow_tree(
     min_data_in_leaf: int = 20,
     categorical_mask: Optional[jnp.ndarray] = None,  # (d,) bool
 ) -> GrownTree:
+    """Grow one tree. The categorical-split machinery (per-leaf argsort of
+    category bins) is statically compiled OUT when ``categorical_mask`` is
+    None — the common all-numerical case pays nothing for it."""
+    has_categorical = categorical_mask is not None
+    if not has_categorical:
+        categorical_mask = jnp.zeros((bins.shape[1],), bool)
+    return _grow_tree(
+        bins, grad, hess, row_weight,
+        num_leaves=num_leaves, lambda_l2=lambda_l2, min_gain=min_gain,
+        learning_rate=learning_rate, feature_mask=feature_mask,
+        max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
+        categorical_mask=categorical_mask, has_categorical=has_categorical,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_leaves", "max_depth", "min_data_in_leaf", "has_categorical",
+    ),
+)
+def _grow_tree(
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    row_weight: jnp.ndarray,
+    num_leaves: int,
+    lambda_l2: float,
+    min_gain: float,
+    learning_rate: float,
+    feature_mask: jnp.ndarray,
+    max_depth: int,
+    min_data_in_leaf: int,
+    categorical_mask: jnp.ndarray,
+    has_categorical: bool,
+) -> GrownTree:
     n, d = bins.shape
     L = num_leaves
     B = NUM_BINS
     bins = bins.astype(jnp.int32)
-    if categorical_mask is None:
-        categorical_mask = jnp.zeros((d,), bool)
     cat_f = categorical_mask.astype(bool)
+    lam = lambda_l2
     g = grad * row_weight
     h = hess * row_weight
     cnt_w = row_weight
@@ -90,92 +119,115 @@ def grow_tree(
         """Histogram of the rows selected by ``mask`` -> (d*B, 3)."""
         return plane_histogram(bins, row_stats, mask)
 
-    def step(k: int, state: tuple) -> tuple:
-        (hist, row_leaf, leaf_depth, done,
-         rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
-         rec_is_cat, rec_catmask) = state
+    def leaf_best(plane: jnp.ndarray) -> tuple:
+        """Best split of ONE leaf from its (d*B, 3) histogram plane.
 
-        # hist is carried incrementally: (L, d*B, 3) cube, only the two
-        # children of the previous split changed (LightGBM's
-        # parent-minus-child trick — one plane scatter per step instead of
-        # rebuilding every leaf's histogram from all rows)
-        cube = hist.reshape(L, d, B, 3)
+        Returns (gain, feature, bin/prefix, catmask). Only state-free
+        validity (min_data, feature_fraction) is applied here; per-leaf
+        state (activity, depth) is applied at selection time, so cached
+        results stay exact until the leaf's histogram changes."""
+        cube = plane.reshape(d, B, 3)
         hg, hh, hc = cube[..., 0], cube[..., 1], cube[..., 2]
-        # per-(leaf,f): cumulative left stats over threshold bins
-        cg = jnp.cumsum(hg, axis=2)
-        ch = jnp.cumsum(hh, axis=2)
-        cc = jnp.cumsum(hc, axis=2)
-        G = cg[:, :, -1:]
-        H = ch[:, :, -1:]
-        C = cc[:, :, -1:]
+        cg = jnp.cumsum(hg, axis=1)
+        ch = jnp.cumsum(hh, axis=1)
+        cc = jnp.cumsum(hc, axis=1)
+        G, H, C = cg[:, -1:], ch[:, -1:], cc[:, -1:]
         GL, HL, CL = cg, ch, cc
         GR, HR, CR = G - GL, H - HL, C - CL
-        lam = lambda_l2
         gain_num = (
             GL * GL / (HL + lam)
             + GR * GR / (HR + lam)
             - G * G / (H + lam)
         )
-        # categorical subset split (LightGBM's sorted-by-ratio scan: order
-        # category bins by G/H, then the best LEFT set is some prefix —
-        # Fisher's optimal-partition result for convex losses). ``bb`` for a
-        # categorical split is the PREFIX LENGTH in this order, not a bin.
-        ratio = jnp.where(hc > 0, hg / (hh + 1e-12), -jnp.inf)
-        order = jnp.argsort(-ratio, axis=2)  # (L, d, B) bin ids, best first
-        sgs = jnp.take_along_axis(hg, order, 2)
-        shs = jnp.take_along_axis(hh, order, 2)
-        scs = jnp.take_along_axis(hc, order, 2)
-        cgs = jnp.cumsum(sgs, axis=2)
-        chs = jnp.cumsum(shs, axis=2)
-        ccs = jnp.cumsum(scs, axis=2)
-        gain_cat = (
-            cgs * cgs / (chs + lam)
-            + (G - cgs) ** 2 / (H - chs + lam)
-            - G * G / (H + lam)
-        )
-        num_active = k + 1
-        leaf_ids = jnp.arange(L, dtype=jnp.int32)
-        leaf_ok = (leaf_ids < num_active)[:, None, None]
-        if max_depth > 0:
-            leaf_ok = leaf_ok & (leaf_depth < max_depth)[:, None, None]
-        base_ok = leaf_ok & (feature_mask[None, :, None] > 0)
-        valid_num = (
-            base_ok
-            & ~cat_f[None, :, None]
-            & (CL >= min_data_in_leaf)
-            & (CR >= min_data_in_leaf)
-        )
-        valid_cat = (
-            base_ok
-            & cat_f[None, :, None]
-            & (ccs >= min_data_in_leaf)
-            & ((C - ccs) >= min_data_in_leaf)
-        )
-        gain = jnp.where(
-            cat_f[None, :, None],
-            jnp.where(valid_cat, gain_cat, -jnp.inf),
-            jnp.where(valid_num, gain_num, -jnp.inf),
-        )
+        feat_ok = (feature_mask > 0)[:, None]
+        valid_num = feat_ok & (CL >= min_data_in_leaf) & (CR >= min_data_in_leaf)
+        if has_categorical:
+            # categorical subset split (LightGBM's sorted-by-ratio scan:
+            # order category bins by G/H, then the best LEFT set is some
+            # prefix — Fisher's optimal-partition result for convex
+            # losses). ``bb`` for a categorical split is the PREFIX LENGTH
+            # in this order, not a bin.
+            ratio = jnp.where(hc > 0, hg / (hh + 1e-12), -jnp.inf)
+            order = jnp.argsort(-ratio, axis=1)  # (d, B) bin ids, best first
+            sgs = jnp.take_along_axis(hg, order, 1)
+            shs = jnp.take_along_axis(hh, order, 1)
+            scs = jnp.take_along_axis(hc, order, 1)
+            cgs = jnp.cumsum(sgs, axis=1)
+            chs = jnp.cumsum(shs, axis=1)
+            ccs = jnp.cumsum(scs, axis=1)
+            gain_cat = (
+                cgs * cgs / (chs + lam)
+                + (G - cgs) ** 2 / (H - chs + lam)
+                - G * G / (H + lam)
+            )
+            valid_cat = (
+                feat_ok
+                & (ccs >= min_data_in_leaf)
+                & ((C - ccs) >= min_data_in_leaf)
+            )
+            gain = jnp.where(
+                cat_f[:, None],
+                jnp.where(valid_cat, gain_cat, -jnp.inf),
+                jnp.where(valid_num, gain_num, -jnp.inf),
+            )
+        else:
+            gain = jnp.where(valid_num, gain_num, -jnp.inf)
         flat = gain.reshape(-1)
         best = jnp.argmax(flat)
-        best_gain = flat[best]
-        bl = (best // (d * B)).astype(jnp.int32)
-        bf = ((best // B) % d).astype(jnp.int32)
+        bf = (best // B).astype(jnp.int32)
         bb = (best % B).astype(jnp.int32)
+        if has_categorical:
+            # left-set membership per bin for the chosen feature:
+            # rank[bin] = position of bin in the sorted order; prefix <= bb
+            order_sel = order[bf]                 # (B,)
+            rank = jnp.argsort(order_sel)         # inverse permutation
+            catmask = rank <= bb                  # (B,) bool: LEFT bins
+        else:
+            catmask = jnp.zeros((B,), bool)
+        return flat[best], bf, bb, catmask
+
+    def step(k: int, state: tuple) -> tuple:
+        (hist, row_leaf, leaf_depth, done,
+         cache_gain, cache_feat, cache_bin, cache_catmask, prev_pair,
+         rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+         rec_is_cat, rec_catmask) = state
+
+        # hist is carried incrementally: (L, d*B, 3) cube, only the two
+        # children of the previous split changed (LightGBM's
+        # parent-minus-child trick). The split-search cache mirrors that:
+        # re-evaluate ONLY those two leaves' planes, keep every other
+        # leaf's cached best split (their histograms are untouched).
+        pg, pf, pb, pcm = jax.vmap(leaf_best)(hist[prev_pair])
+        cache_gain = cache_gain.at[prev_pair].set(pg)
+        cache_feat = cache_feat.at[prev_pair].set(pf)
+        cache_bin = cache_bin.at[prev_pair].set(pb)
+        cache_catmask = cache_catmask.at[prev_pair].set(pcm)
+
+        # selection: apply the per-leaf state masks to the cached gains
+        num_active = k + 1
+        leaf_ids = jnp.arange(L, dtype=jnp.int32)
+        leaf_ok = leaf_ids < num_active
+        if max_depth > 0:
+            leaf_ok = leaf_ok & (leaf_depth < max_depth)
+        sel = jnp.where(leaf_ok, cache_gain, -jnp.inf)
+        bl = jnp.argmax(sel).astype(jnp.int32)
+        best_gain = sel[bl]
+        bf = cache_feat[bl]
+        bb = cache_bin[bl]
+        catmask = cache_catmask[bl]
 
         do_split = (~done) & (best_gain > min_gain) & jnp.isfinite(best_gain)
-        is_cat_split = cat_f[bf]
-        # left-set membership per bin for the chosen (leaf, feature):
-        # rank[bin] = position of bin in the sorted order; prefix <= bb
-        order_sel = order[bl, bf]                 # (B,)
-        rank = jnp.argsort(order_sel)             # inverse permutation
-        catmask = rank <= bb                      # (B,) bool: LEFT bins
         new_id = jnp.int32(k + 1)
         in_leaf = row_leaf == bl
         row_bins = bins[:, bf]
-        goes_right = in_leaf & jnp.where(
-            is_cat_split, ~catmask[row_bins], row_bins > bb
-        )
+        if has_categorical:
+            is_cat_split = cat_f[bf]
+            goes_right = in_leaf & jnp.where(
+                is_cat_split, ~catmask[row_bins], row_bins > bb
+            )
+        else:
+            is_cat_split = jnp.asarray(False)
+            goes_right = in_leaf & (row_bins > bb)
         moved = do_split & goes_right
         row_leaf = jnp.where(moved, new_id, row_leaf)
         # incremental histogram update: scatter only the moved rows into the
@@ -200,7 +252,10 @@ def grow_tree(
             jnp.where(do_split & is_cat_split, catmask, False)
         )
         done = done | ~do_split
+        # the two leaves whose planes changed — next step refreshes them
+        prev_pair = jnp.stack([bl, new_id])
         return (hist, row_leaf, leaf_depth, done,
+                cache_gain, cache_feat, cache_bin, cache_catmask, prev_pair,
                 rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
                 rec_is_cat, rec_catmask)
 
@@ -215,6 +270,11 @@ def grow_tree(
         jnp.zeros((n,), jnp.int32),
         jnp.zeros((L,), jnp.int32),
         jnp.asarray(False),
+        jnp.full((L,), -jnp.inf, jnp.float32),   # cache_gain
+        jnp.zeros((L,), jnp.int32),              # cache_feat
+        jnp.zeros((L,), jnp.int32),              # cache_bin
+        jnp.zeros((L, B), bool),                 # cache_catmask
+        jnp.zeros((2,), jnp.int32),              # prev_pair: root twice
         jnp.full((L - 1,), -1, jnp.int32),
         jnp.full((L - 1,), -1, jnp.int32),
         jnp.full((L - 1,), -1, jnp.int32),
@@ -223,7 +283,8 @@ def grow_tree(
         jnp.zeros((L - 1,), bool),
         jnp.zeros((L - 1, B), bool),
     )
-    (_, row_leaf, _, _, rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+    (_, row_leaf, _, _, _, _, _, _, _,
+     rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
      rec_is_cat, rec_catmask) = (
         jax.lax.fori_loop(0, L - 1, step, init)
     )
@@ -244,6 +305,18 @@ def grow_tree(
 # -- prediction -------------------------------------------------------------
 
 
+def category_bin_slot(vals: Any, B: int = NUM_BINS, xp: Any = np):
+    """Category value -> bin slot, the ONE encoding shared by training
+    (identity binning in BinMapper), device prediction (predict_leaves) and
+    host SHAP replay (_tree_contribs): NaN -> 0 (missing bin), value v ->
+    v+1, clipped into [0, B-1]. ``xp`` selects numpy (host) or jax.numpy
+    (traced)."""
+    finite = xp.nan_to_num(vals, nan=-1.0)  # NaN -> -1 -> rounds to slot 0
+    # clip in float first: huge values must not overflow the int cast
+    slot = xp.round(xp.clip(finite, -1.0, float(B))).astype(xp.int32) + 1
+    return xp.clip(xp.where(xp.isnan(vals), 0, slot), 0, B - 1)
+
+
 @jax.jit
 def predict_leaves(
     x: jnp.ndarray,            # (n, d) float32 raw features
@@ -258,57 +331,45 @@ def predict_leaves(
 
     Numerical: NaN goes LEFT (missing-bin semantics). Categorical splits
     route by set membership — a category value v looks up catmask[v + 1]
-    (identity binning; NaN -> slot 0, the missing category)."""
+    (identity binning; NaN -> slot 0, the missing category). Passing
+    rec_is_cat=None statically compiles the categorical machinery OUT —
+    all-numerical models pay nothing for it (mirrors grow_tree's gating)."""
     n = x.shape[0]
     T, S = rec_leaf.shape
     B = NUM_BINS
     row_leaf = jnp.zeros((n, T), jnp.int32)
-    if rec_is_cat is None:
-        rec_is_cat = jnp.zeros((T, S), bool)
-    if rec_catmask is None:
+    has_cat = rec_is_cat is not None
+    if has_cat and rec_catmask is None:
         rec_catmask = jnp.zeros((T, S, B), bool)
 
     # scan over split steps: right child id of step k is k+1
     def body(row_leaf: jnp.ndarray, inputs: tuple) -> tuple:
-        k, leaf, feat, thr, active, is_cat, catmask = inputs
+        if has_cat:
+            k, leaf, feat, thr, active, is_cat, catmask = inputs
+        else:
+            k, leaf, feat, thr, active = inputs
         vals = jnp.take_along_axis(
             x, jnp.broadcast_to(jnp.clip(feat, 0, x.shape[1] - 1)[None, :], (n, T)), axis=1
         )
         in_leaf = row_leaf == leaf[None, :]
         right_num = (vals > thr[None, :]) & ~jnp.isnan(vals)
-        # categorical: value -> bin slot (identity + missing at 0)
-        vbin = jnp.where(
-            jnp.isnan(vals),
-            0,
-            # clip in float first: huge values must not overflow the int cast
-            jnp.round(jnp.clip(vals, -1.0, float(B))).astype(jnp.int32) + 1,
-        )
-        vbin = jnp.clip(vbin, 0, B - 1)  # (n, T)
-        left_cat = jnp.take_along_axis(
-            jnp.broadcast_to(catmask[None], (n, T, B)), vbin[..., None], axis=2
-        )[..., 0]
-        goes_right = (
-            in_leaf
-            & active[None, :]
-            & jnp.where(is_cat[None, :], ~left_cat, right_num)
-        )
+        if has_cat:
+            vbin = category_bin_slot(vals, B, jnp)  # (n, T)
+            left_cat = jnp.take_along_axis(
+                jnp.broadcast_to(catmask[None], (n, T, B)), vbin[..., None], axis=2
+            )[..., 0]
+            decide = jnp.where(is_cat[None, :], ~left_cat, right_num)
+        else:
+            decide = right_num
+        goes_right = in_leaf & active[None, :] & decide
         row_leaf = jnp.where(goes_right, jnp.int32(k + 1), row_leaf)
         return row_leaf, None
 
     ks = jnp.arange(S, dtype=jnp.int32)
-    row_leaf, _ = jax.lax.scan(
-        body,
-        row_leaf,
-        (
-            ks,
-            rec_leaf.T,
-            rec_feature.T,
-            rec_threshold.T,
-            rec_active.T,
-            rec_is_cat.T,
-            jnp.moveaxis(rec_catmask, 1, 0),
-        ),
-    )
+    xs = (ks, rec_leaf.T, rec_feature.T, rec_threshold.T, rec_active.T)
+    if has_cat:
+        xs = xs + (rec_is_cat.T, jnp.moveaxis(rec_catmask, 1, 0))
+    row_leaf, _ = jax.lax.scan(body, row_leaf, xs)
     return row_leaf
 
 
