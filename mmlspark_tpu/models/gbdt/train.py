@@ -7,10 +7,13 @@ split search + partition assignment all on device), update scores from the
 grower's own row->leaf output (free, no re-predict), evaluate + early-stop.
 
 Distribution: rows are batch-sharded over the mesh ``data`` axis before the
-loop (LightGBM data_parallel); ``voting_parallel``'s top-K histogram
-exchange is an optimization of the same allreduce and is handled by XLA's
-collective scheduling — the parallelism param is accepted for parity and
-recorded, but both modes lower to the same sharded program here.
+loop. ``data_parallel`` lets GSPMD partition the histogram scatter and
+insert the full-plane ICI allreduce; ``voting_parallel`` switches to the
+PV-Tree grower (models/gbdt/voting.py) — local top-K feature votes, one
+tiny vote psum, and an allreduce of only the winning candidates' histogram
+columns (LightGBMParams.scala:13-18 semantics, real reduced communication).
+Voting needs >1 shard and all-numerical features; otherwise training falls
+back to data_parallel with a log note.
 """
 
 from __future__ import annotations
@@ -152,8 +155,10 @@ def train(
     w = np.where(train_mask, w, 0.0).astype(np.float32)
 
     # device placement: rows sharded over the data axis when a mesh exists
+    mesh = None
+    use_voting = False
     if shard:
-        from mmlspark_tpu.parallel.mesh import get_mesh
+        from mmlspark_tpu.parallel.mesh import DATA_AXIS, get_mesh
         from mmlspark_tpu.parallel.sharding import pad_batch, shard_batch
 
         mesh = get_mesh()
@@ -162,6 +167,14 @@ def train(
         pad = bins_p.shape[0] - n
         bins_dev = shard_batch(bins_p, mesh)
         w_dev = shard_batch(np.pad(w, (0, pad)), mesh)
+        if cfg.parallelism == "voting_parallel":
+            if dict(mesh.shape).get(DATA_AXIS, 1) > 1 and not cat_features:
+                use_voting = True
+            else:
+                log.info(
+                    "voting_parallel needs >1 data shard and numerical "
+                    "features; falling back to data_parallel"
+                )
     else:
         pad = 0
         bins_dev = jnp.asarray(bins_host)
@@ -238,11 +251,7 @@ def train(
                 gc, hc = g_all[:, c], h_all[:, c]
             else:
                 gc, hc = g, h
-            grown = grow_tree(
-                bins_dev,
-                padded(gc.astype(np.float32)),
-                padded(hc.astype(np.float32)),
-                padded(w_it),
+            grow_kw = dict(
                 num_leaves=cfg.num_leaves,
                 lambda_l2=float(cfg.lambda_l2),
                 min_gain=float(cfg.min_gain_to_split),
@@ -250,8 +259,28 @@ def train(
                 feature_mask=fm_dev,
                 max_depth=int(cfg.max_depth),
                 min_data_in_leaf=int(cfg.min_data_in_leaf),
-                categorical_mask=cat_mask_dev,
             )
+            if use_voting:
+                from mmlspark_tpu.models.gbdt.voting import grow_tree_voting
+
+                grown = grow_tree_voting(
+                    bins_dev,
+                    padded(gc.astype(np.float32)),
+                    padded(hc.astype(np.float32)),
+                    padded(w_it),
+                    top_k=int(cfg.top_k),
+                    mesh=mesh,
+                    **grow_kw,
+                )
+            else:
+                grown = grow_tree(
+                    bins_dev,
+                    padded(gc.astype(np.float32)),
+                    padded(hc.astype(np.float32)),
+                    padded(w_it),
+                    categorical_mask=cat_mask_dev,
+                    **grow_kw,
+                )
             tree = _tree_from_device(grown, mapper)
             booster.trees.append(tree)
             # score update from the grower's own leaf assignment
