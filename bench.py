@@ -2,14 +2,22 @@
 
 North-star config (BASELINE.md): ResNet-50 featurization over a DataFrame at
 >= 8,000 images/sec on v5e-32 => 250 images/sec/chip. ``vs_baseline`` is
-measured images/sec/chip / 250.
+measured images/sec/chip / 250. The single JSON line also carries an
+``extra`` dict: Pallas histogram microbench (plane builds/sec), serving
+loopback p50/p99 (the reference's sub-ms claim, README.md:22-23), and an
+explicit ``fallback`` flag so a CPU number can never masquerade as a TPU
+regression.
 
-Structure: the wrapper (``main``) launches the measurement in a child
-process because the TPU-tunnel backend can BLOCK indefinitely inside
-backend init rather than raise; on timeout/failure it reruns the child on
-clean CPU (axon sitecustomize stripped) so the driver always gets its one
-JSON line. End-to-end path measured: DataFrame -> host staging -> jitted
-resize+normalize+ResNet50(bf16) -> feature column, divided by device count.
+Tunnel-failure model (learned from rounds 1-2): the axon TPU backend can
+(a) HANG forever inside backend init when the relay is down — the claim
+loop never times out — or (b) come up and then die at any later compile
+with ``remote_compile: Connection refused`` when the relay flaps. So:
+- every TPU attempt runs in a CHILD process with a hard wall-clock timeout;
+- the parent retries attempts with backoff until a total budget is spent;
+- inside the child, the first tiny-jit warmup and the model compile each
+  retry with backoff (a flapped relay often returns within a minute);
+- only after the budget is exhausted does a clean-CPU child run, and its
+  line says ``"fallback": true`` plus the last TPU error.
 """
 
 from __future__ import annotations
@@ -22,29 +30,33 @@ import time
 
 import numpy as np
 
-INIT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_TIMEOUT", "2400"))
+TPU_BUDGET_S = int(os.environ.get("MMLSPARK_BENCH_TPU_BUDGET", "2400"))
+ATTEMPT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_ATTEMPT_TIMEOUT", "1200"))
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
 
 
-def run_bench() -> None:
-    import jax
+def _retry(fn, what: str, tries: int = 4, base_sleep: float = 20.0):
+    """Retry a compile-bearing step: the remote-compile relay flaps."""
+    for i in range(tries):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — any backend error is retryable
+            sys.stderr.write(f"bench: {what} attempt {i + 1}/{tries} failed: {e}\n")
+            if i == tries - 1:
+                raise
+            time.sleep(base_sleep * (i + 1))
 
-    devices = jax.devices()
-    platform = devices[0].platform
-    n_dev = len(devices)
 
+def _bench_featurizer(on_accel: bool, n_dev: int) -> float:
     from mmlspark_tpu import DataFrame
     from mmlspark_tpu.models import ImageFeaturizer
 
-    # CPU smoke mode keeps the same code path but tiny sizes
-    on_accel = platform not in ("cpu",)
     n_rows = 2048 if on_accel else 64
     batch = 256 if on_accel else 16
     size = 224
-
     rng = np.random.default_rng(0)
     imgs = rng.integers(0, 255, size=(n_rows, size, size, 3), dtype=np.uint8)
     df = DataFrame.from_dict({"image": imgs})
-
     feat = ImageFeaturizer(
         input_col="image",
         output_col="features",
@@ -53,11 +65,8 @@ def run_bench() -> None:
         cut_output_layers=1,
         image_size=size,
     )
-
-    # warmup: build model + compile
     warm = DataFrame.from_dict({"image": imgs[:batch]})
-    feat.transform(warm)
-
+    _retry(lambda: feat.transform(warm), "resnet50 compile")
     best = 0.0
     for _ in range(3):
         t0 = time.perf_counter()
@@ -65,50 +74,197 @@ def run_bench() -> None:
         _ = out["features"]  # materialize
         dt = time.perf_counter() - t0
         best = max(best, n_rows / dt)
+    return best / n_dev
 
-    per_chip = best / n_dev
+
+def _bench_histogram(on_accel: bool) -> dict:
+    """Pallas histogram kernel: (n, d) bins -> (d*B, 3) plane, builds/sec."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.ops.histogram import NUM_BINS, plane_histogram, use_pallas
+
+    n = 1 << 18 if on_accel else 1 << 12
+    d = 64 if on_accel else 16
+    rng = np.random.default_rng(1)
+    bins = jnp.asarray(rng.integers(0, NUM_BINS, size=(n, d), dtype=np.int32))
+    stats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+    hist = jax.jit(plane_histogram)
+    _retry(lambda: hist(bins, stats).block_until_ready(), "histogram compile")
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = hist(bins, stats)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return {
+        "hist_rows": n,
+        "hist_features": d,
+        "hist_builds_per_sec": round(reps / dt, 2),
+        "hist_gcells_per_sec": round(reps * n * d / dt / 1e9, 3),
+        "hist_pallas": bool(use_pallas()),
+    }
+
+
+def _bench_serving() -> dict:
+    """Loopback POST -> fixed-shape batch -> jitted model -> reply, ms."""
+    import http.client
+
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    dim = 64
+    w = jnp.asarray(np.random.default_rng(2).normal(size=(dim, dim)).astype(np.float32))
+
+    @jax.jit
+    def model(x):
+        return jnp.tanh(x @ w).sum(axis=-1)
+
+    _retry(
+        lambda: model(jnp.zeros((8, dim), jnp.float32)).block_until_ready(),
+        "serving-model compile",
+    )
+
+    def handler(reqs):
+        x = np.stack(
+            [np.asarray(json.loads(r.body)["x"], np.float32) for r in reqs]
+        )
+        pad = -len(x) % 8  # fixed-shape batch: pad to the 8-row bucket
+        if pad:
+            x = np.pad(x, ((0, pad), (0, 0)))
+        y = np.asarray(model(jnp.asarray(x)))[: len(reqs)]
+        return {
+            r.id: (200, json.dumps({"y": float(v)}).encode(), {})
+            for r, v in zip(reqs, y)
+        }
+
+    srv = WorkerServer()
+    info = srv.start()
+    q = ServingQuery(srv, handler, max_wait_ms=1).start()
+    try:
+        payload = json.dumps({"x": [0.1] * dim})
+        conn = http.client.HTTPConnection("127.0.0.1", info.port, timeout=10)
+        lat = []
+        for i in range(300):
+            t0 = time.perf_counter()
+            conn.request(
+                "POST", "/", body=payload, headers={"Content-Type": "application/json"}
+            )
+            resp = conn.getresponse()
+            resp.read()
+            lat.append((time.perf_counter() - t0) * 1e3)
+        conn.close()
+        lat = np.sort(np.asarray(lat[50:]))  # drop warmup requests
+        return {
+            "serving_p50_ms": round(float(lat[len(lat) // 2]), 3),
+            "serving_p99_ms": round(float(lat[int(len(lat) * 0.99)]), 3),
+        }
+    finally:
+        q.stop()
+        srv.stop()
+
+
+def run_bench() -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax: cache is an optimization, not a requirement
+
+    devices = _retry(jax.devices, "backend init", tries=3, base_sleep=30.0)
+    platform = devices[0].platform
+    n_dev = len(devices)
+    on_accel = platform not in ("cpu",)
+
+    # trivial 1-op warmup first: proves the compile path end-to-end before
+    # spending minutes tracing ResNet, and retries through relay flaps
+    import jax.numpy as jnp
+
+    _retry(
+        lambda: (jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready(),
+        "warmup jit",
+        tries=5,
+        base_sleep=30.0,
+    )
+
+    per_chip = _bench_featurizer(on_accel, n_dev)
+    extra = {"fallback": not on_accel}
+    try:
+        extra.update(_bench_histogram(on_accel))
+    except Exception as e:  # noqa: BLE001
+        extra["hist_error"] = str(e)[:200]
+    try:
+        extra.update(_bench_serving())
+    except Exception as e:  # noqa: BLE001
+        extra["serving_error"] = str(e)[:200]
+
     result = {
         "metric": "imagefeaturizer_resnet50_throughput",
         "value": round(per_chip, 2),
         "unit": f"images/sec/chip ({platform} x{n_dev})",
         "vs_baseline": round(per_chip / 250.0, 3),
+        "extra": extra,
     }
     print(json.dumps(result))
 
 
-def main() -> None:
-    env = dict(os.environ)
+def _run_child(env: dict, timeout_s: int) -> tuple:
+    """Returns (json_line or '', stderr_tail)."""
     try:
         proc = subprocess.run(
-            [sys.executable, __file__, "--child"],
+            [sys.executable, os.path.abspath(__file__), "--child"],
             env=env,
-            timeout=INIT_TIMEOUT_S,
+            timeout=timeout_s,
             capture_output=True,
             text=True,
         )
         line = _json_line(proc.stdout)
         if proc.returncode == 0 and line:
-            print(line)
-            return
-        sys.stderr.write(proc.stderr[-2000:] + "\n")
+            return line, proc.stderr[-2000:]
+        return "", proc.stderr[-2000:]
     except subprocess.TimeoutExpired:
-        sys.stderr.write(f"bench: accelerator init exceeded {INIT_TIMEOUT_S}s; CPU fallback\n")
+        return "", f"child exceeded {timeout_s}s (backend init hang?)"
+
+
+def main() -> None:
+    deadline = time.monotonic() + TPU_BUDGET_S
+    attempt = 0
+    last_err = ""
+    while time.monotonic() < deadline:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        line, err = _run_child(
+            dict(os.environ), int(min(ATTEMPT_TIMEOUT_S, max(remaining, 60)))
+        )
+        if line:
+            # a child that silently initialized on CPU (plugin failed fast
+            # instead of hanging) is a FAILED TPU attempt, not a result
+            if not json.loads(line).get("extra", {}).get("fallback"):
+                print(line)
+                return
+            err = "child ran on CPU (TPU plugin unavailable)"
+        last_err = err
+        sys.stderr.write(f"bench: TPU attempt {attempt} failed:\n{err}\n")
+        if time.monotonic() + 30 < deadline:
+            time.sleep(min(30 * attempt, 120))
     # clean-CPU fallback: drop the axon sitecustomize and force cpu
+    sys.stderr.write("bench: TPU budget exhausted; running CPU fallback\n")
+    env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
-    proc = subprocess.run(
-        [sys.executable, __file__, "--child"],
-        env=env,
-        timeout=INIT_TIMEOUT_S,
-        capture_output=True,
-        text=True,
-    )
-    line = _json_line(proc.stdout)
-    if line:
-        print(line)
-    else:
-        sys.stderr.write(proc.stderr[-2000:] + "\n")
+    line, err = _run_child(env, ATTEMPT_TIMEOUT_S)
+    if not line:
+        sys.stderr.write(err + "\n")
         raise SystemExit(1)
+    d = json.loads(line)
+    d.setdefault("extra", {})["fallback"] = True
+    d["extra"]["tpu_error"] = last_err[-300:]
+    print(json.dumps(d))
 
 
 def _json_line(out: str) -> str:

@@ -5,6 +5,15 @@ LightGBM's BinMapper equivalent: each feature is quantized to at most
 uint8 bin matrix. Bin 0 is reserved for missing values (NaN), matching
 LightGBM's missing-bin handling (zero_as_missing=False semantics).
 
+Sparse input: ``fit``/``transform`` also accept a scipy-style CSR/CSC
+matrix (anything with ``data``/``indices``/``indptr``/``shape``) — the
+reference builds native datasets from dense rows OR sparse rows the same
+way (LightGBMUtils.scala:211-265). Stored values are binned per column
+without ever densifying the float matrix; absent entries map to the
+missing bin (LightGBM's ``zero_as_missing=true``, its recommended setting
+for sparse data). The bin matrix itself stays dense uint8 — 1 byte/cell is
+the histogram substrate the device kernels consume.
+
 Upper-bound thresholds are kept in original feature space so trained trees
 carry real-valued thresholds and prediction never needs the bin mapper.
 """
@@ -17,6 +26,39 @@ from typing import Optional
 import numpy as np
 
 MISSING_BIN = 0
+
+
+def is_sparse(x: object) -> bool:
+    return hasattr(x, "indptr") and hasattr(x, "indices") and hasattr(x, "data")
+
+
+def densify_missing(x: object) -> np.ndarray:
+    """Sparse -> dense float32 with ABSENT entries as NaN.
+
+    Prediction-time companion of the zero_as_missing binning: a tree
+    trained on sparse data routes absent entries through the missing bin,
+    so scoring must present them as NaN, not 0.0."""
+    n, d = x.shape
+    out = np.full((n, d), np.nan, np.float32)
+    xc = x.tocsc() if hasattr(x, "tocsc") else x
+    indptr = np.asarray(xc.indptr)
+    rows = np.asarray(xc.indices)
+    data = np.asarray(xc.data, np.float32)
+    for f in range(d):
+        lo, hi = indptr[f], indptr[f + 1]
+        if hi > lo:
+            out[rows[lo:hi], f] = data[lo:hi]
+    return out
+
+
+def _csc_columns(x: object):
+    """Yield (f, stored_values) for every column with stored entries."""
+    xc = x.tocsc() if hasattr(x, "tocsc") else x
+    indptr = np.asarray(xc.indptr)
+    for f in range(x.shape[1]):
+        lo, hi = indptr[f], indptr[f + 1]
+        if hi > lo:
+            yield f, np.asarray(xc.data[lo:hi], np.float64)
 
 
 @dataclass
@@ -50,6 +92,14 @@ class BinMapper:
             # bins live in a uint8 matrix (bin 0 = missing); larger values
             # would silently wrap mod 256
             raise ValueError(f"max_bin must be in [2, 255], got {max_bin}")
+        if is_sparse(x):
+            if categorical_features:
+                raise ValueError(
+                    "categorical features require dense input (sparse "
+                    "columns have no stable category<->bin identity for "
+                    "absent entries)"
+                )
+            return BinMapper._fit_sparse(x, max_bin, sample=sample, seed=seed)
         n, d = x.shape
         if n > sample:
             idx = np.random.default_rng(seed).choice(n, sample, replace=False)
@@ -87,9 +137,53 @@ class BinMapper:
             uppers.append(bounds.astype(np.float64))
         return BinMapper(uppers=uppers, max_bin=max_bin)
 
+    @staticmethod
+    def _fit_sparse(
+        x: object, max_bin: int, sample: int = 200_000, seed: int = 0
+    ) -> "BinMapper":
+        """Quantile bounds from each column's STORED values only (capped at
+        the same per-fit sampling budget as the dense path)."""
+        d = x.shape[1]
+        rng = np.random.default_rng(seed)
+        uppers = [np.array([], dtype=np.float64)] * d
+        for f, col in _csc_columns(x):
+            if len(col) > sample:
+                col = rng.choice(col, sample, replace=False)
+            col = col[~np.isnan(col)]
+            uniq = np.unique(col)
+            if len(uniq) <= 1:
+                continue
+            if len(uniq) <= max_bin - 1:
+                bounds = (uniq[:-1] + uniq[1:]) / 2.0
+            else:
+                qs = np.linspace(0, 100, max_bin)[1:-1]
+                bounds = np.unique(np.percentile(col, qs, method="linear"))
+            uppers[f] = bounds.astype(np.float64)
+        return BinMapper(uppers=uppers, max_bin=max_bin)
+
+    def _transform_sparse(self, x: object) -> np.ndarray:
+        """CSR/CSC -> dense uint8 bins; absent entries stay MISSING_BIN."""
+        n, d = x.shape
+        out = np.zeros((n, d), dtype=np.uint8)
+        xc = x.tocsc() if hasattr(x, "tocsc") else x
+        indptr = np.asarray(xc.indptr)
+        rows = np.asarray(xc.indices)
+        data = np.asarray(xc.data, np.float32)
+        for f in range(d):
+            lo, hi = indptr[f], indptr[f + 1]
+            if hi == lo:
+                continue
+            vals = data[lo:hi]
+            b = np.searchsorted(self.uppers[f], vals, side="left") + 1
+            b = np.where(np.isnan(vals), MISSING_BIN, b)
+            out[rows[lo:hi], f] = b.astype(np.uint8)
+        return out
+
     def transform(self, x: np.ndarray) -> np.ndarray:
         """(n, d) float -> (n, d) uint8 bins; NaN -> MISSING_BIN(0); real
         values start at bin 1."""
+        if is_sparse(x):
+            return self._transform_sparse(x)
         from mmlspark_tpu.ops import native_loader
 
         # bin at float32 on BOTH paths so results are identical with and

@@ -115,6 +115,9 @@ class Booster:
     # boost_from_average baseline added to every raw score: float, or a
     # per-class list for multiclass (LightGBM's init score from label avg)
     base_score: Any = 0.0
+    # gbdt|goss|dart|rf — rf predictions AVERAGE trees instead of summing
+    # (LightGBM boostingType, lightgbm/LightGBMParams.scala)
+    boosting_type: str = "gbdt"
 
     # -- serialization ------------------------------------------------------
 
@@ -132,6 +135,7 @@ class Booster:
                     if isinstance(self.base_score, np.ndarray)
                     else self.base_score
                 ),
+                "boosting_type": self.boosting_type,
                 "trees": [t.to_dict() for t in self.trees],
             }
         )
@@ -147,6 +151,7 @@ class Booster:
             best_iteration=d.get("best_iteration", -1),
             feature_names=d.get("feature_names"),
             base_score=d.get("base_score", 0.0),
+            boosting_type=d.get("boosting_type", "gbdt"),
         )
         return b
 
@@ -162,75 +167,36 @@ class Booster:
             # continued training fit residuals on top of self's predictions,
             # which already include self's baseline — keep it
             base_score=self.base_score,
+            boosting_type=self.boosting_type,
         )
 
     # -- device scoring ------------------------------------------------------
 
     def _stacked(self, upto: Optional[int] = None) -> tuple:
         trees = self.trees[: upto * self.num_class] if upto else self.trees
-        if not trees:
-            return None
-        S = max(len(t.leaf) for t in trees)
-        L = max(len(t.values) for t in trees)
-        T = len(trees)
-
-        def pad(a: np.ndarray, n: int, fill: Any) -> np.ndarray:
-            out = np.full((n,), fill, dtype=a.dtype)
-            out[: len(a)] = a
-            return out
-
-        rec_leaf = np.stack([pad(t.leaf, S, -1) for t in trees])
-        rec_feature = np.stack([pad(np.clip(t.feature, 0, None), S, 0) for t in trees])
-        rec_threshold = np.stack(
-            [pad(t.threshold.astype(np.float32), S, np.float32(np.inf)) for t in trees]
-        )
-        rec_active = np.stack([pad(t.active, S, False) for t in trees])
-        values = np.stack([pad(t.values, L, np.float32(0)) for t in trees])
-        rec_is_cat = rec_catmask = None
-        if any(t.has_categorical for t in trees):
-            from mmlspark_tpu.ops.histogram import NUM_BINS
-
-            rec_is_cat = np.zeros((T, S), bool)
-            rec_catmask = np.zeros((T, S, NUM_BINS), bool)
-            for i, t in enumerate(trees):
-                if t.is_cat is not None:
-                    rec_is_cat[i, : len(t.is_cat)] = t.is_cat
-                    rec_catmask[i, : t.catmask.shape[0]] = t.catmask
-        return rec_leaf, rec_feature, rec_threshold, rec_active, values, rec_is_cat, rec_catmask
+        return _stack_trees(trees)
 
     def predict_raw(self, x: np.ndarray, num_iteration: Optional[int] = None) -> np.ndarray:
         """(n, d) -> (n,) raw scores (binary/regression) or (n, k) multiclass."""
-        import jax.numpy as jnp
-
         n = x.shape[0]
         if num_iteration is None and self.best_iteration > 0:
             num_iteration = self.best_iteration
-        stacked = self._stacked(num_iteration)
+        trees = self.trees[: num_iteration * self.num_class] if num_iteration else self.trees
         k = self.num_class
         base = np.asarray(self.base_score, np.float32)
-        if stacked is None:
+        if not trees:
             return np.broadcast_to(
                 base, (n,) if k == 1 else (n, k)
             ).astype(np.float32).copy()
-        rec_leaf, rec_feature, rec_threshold, rec_active, values, is_cat, catmask = stacked
-        leaves = np.asarray(
-            treegrow.predict_leaves(
-                jnp.asarray(x, jnp.float32),
-                jnp.asarray(rec_leaf),
-                jnp.asarray(rec_feature),
-                jnp.asarray(rec_threshold),
-                jnp.asarray(rec_active),
-                jnp.asarray(is_cat) if is_cat is not None else None,
-                jnp.asarray(catmask) if catmask is not None else None,
-            )
-        )  # (n, T)
-        per_tree = np.take_along_axis(values[None], leaves[..., None], axis=2)[..., 0]
-        if k == 1:
-            return (per_tree.sum(axis=1) + base).astype(np.float32)
+        per_tree = per_tree_raw(trees, x)  # (n, T)
         T = per_tree.shape[1]
+        # rf averages the forest; boosting sums it
+        denom = (T // k) if self.boosting_type == "rf" else 1
+        if k == 1:
+            return (per_tree.sum(axis=1) / denom + base).astype(np.float32)
         out = np.zeros((n, k), np.float32)
         for c in range(k):
-            out[:, c] = per_tree[:, c::k].sum(axis=1)
+            out[:, c] = per_tree[:, c::k].sum(axis=1) / denom
         return out + base
 
     def predict_leaf(self, x: np.ndarray) -> np.ndarray:
@@ -279,6 +245,62 @@ class Booster:
 
     def dump_model(self) -> dict:
         return json.loads(self.to_model_string())
+
+
+def _stack_trees(trees: list) -> Optional[tuple]:
+    """Pad a tree list to common split/leaf counts for the batched device
+    traversal (treegrow.predict_leaves evaluates all trees in one program)."""
+    if not trees:
+        return None
+    S = max(len(t.leaf) for t in trees)
+    L = max(len(t.values) for t in trees)
+    T = len(trees)
+
+    def pad(a: np.ndarray, n: int, fill: Any) -> np.ndarray:
+        out = np.full((n,), fill, dtype=a.dtype)
+        out[: len(a)] = a
+        return out
+
+    rec_leaf = np.stack([pad(t.leaf, S, -1) for t in trees])
+    rec_feature = np.stack([pad(np.clip(t.feature, 0, None), S, 0) for t in trees])
+    rec_threshold = np.stack(
+        [pad(t.threshold.astype(np.float32), S, np.float32(np.inf)) for t in trees]
+    )
+    rec_active = np.stack([pad(t.active, S, False) for t in trees])
+    values = np.stack([pad(t.values, L, np.float32(0)) for t in trees])
+    rec_is_cat = rec_catmask = None
+    if any(t.has_categorical for t in trees):
+        from mmlspark_tpu.ops.histogram import NUM_BINS
+
+        rec_is_cat = np.zeros((T, S), bool)
+        rec_catmask = np.zeros((T, S, NUM_BINS), bool)
+        for i, t in enumerate(trees):
+            if t.is_cat is not None:
+                rec_is_cat[i, : len(t.is_cat)] = t.is_cat
+                rec_catmask[i, : t.catmask.shape[0]] = t.catmask
+    return rec_leaf, rec_feature, rec_threshold, rec_active, values, rec_is_cat, rec_catmask
+
+
+def per_tree_raw(trees: list, x: np.ndarray) -> np.ndarray:
+    """(n, T) raw contribution of each tree (device traversal + gather)."""
+    import jax.numpy as jnp
+
+    stacked = _stack_trees(trees)
+    if stacked is None:
+        return np.zeros((x.shape[0], 0), np.float32)
+    rec_leaf, rec_feature, rec_threshold, rec_active, values, is_cat, catmask = stacked
+    leaves = np.asarray(
+        treegrow.predict_leaves(
+            jnp.asarray(x, jnp.float32),
+            jnp.asarray(rec_leaf),
+            jnp.asarray(rec_feature),
+            jnp.asarray(rec_threshold),
+            jnp.asarray(rec_active),
+            jnp.asarray(is_cat) if is_cat is not None else None,
+            jnp.asarray(catmask) if catmask is not None else None,
+        )
+    )  # (n, T)
+    return np.take_along_axis(values[None], leaves[..., None], axis=2)[..., 0]
 
 
 def _tree_contribs(tree: Tree, x: np.ndarray) -> np.ndarray:

@@ -72,6 +72,18 @@ class _LightGBMParams(
     use_barrier_execution_mode = Param("parity no-op (SPMD is the gang)", default=False, type_=bool)
     top_k = Param("voting_parallel K (parity)", default=20, type_=int)
     boost_from_average = Param("init score from label average", default=True, type_=bool)
+    boosting_type = Param(
+        "gbdt | goss | dart | rf (LightGBMParams boostingType)",
+        default="gbdt",
+        type_=str,
+        validator=lambda v: v in ("gbdt", "goss", "dart", "rf"),
+    )
+    drop_rate = Param("dart: per-iteration tree dropout rate", default=0.1, type_=float)
+    max_drop = Param("dart: max trees dropped per iteration", default=50, type_=int)
+    skip_drop = Param("dart: probability of skipping dropout", default=0.5, type_=float)
+    top_rate = Param("goss: large-gradient retain fraction", default=0.2, type_=float)
+    other_rate = Param("goss: small-gradient sample fraction", default=0.1, type_=float)
+    eval_at = Param("ranking eval truncation (ndcg@k)", default=5, type_=int)
     categorical_slot_indexes = Param(
         "feature indices treated as categorical (subset splits; "
         "LightGBMParams categoricalSlotIndexes analogue). Values must be "
@@ -105,6 +117,13 @@ class _LightGBMParams(
             top_k=self.get("top_k"),
             verbosity=self.get("verbosity"),
             categorical_features=tuple(self.get("categorical_slot_indexes") or ()),
+            boosting_type=self.get("boosting_type"),
+            drop_rate=self.get("drop_rate"),
+            max_drop=self.get("max_drop"),
+            skip_drop=self.get("skip_drop"),
+            top_rate=self.get("top_rate"),
+            other_rate=self.get("other_rate"),
+            eval_at=self.get("eval_at"),
         )
 
     def _gather(self, df: DataFrame) -> dict:

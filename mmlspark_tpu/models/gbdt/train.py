@@ -6,6 +6,26 @@ grow one tree per class (the compiled ``grow_tree`` program — histogram +
 split search + partition assignment all on device), update scores from the
 grower's own row->leaf output (free, no re-predict), evaluate + early-stop.
 
+Boosting modes (``boostingType`` in lightgbm/LightGBMParams.scala, golden
+matrix src/test/resources/benchmarks/benchmarks_VerifyLightGBMClassifier.csv):
+- ``gbdt``  — plain gradient boosting.
+- ``goss``  — gradient-based one-side sampling: keep the top ``top_rate``
+  fraction of rows by |gradient|, sample ``other_rate`` of the rest and
+  amplify their weight by (1-a)/b so histogram sums stay unbiased.
+- ``dart``  — per iteration (unless ``skip_drop`` fires) drop a random
+  subset of past iterations, fit the new tree against the scores without
+  them, then normalize: new tree x 1/(k+1), dropped trees x k/(k+1).
+- ``rf``    — random forest: constant gradients at the initial score,
+  bagging per iteration, no shrinkage; prediction averages trees.
+
+Device residency: scores, gradients, labels and bagging/GOSS masks live on
+device (sharded over the mesh ``data`` axis) across all iterations — the
+host sees only the per-tree split records and the eval-metric scalar
+(lightgbm/TrainUtils.scala:220-315 keeps the equivalent state inside the
+native booster for the same reason). LambdaRank is the exception: its
+group-sorted pairwise gradients run on host, so scores round-trip per
+iteration on that objective only.
+
 Distribution: rows are batch-sharded over the mesh ``data`` axis before the
 loop. ``data_parallel`` lets GSPMD partition the histogram scatter and
 insert the full-plane ICI allreduce; ``voting_parallel`` switches to the
@@ -28,10 +48,12 @@ import numpy as np
 
 from mmlspark_tpu.models.gbdt import objectives
 from mmlspark_tpu.models.gbdt.binning import BinMapper
-from mmlspark_tpu.models.gbdt.booster import Booster, Tree
+from mmlspark_tpu.models.gbdt.booster import Booster, Tree, per_tree_raw
 from mmlspark_tpu.models.gbdt.treegrow import grow_tree
 
 log = logging.getLogger("mmlspark_tpu.gbdt")
+
+BOOSTING_TYPES = ("gbdt", "goss", "dart", "rf")
 
 
 @dataclass
@@ -58,9 +80,19 @@ class TrainConfig:
     # feature indices treated as categorical (LightGBM categoricalSlotIndexes
     # analogue): identity-binned, split by subset membership
     categorical_features: tuple = ()
+    boosting_type: str = "gbdt"        # gbdt|goss|dart|rf
+    # dart knobs (LightGBM drop_rate/max_drop/skip_drop defaults)
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    # goss knobs (LightGBM top_rate/other_rate defaults)
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    # lambdarank eval truncation: NDCG@eval_at on the validation rows
+    eval_at: int = 5
 
 
-def _tree_from_device(grown: Any, mapper: BinMapper) -> Tree:
+def _tree_from_device(grown: Any, mapper: BinMapper, value_scale: float = 1.0) -> Tree:
     rec_leaf = np.asarray(grown.rec_leaf)
     rec_feature = np.asarray(grown.rec_feature)
     rec_bin = np.asarray(grown.rec_bin)
@@ -75,20 +107,58 @@ def _tree_from_device(grown: Any, mapper: BinMapper) -> Tree:
         dtype=np.float64,
     )
     has_cat = bool(is_cat.any())
+    values = np.asarray(grown.leaf_values)
+    if value_scale != 1.0:
+        values = (values * value_scale).astype(values.dtype)
     return Tree(
         leaf=rec_leaf,
         feature=rec_feature,
         threshold=thr,
         active=np.asarray(grown.rec_active),
         gain=np.asarray(grown.rec_gain),
-        values=np.asarray(grown.leaf_values),
+        values=values,
         counts=np.asarray(grown.leaf_counts),
         is_cat=is_cat if has_cat else None,
         catmask=np.asarray(grown.rec_catmask) if has_cat else None,
     )
 
 
-def _eval_metric(cfg: TrainConfig, scores: np.ndarray, y: np.ndarray, mask: np.ndarray) -> tuple:
+def grouped_ndcg(
+    scores: np.ndarray, labels: np.ndarray, group_ids: np.ndarray, k: int = 5
+) -> float:
+    """Mean NDCG@k over query groups with LightGBM's 2^rel-1 gain.
+
+    The real ranking eval the reference's early stopping uses
+    (lightgbm/LightGBMRanker.scala; TrainUtils.scala:276-308 evaluates the
+    native booster's ndcg@k). Mirrors recommendation/evaluator.py's
+    per-user NDCG, specialized to flat score/label arrays."""
+    total, n_groups = 0.0, 0
+    for gid in np.unique(group_ids):
+        m = group_ids == gid
+        s, rel = scores[m], labels[m]
+        if len(s) == 0:
+            continue
+        kk = min(k, len(s))
+        order = np.argsort(-s, kind="stable")[:kk]
+        gains = 2.0 ** rel - 1.0
+        disc = 1.0 / np.log2(np.arange(2, kk + 2))
+        dcg = float((gains[order] * disc).sum())
+        ideal = np.sort(gains)[::-1][:kk]
+        idcg = float((ideal * disc).sum())
+        # all-zero-relevance groups score 1.0 (LightGBM's NDCG convention:
+        # nothing to rank correctly means nothing ranked incorrectly)
+        total += dcg / idcg if idcg > 0 else 1.0
+        n_groups += 1
+    return total / max(n_groups, 1)
+
+
+def _eval_metric(
+    cfg: TrainConfig,
+    scores: np.ndarray,
+    y: np.ndarray,
+    mask: np.ndarray,
+    group_ids: Optional[np.ndarray] = None,
+) -> tuple:
     """Returns (name, value, higher_is_better) on masked rows."""
     if mask.sum() == 0:
         return ("none", float("nan"), False)
@@ -114,8 +184,36 @@ def _eval_metric(cfg: TrainConfig, scores: np.ndarray, y: np.ndarray, mask: np.n
             False,
         )
     if obj == "lambdarank":
-        return ("ndcg_proxy", float(-np.corrcoef(s, yy)[0, 1]) if len(yy) > 1 else 0.0, False)
+        k = cfg.eval_at
+        if metric.startswith("ndcg@"):
+            k = int(metric.split("@", 1)[1])
+        g = group_ids[mask] if group_ids is not None else np.zeros(len(yy), np.int64)
+        return (f"ndcg@{k}", grouped_ndcg(s, yy, g, k=k), True)
     return ("l2", float(((s - yy) ** 2).mean()), False)
+
+
+@jax.jit
+def _goss_weights(g_abs: jnp.ndarray, w: jnp.ndarray, u: jnp.ndarray,
+                  top_rate: float, other_rate: float) -> jnp.ndarray:
+    """One-side sampling weights on device: rows ranked by |g| among rows
+    with nonzero base weight; top a kept at 1x, random b of the rest kept
+    at (1-a)/b, remainder dropped."""
+    eligible = w > 0
+    n_eligible = jnp.maximum(eligible.sum(), 1)
+    n_top = jnp.maximum((top_rate * n_eligible).astype(jnp.int32), 1)
+    masked = jnp.where(eligible, g_abs, -jnp.inf)
+    # value threshold for the top-a set (ties may admit a few extra rows;
+    # LightGBM's exact-count selection differs by at most the tie set)
+    srt = jnp.sort(masked)[::-1]
+    thresh = srt[jnp.clip(n_top - 1, 0, masked.shape[0] - 1)]
+    is_top = eligible & (masked >= thresh)
+    # LightGBM draws b*n rows out of the (1-a)*n remainder — per-row
+    # probability b/(1-a) — and amplifies by (1-a)/b, so each non-top row's
+    # EXPECTED histogram weight is exactly 1 (unbiased)
+    p_other = jnp.minimum(other_rate / jnp.maximum(1.0 - top_rate, 1e-12), 1.0)
+    amp = (1.0 - top_rate) / jnp.maximum(other_rate, 1e-12)
+    is_other = eligible & ~is_top & (u < p_other)
+    return jnp.where(is_top, 1.0, jnp.where(is_other, amp, 0.0)).astype(jnp.float32)
 
 
 def train(
@@ -130,12 +228,24 @@ def train(
     base_score: Any = 0.0,
     shard: bool = True,
 ) -> Booster:
-    """Fit a booster on dense (n, d) features.
+    """Fit a booster on dense (n, d) features or a CSR triple.
+
+    ``x`` may be a scipy-style CSR matrix (anything with ``data``/
+    ``indices``/``indptr``/``shape``); binning then runs per-column over the
+    stored values only (LightGBMUtils.scala:211-265 builds native datasets
+    from dense or sparse rows the same way).
 
     ``base_score``: boost_from_average baseline (scalar, or (k,) for
     multiclass) — added to the initial scores AND stored on the booster so
     prediction replays it."""
+    if cfg.boosting_type not in BOOSTING_TYPES:
+        raise ValueError(f"boosting_type must be one of {BOOSTING_TYPES}")
+    from mmlspark_tpu.models.gbdt.binning import is_sparse
+
+    sparse_input = is_sparse(x)
     n, d = x.shape
+    # np.matrix-shaped labels (scipy .sum(axis=) results) flatten silently
+    y = np.asarray(y).reshape(n)
     k = cfg.num_class if cfg.objective == "multiclass" else 1
     cat_features = tuple(int(f) for f in (cfg.categorical_features or ()))
     mapper = BinMapper.fit(
@@ -153,6 +263,17 @@ def train(
     )
     w = sample_weight if sample_weight is not None else np.ones(n, np.float32)
     w = np.where(train_mask, w, 0.0).astype(np.float32)
+
+    bagging_fraction = cfg.bagging_fraction
+    bagging_freq = cfg.bagging_freq
+    if cfg.boosting_type == "rf" and not (bagging_freq > 0 and bagging_fraction < 1.0):
+        # rf without bagging would grow the same tree every round; LightGBM
+        # hard-errors here, we default to the classic 0.632 bootstrap rate
+        log.info("rf boosting without bagging params: defaulting to bagging_fraction=0.632, bagging_freq=1")
+        bagging_fraction, bagging_freq = 0.632, 1
+    if cfg.boosting_type == "goss" and bagging_freq > 0:
+        log.info("goss boosting: bagging disabled (GOSS is the row sampler)")
+        bagging_freq = 0
 
     # device placement: rows sharded over the data axis when a mesh exists
     mesh = None
@@ -179,6 +300,7 @@ def train(
         pad = 0
         bins_dev = jnp.asarray(bins_host)
         w_dev = jnp.asarray(w)
+    n_pad = n + pad
 
     def padded(a: np.ndarray) -> jnp.ndarray:
         if pad:
@@ -189,39 +311,81 @@ def train(
             return shard_batch(a)
         return jnp.asarray(a)
 
+    # -- device-resident loop state -----------------------------------------
+    # scores, labels and per-iteration gradients stay sharded on device for
+    # the whole loop; the host receives only split records + eval scalars.
     if k > 1:
-        scores = np.zeros((n, k), np.float32)
-        y_onehot = np.eye(k, dtype=np.float32)[y.astype(np.int64)]
+        scores0 = np.zeros((n, k), np.float32)
+        y_onehot_dev = padded(np.eye(k, dtype=np.float32)[y.astype(np.int64)])
     else:
-        scores = np.zeros(n, np.float32)
-    scores = scores + np.asarray(base_score, np.float32)
+        scores0 = np.zeros(n, np.float32)
+        y_dev = padded(y.astype(np.float32))
+    scores0 = scores0 + np.asarray(base_score, np.float32)
     if init_score is not None:
-        scores = scores + init_score.astype(scores.dtype)
+        scores0 = scores0 + init_score.astype(scores0.dtype)
     if init_booster is not None and init_booster.trees:
         # score with ALL trees (not the best_iteration prefix predict_raw
         # would default to): merge() replays every init tree, so residuals
         # must be fit against exactly that
         all_iters = len(init_booster.trees) // init_booster.num_class
-        prev = init_booster.predict_raw(x, num_iteration=all_iters)
-        scores = scores + prev.astype(scores.dtype)
+        prev = init_booster.predict_raw(
+            _densify(x) if sparse_input else x, num_iteration=all_iters
+        )
+        scores0 = scores0 + prev.astype(scores0.dtype)
+    scores = padded(scores0)
+
+    is_rf = cfg.boosting_type == "rf"
+    is_dart = cfg.boosting_type == "dart"
+    is_goss = cfg.boosting_type == "goss"
+    early_stopping_round = cfg.early_stopping_round
+    if is_dart and early_stopping_round > 0:
+        # dropout keeps rescaling trees INSIDE any recorded best-iteration
+        # prefix, so the prefix can't reproduce the scores that won —
+        # LightGBM hard-errors on this combination, we disable with a note
+        log.info("early stopping is not available in dart mode; disabled")
+        early_stopping_round = 0
+    if is_rf:
+        # constant gradients at the initial score; `scores` becomes the
+        # running SUM of tree contributions (averaged for eval/predict)
+        rf_base = scores
+        scores = padded(np.zeros_like(scores0))
+        if cfg.objective == "binary":
+            g_rf, h_rf = objectives.binary_grad_hess(rf_base, y_dev)
+        elif cfg.objective == "multiclass":
+            g_rf, h_rf = objectives.multiclass_grad_hess(rf_base, y_onehot_dev)
+        elif cfg.objective == "lambdarank":
+            g_np, h_np = objectives.lambdarank_grad_hess(
+                scores0.astype(np.float64), y.astype(np.float64), group_ids
+            )
+            g_rf, h_rf = padded(g_np.astype(np.float32)), padded(h_np.astype(np.float32))
+        else:
+            g_rf, h_rf = objectives.l2_grad_hess(rf_base, y_dev)
 
     rng = np.random.default_rng(cfg.seed)
+    base_key = jax.random.PRNGKey(cfg.seed)
     booster = Booster(
         trees=[], objective=cfg.objective, num_class=k, num_features=d,
-        base_score=base_score,
+        base_score=base_score, boosting_type=cfg.boosting_type,
     )
+    x_host_dense: Optional[np.ndarray] = None  # dart re-predicts dropped trees
 
     best_val = None
     best_iter = -1
     rounds_no_improve = 0
+    bag = None
 
     for it in range(cfg.num_iterations):
-        # bagging / feature sampling for this iteration
-        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0 and it % cfg.bagging_freq == 0:
-            bag = (rng.random(n) < cfg.bagging_fraction).astype(np.float32)
-        elif cfg.bagging_fraction >= 1.0 or cfg.bagging_freq == 0:
-            bag = np.ones(n, np.float32)
-        w_it = w * bag
+        it_key = jax.random.fold_in(base_key, it)
+        # bagging for this iteration (device mask, no host transfer)
+        if bagging_freq > 0 and bagging_fraction < 1.0:
+            if it % bagging_freq == 0 or bag is None:
+                bag = (
+                    jax.random.uniform(jax.random.fold_in(it_key, 1), (n_pad,))
+                    < bagging_fraction
+                ).astype(jnp.float32)
+        else:
+            bag = None
+        w_it = w_dev * bag if bag is not None else w_dev
         if cfg.feature_fraction < 1.0:
             fm = (rng.random(d) < cfg.feature_fraction).astype(np.float32)
             if fm.sum() == 0:
@@ -230,32 +394,62 @@ def train(
             fm = np.ones(d, np.float32)
         fm_dev = jnp.asarray(fm)
 
-        # gradients
+        # dart: choose dropped iterations, fit against scores without them
+        drop_set: list = []
+        drop_contrib = None
+        eff_scores = scores
+        if is_dart and it > 0 and rng.random() >= cfg.skip_drop:
+            sel = np.flatnonzero(rng.random(it) < cfg.drop_rate)
+            if len(sel) > cfg.max_drop:
+                sel = rng.choice(sel, cfg.max_drop, replace=False)
+            drop_set = [int(s) for s in sel]
+        if drop_set:
+            if x_host_dense is None:
+                x_host_dense = _densify(x) if sparse_input else np.asarray(x, np.float32)
+            drop_contrib = _iterations_contrib(booster, x_host_dense, drop_set, k)
+            eff_scores = scores - padded(drop_contrib)
+
+        # gradients (device, except lambdarank's group-sorted host path)
         if cfg.objective == "binary":
-            g, h = binary_np(scores, y)
+            g_dev, h_dev = (g_rf, h_rf) if is_rf else objectives.binary_grad_hess(eff_scores, y_dev)
         elif cfg.objective == "multiclass":
-            g_all, h_all = objectives.multiclass_grad_hess(
-                jnp.asarray(scores), jnp.asarray(y_onehot)
-            )
-            g_all, h_all = np.asarray(g_all), np.asarray(h_all)
+            g_dev, h_dev = (g_rf, h_rf) if is_rf else objectives.multiclass_grad_hess(eff_scores, y_onehot_dev)
         elif cfg.objective == "lambdarank":
-            g, h = objectives.lambdarank_grad_hess(
-                scores.astype(np.float64), y.astype(np.float64), group_ids
-            )
+            if is_rf:
+                g_dev, h_dev = g_rf, h_rf
+            else:
+                s_host = np.asarray(eff_scores)[:n]
+                g_np, h_np = objectives.lambdarank_grad_hess(
+                    s_host.astype(np.float64), y.astype(np.float64), group_ids
+                )
+                g_dev, h_dev = padded(g_np.astype(np.float32)), padded(h_np.astype(np.float32))
         else:
-            g, h = np.asarray(scores - y, np.float32), np.ones(n, np.float32)
+            g_dev, h_dev = (g_rf, h_rf) if is_rf else objectives.l2_grad_hess(eff_scores, y_dev)
+
+        # goss: one-side sampling weights from this iteration's |g|
+        if is_goss:
+            g_abs = jnp.abs(g_dev).sum(axis=1) if k > 1 else jnp.abs(g_dev)
+            u = jax.random.uniform(jax.random.fold_in(it_key, 2), (n_pad,))
+            w_it = w_it * _goss_weights(
+                g_abs, w_it, u, float(cfg.top_rate), float(cfg.other_rate)
+            )
+
+        # dart normalization factors (paper semantics: new tree 1/(k+1),
+        # dropped trees k/(k+1))
+        n_drop = len(drop_set)
+        nf_new = 1.0 / (n_drop + 1) if is_dart else 1.0
+        nf_drop = n_drop / (n_drop + 1) if n_drop else 1.0
 
         classes = range(k) if k > 1 else [0]
+        deltas = []
         for c in classes:
-            if k > 1:
-                gc, hc = g_all[:, c], h_all[:, c]
-            else:
-                gc, hc = g, h
+            gc = g_dev[:, c] if k > 1 else g_dev
+            hc = h_dev[:, c] if k > 1 else h_dev
             grow_kw = dict(
                 num_leaves=cfg.num_leaves,
                 lambda_l2=float(cfg.lambda_l2),
                 min_gain=float(cfg.min_gain_to_split),
-                learning_rate=float(cfg.learning_rate),
+                learning_rate=1.0 if is_rf else float(cfg.learning_rate),
                 feature_mask=fm_dev,
                 max_depth=int(cfg.max_depth),
                 min_data_in_leaf=int(cfg.min_data_in_leaf),
@@ -264,36 +458,39 @@ def train(
                 from mmlspark_tpu.models.gbdt.voting import grow_tree_voting
 
                 grown = grow_tree_voting(
-                    bins_dev,
-                    padded(gc.astype(np.float32)),
-                    padded(hc.astype(np.float32)),
-                    padded(w_it),
-                    top_k=int(cfg.top_k),
-                    mesh=mesh,
-                    **grow_kw,
+                    bins_dev, gc, hc, w_it,
+                    top_k=int(cfg.top_k), mesh=mesh, **grow_kw,
                 )
             else:
                 grown = grow_tree(
-                    bins_dev,
-                    padded(gc.astype(np.float32)),
-                    padded(hc.astype(np.float32)),
-                    padded(w_it),
-                    categorical_mask=cat_mask_dev,
-                    **grow_kw,
+                    bins_dev, gc, hc, w_it,
+                    categorical_mask=cat_mask_dev, **grow_kw,
                 )
-            tree = _tree_from_device(grown, mapper)
+            tree = _tree_from_device(grown, mapper, value_scale=nf_new)
             booster.trees.append(tree)
-            # score update from the grower's own leaf assignment
-            row_leaf = np.asarray(grown.row_leaf)[:n]
-            delta = tree.values[row_leaf]
-            if k > 1:
-                scores[:, c] += delta
-            else:
-                scores += delta
+            # score update from the grower's own leaf assignment (device
+            # gather — row_leaf and leaf_values never leave the chip)
+            delta = jnp.asarray(tree.values)[grown.row_leaf]
+            deltas.append(delta)
+        if k > 1:
+            scores = scores + jnp.stack(deltas, axis=1)
+        else:
+            scores = scores + deltas[0]
+        if drop_set:
+            # dropped trees shrink to k/(k+1): mutate their stored values
+            # and fold the same correction into the running scores
+            for itdrop in drop_set:
+                for c in range(k):
+                    t = booster.trees[itdrop * k + c]
+                    t.values = (t.values * nf_drop).astype(t.values.dtype)
+            scores = scores - padded(drop_contrib * (1.0 - nf_drop))
 
-        # eval + early stopping on validation rows
+        # eval + early stopping on validation rows (the only host sync)
         if valid_mask is not None and valid_mask.any():
-            name, val, higher = _eval_metric(cfg, scores, y, valid_mask)
+            s_eval = np.asarray(scores)[:n]
+            if is_rf:
+                s_eval = np.asarray(rf_base)[:n] + s_eval / (it + 1)
+            name, val, higher = _eval_metric(cfg, s_eval, y, valid_mask, group_ids)
             if cfg.verbosity > 0:
                 log.info("iter %d %s=%.6f", it, name, val)
             improved = (
@@ -305,12 +502,14 @@ def train(
                 best_val, best_iter, rounds_no_improve = val, it + 1, 0
             else:
                 rounds_no_improve += 1
-                if cfg.early_stopping_round > 0 and rounds_no_improve >= cfg.early_stopping_round:
+                if early_stopping_round > 0 and rounds_no_improve >= early_stopping_round:
                     log.info("early stop at iter %d (best %d)", it, best_iter)
                     booster.best_iteration = best_iter
                     break
 
-    if valid_mask is not None and best_iter > 0 and booster.best_iteration < 0:
+    # dart never records best_iteration: later dropouts rescale trees inside
+    # any prefix, so no prefix reproduces a historical eval score
+    if valid_mask is not None and best_iter > 0 and booster.best_iteration < 0 and not is_dart:
         booster.best_iteration = best_iter
     if init_booster is not None and init_booster.trees:
         new_best = booster.best_iteration
@@ -322,6 +521,27 @@ def train(
     return booster
 
 
-def binary_np(scores: np.ndarray, y: np.ndarray) -> tuple:
-    p = objectives.sigmoid(scores)
-    return (p - y).astype(np.float32), (p * (1 - p)).astype(np.float32)
+def _densify(x: Any) -> np.ndarray:
+    """CSR -> dense float32 with absent entries as NaN (prediction-time
+    only; training stays sparse). NaN, not 0: trees trained on sparse data
+    route absent entries through the missing bin."""
+    from mmlspark_tpu.models.gbdt.binning import densify_missing, is_sparse
+
+    if is_sparse(x):
+        return densify_missing(x)
+    return np.asarray(x, np.float32)
+
+
+def _iterations_contrib(
+    booster: Booster, x: np.ndarray, iterations: list, k: int
+) -> np.ndarray:
+    """Summed raw contribution of the given iterations: (n,) or (n, k)."""
+    idx = [it * k + c for it in iterations for c in range(k)]
+    per = per_tree_raw([booster.trees[i] for i in idx], x)  # (n, len(idx))
+    if k == 1:
+        return per.sum(axis=1).astype(np.float32)
+    n = per.shape[0]
+    out = np.zeros((n, k), np.float32)
+    for j, i in enumerate(idx):
+        out[:, i % k] += per[:, j]
+    return out

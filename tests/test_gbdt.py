@@ -436,3 +436,204 @@ def test_categorical_unseen_category_routes_right():
     if len(x_unseen):
         p = b.predict_raw(x_unseen)
         assert np.isfinite(p).all()
+
+
+# -- boosting modes (LightGBMParams boostingType: gbdt|goss|dart|rf) -------
+
+
+def _mode_auc(boosting_type, **kw):
+    x, y = make_binary(800)
+    base = dict(
+        objective="binary", num_iterations=40, num_leaves=15,
+        learning_rate=0.15, boosting_type=boosting_type, seed=3,
+    )
+    base.update(kw)
+    cfg = TrainConfig(**base)
+    b = train(x, y, cfg)
+    from mmlspark_tpu.models.gbdt.objectives import sigmoid
+
+    return binary_auc(y, sigmoid(b.predict_raw(x))), b
+
+
+def test_goss_quality():
+    auc, b = _mode_auc("goss", top_rate=0.2, other_rate=0.2)
+    assert b.boosting_type == "goss"
+    assert auc > 0.93
+
+
+def test_dart_quality_and_rescaled_trees():
+    auc, b = _mode_auc("dart", drop_rate=0.3, skip_drop=0.2)
+    assert auc > 0.92
+    # dropout normalization must have rescaled at least one earlier tree
+    # (k/(k+1) shrink) unless rng never dropped — with these rates it does
+    norms = [np.abs(t.values).max() for t in b.trees]
+    assert min(norms) < max(norms)
+
+
+def test_rf_quality_and_averaging():
+    auc, b = _mode_auc("rf", num_iterations=60)
+    assert auc > 0.88
+    # rf prediction averages trees: doubling the forest by merge must keep
+    # predictions in the same range, not double them
+    x, _ = make_binary(50, seed=9)
+    p1 = b.predict_raw(x)
+    p2 = b.merge(b).predict_raw(x)
+    np.testing.assert_allclose(p2, p1, rtol=1e-5, atol=1e-5)
+
+
+def test_rf_predict_is_tree_average():
+    x, y = make_binary(300)
+    cfg = TrainConfig(objective="binary", num_iterations=10, num_leaves=7,
+                      boosting_type="rf", seed=1)
+    b = train(x, y, cfg)
+    from mmlspark_tpu.models.gbdt.booster import per_tree_raw
+
+    per = per_tree_raw(b.trees, x)
+    expect = per.mean(axis=1) + np.float32(b.base_score)
+    np.testing.assert_allclose(b.predict_raw(x), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_boosting_type_roundtrips_model_string():
+    for bt in ("gbdt", "goss", "dart", "rf"):
+        x, y = make_binary(200)
+        cfg = TrainConfig(objective="binary", num_iterations=3, num_leaves=7,
+                          boosting_type=bt)
+        b = train(x, y, cfg)
+        b2 = Booster.from_model_string(b.to_model_string())
+        assert b2.boosting_type == bt
+        np.testing.assert_allclose(b.predict_raw(x), b2.predict_raw(x), atol=1e-6)
+
+
+def test_invalid_boosting_type_raises():
+    x, y = make_binary(100)
+    with pytest.raises(ValueError):
+        train(x, y, TrainConfig(objective="binary", boosting_type="plume"))
+
+
+def test_dart_multiclass():
+    r = np.random.default_rng(5)
+    x = r.normal(size=(500, 6)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.int64) + (x[:, 2] > 0.5).astype(np.int64)
+    cfg = TrainConfig(objective="multiclass", num_class=3, num_iterations=25,
+                      num_leaves=15, boosting_type="dart", drop_rate=0.3,
+                      skip_drop=0.2, seed=2)
+    b = train(x, y.astype(np.float64), cfg)
+    pred = b.predict_raw(x).argmax(axis=1)
+    assert (pred == y).mean() > 0.85
+
+
+def test_goss_classifier_facade():
+    x, y = make_binary(400)
+    df = DataFrame.from_dict({"features": x, "label": y})
+    clf = LightGBMClassifier(boosting_type="goss", num_iterations=20, num_leaves=15)
+    model = clf.fit(df)
+    out = model.transform(df)
+    assert model._booster.boosting_type == "goss"
+    assert binary_auc(y, out["probability"][:, 1]) > 0.9
+
+
+# -- ranking eval: real grouped NDCG (not a corrcoef proxy) ----------------
+
+
+def make_ranking(n_groups=30, per_group=12, d=6, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n_groups * per_group, d)).astype(np.float32)
+    rel = np.clip((x[:, 0] * 1.5 + x[:, 1] + 0.3 * r.normal(size=len(x))), 0, None)
+    y = np.digitize(rel, [0.5, 1.2, 2.0]).astype(np.float64)  # 0..3 grades
+    groups = np.repeat(np.arange(n_groups), per_group)
+    return x, y, groups
+
+
+def test_grouped_ndcg_metric():
+    from mmlspark_tpu.models.gbdt.train import grouped_ndcg
+
+    # perfect ranking => 1.0; inverted ranking < 1
+    y = np.array([3.0, 2.0, 1.0, 0.0])
+    g = np.zeros(4, np.int64)
+    assert grouped_ndcg(np.array([4.0, 3.0, 2.0, 1.0]), y, g, k=4) == pytest.approx(1.0)
+    assert grouped_ndcg(np.array([1.0, 2.0, 3.0, 4.0]), y, g, k=4) < 0.8
+    # two groups average
+    y2 = np.array([1.0, 0.0, 1.0, 0.0])
+    g2 = np.array([0, 0, 1, 1])
+    v = grouped_ndcg(np.array([2.0, 1.0, 1.0, 2.0]), y2, g2, k=2)
+    assert v == pytest.approx(0.5 * (1.0 + (1.0 / np.log2(3)) / 1.0))
+
+
+def test_ranker_early_stopping_uses_ndcg():
+    x, y, groups = make_ranking(seed=4)
+    valid = np.zeros(len(y), bool)
+    valid[groups >= 24] = True  # last 6 groups held out
+    cfg = TrainConfig(objective="lambdarank", num_iterations=40, num_leaves=15,
+                      early_stopping_round=5, eval_at=5, verbosity=-1)
+    b = train(x, y, cfg, valid_mask=valid, group_ids=groups)
+    from mmlspark_tpu.models.gbdt.train import _eval_metric, grouped_ndcg
+
+    name, val, higher = _eval_metric(cfg, b.predict_raw(x), y, valid, groups)
+    assert name == "ndcg@5" and higher
+    assert val > 0.8
+    # trained ranker must beat a random scorer on held-out groups
+    rand = np.random.default_rng(0).normal(size=len(y))
+    assert val > grouped_ndcg(rand[valid], y[valid], groups[valid], k=5)
+
+
+# -- sparse CSR input (LightGBMUtils.scala:211-265 dense-or-sparse parity) --
+
+
+def make_hashed_text(n=400, dim=1024, seed=0):
+    """Hashed bag-of-words CSR: the wide-sparse regime of VW-adjacent data."""
+    import scipy.sparse as sp
+
+    r = np.random.default_rng(seed)
+    vocab = 300
+    rows, cols, vals = [], [], []
+    y = np.zeros(n, np.float64)
+    for i in range(n):
+        n_words = r.integers(5, 20)
+        words = r.integers(0, vocab, size=n_words)
+        # class signal: words < 100 indicate positives
+        y[i] = float((words < 100).mean() > 0.35)
+        for wd in words:
+            rows.append(i)
+            # deterministic Knuth-style hash (process hash() is seeded)
+            cols.append(int((int(wd) * 2654435761) % dim))
+            vals.append(1.0)
+    x = sp.csr_matrix((vals, (rows, cols)), shape=(n, dim), dtype=np.float64)
+    x.sum_duplicates()
+    return x, y
+
+
+def test_sparse_csr_training_quality():
+    x, y = make_hashed_text()
+    cfg = TrainConfig(objective="binary", num_iterations=20, num_leaves=15,
+                      min_data_in_leaf=5, seed=0)
+    b = train(x, y, cfg)
+    from mmlspark_tpu.models.gbdt.binning import densify_missing
+    from mmlspark_tpu.models.gbdt.objectives import sigmoid
+
+    p = sigmoid(b.predict_raw(densify_missing(x)))
+    assert binary_auc(y, p) > 0.9
+
+
+def test_sparse_bins_match_nan_dense():
+    """Sparse binning == dense binning when absent entries are NaN."""
+    x, _ = make_hashed_text(n=80, dim=512)
+    m = BinMapper.fit(x, max_bin=16)
+    from mmlspark_tpu.models.gbdt.binning import densify_missing
+
+    b_sparse = m.transform(x)
+    b_dense = m.transform(densify_missing(x))
+    np.testing.assert_array_equal(b_sparse, b_dense)
+
+
+def test_sparse_categorical_rejected():
+    x, _ = make_hashed_text(n=40, dim=64)
+    with pytest.raises(ValueError, match="dense"):
+        BinMapper.fit(x, categorical_features=(0,))
+
+
+def test_sparse_dart_training():
+    x, y = make_hashed_text(n=200, dim=1024, seed=2)
+    cfg = TrainConfig(objective="binary", num_iterations=10, num_leaves=7,
+                      boosting_type="dart", drop_rate=0.5, skip_drop=0.0, seed=1)
+    b = train(x, y, cfg)  # exercises _densify on the drop-contrib path
+    assert len(b.trees) == 10
