@@ -180,6 +180,11 @@ def run_bench() -> None:
     platform = devices[0].platform
     n_dev = len(devices)
     on_accel = platform not in ("cpu",)
+    if not on_accel and os.environ.get("MMLSPARK_BENCH_REQUIRE_TPU") == "1":
+        # TPU-attempt child that silently initialized on CPU: fail fast so
+        # the parent doesn't burn its budget benchmarking the wrong backend
+        sys.stderr.write("bench child: backend is cpu but TPU was required\n")
+        raise SystemExit(3)
 
     # trivial 1-op warmup first: proves the compile path end-to-end before
     # spending minutes tracing ResNet, and retries through relay flaps
@@ -234,20 +239,25 @@ def _run_child(env: dict, timeout_s: int) -> tuple:
 def main() -> None:
     deadline = time.monotonic() + TPU_BUDGET_S
     attempt = 0
+    cpu_fails = 0
     last_err = ""
     while time.monotonic() < deadline:
         attempt += 1
         remaining = deadline - time.monotonic()
+        env = dict(os.environ)
+        env["MMLSPARK_BENCH_REQUIRE_TPU"] = "1"  # CPU-silent init fails fast
         line, err = _run_child(
-            dict(os.environ), int(min(ATTEMPT_TIMEOUT_S, max(remaining, 60)))
+            env, int(min(ATTEMPT_TIMEOUT_S, max(remaining, 60)))
         )
         if line:
-            # a child that silently initialized on CPU (plugin failed fast
-            # instead of hanging) is a FAILED TPU attempt, not a result
-            if not json.loads(line).get("extra", {}).get("fallback"):
-                print(line)
-                return
-            err = "child ran on CPU (TPU plugin unavailable)"
+            print(line)
+            return
+        if "backend is cpu" in err:
+            cpu_fails += 1
+            if cpu_fails >= 2:
+                # deterministic plugin absence — stop burning the budget
+                last_err = "TPU plugin unavailable (child ran on CPU twice)"
+                break
         last_err = err
         sys.stderr.write(f"bench: TPU attempt {attempt} failed:\n{err}\n")
         if time.monotonic() + 30 < deadline:
@@ -257,6 +267,7 @@ def main() -> None:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    env.pop("MMLSPARK_BENCH_REQUIRE_TPU", None)
     line, err = _run_child(env, ATTEMPT_TIMEOUT_S)
     if not line:
         sys.stderr.write(err + "\n")

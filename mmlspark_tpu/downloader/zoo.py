@@ -4,15 +4,19 @@ The reference maintains a repository of CNTK model schemas (uri, hash,
 size, inputNode, layerNames) fetched over HDFS/HTTP
 (downloader/ModelDownloader.scala:27-118, downloader/Schema.scala:54-66).
 Here the repository is a local directory of Flax checkpoints + JSON
-schemas; remote URIs can be registered but this build is egress-free, so
-absent checkpoints are materialized as seeded random inits (weights are
-still content-hashed so cache hits are exact).
+schemas. TRAINED weights ship inside the package for the compact backbones
+(``downloader/builtin/``, produced by tools/train_zoo_backbone.py from the
+committed datasets — the egress-free stand-in for the reference's remote
+model files). Remote URIs can be registered via RemoteRepository; absent
+large-model checkpoints fall back to seeded random inits with a loud
+warning (weights are still content-hashed so cache hits are exact).
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import re
 from dataclasses import asdict, dataclass, field
@@ -22,9 +26,16 @@ import numpy as np
 
 from mmlspark_tpu.core.utils import retry_with_backoff
 
+log = logging.getLogger("mmlspark_tpu.downloader")
+
 DEFAULT_REPO = os.path.join(
     os.environ.get("MMLSPARK_TPU_HOME", os.path.expanduser("~/.mmlspark_tpu")), "models"
 )
+
+# Trained checkpoints shipped INSIDE the package (tools/train_zoo_backbone.py
+# trains them from the committed datasets): the egress-free counterpart of
+# the reference's remote model repository (ModelDownloader.scala:210-276).
+PACKAGED_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "builtin")
 
 
 @dataclass
@@ -74,9 +85,13 @@ class ModelDownloader:
 
     def list_models(self) -> list:
         names = set(BUILTIN_MODELS)
-        for f in os.listdir(self.repo_dir):
-            if f.endswith(".schema.json"):
-                names.add(f[: -len(".schema.json")])
+        dirs = [self.repo_dir]
+        if os.path.isdir(PACKAGED_DIR):
+            dirs.append(PACKAGED_DIR)
+        for d in dirs:
+            for f in os.listdir(d):
+                if f.endswith(".schema.json"):
+                    names.add(f[: -len(".schema.json")])
         return sorted(names)
 
     def _paths(self, name: str) -> tuple:
@@ -108,9 +123,32 @@ class ModelDownloader:
     def download_by_name(self, name: str) -> ModelSchema:
         """Ensure the named model exists locally; return its schema."""
         spath, wpath = self._paths(name)
+        pk_s = os.path.join(PACKAGED_DIR, f"{name}.schema.json")
+        pk_w = os.path.join(PACKAGED_DIR, f"{name}.msgpack")
+        packaged = os.path.exists(pk_s) and os.path.exists(pk_w)
         if os.path.exists(spath) and os.path.exists(wpath):
             with open(spath) as f:
-                return ModelSchema(**json.load(f))
+                local = ModelSchema(**json.load(f))
+            if packaged:
+                # a retrained packaged checkpoint supersedes a stale local
+                # install (compare by recorded sha256)
+                with open(pk_s) as f:
+                    pk_schema = ModelSchema(**json.load(f))
+                if pk_schema.sha256 and pk_schema.sha256 != local.sha256:
+                    log.info("reinstalling %s from updated packaged weights", name)
+                else:
+                    return local
+            else:
+                return local
+        if packaged:
+            # packaged trained checkpoint: install into the local repo verbatim
+            with open(pk_s) as f:
+                schema = ModelSchema(**json.load(f))
+            with open(pk_w, "rb") as f:
+                blob = f.read()
+            if schema.sha256 and hashlib.sha256(blob).hexdigest() != schema.sha256:
+                raise IOError(f"packaged checksum mismatch for model {name}")
+            return self.install_blob(schema, blob)
         schema = BUILTIN_MODELS.get(name)
         if schema is None:
             raise KeyError(f"unknown model {name!r}; known: {self.list_models()}")
@@ -123,6 +161,14 @@ class ModelDownloader:
         else:
             from mmlspark_tpu.models.resnet import init_resnet
 
+            log.warning(
+                "model %r has no trained checkpoint in this egress-free "
+                "repository; materializing a SEEDED RANDOM init — features "
+                "will carry no semantic content (use ResNet8_Digits for "
+                "trained weights, or RemoteRepository.sync to import real "
+                "checkpoints)",
+                name,
+            )
             _, variables = init_resnet(
                 schema.variant,
                 num_classes=schema.num_classes,

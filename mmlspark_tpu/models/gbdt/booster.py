@@ -201,23 +201,9 @@ class Booster:
 
     def predict_leaf(self, x: np.ndarray) -> np.ndarray:
         """(n, d) -> (n, T) leaf index per tree (predictLeaf analogue)."""
-        import jax.numpy as jnp
-
-        stacked = self._stacked()
-        if stacked is None:
+        if not self.trees:
             return np.zeros((x.shape[0], 0), np.int32)
-        rec_leaf, rec_feature, rec_threshold, rec_active, _, is_cat, catmask = stacked
-        return np.asarray(
-            treegrow.predict_leaves(
-                jnp.asarray(x, jnp.float32),
-                jnp.asarray(rec_leaf),
-                jnp.asarray(rec_feature),
-                jnp.asarray(rec_threshold),
-                jnp.asarray(rec_active),
-                jnp.asarray(is_cat) if is_cat is not None else None,
-                jnp.asarray(catmask) if catmask is not None else None,
-            )
-        )
+        return tree_leaves(self.trees, x)
 
     def feature_contribs(self, x: np.ndarray) -> np.ndarray:
         """Per-feature contributions (n, d+1), last column = expected value.
@@ -281,15 +267,16 @@ def _stack_trees(trees: list) -> Optional[tuple]:
     return rec_leaf, rec_feature, rec_threshold, rec_active, values, rec_is_cat, rec_catmask
 
 
-def per_tree_raw(trees: list, x: np.ndarray) -> np.ndarray:
-    """(n, T) raw contribution of each tree (device traversal + gather)."""
+def tree_leaves(trees: list, x: np.ndarray) -> np.ndarray:
+    """(n, T) leaf index per tree: the single batched device traversal every
+    scoring entry point shares."""
     import jax.numpy as jnp
 
     stacked = _stack_trees(trees)
     if stacked is None:
-        return np.zeros((x.shape[0], 0), np.float32)
-    rec_leaf, rec_feature, rec_threshold, rec_active, values, is_cat, catmask = stacked
-    leaves = np.asarray(
+        return np.zeros((x.shape[0], 0), np.int32)
+    rec_leaf, rec_feature, rec_threshold, rec_active, _, is_cat, catmask = stacked
+    return np.asarray(
         treegrow.predict_leaves(
             jnp.asarray(x, jnp.float32),
             jnp.asarray(rec_leaf),
@@ -299,7 +286,18 @@ def per_tree_raw(trees: list, x: np.ndarray) -> np.ndarray:
             jnp.asarray(is_cat) if is_cat is not None else None,
             jnp.asarray(catmask) if catmask is not None else None,
         )
-    )  # (n, T)
+    )
+
+
+def per_tree_raw(trees: list, x: np.ndarray) -> np.ndarray:
+    """(n, T) raw contribution of each tree (device traversal + gather)."""
+    if not trees:
+        return np.zeros((x.shape[0], 0), np.float32)
+    L = max(len(t.values) for t in trees)
+    values = np.stack(
+        [np.pad(t.values, (0, L - len(t.values))) for t in trees]
+    ).astype(np.float32)
+    leaves = tree_leaves(trees, x)  # (n, T)
     return np.take_along_axis(values[None], leaves[..., None], axis=2)[..., 0]
 
 

@@ -240,6 +240,10 @@ def train(
     prediction replays it."""
     if cfg.boosting_type not in BOOSTING_TYPES:
         raise ValueError(f"boosting_type must be one of {BOOSTING_TYPES}")
+    if cfg.boosting_type == "goss" and cfg.top_rate + cfg.other_rate > 1.0:
+        # LightGBM hard-errors here too: the sampler's unbiasedness
+        # guarantee needs b/(1-a) <= 1
+        raise ValueError("goss requires top_rate + other_rate <= 1")
     from mmlspark_tpu.models.gbdt.binning import is_sparse
 
     sparse_input = is_sparse(x)

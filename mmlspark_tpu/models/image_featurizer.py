@@ -35,7 +35,10 @@ from mmlspark_tpu.ops import image as image_ops
 
 
 class ImageFeaturizer(Model, HasInputCol, HasOutputCol, HasBatchSize):
-    model_name = Param("zoo model name", default="ResNet50", type_=str)
+    # default = the zoo entry with COMMITTED TRAINED weights
+    # (mmlspark_tpu/downloader/builtin/, tools/train_zoo_backbone.py);
+    # the large ResNet variants stay selectable for scale benchmarking
+    model_name = Param("zoo model name", default="ResNet8_Digits", type_=str)
     cut_output_layers = Param(
         "how many output layers to drop (0=logits, 1=pooled features)",
         default=1,

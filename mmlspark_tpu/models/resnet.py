@@ -118,6 +118,15 @@ class ResNet(nn.Module):
         return outputs
 
 
+def resnet8(**kw: Any) -> ResNet:
+    """Three-stage compact ResNet (~80k params at width 16): small enough
+    to train in-repo and commit trained weights to the zoo, the committed
+    counterpart of the reference's downloaded model files
+    (downloader/Schema.scala:54-66)."""
+    kw.setdefault("num_filters", 16)
+    return ResNet(stage_sizes=[1, 1, 1], block=BasicBlock, **kw)
+
+
 def resnet18(**kw: Any) -> ResNet:
     return ResNet(stage_sizes=[2, 2, 2, 2], block=BasicBlock, **kw)
 
@@ -135,6 +144,7 @@ def resnet101(**kw: Any) -> ResNet:
 
 
 RESNETS: dict = {
+    "ResNet8": resnet8,
     "ResNet18": resnet18,
     "ResNet34": resnet34,
     "ResNet50": resnet50,

@@ -637,3 +637,10 @@ def test_sparse_dart_training():
                       boosting_type="dart", drop_rate=0.5, skip_drop=0.0, seed=1)
     b = train(x, y, cfg)  # exercises _densify on the drop-contrib path
     assert len(b.trees) == 10
+
+
+def test_goss_rate_sum_rejected():
+    x, y = make_binary(100)
+    with pytest.raises(ValueError, match="top_rate"):
+        train(x, y, TrainConfig(objective="binary", boosting_type="goss",
+                                top_rate=0.6, other_rate=0.6))

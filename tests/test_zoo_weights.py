@@ -1,0 +1,89 @@
+"""Packaged trained-weights zoo entry (the trained-model capability of the
+reference's ModelDownloader, Schema.scala:54-66): loading ResNet8_Digits
+must yield non-random features that transfer.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.downloader.zoo import ModelDownloader, PACKAGED_DIR
+from mmlspark_tpu.models import ImageFeaturizer
+
+
+def load_digits_images():
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "resources", "data", "digits.csv"
+    )
+    raw = np.genfromtxt(path, delimiter=",", skip_header=1)
+    x8, y = raw[:, :64].reshape(-1, 8, 8), raw[:, 64].astype(np.int64)
+    rep = 4
+    img = np.kron(x8 / 16.0, np.ones((rep, rep)))
+    imgs = np.repeat(img[..., None], 3, axis=-1).astype(np.float32)
+    return (imgs * 255).astype(np.uint8), y
+
+
+def test_packaged_model_loads_and_classifies(tmp_path):
+    repo = ModelDownloader(repo_dir=str(tmp_path))
+    module, variables, schema = repo.load("ResNet8_Digits")
+    assert schema.sha256  # checksum recorded and verified on load
+    imgs, y = load_digits_images()
+    test = slice(1500, None)  # rows never seen in training (tools/train_zoo_backbone.py)
+    from mmlspark_tpu.ops.image import normalize
+    import jax.numpy as jnp
+
+    out = module.apply(
+        variables, normalize(jnp.asarray(imgs[test], jnp.float32)), train=False
+    )
+    acc = (np.asarray(out["logits"]).argmax(-1) == y[test]).mean()
+    assert acc > 0.95, acc
+
+
+def test_default_featurizer_uses_trained_weights(tmp_path):
+    """The DEFAULT ImageFeaturizer path loads committed trained weights."""
+    feat = ImageFeaturizer(
+        input_col="image", output_col="features", repo_dir=str(tmp_path)
+    )
+    assert feat.get("model_name") == "ResNet8_Digits"
+    imgs, y = load_digits_images()
+    df = DataFrame.from_dict({"image": imgs[:32]})
+    out = feat.transform(df)
+    f = out["features"]
+    assert f.shape == (32, 64)  # pool features of width-16 stage-3 net
+    assert np.abs(f).max() > 0
+
+
+def test_transfer_features_beat_raw_pixels(tmp_path):
+    """Few-shot transfer: linear head on zoo features beats the same head
+    on raw pixels (the reference's transfer-learning demo capability)."""
+    imgs, y = load_digits_images()
+    feat = ImageFeaturizer(
+        input_col="image", output_col="features", repo_dir=str(tmp_path)
+    )
+    out = feat.transform(DataFrame.from_dict({"image": imgs}))
+    feats = out["features"]
+    raw = imgs.reshape(len(imgs), -1).astype(np.float64) / 255.0
+
+    # k-shot head: 3 examples per class from the train region; eval on the
+    # held-out tail the backbone never saw
+    rng = np.random.default_rng(0)
+    train_idx = []
+    for c in range(10):
+        cand = np.flatnonzero(y[:1500] == c)
+        train_idx.extend(rng.choice(cand, 3, replace=False))
+    train_idx = np.asarray(train_idx)
+    test_idx = np.arange(1500, len(y))
+
+    def head_acc(xmat):
+        from sklearn.linear_model import LogisticRegression
+
+        clf = LogisticRegression(max_iter=2000)
+        clf.fit(xmat[train_idx], y[train_idx])
+        return clf.score(xmat[test_idx], y[test_idx])
+
+    a_feat = head_acc(np.asarray(feats, np.float64))
+    a_raw = head_acc(raw)
+    assert a_feat > a_raw + 0.05, (a_feat, a_raw)
+    assert a_feat > 0.85, a_feat
