@@ -1,23 +1,131 @@
 """PartitionConsolidator (io/http/PartitionConsolidator.scala:19-132 analogue).
 
-Funnels many partitions' rows through a bounded number of workers — the
-pattern for rate-limited external services: regardless of upstream
-parallelism, at most ``num_workers`` partitions exist downstream, so at most
-``num_workers * concurrency`` requests are ever in flight.
+Funnels many partitions' rows through ONE live worker — the pattern for
+rate-limited external services: regardless of upstream parallelism, a
+single partition performs the downstream work (e.g. HTTP calls), so the
+service sees one client no matter how wide the job is.
+
+Faithful to the reference's ``Consolidator``: partitions race to register;
+the FIRST becomes the chosen worker and drains its own rows plus a shared
+queue, staying alive (with a grace period) while other workers are still
+feeding; every other partition forwards its rows into the queue and emits
+nothing. Partition functions here run on the DataFrame thread pool
+(core/dataframe._get_pool), so the chosen/forwarder race is real
+concurrency, as on Spark executors.
+
+Unlike ``coalesce`` (a static repartition), consolidation is LIVE: rows
+forwarded while the chosen worker is mid-drain are still picked up, and
+the chosen worker exits only after the last feeder deregisters. A
+post-pass sweeps any rows enqueued after the chosen worker exited (serial
+execution degenerates to that path), so no row is ever dropped.
 """
 
 from __future__ import annotations
 
-from mmlspark_tpu.core.dataframe import DataFrame
+import queue
+import threading
+import time
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, Partition
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Transformer
 
 
+class Consolidator:
+    """Chosen-worker queue (PartitionConsolidator.scala:19-60)."""
+
+    def __init__(self, grace_period_s: float = 1.0):
+        self.buffer: "queue.Queue[Partition]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._working = 0
+        self._grace = grace_period_s
+
+    def _register(self) -> bool:
+        with self._lock:
+            chosen = self._working == 0
+            self._working += 1
+            return chosen
+
+    def _deregister(self) -> None:
+        with self._lock:
+            self._working -= 1
+
+    @property
+    def working_partitions(self) -> int:
+        with self._lock:
+            return self._working
+
+    def register_and_receive(self, part: Partition) -> list:
+        """Run one partition through the funnel; returns the chunks this
+        partition emits (chosen: everything; forwarders: nothing)."""
+        chosen = self._register()
+        if not chosen:
+            self.buffer.put(part)
+            self._deregister()
+            return []
+        # chosen worker: own rows first, then drain the queue while other
+        # partitions are still feeding (hasNextHelper's recurse-once grace)
+        out = [part]
+        graced = False
+        while True:
+            try:
+                out.append(self.buffer.get_nowait())
+                graced = False
+                continue
+            except queue.Empty:
+                pass
+            if self.working_partitions > 1:
+                time.sleep(0.002)  # feeders still registered: poll
+                graced = False
+                continue
+            if not graced:
+                time.sleep(self._grace)
+                graced = True
+                continue
+            self._deregister()
+            return out
+
+    def drain_leftovers(self) -> list:
+        """Chunks enqueued after the chosen worker exited (possible only
+        under serial scheduling); the transformer sweeps these."""
+        out = []
+        while True:
+            try:
+                out.append(self.buffer.get_nowait())
+            except queue.Empty:
+                return out
+
+
 class PartitionConsolidator(Transformer):
-    num_workers = Param(
-        "number of consolidated partitions (chosen workers)", default=1, type_=int,
-        validator=lambda v: v >= 1,
+    grace_period_s = Param(
+        "how long the chosen worker lingers for late feeders", default=0.05,
+        type_=float,
     )
 
     def transform(self, df: DataFrame) -> DataFrame:
-        return df.coalesce(self.get("num_workers"))
+        cons = Consolidator(grace_period_s=self.get("grace_period_s"))
+
+        def per_partition(part: Partition) -> Partition:
+            chunks = cons.register_and_receive(part)
+            if not chunks:
+                return {k: v[:0] for k, v in part.items()}
+            return _concat(chunks)
+
+        out = df.map_partitions(per_partition)
+        leftovers = cons.drain_leftovers()
+        if leftovers:
+            parts = [p for p in out._parts]
+            merged = _concat([p for p in parts if p and len(next(iter(p.values())))]
+                             + leftovers)
+            empty = [
+                {k: v[:0] for k, v in p.items()} for p in parts[1:]
+            ]
+            out = DataFrame([merged] + empty, metadata=df._metadata)
+        return out
+
+
+def _concat(chunks: list) -> Partition:
+    keys = chunks[0].keys()
+    return {k: np.concatenate([c[k] for c in chunks]) for k in keys}

@@ -43,7 +43,14 @@ def read_csv(
     """
     with open(path, "rb") as f:
         raw = f.read()
+    body, names = split_csv_header(raw, header, columns)
+    return parse_csv_bytes(body, names, numeric_only, num_partitions)
 
+
+def split_csv_header(
+    raw: bytes, header: bool, columns: Optional[Sequence[str]]
+) -> tuple:
+    """(raw file bytes) -> (body bytes, column names or None)."""
     body = raw
     names = list(columns) if columns else None
     if header:
@@ -52,7 +59,17 @@ def read_csv(
         if names is None:
             names = [c.strip() for c in head_line.split(",")]
         body = raw[nl + 1 :] if nl >= 0 else b""
+    return body, names
 
+
+def parse_csv_bytes(
+    body: bytes,
+    names: Optional[list],
+    numeric_only: Optional[bool] = None,
+    num_partitions: int = 1,
+) -> DataFrame:
+    """Parse headerless CSV bytes (the per-chunk entry the streaming reader
+    shares with read_csv)."""
     auto_detected = numeric_only is None
     if numeric_only is None:
         # probe a prefix of data lines, not just the first — a leading row

@@ -172,10 +172,6 @@ class Booster:
 
     # -- device scoring ------------------------------------------------------
 
-    def _stacked(self, upto: Optional[int] = None) -> tuple:
-        trees = self.trees[: upto * self.num_class] if upto else self.trees
-        return _stack_trees(trees)
-
     def predict_raw(self, x: np.ndarray, num_iteration: Optional[int] = None) -> np.ndarray:
         """(n, d) -> (n,) raw scores (binary/regression) or (n, k) multiclass."""
         n = x.shape[0]
@@ -267,14 +263,9 @@ def _stack_trees(trees: list) -> Optional[tuple]:
     return rec_leaf, rec_feature, rec_threshold, rec_active, values, rec_is_cat, rec_catmask
 
 
-def tree_leaves(trees: list, x: np.ndarray) -> np.ndarray:
-    """(n, T) leaf index per tree: the single batched device traversal every
-    scoring entry point shares."""
+def _leaves_from_stacked(stacked: tuple, x: np.ndarray) -> np.ndarray:
     import jax.numpy as jnp
 
-    stacked = _stack_trees(trees)
-    if stacked is None:
-        return np.zeros((x.shape[0], 0), np.int32)
     rec_leaf, rec_feature, rec_threshold, rec_active, _, is_cat, catmask = stacked
     return np.asarray(
         treegrow.predict_leaves(
@@ -289,15 +280,22 @@ def tree_leaves(trees: list, x: np.ndarray) -> np.ndarray:
     )
 
 
+def tree_leaves(trees: list, x: np.ndarray) -> np.ndarray:
+    """(n, T) leaf index per tree: the single batched device traversal every
+    scoring entry point shares."""
+    stacked = _stack_trees(trees)
+    if stacked is None:
+        return np.zeros((x.shape[0], 0), np.int32)
+    return _leaves_from_stacked(stacked, x)
+
+
 def per_tree_raw(trees: list, x: np.ndarray) -> np.ndarray:
     """(n, T) raw contribution of each tree (device traversal + gather)."""
-    if not trees:
+    stacked = _stack_trees(trees)
+    if stacked is None:
         return np.zeros((x.shape[0], 0), np.float32)
-    L = max(len(t.values) for t in trees)
-    values = np.stack(
-        [np.pad(t.values, (0, L - len(t.values))) for t in trees]
-    ).astype(np.float32)
-    leaves = tree_leaves(trees, x)  # (n, T)
+    values = stacked[4]  # (T, L) padded leaf values from the same stacking
+    leaves = _leaves_from_stacked(stacked, x)  # (n, T)
     return np.take_along_axis(values[None], leaves[..., None], axis=2)[..., 0]
 
 

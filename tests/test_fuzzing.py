@@ -234,7 +234,7 @@ def make_test_objects() -> list:
             ),
             resp_df,
         ),
-        TestObject(IO.PartitionConsolidator(num_workers=1), df),
+        TestObject(IO.PartitionConsolidator(), df),
     ]
 
     # cognitive stages: fuzz offline against an unreachable endpoint (rows

@@ -95,7 +95,10 @@ def test_concurrent_clients_and_latency():
     assert not errs
     lat = q.latency_quantiles_ms()
     assert lat["n"] >= 100
-    assert lat["p50"] < 100.0  # sanity on CPU-under-test; TPU bench tracks real p50
+    # reference claims ~1ms end-to-end on cluster hardware
+    # (docs/mmlspark-serving.md:142-146); CPU-under-test gate is single-digit
+    # ms server-side, and bench.py tracks the real loopback p50 per round
+    assert lat["p50"] < 10.0, lat
     q.stop()
     srv.stop()
 
