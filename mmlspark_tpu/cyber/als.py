@@ -8,6 +8,14 @@ and solved as a stacked batch of (F, F) systems — MXU-sized work, no
 Python per-user loop. Explicit mode uses the observation mask as weights;
 implicit mode (Hu-Koren-Volinsky) uses confidence ``1 + alpha*r`` on all
 cells with binary preference targets.
+
+Two entry points:
+- ``als_train`` — dense (U, I) matrix; fine for per-tenant demo scale.
+- ``als_train_coo`` — SPARSE (user, item, rating) triples, the production
+  path (Spark ALS also consumes sparse ratings): gram matrices and
+  right-hand sides accumulate per-edge via ``segment_sum`` over
+  fixed-size edge blocks under ``lax.scan``, so memory is
+  O(U*F^2 + block*F^2), never O(U*I).
 """
 
 from __future__ import annotations
@@ -82,6 +90,110 @@ def als_train(
             mask if mask is not None else (ratings != 0), jnp.float32
         )
     x, y = _als_run(r, w, jax.random.PRNGKey(seed), rank, iters, reg, implicit)
+    return np.asarray(x), np.asarray(y)
+
+
+_EDGE_BLOCK = 8192
+
+
+@partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
+def _als_run_coo(
+    eu: jnp.ndarray,       # (E,) int32 user of each edge (padded w/ weight 0)
+    ei: jnp.ndarray,       # (E,) int32 item of each edge
+    er: jnp.ndarray,       # (E,) float32 rating
+    ew: jnp.ndarray,       # (E,) float32 edge weight (0 = padding)
+    key: jnp.ndarray,
+    u_n: int,
+    i_n: int,
+    rank: int,
+    iters: int,
+    reg: float,
+    implicit: bool,
+) -> tuple:
+    ku, ki = jax.random.split(key)
+    x = 0.1 * jax.random.normal(ku, (u_n, rank), jnp.float32)
+    y = 0.1 * jax.random.normal(ki, (i_n, rank), jnp.float32)
+    eye = jnp.eye(rank, dtype=jnp.float32) * reg
+    n_blocks = eu.shape[0] // _EDGE_BLOCK
+
+    def accumulate(fixed: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray,
+                   n_out: int) -> tuple:
+        """Per-row grams/rhs from observed edges, one edge block at a time.
+
+        Explicit: A_u = sum_e w y y^T, b_u = sum_e w r y.
+        Implicit (Hu-Koren): confidence c = 1 + alpha*r on observed cells
+        (alpha arrives premultiplied in ``ew``), identity confidence
+        elsewhere -> A_u = Y^T Y + sum_e (c-1) y y^T, b_u = sum_e c y.
+        """
+        src_b = src.reshape(n_blocks, _EDGE_BLOCK)
+        dst_b = dst.reshape(n_blocks, _EDGE_BLOCK)
+        r_b = er.reshape(n_blocks, _EDGE_BLOCK)
+        w_b = ew.reshape(n_blocks, _EDGE_BLOCK)
+
+        def blk(carry, inp):
+            a_acc, b_acc = carry
+            s, d, r, w = inp
+            yf = fixed[d]                               # (B, F)
+            if implicit:
+                aw = w * r                              # c - 1 = alpha*r
+                # c * pref(=1); padding edges (w == 0) must contribute 0
+                bw = jnp.where(w > 0, 1.0 + w * r, 0.0)
+            else:
+                aw = w
+                bw = w * r
+            outer = (aw[:, None, None] * yf[:, :, None]) * yf[:, None, :]
+            a_acc = a_acc.at[s].add(outer)
+            b_acc = b_acc.at[s].add(bw[:, None] * yf)
+            return (a_acc, b_acc), None
+
+        a0 = jnp.zeros((n_out, rank, rank), jnp.float32)
+        b0 = jnp.zeros((n_out, rank), jnp.float32)
+        (a, b), _ = jax.lax.scan(blk, (a0, b0), (src_b, dst_b, r_b, w_b))
+        return a, b
+
+    def step(carry, _):
+        x, y = carry
+        a, b = accumulate(y, eu, ei, u_n)
+        if implicit:
+            a = a + (y.T @ y)[None]
+        x = jnp.linalg.solve(a + eye[None], b[..., None])[..., 0]
+        a, b = accumulate(x, ei, eu, i_n)
+        if implicit:
+            a = a + (x.T @ x)[None]
+        y = jnp.linalg.solve(a + eye[None], b[..., None])[..., 0]
+        return (x, y), None
+
+    (x, y), _ = jax.lax.scan(step, (x, y), None, length=iters)
+    return x, y
+
+
+def als_train_coo(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    num_users: int,
+    num_items: int,
+    rank: int = 10,
+    iters: int = 10,
+    reg: float = 0.1,
+    implicit: bool = False,
+    alpha: float = 40.0,
+    seed: int = 0,
+) -> tuple:
+    """Sparse ALS on (user, item, rating) triples — never builds (U, I)."""
+    e = len(users)
+    pad = (-e) % _EDGE_BLOCK
+    eu = np.pad(np.asarray(users, np.int32), (0, pad))
+    ei = np.pad(np.asarray(items, np.int32), (0, pad))
+    er = np.pad(np.asarray(ratings, np.float32), (0, pad))
+    ew = np.pad(
+        np.full(e, alpha if implicit else 1.0, np.float32), (0, pad)
+    )  # padded edges carry weight 0 -> contribute nothing
+    x, y = _als_run_coo(
+        jnp.asarray(eu), jnp.asarray(ei), jnp.asarray(er), jnp.asarray(ew),
+        jax.random.PRNGKey(seed), int(num_users), int(num_items),
+        int(rank), int(iters), float(reg), bool(implicit),
+    )
     return np.asarray(x), np.asarray(y)
 
 

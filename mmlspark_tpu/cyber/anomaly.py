@@ -21,7 +21,7 @@ import numpy as np
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.params import ComplexParam, Param
 from mmlspark_tpu.core.pipeline import Estimator, Model
-from mmlspark_tpu.cyber.als import als_predict, als_train
+from mmlspark_tpu.cyber.als import als_predict, als_train_coo
 from mmlspark_tpu.cyber.complement import complement_sample
 
 
@@ -68,9 +68,14 @@ class AccessAnomaly(Estimator, _AccessAnomalyParams):
             r_idx = np.array([r_map[v] for v in np.asarray(res_raw)[sel]], np.int64)
             vals = likes[sel]
 
-            ratings = np.zeros((len(u_labels), len(r_labels)), np.float32)
-            np.add.at(ratings, (u_idx, r_idx), vals)
-            mask = (ratings != 0).astype(np.float32)
+            # sparse COO edges, duplicates aggregated — the ratings matrix
+            # is never densified (Spark ALS consumes the same triples)
+            keys = u_idx * len(r_labels) + r_idx
+            uniq, inv = np.unique(keys, return_inverse=True)
+            agg = np.zeros(len(uniq), np.float32)
+            np.add.at(agg, inv, vals.astype(np.float32))
+            eu = (uniq // len(r_labels)).astype(np.int64)
+            er_ = (uniq % len(r_labels)).astype(np.int64)
             if not self.get("implicit") and self.get("complement_factor") > 0:
                 cu, ci = complement_sample(
                     u_idx, r_idx, len(u_labels), len(r_labels),
@@ -78,12 +83,19 @@ class AccessAnomaly(Estimator, _AccessAnomalyParams):
                     # independent complement draws per tenant
                     self.get("seed") + (zlib.crc32(str(t).encode()) % (1 << 20)),
                 )
-                mask[cu, ci] = 1.0  # observed zeros
+                # observed zeros: rating-0 edges with full weight; drop any
+                # that collide with real observations
+                ckeys = cu * len(r_labels) + ci
+                fresh = ~np.isin(ckeys, uniq)
+                eu = np.concatenate([eu, cu[fresh]])
+                er_ = np.concatenate([er_, ci[fresh]])
+                agg = np.concatenate([agg, np.zeros(fresh.sum(), np.float32)])
 
-            uf, rf = als_train(
-                ratings,
-                mask=mask,
-                rank=min(self.get("rank"), max(1, min(ratings.shape) - 1)),
+            uf, rf = als_train_coo(
+                eu, er_, agg,
+                num_users=len(u_labels),
+                num_items=len(r_labels),
+                rank=min(self.get("rank"), max(1, min(len(u_labels), len(r_labels)) - 1)),
                 iters=self.get("max_iter"),
                 reg=self.get("reg_param"),
                 implicit=self.get("implicit"),

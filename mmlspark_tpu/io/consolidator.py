@@ -15,9 +15,11 @@ concurrency, as on Spark executors.
 
 Unlike ``coalesce`` (a static repartition), consolidation is LIVE: rows
 forwarded while the chosen worker is mid-drain are still picked up, and
-the chosen worker exits only after the last feeder deregisters. A
-post-pass sweeps any rows enqueued after the chosen worker exited (serial
-execution degenerates to that path), so no row is ever dropped.
+the chosen worker exits only after the last feeder deregisters. The
+chosen role is sticky for the transform, and a post-pass sweeps any rows
+enqueued after the chosen worker exited (serial execution degenerates to
+exactly that sweep), so all rows land in ONE output partition on any
+schedule and none are dropped.
 """
 
 from __future__ import annotations
@@ -40,11 +42,18 @@ class Consolidator:
         self.buffer: "queue.Queue[Partition]" = queue.Queue()
         self._lock = threading.Lock()
         self._working = 0
+        self._chosen_taken = False
         self._grace = grace_period_s
 
     def _register(self) -> bool:
         with self._lock:
-            chosen = self._working == 0
+            # STICKY choice: the first registration ever wins. (The
+            # reference re-elects when workingPartitions drops to 0, which
+            # under serial scheduling would make EVERY partition chosen and
+            # consolidate nothing; stickiness + the drain_leftovers sweep
+            # keeps the one-live-worker guarantee on any schedule.)
+            chosen = not self._chosen_taken
+            self._chosen_taken = True
             self._working += 1
             return chosen
 

@@ -89,6 +89,14 @@ class StreamingDataFrame:
                         return
                     body = carry + b"".join(lines)
                     carry = b""
+                    # a quoted field may contain newlines (write_csv emits
+                    # them): an odd quote count means the chunk boundary cut
+                    # a record — extend until the record closes
+                    while lines and body.count(b'"') % 2 == 1:
+                        more = f.readline()
+                        if not more:
+                            break
+                        body += more
                     if not body.strip():
                         continue  # a run of blank lines is not end-of-file
                     yield parse_csv_bytes(body, names, numeric_only)

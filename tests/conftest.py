@@ -19,6 +19,14 @@ os.environ["XLA_FLAGS"] = (
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# repo root on sys.path: `pytest` (unlike `python -m pytest`) does not add
+# the cwd, and tests import repo-root modules like tools.northstar_stream
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -165,3 +165,36 @@ class TestAccessAnomaly:
             m2.transform(probe)["anomaly_score"],
             atol=1e-6,
         )
+
+
+def test_als_coo_matches_dense():
+    """Sparse COO ALS == dense ALS on the same observations (explicit)."""
+    import numpy as np
+
+    from mmlspark_tpu.cyber.als import als_train, als_train_coo
+
+    rng = np.random.default_rng(0)
+    U, I = 12, 9
+    mask = rng.random((U, I)) < 0.4
+    r = np.where(mask, rng.integers(1, 5, size=(U, I)).astype(np.float32), 0.0)
+    uf1, if1 = als_train(r, rank=4, iters=8, reg=0.1, seed=3)
+    eu, ei = np.nonzero(mask)
+    uf2, if2 = als_train_coo(eu, ei, r[eu, ei], U, I, rank=4, iters=8, reg=0.1, seed=3)
+    np.testing.assert_allclose(uf1 @ if1.T, uf2 @ if2.T, rtol=1e-3, atol=1e-3)
+
+
+def test_als_coo_implicit_matches_dense():
+    import numpy as np
+
+    from mmlspark_tpu.cyber.als import als_train, als_train_coo
+
+    rng = np.random.default_rng(1)
+    U, I = 10, 8
+    mask = rng.random((U, I)) < 0.35
+    r = np.where(mask, rng.integers(1, 4, size=(U, I)).astype(np.float32), 0.0)
+    uf1, if1 = als_train(r, rank=3, iters=6, implicit=True, alpha=10.0, seed=5)
+    eu, ei = np.nonzero(mask)
+    uf2, if2 = als_train_coo(
+        eu, ei, r[eu, ei], U, I, rank=3, iters=6, implicit=True, alpha=10.0, seed=5
+    )
+    np.testing.assert_allclose(uf1 @ if1.T, uf2 @ if2.T, rtol=1e-3, atol=1e-3)
