@@ -50,6 +50,10 @@ class _LightGBMParams(
     num_leaves = Param("max leaves per tree", default=31, type_=int)
     max_depth = Param("max tree depth (-1 = unlimited)", default=-1, type_=int)
     lambda_l2 = Param("L2 leaf regularization", default=0.0, type_=float)
+    lambda_l1 = Param("L1 leaf regularization (ThresholdL1)", default=0.0, type_=float)
+    min_sum_hessian_in_leaf = Param(
+        "min child hessian mass for a valid split", default=1e-3, type_=float
+    )
     min_gain_to_split = Param("min split gain", default=0.0, type_=float)
     min_data_in_leaf = Param("min rows per leaf", default=20, type_=int)
     max_bin = Param(
@@ -104,6 +108,8 @@ class _LightGBMParams(
             num_leaves=self.get("num_leaves"),
             max_depth=self.get("max_depth"),
             lambda_l2=self.get("lambda_l2"),
+            lambda_l1=self.get("lambda_l1"),
+            min_sum_hessian_in_leaf=self.get("min_sum_hessian_in_leaf"),
             min_gain_to_split=self.get("min_gain_to_split"),
             min_data_in_leaf=self.get("min_data_in_leaf"),
             max_bin=self.get("max_bin"),

@@ -65,6 +65,8 @@ class TrainConfig:
     num_leaves: int = 31
     max_depth: int = -1
     lambda_l2: float = 0.0
+    lambda_l1: float = 0.0
+    min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
     min_data_in_leaf: int = 20
     max_bin: int = 255
@@ -452,6 +454,8 @@ def train(
             grow_kw = dict(
                 num_leaves=cfg.num_leaves,
                 lambda_l2=float(cfg.lambda_l2),
+                lambda_l1=float(cfg.lambda_l1),
+                min_sum_hessian=float(cfg.min_sum_hessian_in_leaf),
                 min_gain=float(cfg.min_gain_to_split),
                 learning_rate=1.0 if is_rf else float(cfg.learning_rate),
                 feature_mask=fm_dev,

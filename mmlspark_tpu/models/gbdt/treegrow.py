@@ -48,6 +48,18 @@ class GrownTree(NamedTuple):
     rec_catmask: jnp.ndarray   # (L-1, B) bool: bins going LEFT (cat splits)
 
 
+def threshold_l1(G: jnp.ndarray, l1: Any) -> jnp.ndarray:
+    """LightGBM ThresholdL1: sign(G) * max(|G| - l1, 0). The ONE L1
+    soft-threshold both growers (single-chip and voting) share."""
+    return jnp.sign(G) * jnp.maximum(jnp.abs(G) - l1, 0.0)
+
+
+def split_gain_term(G: jnp.ndarray, H: jnp.ndarray, lam: Any, l1: Any) -> jnp.ndarray:
+    """One side's contribution to split gain: ThresholdL1(G)^2 / (H + lam)."""
+    t = threshold_l1(G, l1)
+    return t * t / (H + lam)
+
+
 def grow_tree(
     bins: jnp.ndarray,            # (n, d) uint8/int32
     grad: jnp.ndarray,            # (n,) f32
@@ -61,10 +73,17 @@ def grow_tree(
     max_depth: int = -1,
     min_data_in_leaf: int = 20,
     categorical_mask: Optional[jnp.ndarray] = None,  # (d,) bool
+    lambda_l1: float = 0.0,
+    min_sum_hessian: float = 1e-3,
 ) -> GrownTree:
     """Grow one tree. The categorical-split machinery (per-leaf argsort of
     category bins) is statically compiled OUT when ``categorical_mask`` is
-    None — the common all-numerical case pays nothing for it."""
+    None — the common all-numerical case pays nothing for it.
+
+    ``lambda_l1`` soft-thresholds gradient sums in both split gains and
+    leaf values; ``min_sum_hessian`` invalidates splits whose child
+    hessian mass is below it (LightGBM lambda_l1 /
+    min_sum_hessian_in_leaf semantics)."""
     has_categorical = categorical_mask is not None
     if not has_categorical:
         categorical_mask = jnp.zeros((bins.shape[1],), bool)
@@ -74,6 +93,7 @@ def grow_tree(
         learning_rate=learning_rate, feature_mask=feature_mask,
         max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
         categorical_mask=categorical_mask, has_categorical=has_categorical,
+        lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
     )
 
 
@@ -97,6 +117,8 @@ def _grow_tree(
     min_data_in_leaf: int,
     categorical_mask: jnp.ndarray,
     has_categorical: bool,
+    lambda_l1: float = 0.0,
+    min_sum_hessian: float = 1e-3,
 ) -> GrownTree:
     n, d = bins.shape
     L = num_leaves
@@ -104,9 +126,17 @@ def _grow_tree(
     bins = bins.astype(jnp.int32)
     cat_f = categorical_mask.astype(bool)
     lam = lambda_l2
+    l1 = lambda_l1
+    msh = min_sum_hessian
     g = grad * row_weight
     h = hess * row_weight
     cnt_w = row_weight
+
+    def soft(Gv: jnp.ndarray) -> jnp.ndarray:
+        return threshold_l1(Gv, l1)
+
+    def gscore(Gv: jnp.ndarray, Hv: jnp.ndarray) -> jnp.ndarray:
+        return split_gain_term(Gv, Hv, lam, l1)
 
     # per-row (g, h, count) stats; the histogram op picks its lowering
     # (Pallas one-hot matmul on single-chip TPU, GSPMD-partitioned scatter
@@ -134,13 +164,13 @@ def _grow_tree(
         G, H, C = cg[:, -1:], ch[:, -1:], cc[:, -1:]
         GL, HL, CL = cg, ch, cc
         GR, HR, CR = G - GL, H - HL, C - CL
-        gain_num = (
-            GL * GL / (HL + lam)
-            + GR * GR / (HR + lam)
-            - G * G / (H + lam)
-        )
+        gain_num = gscore(GL, HL) + gscore(GR, HR) - gscore(G, H)
         feat_ok = (feature_mask > 0)[:, None]
-        valid_num = feat_ok & (CL >= min_data_in_leaf) & (CR >= min_data_in_leaf)
+        valid_num = (
+            feat_ok
+            & (CL >= min_data_in_leaf) & (CR >= min_data_in_leaf)
+            & (HL >= msh) & (HR >= msh)
+        )
         if has_categorical:
             # categorical subset split (LightGBM's sorted-by-ratio scan:
             # order category bins by G/H, then the best LEFT set is some
@@ -156,14 +186,13 @@ def _grow_tree(
             chs = jnp.cumsum(shs, axis=1)
             ccs = jnp.cumsum(scs, axis=1)
             gain_cat = (
-                cgs * cgs / (chs + lam)
-                + (G - cgs) ** 2 / (H - chs + lam)
-                - G * G / (H + lam)
+                gscore(cgs, chs) + gscore(G - cgs, H - chs) - gscore(G, H)
             )
             valid_cat = (
                 feat_ok
                 & (ccs >= min_data_in_leaf)
                 & ((C - ccs) >= min_data_in_leaf)
+                & (chs >= msh) & ((H - chs) >= msh)
             )
             gain = jnp.where(
                 cat_f[:, None],
@@ -289,11 +318,11 @@ def _grow_tree(
         jax.lax.fori_loop(0, L - 1, step, init)
     )
 
-    # leaf values: -G/(H+lambda) * lr per final leaf
+    # leaf values: -ThresholdL1(G)/(H+lambda) * lr per final leaf
     Gl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(g)
     Hl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(h)
     Cl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(cnt_w)
-    leaf_values = -Gl / (Hl + lambda_l2) * learning_rate
+    leaf_values = -soft(Gl) / (Hl + lambda_l2) * learning_rate
     leaf_values = jnp.where(Cl > 0, leaf_values, 0.0)
     return GrownTree(
         rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,

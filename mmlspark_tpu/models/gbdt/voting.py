@@ -57,6 +57,8 @@ def grow_tree_voting(
     top_k: int = 20,
     mesh: Any = None,
     axis: str = DATA_AXIS,
+    lambda_l1: float = 0.0,
+    min_sum_hessian: float = 1e-3,
 ) -> GrownTree:
     """Grow one tree with PV-Tree voting over ``mesh``'s ``axis``."""
     if mesh is None:
@@ -71,6 +73,7 @@ def grow_tree_voting(
         bins, grad, hess, row_weight,
         jnp.float32(lambda_l2), jnp.float32(min_gain),
         jnp.float32(learning_rate), feature_mask,
+        jnp.float32(lambda_l1), jnp.float32(min_sum_hessian),
     )
 
 
@@ -80,13 +83,25 @@ def _voting_program(mesh, axis, num_leaves, max_depth, min_data_in_leaf, top_k):
     B = NUM_BINS
 
     def program(bins, grad, hess, row_weight, lambda_l2, min_gain,
-                learning_rate, feature_mask):
+                learning_rate, feature_mask, lambda_l1, min_sum_hessian):
         # executes PER SHARD: shapes below are shard-local
         n, d = bins.shape
         K = min(top_k, d)
         C = min(2 * top_k, d)
         bins = bins.astype(jnp.int32)
         lam = lambda_l2
+        l1 = lambda_l1
+        msh = min_sum_hessian
+
+        from mmlspark_tpu.models.gbdt.treegrow import (
+            split_gain_term, threshold_l1)
+
+        def soft(Gv):
+            return threshold_l1(Gv, l1)
+
+        def gscore(Gv, Hv):
+            return split_gain_term(Gv, Hv, lam, l1)
+
         g = grad * row_weight
         h = hess * row_weight
         row_stats = jnp.stack([g, h, row_weight], axis=-1)
@@ -105,15 +120,14 @@ def _voting_program(mesh, axis, num_leaves, max_depth, min_data_in_leaf, top_k):
             ch = jnp.cumsum(hh, axis=1)
             cc = jnp.cumsum(hc, axis=1)
             G, H, Ct = cg[:, -1:], ch[:, -1:], cc[:, -1:]
-            gain = (
-                cg * cg / (ch + lam)
-                + (G - cg) ** 2 / (H - ch + lam)
-                - G * G / (H + lam)
-            )
+            gain = gscore(cg, ch) + gscore(G - cg, H - ch) - gscore(G, H)
             valid = (
                 (feature_mask > 0)[:, None]
                 & (cc >= min_data_in_leaf)
                 & ((Ct - cc) >= min_data_in_leaf)
+                # same hessian floor as the exact phase: a feature whose
+                # splits all fail it must not win votes
+                & (ch >= msh) & ((H - ch) >= msh)
             )
             return jnp.where(valid, gain, -jnp.inf).max(axis=1)
 
@@ -127,15 +141,12 @@ def _voting_program(mesh, axis, num_leaves, max_depth, min_data_in_leaf, top_k):
             ch = jnp.cumsum(hh, axis=1)
             cc = jnp.cumsum(hc, axis=1)
             G, H, Ct = cg[:, -1:], ch[:, -1:], cc[:, -1:]
-            gain = (
-                cg * cg / (ch + lam)
-                + (G - cg) ** 2 / (H - ch + lam)
-                - G * G / (H + lam)
-            )
+            gain = gscore(cg, ch) + gscore(G - cg, H - ch) - gscore(G, H)
             valid = (
                 (feature_mask[cand_ids] > 0)[:, None]
                 & (cc >= min_data_in_leaf)
                 & ((Ct - cc) >= min_data_in_leaf)
+                & (ch >= msh) & ((H - ch) >= msh)
             )
             gain = jnp.where(valid, gain, -jnp.inf)
             flat = gain.reshape(-1)
@@ -247,7 +258,7 @@ def _voting_program(mesh, axis, num_leaves, max_depth, min_data_in_leaf, top_k):
         )
         sums = jax.lax.psum(sums, axis)
         Gl, Hl, Cl = sums[:, 0], sums[:, 1], sums[:, 2]
-        leaf_values = -Gl / (Hl + lam) * learning_rate
+        leaf_values = -soft(Gl) / (Hl + lam) * learning_rate
         leaf_values = jnp.where(Cl > 0, leaf_values, 0.0)
         return GrownTree(
             rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
@@ -260,7 +271,7 @@ def _voting_program(mesh, axis, num_leaves, max_depth, min_data_in_leaf, top_k):
     mapped = jax.shard_map(
         program,
         mesh=mesh,
-        in_specs=(row, row, row, row, rep, rep, rep, rep),
+        in_specs=(row, row, row, row, rep, rep, rep, rep, rep, rep),
         out_specs=GrownTree(
             rep, rep, rep, rep, rep,   # split records
             rep, rep,                  # leaf values/counts

@@ -644,3 +644,28 @@ def test_goss_rate_sum_rejected():
     with pytest.raises(ValueError, match="top_rate"):
         train(x, y, TrainConfig(objective="binary", boosting_type="goss",
                                 top_rate=0.6, other_rate=0.6))
+
+
+def test_lambda_l1_shrinks_leaves():
+    x, y = make_binary(400)
+    cfg0 = TrainConfig(objective="binary", num_iterations=10, num_leaves=15)
+    cfg1 = TrainConfig(objective="binary", num_iterations=10, num_leaves=15,
+                       lambda_l1=2.0)
+    b0, b1 = train(x, y, cfg0), train(x, y, cfg1)
+    m0 = np.mean([np.abs(t.values).mean() for t in b0.trees])
+    m1 = np.mean([np.abs(t.values).mean() for t in b1.trees])
+    assert m1 < m0  # L1 soft-threshold shrinks leaf outputs
+    # exact-zero OCCUPIED leaves appear once |G| <= l1 (unoccupied leaf
+    # slots are structurally zero and don't count)
+    assert any((t.values[t.counts > 0] == 0).any() for t in b1.trees)
+
+
+def test_min_sum_hessian_blocks_splits():
+    x, y = make_binary(300)
+    few = train(x, y, TrainConfig(objective="binary", num_iterations=5,
+                                  num_leaves=31, min_sum_hessian_in_leaf=40.0))
+    many = train(x, y, TrainConfig(objective="binary", num_iterations=5,
+                                   num_leaves=31))
+    s_few = sum(t.num_splits for t in few.trees)
+    s_many = sum(t.num_splits for t in many.trees)
+    assert s_few < s_many  # large hessian floor prunes candidate splits

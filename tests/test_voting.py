@@ -88,6 +88,7 @@ class TestVotingParallel:
         vp_hlo = vp.lower(
             bins, g, ones, ones,
             jnp.float32(1.0), jnp.float32(0.0), jnp.float32(0.1), fm,
+            jnp.float32(0.0), jnp.float32(1e-3),
         ).compile().as_text()
 
         dp_elems = _allreduce_elements(dp_hlo)
