@@ -170,7 +170,9 @@ def _bench_serving() -> dict:
 
     srv = WorkerServer()
     info = srv.start()
-    q = ServingQuery(srv, handler, max_wait_ms=1).start()
+    # max_wait_ms=0: no batch-accumulation wait — the continuous low-latency
+    # mode; throughput-oriented deployments raise it to batch harder
+    q = ServingQuery(srv, handler, max_wait_ms=0).start()
     try:
         payload = json.dumps({"x": [0.1] * dim})
         conn = http.client.HTTPConnection("127.0.0.1", info.port, timeout=10)

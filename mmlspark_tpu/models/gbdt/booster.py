@@ -201,19 +201,25 @@ class Booster:
             return np.zeros((x.shape[0], 0), np.int32)
         return tree_leaves(self.trees, x)
 
-    def feature_contribs(self, x: np.ndarray) -> np.ndarray:
+    def feature_contribs(self, x: np.ndarray, approximate: bool = False) -> np.ndarray:
         """Per-feature contributions (n, d+1), last column = expected value.
 
-        Saabas-style attribution: walking each tree, the change in subtree
-        expected value at a split is credited to the split feature. (The
-        reference surfaces LightGBM's TreeSHAP as ``featuresShap``;
-        Saabas is its fast first-order approximation.)"""
+        Default is EXACT TreeSHAP (treeshap.py — the reference surfaces
+        LightGBM's exact ``featuresShap``); ``approximate=True`` switches
+        to the fast Saabas walk (the change in subtree expectation at each
+        split credited to its feature — TreeSHAP's first-order
+        approximation). Both satisfy sum(contribs) == raw score."""
         n, d = x.shape
         out = np.zeros((n, d + 1), np.float64)
         out[:, d] += float(np.sum(np.asarray(self.base_score)))
-        for t_i, tree in enumerate(self.trees):
-            contrib = _tree_contribs(tree, x)
-            out[:, : d + 1] += contrib
+        if approximate:
+            for tree in self.trees:
+                out += _tree_contribs(tree, x)
+            return out
+        from mmlspark_tpu.models.gbdt.treeshap import shap_values
+
+        for tree in self.trees:
+            out += shap_values(tree, x)
         return out
 
     def feature_importances(self, importance_type: str = "split") -> np.ndarray:

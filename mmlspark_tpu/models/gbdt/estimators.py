@@ -267,8 +267,12 @@ class LightGBMClassificationModel(
     def predict_leaf(self, x: np.ndarray) -> np.ndarray:
         return self.booster.predict_leaf(np.asarray(x, np.float32))
 
-    def features_shap(self, x: np.ndarray) -> np.ndarray:
-        return self.booster.feature_contribs(np.asarray(x, np.float32))
+    def features_shap(self, x: np.ndarray, approximate: bool = False) -> np.ndarray:
+        """Exact TreeSHAP by default; ``approximate=True`` = the vectorized
+        Saabas walk (orders of magnitude faster on large batches)."""
+        return self.booster.feature_contribs(
+            np.asarray(x, np.float32), approximate=approximate
+        )
 
     def get_feature_importances(self, importance_type: str = "split") -> np.ndarray:
         return self.booster.feature_importances(importance_type)
@@ -317,8 +321,12 @@ class LightGBMRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
             lambda p: booster.predict_raw(np.asarray(p[fc], np.float32)).astype(np.float64),
         )
 
-    def features_shap(self, x: np.ndarray) -> np.ndarray:
-        return self.booster.feature_contribs(np.asarray(x, np.float32))
+    def features_shap(self, x: np.ndarray, approximate: bool = False) -> np.ndarray:
+        """Exact TreeSHAP by default; ``approximate=True`` = the vectorized
+        Saabas walk (orders of magnitude faster on large batches)."""
+        return self.booster.feature_contribs(
+            np.asarray(x, np.float32), approximate=approximate
+        )
 
 
 class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol, HasPredictionCol):

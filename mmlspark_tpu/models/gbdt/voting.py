@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from mmlspark_tpu.models.gbdt.treegrow import GrownTree
+from mmlspark_tpu.models.gbdt.treegrow import GrownTree, split_gain_term, threshold_l1
 from mmlspark_tpu.ops.histogram import NUM_BINS, plane_histogram
 from mmlspark_tpu.parallel.mesh import DATA_AXIS
 
@@ -92,9 +92,6 @@ def _voting_program(mesh, axis, num_leaves, max_depth, min_data_in_leaf, top_k):
         lam = lambda_l2
         l1 = lambda_l1
         msh = min_sum_hessian
-
-        from mmlspark_tpu.models.gbdt.treegrow import (
-            split_gain_term, threshold_l1)
 
         def soft(Gv):
             return threshold_l1(Gv, l1)

