@@ -133,6 +133,30 @@ def _bench_gbdt(on_accel: bool) -> dict:
     }
 
 
+def _bench_vw(on_accel: bool) -> dict:
+    """Online-learning throughput: hashed sparse text rows/sec through the
+    device SGD (the BASELINE 20-newsgroups-style tracked metric)."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer
+
+    n = 100_000 if on_accel else 10_000
+    rng = np.random.default_rng(5)
+    vocab = [f"w{i}" for i in range(2000)]
+    texts = np.array(
+        [" ".join(rng.choice(vocab, size=12)) for _ in range(n)], dtype=object
+    )
+    y = rng.integers(0, 2, size=n).astype(np.float64)
+    df = DataFrame.from_dict({"text": texts, "label": y})
+    feat = VowpalWabbitFeaturizer(input_cols=["text"], output_col="features")
+    clf = VowpalWabbitClassifier(num_passes=1)
+    fdf = feat.transform(df)
+    _retry(lambda: clf.fit(fdf), "vw compile")
+    t0 = time.perf_counter()
+    clf.fit(fdf)
+    dt = time.perf_counter() - t0
+    return {"vw_rows": n, "vw_rows_per_sec": round(n / dt, 1)}
+
+
 def _bench_serving() -> dict:
     """Loopback POST -> fixed-shape batch -> jitted model -> reply, ms."""
     import http.client
@@ -236,6 +260,10 @@ def run_bench() -> None:
         extra.update(_bench_gbdt(on_accel))
     except Exception as e:  # noqa: BLE001
         extra["gbdt_error"] = str(e)[:200]
+    try:
+        extra.update(_bench_vw(on_accel))
+    except Exception as e:  # noqa: BLE001
+        extra["vw_error"] = str(e)[:200]
     try:
         extra.update(_bench_serving())
     except Exception as e:  # noqa: BLE001

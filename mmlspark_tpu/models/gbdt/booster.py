@@ -7,8 +7,8 @@ string + scoring entry points (lightgbm/LightGBMBooster.scala:37-128):
 - ``merge`` — continued-training semantics (LGBM_BoosterMerge,
   TrainUtils.scala:157-174)
 - ``predict_raw`` / ``predict_leaf`` / ``feature_contribs`` (the
-  featuresShap analogue; Saabas-style per-node attribution computed from
-  split records — fast on device-free host path, exact TreeSHAP TBD)
+  featuresShap analogue: EXACT TreeSHAP by default via treeshap.py, with
+  ``approximate=True`` selecting the fast vectorized Saabas walk)
 """
 
 from __future__ import annotations
@@ -201,25 +201,39 @@ class Booster:
             return np.zeros((x.shape[0], 0), np.int32)
         return tree_leaves(self.trees, x)
 
-    def feature_contribs(self, x: np.ndarray, approximate: bool = False) -> np.ndarray:
+    def feature_contribs(
+        self,
+        x: np.ndarray,
+        approximate: bool = False,
+        num_iteration: Optional[int] = None,
+    ) -> np.ndarray:
         """Per-feature contributions (n, d+1), last column = expected value.
 
         Default is EXACT TreeSHAP (treeshap.py — the reference surfaces
         LightGBM's exact ``featuresShap``); ``approximate=True`` switches
         to the fast Saabas walk (the change in subtree expectation at each
         split credited to its feature — TreeSHAP's first-order
-        approximation). Both satisfy sum(contribs) == raw score."""
+        approximation). Both satisfy sum(contribs) == raw score, including
+        under rf averaging and best-iteration truncation (Shapley values
+        are linear in the ensemble, so the same denominator/prefix
+        predict_raw applies transfers to each tree's contributions)."""
         n, d = x.shape
+        if num_iteration is None and self.best_iteration > 0:
+            num_iteration = self.best_iteration
+        trees = self.trees[: num_iteration * self.num_class] if num_iteration else self.trees
         out = np.zeros((n, d + 1), np.float64)
         out[:, d] += float(np.sum(np.asarray(self.base_score)))
+        scale = 1.0
+        if self.boosting_type == "rf" and trees:
+            scale = 1.0 / (len(trees) // self.num_class)
         if approximate:
-            for tree in self.trees:
-                out += _tree_contribs(tree, x)
+            for tree in trees:
+                out += scale * _tree_contribs(tree, x)
             return out
         from mmlspark_tpu.models.gbdt.treeshap import shap_values
 
-        for tree in self.trees:
-            out += shap_values(tree, x)
+        for tree in trees:
+            out += scale * shap_values(tree, x)
         return out
 
     def feature_importances(self, importance_type: str = "split") -> np.ndarray:

@@ -22,6 +22,8 @@ from typing import Optional
 
 import numpy as np
 
+from mmlspark_tpu.models.gbdt import treegrow
+
 
 class _BinaryTree:
     """Replay log -> explicit binary tree with per-node covers."""
@@ -76,8 +78,6 @@ class _BinaryTree:
         f = self.feature[node]
         v = x_row[f]
         if self.is_cat[node]:
-            from mmlspark_tpu.models.gbdt import treegrow
-
             vbin = treegrow.category_bin_slot(np.asarray([v]), len(self.catmask[node]), np)[0]
             return bool(self.catmask[node][vbin])
         # NaN routes LEFT, matching predict_leaves and the Saabas walk

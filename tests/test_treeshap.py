@@ -95,7 +95,8 @@ def test_saabas_and_exact_share_sum_but_differ():
 
 def test_exact_with_categorical_splits():
     booster, x = small_model(cat=(3,), seed=2)
-    assert any(t.has_categorical for t in booster.trees) or True
+    if not any(t.has_categorical for t in booster.trees):
+        pytest.skip("grower produced no categorical split")
     contribs = booster.feature_contribs(x[:10])
     raw = booster.predict_raw(x[:10])
     np.testing.assert_allclose(contribs.sum(axis=1), raw, rtol=1e-5, atol=1e-5)
@@ -126,3 +127,26 @@ def test_exact_shap_nan_routes_left():
     contribs = booster.feature_contribs(xt)
     raw = booster.predict_raw(xt.astype(np.float32))
     np.testing.assert_allclose(contribs.sum(axis=1), raw, rtol=1e-5, atol=1e-5)
+
+
+def test_rf_and_best_iteration_contribs_sum_to_raw():
+    # rf: averaged ensemble — contribs must carry the same denominator
+    booster, x = small_model(iters=6)
+    from mmlspark_tpu.models.gbdt import TrainConfig, train
+
+    r = np.random.default_rng(3)
+    xr = r.normal(size=(300, 4)).astype(np.float32)
+    yr = (xr[:, 0] > 0).astype(np.float64)
+    rf = train(xr, yr, TrainConfig(objective="binary", num_iterations=6,
+                                   num_leaves=7, boosting_type="rf", seed=1))
+    c = rf.feature_contribs(xr[:12])
+    np.testing.assert_allclose(
+        c.sum(axis=1), rf.predict_raw(xr[:12]), rtol=1e-5, atol=1e-5
+    )
+    # best_iteration truncation: contribs use the same prefix as predict_raw
+    booster.best_iteration = 2
+    c2 = booster.feature_contribs(x[:12])
+    np.testing.assert_allclose(
+        c2.sum(axis=1), booster.predict_raw(x[:12]), rtol=1e-5, atol=1e-5
+    )
+    booster.best_iteration = -1
