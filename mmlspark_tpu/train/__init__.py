@@ -1,4 +1,6 @@
 from mmlspark_tpu.train.train import (
+    OneVsRest,
+    OneVsRestModel,
     TrainClassifier,
     TrainRegressor,
     TrainedClassifierModel,
@@ -10,6 +12,8 @@ from mmlspark_tpu.train.statistics import (
 )
 
 __all__ = [
+    "OneVsRest",
+    "OneVsRestModel",
     "TrainClassifier",
     "TrainRegressor",
     "TrainedClassifierModel",

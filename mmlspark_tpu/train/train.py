@@ -13,7 +13,13 @@ from typing import Any, Optional
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
-from mmlspark_tpu.core.params import ComplexParam, HasFeaturesCol, HasLabelCol, Param
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    Param,
+)
 from mmlspark_tpu.core.pipeline import Estimator, Model
 from mmlspark_tpu.core.schema import CATEGORICAL_KEY
 from mmlspark_tpu.featurize import Featurize, ValueIndexer
@@ -122,3 +128,74 @@ class TrainedRegressorModel(Model, HasLabelCol):
     def transform(self, df: DataFrame) -> DataFrame:
         feats = self.get_or_fail("featurizer").transform(df)
         return self.get_or_fail("inner_model").transform(feats)
+
+
+class OneVsRest(Estimator, HasLabelCol, HasFeaturesCol, HasPredictionCol):
+    """Fit one binary copy of any classifier per class; predict argmax of
+    per-class positive scores.
+
+    The reference promotes multiclass LogisticRegression through Spark's
+    OneVsRest (train/TrainClassifier.scala:106-128) because its LR is
+    binary-only; here LogisticRegression is natively softmax-multiclass,
+    so this stage exists as the user-facing meta-estimator, not a
+    promotion workaround."""
+
+    classifier = ComplexParam("binary base classifier (cloned per class)")
+
+    def fit(self, df: DataFrame) -> "OneVsRestModel":
+        import copy
+
+        base = self.get_or_fail("classifier")
+        label = self.get("label_col")
+        y = np.asarray(df[label], np.float64)
+        classes = sorted(float(c) for c in np.unique(y))
+        models = []
+        for c in classes:
+            est = copy.deepcopy(base)
+            # base estimators vary in declared params ("any classifier"):
+            # set only what each one understands (train.py pattern above)
+            if "label_col" in est.params():
+                est.set(label_col="__ovr_label__")
+            if "features_col" in est.params():
+                est.set(features_col=self.get("features_col"))
+            binary = df.with_column("__ovr_label__", (y == c).astype(np.float64))
+            models.append(est.fit(binary))
+        m = OneVsRestModel(
+            features_col=self.get("features_col"),
+            prediction_col=self.get("prediction_col"),
+        )
+        m.set(models=models, classes=classes)
+        return m
+
+
+class OneVsRestModel(Model, HasFeaturesCol, HasPredictionCol):
+    models = ComplexParam("per-class fitted binary models")
+    classes = ComplexParam("class label per model")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        models = self.get_or_fail("models")
+        classes = np.asarray(self.get_or_fail("classes"))
+        scores = []
+        for sub in models:
+            out = sub.transform(df)
+            # positive-class confidence from the sub-model's CONFIGURED
+            # columns (probability_col when it has one, else prediction_col)
+            pc = (
+                sub.get("probability_col")
+                if "probability_col" in sub.params()
+                else None
+            )
+            if pc and pc in out.columns:
+                p = np.asarray(out[pc], np.float64)
+                scores.append(p[:, 1] if p.ndim == 2 else p)
+            else:
+                spc = (
+                    sub.get("prediction_col")
+                    if "prediction_col" in sub.params()
+                    else "prediction"
+                )
+                scores.append(np.asarray(out[spc], np.float64))
+        stacked = np.stack(scores, axis=1)  # (n, k)
+        return df.with_column(
+            self.get("prediction_col"), classes[stacked.argmax(axis=1)]
+        )

@@ -130,6 +130,7 @@ def make_test_objects() -> list:
     from mmlspark_tpu.train import (
         ComputeModelStatistics,
         ComputePerInstanceStatistics,
+        OneVsRest,
         TrainClassifier,
         TrainRegressor,
     )
@@ -146,6 +147,10 @@ def make_test_objects() -> list:
         TestObject(LinearRegression(), lin_df),
         TestObject(TrainClassifier(label_col="label"), df.select("x", "cat", "label")),
         TestObject(TrainRegressor(label_col="x"), df.select("features", "x")),
+        TestObject(
+            OneVsRest(classifier=LogisticRegression(max_iter=10), label_col="label"),
+            lin_df,
+        ),
     ]
     scored = LogisticRegression(max_iter=20).fit(lin_df).transform(lin_df)
     objs += [
@@ -483,7 +488,7 @@ EXCLUDED = {
     "ClassBalancerModel", "CleanMissingDataModel", "FeaturizeModel",
     "ValueIndexerModel", "TextFeaturizerModel", "MeanShiftModel",
     "LogisticRegressionModel", "LinearRegressionModel",
-    "TrainedClassifierModel", "TrainedRegressorModel",
+    "TrainedClassifierModel", "TrainedRegressorModel", "OneVsRestModel",
     "TuneHyperparametersModel", "FindBestModelResult",
     "LightGBMClassificationModel", "LightGBMRegressionModel", "LightGBMRankerModel",
     "VowpalWabbitClassificationModel", "VowpalWabbitRegressionModel",

@@ -145,3 +145,32 @@ def test_trained_classifier_save_load(tmp_path, tabular_df):
     a = model.transform(tabular_df)["prediction"]
     b = m2.transform(tabular_df)["prediction"]
     np.testing.assert_array_equal(a, b)
+
+
+def test_one_vs_rest_multiclass():
+    from mmlspark_tpu.models.gbdt import LightGBMClassifier
+    from mmlspark_tpu.train import OneVsRest
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(400, 5)).astype(np.float64)
+    y = ((x[:, 0] > 0).astype(int) + (x[:, 1] > 0.5).astype(int)).astype(np.float64)
+    df = DataFrame.from_dict({"features": x, "label": y})
+    ovr = OneVsRest(
+        classifier=LightGBMClassifier(num_iterations=15, num_leaves=7,
+                                      min_data_in_leaf=5),
+        label_col="label",
+    )
+    model = ovr.fit(df)
+    out = model.transform(df)
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.85, acc
+    # save/load round trip
+    import os
+    import tempfile
+
+    from mmlspark_tpu.core.serialize import load_stage, save_stage
+
+    d = tempfile.mkdtemp()
+    save_stage(model, os.path.join(d, "m"))
+    m2 = load_stage(os.path.join(d, "m"))
+    np.testing.assert_allclose(m2.transform(df)["prediction"], out["prediction"])
