@@ -33,9 +33,10 @@ import numpy as np
 NUM_BINS = 256
 
 # block sizes: DF features x NC rows per grid step; the one-hot block is
-# (NC, DF * B) f32 = 512 x 2048 x 4B = 4 MB VMEM
-_DF = 8
-_NC = 512
+# (NC, DF * B) f32 = 512 x 2048 x 4B = 4 MB VMEM by default. Env-tunable
+# (MMLSPARK_TPU_HIST_DF / _NC) so on-chip sweeps need no code edits.
+_DF = int(os.environ.get("MMLSPARK_TPU_HIST_DF", "8"))
+_NC = int(os.environ.get("MMLSPARK_TPU_HIST_NC", "512"))
 
 
 def use_pallas() -> bool:

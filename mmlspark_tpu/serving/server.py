@@ -21,7 +21,7 @@ import time
 import uuid
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Any, Optional
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found", 500: "Internal Server Error",
              503: "Service Unavailable"}
@@ -48,6 +48,10 @@ class ServiceInfo:
     host: str
     port: int
     path: str = "/"
+    # public endpoint when an SSH reverse forward fronts the worker
+    # (HTTPSourceV2.scala :657-665 forwarding options)
+    forwarded_host: Optional[str] = None
+    forwarded_port: Optional[int] = None
 
 
 class WorkerServer:
@@ -60,9 +64,17 @@ class WorkerServer:
         api_path: str = "/",
         name: str = "serving",
         max_queue: int = 100_000,
+        forwarding: Optional[dict] = None,
     ):
+        """``forwarding``: kwargs for io.port_forwarding.PortForwarding
+        (remote_host, remote_port, user, key_file, ...) — when given,
+        ``start()`` opens an ssh -R tunnel exposing this worker publicly
+        and reports the forwarded endpoint in ServiceInfo, like the
+        reference's worker port forwarding (HTTPSourceV2.scala:657-665)."""
         self.name = name
         self.host = host
+        self._forwarding_cfg = forwarding
+        self._forwarding: Any = None
         self.api_path = api_path.rstrip("/") or "/"
         self._requested_port = port
         self.port: int = 0
@@ -91,7 +103,21 @@ class WorkerServer:
         self._thread.start()
         if not self._started.wait(10.0):
             raise RuntimeError("WorkerServer failed to start")
-        return ServiceInfo(self.name, self.host, self.port, self.api_path)
+        info = ServiceInfo(self.name, self.host, self.port, self.api_path)
+        if self._forwarding_cfg:
+            from mmlspark_tpu.io.port_forwarding import PortForwarding
+
+            try:
+                cfg = dict(self._forwarding_cfg)
+                cfg.setdefault("local_port", self.port)
+                self._forwarding = PortForwarding(**cfg).start()
+            except Exception:
+                # a failed start() must not leave a live listener behind
+                self.stop()
+                raise
+            info.forwarded_host = cfg.get("remote_host")
+            info.forwarded_port = cfg.get("remote_port")
+        return info
 
     def _run_loop(self) -> None:
         loop = asyncio.new_event_loop()
@@ -112,6 +138,9 @@ class WorkerServer:
             loop.close()
 
     def stop(self) -> None:
+        if self._forwarding is not None:
+            self._forwarding.stop()
+            self._forwarding = None
         loop = self._loop
         if loop is None:
             return

@@ -158,7 +158,10 @@ class OneVsRest(Estimator, HasLabelCol, HasFeaturesCol, HasPredictionCol):
                 est.set(label_col="__ovr_label__")
             if "features_col" in est.params():
                 est.set(features_col=self.get("features_col"))
-            binary = df.with_column("__ovr_label__", (y == c).astype(np.float64))
+            # the multiclass label must not leak into featurize-all bases
+            binary = df.with_column(
+                "__ovr_label__", (y == c).astype(np.float64)
+            ).drop(label)
             models.append(est.fit(binary))
         m = OneVsRestModel(
             features_col=self.get("features_col"),
@@ -179,12 +182,16 @@ class OneVsRestModel(Model, HasFeaturesCol, HasPredictionCol):
         for sub in models:
             out = sub.transform(df)
             # positive-class confidence from the sub-model's CONFIGURED
-            # columns (probability_col when it has one, else prediction_col)
+            # columns (probability_col when it has one, else prediction_col);
+            # wrapper models (TrainedClassifierModel) don't declare the
+            # param but their inner model still emits "probability"
             pc = (
                 sub.get("probability_col")
                 if "probability_col" in sub.params()
                 else None
             )
+            if pc is None and "probability" in out.columns:
+                pc = "probability"
             if pc and pc in out.columns:
                 p = np.asarray(out[pc], np.float64)
                 scores.append(p[:, 1] if p.ndim == 2 else p)

@@ -292,3 +292,43 @@ def test_driver_registry():
     finally:
         srv.stop()
         reg.stop()
+
+
+def test_worker_server_forwarding_option(monkeypatch):
+    """forwarding= opens an ssh -R tunnel for the bound port and reports
+    the public endpoint (HTTPSourceV2.scala:657-665 parity). The ssh spawn
+    is faked: the command/port plumbing is what's under test."""
+    import mmlspark_tpu.io.port_forwarding as pf
+
+    started = {}
+
+    class FakeProc:
+        def poll(self):
+            return None
+
+        def terminate(self):
+            started["stopped"] = True
+
+        def wait(self, timeout=None):
+            return 0
+
+        import io as _io
+
+        stderr = _io.BytesIO()
+
+    def fake_popen(cmd, **kw):
+        started["cmd"] = cmd
+        return FakeProc()
+
+    monkeypatch.setattr(pf.subprocess, "Popen", fake_popen)
+    srv = WorkerServer(
+        forwarding={"remote_host": "gateway.example", "remote_port": 9000}
+    )
+    info = srv.start()
+    try:
+        assert info.forwarded_host == "gateway.example"
+        assert info.forwarded_port == 9000
+        assert f"9000:127.0.0.1:{info.port}" in " ".join(started["cmd"])
+    finally:
+        srv.stop()
+    assert started.get("stopped")

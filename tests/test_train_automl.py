@@ -174,3 +174,28 @@ def test_one_vs_rest_multiclass():
     save_stage(model, os.path.join(d, "m"))
     m2 = load_stage(os.path.join(d, "m"))
     np.testing.assert_allclose(m2.transform(df)["prediction"], out["prediction"])
+
+
+def test_one_vs_rest_no_label_leak():
+    """Sub-estimators that featurize ALL columns must not see the original
+    multiclass label, and scoring works on unlabeled data."""
+    from mmlspark_tpu.train import OneVsRest, TrainClassifier
+
+    r = np.random.default_rng(1)
+    x1 = r.normal(size=300)
+    x2 = r.normal(size=300)
+    y = ((x1 > 0).astype(int) + (x2 > 0.5).astype(int)).astype(np.float64)
+    df = DataFrame.from_dict({"x1": x1, "x2": x2, "label": y})
+    from mmlspark_tpu.models.gbdt import LightGBMClassifier
+
+    # tree inner model: the middle class is not linearly separable, so a
+    # linear base would cap accuracy regardless of leakage
+    base = TrainClassifier(
+        model=LightGBMClassifier(num_iterations=20, num_leaves=7,
+                                 min_data_in_leaf=5)
+    )
+    model = OneVsRest(classifier=base, label_col="label").fit(df)
+    unlabeled = DataFrame.from_dict({"x1": x1, "x2": x2})
+    out = model.transform(unlabeled)  # KeyError here would mean leakage
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.85, acc
