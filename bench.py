@@ -32,6 +32,9 @@ import numpy as np
 
 TPU_BUDGET_S = int(os.environ.get("MMLSPARK_BENCH_TPU_BUDGET", "2400"))
 ATTEMPT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_ATTEMPT_TIMEOUT", "1200"))
+# the CPU suite itself takes minutes; independent knob so a shortened
+# TPU-attempt timeout doesn't kill the fallback mid-run
+FALLBACK_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_FALLBACK_TIMEOUT", "1800"))
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
 
 
@@ -370,7 +373,7 @@ def main() -> None:
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
     env.pop("MMLSPARK_BENCH_REQUIRE_TPU", None)
-    line, err = _run_child(env, ATTEMPT_TIMEOUT_S)
+    line, err = _run_child(env, FALLBACK_TIMEOUT_S)
     if not line:
         sys.stderr.write(err + "\n")
         raise SystemExit(1)
