@@ -1,3 +1,9 @@
+from mmlspark_tpu.stages.adapters import (
+    FastVectorAssembler,
+    MultiColumnAdapter,
+    MultiColumnAdapterModel,
+    VectorZipper,
+)
 from mmlspark_tpu.stages.basic import (
     Cacher,
     DropColumns,
@@ -27,6 +33,10 @@ from mmlspark_tpu.stages.summarize import SummarizeData
 from mmlspark_tpu.stages.text import TextPreprocessor, UnicodeNormalize
 
 __all__ = [
+    "VectorZipper",
+    "MultiColumnAdapterModel",
+    "MultiColumnAdapter",
+    "FastVectorAssembler",
     "DropColumns",
     "SelectColumns",
     "RenameColumn",

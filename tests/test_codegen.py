@@ -80,3 +80,25 @@ def test_write_manifest_json(tmp_path, manifest):
     p = write_manifest(str(tmp_path / "manifest.json"), manifest)
     loaded = json.load(open(p))
     assert loaded["stages"].keys() == manifest["stages"].keys()
+
+
+def test_committed_manifest_fresh(manifest):
+    """docs/api/manifest.json must match the live registry — a stale
+    committed manifest silently misleads wrapper/doc consumers."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "api", "manifest.json"
+    )
+    with open(path) as f:
+        committed = json.load(f)
+    live, disk = manifest["stages"], committed["stages"]
+    assert set(disk) == set(live), (
+        f"manifest drift: missing={sorted(set(live) - set(disk))} "
+        f"extra={sorted(set(disk) - set(live))} — regenerate with "
+        f"codegen.generate_manifest()"
+    )
+    # param-level drift (the common change) must fail too
+    stale = [k for k in live if live[k] != disk[k]]
+    assert not stale, f"stale manifest entries: {stale} — regenerate docs/api"

@@ -257,3 +257,46 @@ class TestProfiling:
 
         with annotate("span"):
             pass  # no-op outside an active trace
+
+
+def test_vector_zipper_and_assembler():
+    from mmlspark_tpu.stages import FastVectorAssembler, VectorZipper
+
+    df = DataFrame.from_dict({
+        "a": np.array([1.0, 2.0]),
+        "b": np.array([3.0, 4.0]),
+        "v": np.array([[5.0, 6.0], [7.0, 8.0]]),
+    })
+    z = VectorZipper(input_cols=["a", "b"], output_col="zipped").transform(df)
+    np.testing.assert_array_equal(z["zipped"], [[1.0, 3.0], [2.0, 4.0]])
+    asm = FastVectorAssembler(
+        input_cols=["a", "v", "b"], output_col="features"
+    ).transform(df)
+    np.testing.assert_array_equal(
+        asm["features"], [[1.0, 5.0, 6.0, 3.0], [2.0, 7.0, 8.0, 4.0]]
+    )
+
+
+def test_multi_column_adapter():
+    from mmlspark_tpu.featurize import ValueIndexer
+    from mmlspark_tpu.stages import MultiColumnAdapter
+
+    df = DataFrame.from_dict({
+        "c1": np.array(["x", "y", "x"], dtype=object),
+        "c2": np.array(["p", "p", "q"], dtype=object),
+    })
+    ad = MultiColumnAdapter(
+        base_stage=ValueIndexer(),
+        input_cols=["c1", "c2"],
+        output_cols=["i1", "i2"],
+    )
+    model = ad.fit(df)
+    out = model.transform(df)
+    assert set(np.asarray(out["i1"], np.int64)) == {0, 1}
+    assert set(np.asarray(out["i2"], np.int64)) == {0, 1}
+    # misaligned columns rejected
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="align"):
+        MultiColumnAdapter(base_stage=ValueIndexer(), input_cols=["c1"],
+                           output_cols=["o1", "o2"]).fit(df)

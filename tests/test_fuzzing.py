@@ -44,6 +44,7 @@ def _array_df():
 
 def make_test_objects() -> list:
     from mmlspark_tpu import stages as S
+    from mmlspark_tpu.featurize import ValueIndexer as VI
     from mmlspark_tpu import featurize as F
 
     df = _num_df()
@@ -145,6 +146,16 @@ def make_test_objects() -> list:
     objs += [
         TestObject(LogisticRegression(max_iter=20), lin_df),
         TestObject(LinearRegression(), lin_df),
+                TestObject(S.VectorZipper(input_cols=["x", "label"], output_col="z"), df),
+        TestObject(
+            S.FastVectorAssembler(input_cols=["x", "label"], output_col="fv"), df
+        ),
+        TestObject(
+            S.MultiColumnAdapter(
+                base_stage=VI(), input_cols=["cat"], output_cols=["cat_idx"]
+            ),
+            df,
+        ),
         TestObject(TrainClassifier(label_col="label"), df.select("x", "cat", "label")),
         TestObject(TrainRegressor(label_col="x"), df.select("features", "x")),
         TestObject(
@@ -497,6 +508,7 @@ EXCLUDED = {
     "RecommendationIndexerModel", "SARModel", "RankingAdapterModel",
     "RankingTrainValidationSplitModel", "IsolationForestModel",
     "AccessAnomalyModel", "StandardScalarScalerModel", "LinearScalarScalerModel",
+    "MultiColumnAdapterModel",
     "ImageMean",  # test-local inner model for ImageLIME fuzzing
     # test-local helper stages
     "AddOne", "MeanShift", "Holder", "Scale", "Center", "CenterModel", "T",
