@@ -44,7 +44,6 @@ def _array_df():
 
 def make_test_objects() -> list:
     from mmlspark_tpu import stages as S
-    from mmlspark_tpu.featurize import ValueIndexer as VI
     from mmlspark_tpu import featurize as F
 
     df = _num_df()
@@ -146,13 +145,13 @@ def make_test_objects() -> list:
     objs += [
         TestObject(LogisticRegression(max_iter=20), lin_df),
         TestObject(LinearRegression(), lin_df),
-                TestObject(S.VectorZipper(input_cols=["x", "label"], output_col="z"), df),
+        TestObject(S.VectorZipper(input_cols=["x", "label"], output_col="z"), df),
         TestObject(
             S.FastVectorAssembler(input_cols=["x", "label"], output_col="fv"), df
         ),
         TestObject(
             S.MultiColumnAdapter(
-                base_stage=VI(), input_cols=["cat"], output_cols=["cat_idx"]
+                base_stage=F.ValueIndexer(), input_cols=["cat"], output_cols=["cat_idx"]
             ),
             df,
         ),
