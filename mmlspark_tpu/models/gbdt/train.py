@@ -254,9 +254,54 @@ def train(
     y = np.asarray(y).reshape(n)
     k = cfg.num_class if cfg.objective == "multiclass" else 1
     cat_features = tuple(int(f) for f in (cfg.categorical_features or ()))
-    mapper = BinMapper.fit(
-        x, max_bin=cfg.max_bin, seed=cfg.seed, categorical_features=cat_features
-    )
+
+    # multi-host: every process calls train() with ITS OWN rows; the jitted
+    # grower then runs SPMD over the process-spanning mesh and XLA carries
+    # the histogram allreduce over DCN (the reference's per-machine dataset
+    # build + socket allreduce, TrainUtils.scala:26-66,496-512)
+    multihost = shard and jax.process_count() > 1
+    if multihost:
+        unsupported = [
+            name
+            for flag, name in (
+                (cfg.boosting_type == "dart", "dart"),
+                (cfg.objective == "lambdarank", "lambdarank"),
+                (init_booster is not None, "continued training"),
+                (valid_mask is not None, "validation/early stopping"),
+                (cfg.parallelism == "voting_parallel", "voting_parallel"),
+                (sparse_input, "sparse input"),
+                (bool(cat_features), "categorical features"),
+            )
+            if flag
+        ]
+        if unsupported:
+            raise NotImplementedError(
+                f"multi-host training does not yet support: {unsupported}"
+            )
+
+    if multihost:
+        # bin bounds must be IDENTICAL on every process: fit the mapper on
+        # a NaN-padded sample allgathered from all processes (NaN rows are
+        # ignored by quantile fitting)
+        import jax.experimental.multihost_utils as mhu
+
+        # FIXED buffer size (process-count-based only): processes may hold
+        # unequal row counts, and allgather needs identical shapes — short
+        # processes leave NaN rows, which quantile fitting ignores
+        k_s = max(1, 50_000 // jax.process_count())
+        samp = np.full((k_s, d), np.nan, np.float32)
+        take = np.random.default_rng(cfg.seed).choice(
+            n, min(n, k_s), replace=False
+        )
+        samp[: len(take)] = np.asarray(x, np.float32)[take]
+        global_sample = np.asarray(mhu.process_allgather(samp)).reshape(-1, d)
+        mapper = BinMapper.fit(
+            global_sample, max_bin=cfg.max_bin, seed=cfg.seed
+        )
+    else:
+        mapper = BinMapper.fit(
+            x, max_bin=cfg.max_bin, seed=cfg.seed, categorical_features=cat_features
+        )
     bins_host = mapper.transform(x)
     cat_mask_dev = None
     if cat_features:
@@ -284,7 +329,22 @@ def train(
     # device placement: rows sharded over the data axis when a mesh exists
     mesh = None
     use_voting = False
-    if shard:
+    if multihost:
+        from mmlspark_tpu.parallel.mesh import get_mesh
+        from mmlspark_tpu.parallel.sharding import (
+            multihost_pad_target,
+            shard_batch_multihost,
+        )
+
+        mesh = get_mesh()
+        share = multihost_pad_target(n)  # equal local block per process
+        pad = share - n
+        bins_dev = shard_batch_multihost(
+            np.pad(bins_host, ((0, pad), (0, 0))), mesh
+        )
+        w_dev = shard_batch_multihost(np.pad(w, (0, pad)), mesh)
+        n_pad = share * jax.process_count()  # GLOBAL padded row count
+    elif shard:
         from mmlspark_tpu.parallel.mesh import DATA_AXIS, get_mesh
         from mmlspark_tpu.parallel.sharding import pad_batch, shard_batch
 
@@ -294,6 +354,7 @@ def train(
         pad = bins_p.shape[0] - n
         bins_dev = shard_batch(bins_p, mesh)
         w_dev = shard_batch(np.pad(w, (0, pad)), mesh)
+        n_pad = n + pad
         if cfg.parallelism == "voting_parallel":
             if dict(mesh.shape).get(DATA_AXIS, 1) > 1 and not cat_features:
                 use_voting = True
@@ -306,11 +367,15 @@ def train(
         pad = 0
         bins_dev = jnp.asarray(bins_host)
         w_dev = jnp.asarray(w)
-    n_pad = n + pad
+        n_pad = n
 
     def padded(a: np.ndarray) -> jnp.ndarray:
         if pad:
             a = np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+        if multihost:
+            from mmlspark_tpu.parallel.sharding import shard_batch_multihost
+
+            return shard_batch_multihost(a, mesh)
         if shard:
             from mmlspark_tpu.parallel.sharding import shard_batch
 
@@ -369,6 +434,22 @@ def train(
 
     rng = np.random.default_rng(cfg.seed)
     base_key = jax.random.PRNGKey(cfg.seed)
+    # per-iteration random masks and the small split-record reads must be
+    # REPLICATED arrays under multihost (a bare jax.random.uniform commits
+    # to process-local devices, incompatible with cross-process-sharded
+    # operands); both jits are hoisted here so the cache hits every round
+    if multihost:
+        _rep_sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()
+        )
+        _uniform_global = jax.jit(
+            lambda key: jax.random.uniform(key, (n_pad,)),
+            out_shardings=_rep_sharding,
+        )
+        _replicate_small = jax.jit(lambda t: t, out_shardings=_rep_sharding)
+    else:
+        def _uniform_global(key: Any) -> jnp.ndarray:
+            return jax.random.uniform(key, (n_pad,))
     booster = Booster(
         trees=[], objective=cfg.objective, num_class=k, num_features=d,
         base_score=base_score, boosting_type=cfg.boosting_type,
@@ -386,7 +467,7 @@ def train(
         if bagging_freq > 0 and bagging_fraction < 1.0:
             if it % bagging_freq == 0 or bag is None:
                 bag = (
-                    jax.random.uniform(jax.random.fold_in(it_key, 1), (n_pad,))
+                    _uniform_global(jax.random.fold_in(it_key, 1))
                     < bagging_fraction
                 ).astype(jnp.float32)
         else:
@@ -435,7 +516,7 @@ def train(
         # goss: one-side sampling weights from this iteration's |g|
         if is_goss:
             g_abs = jnp.abs(g_dev).sum(axis=1) if k > 1 else jnp.abs(g_dev)
-            u = jax.random.uniform(jax.random.fold_in(it_key, 2), (n_pad,))
+            u = _uniform_global(jax.random.fold_in(it_key, 2))
             w_it = w_it * _goss_weights(
                 g_abs, w_it, u, float(cfg.top_rate), float(cfg.other_rate)
             )
@@ -473,6 +554,17 @@ def train(
                 grown = grow_tree(
                     bins_dev, gc, hc, w_it,
                     categorical_mask=cat_mask_dev, **grow_kw,
+                )
+            if multihost:
+                # the small split-record outputs must be fully replicated so
+                # every process can read them to host (row_leaf stays
+                # sharded — it is only ever consumed on device)
+                grown = grown._replace(
+                    **{
+                        f: _replicate_small(getattr(grown, f))
+                        for f in grown._fields
+                        if f != "row_leaf"
+                    }
                 )
             tree = _tree_from_device(grown, mapper, value_scale=nf_new)
             booster.trees.append(tree)
