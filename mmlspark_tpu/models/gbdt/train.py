@@ -293,7 +293,7 @@ def train(
         take = np.random.default_rng(cfg.seed).choice(
             n, min(n, k_s), replace=False
         )
-        samp[: len(take)] = np.asarray(x, np.float32)[take]
+        samp[: len(take)] = np.asarray(x[take], np.float32)
         global_sample = np.asarray(mhu.process_allgather(samp)).reshape(-1, d)
         mapper = BinMapper.fit(
             global_sample, max_bin=cfg.max_bin, seed=cfg.seed

@@ -134,10 +134,27 @@ def train_sparse_sgd(
     wt = np.ones(n, np.float32) if wt is None else np.asarray(wt, np.float32)
     mesh = get_mesh()
     n_shards = mesh.shape[DATA_AXIS] if distributed else 1
-    batch = max(1, min(batch, max(1, n // max(1, n_shards))))
-    # pad rows so every shard gets the same number of full minibatches
-    chunk = n_shards * batch
-    n_pad = int(np.ceil(max(n, 1) / chunk)) * chunk
+    # multi-host: every process holds ITS OWN rows; local blocks join a
+    # process-spanning sharded array and the same shard_map program runs
+    # SPMD with the per-pass pmean crossing processes over DCN (the
+    # spanning-tree-allreduce analogue, VowpalWabbitBase.scala:401-429)
+    multihost = distributed and jax.process_count() > 1
+    if multihost:
+        from mmlspark_tpu.parallel.sharding import multihost_pad_target
+
+        # ALL sizing must come from the allgathered target, never local n:
+        # processes hold unequal row counts but must compile the same
+        # static-batch SPMD program over the same global shape
+        target = multihost_pad_target(n)
+        ldc = jax.local_device_count()
+        batch = max(1, min(batch, max(1, target // ldc)))
+        gran = ldc * batch  # whole per-device minibatches per process block
+        share = ((target + gran - 1) // gran) * gran
+        n_pad = share
+    else:
+        batch = max(1, min(batch, max(1, n // max(1, n_shards))))
+        chunk = n_shards * batch
+        n_pad = int(np.ceil(max(n, 1) / chunk)) * chunk
     if n_pad != n:
         pad = n_pad - n
         idx = np.concatenate([idx, np.zeros((pad, idx.shape[1]), idx.dtype)])
@@ -178,6 +195,16 @@ def train_sparse_sgd(
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=P(),
     )
+    if multihost:
+        from mmlspark_tpu.parallel.sharding import shard_batch_multihost
+
+        rows = shard_batch_multihost(
+            (idx.astype(np.int32), val.astype(np.float32),
+             np.asarray(y, np.float32), wt.astype(np.float32)),
+            mesh,
+        )
+        w = jax.jit(fn)(*rows, w0)  # w0: identical host array == replicated
+        return np.asarray(w)
     w = jax.jit(fn)(
         jnp.asarray(idx, jnp.int32),
         jnp.asarray(val),

@@ -175,3 +175,91 @@ def test_two_process_gbdt_training(tmp_path):
     b = Booster.from_model_string(models[0])
     auc = binary_auc(y_all, sigmoid(b.predict_raw(x_all)))
     assert auc > 0.95, auc
+
+
+VW_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, os.environ["MMLSPARK_REPO"])
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    from mmlspark_tpu.parallel.distributed import initialize
+    initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    import numpy as np
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer
+
+    r = np.random.default_rng(7)
+    n = 400
+    words_pos = [f"good{i}" for i in range(30)]
+    words_neg = [f"bad{i}" for i in range(30)]
+    texts, labels = [], []
+    for i in range(n):
+        pos = (i % 2) == 0
+        vocab = words_pos if pos else words_neg
+        texts.append(" ".join(r.choice(vocab, size=6)))
+        labels.append(float(pos))
+    texts = np.array(texts, dtype=object); labels = np.array(labels)
+    lo, hi = (0, 200) if pid == 0 else (200, 400)
+    df = DataFrame.from_dict({"text": texts[lo:hi], "label": labels[lo:hi]})
+    feats = VowpalWabbitFeaturizer(
+        input_cols=["text"], output_col="features", num_bits=12
+    ).transform(df)
+    model = VowpalWabbitClassifier(num_passes=3).fit(feats)
+    # score the FULL dataset locally with the allreduced weights
+    full = VowpalWabbitFeaturizer(
+        input_cols=["text"], output_col="features", num_bits=12
+    ).transform(DataFrame.from_dict({"text": texts, "label": labels}))
+    out = model.transform(full)
+    acc = float((out["prediction"] == labels).mean())
+    import hashlib
+    wh = hashlib.sha256(
+        np.asarray(model.get("weights"), np.float32).tobytes()
+    ).hexdigest()
+    print(f"VWACC:{acc:.4f}:{wh}", flush=True)
+    assert acc > 0.95, acc
+    """
+)
+
+
+def test_two_process_vw_training(tmp_path):
+    """Online learning across a real process boundary: the per-pass weight
+    pmean crosses processes, and the model trained on split halves scores
+    the union accurately on both processes."""
+    worker = tmp_path / "vw_worker.py"
+    worker.write_text(VW_WORKER)
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("PYTHONPATH", "PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    env["MMLSPARK_REPO"] = repo
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    results = []
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"proc{i} rc={rc}\n{err[-3000:]}"
+        tail = out.split("VWACC:", 1)[1].splitlines()[0]
+        acc, wh = tail.rsplit(":", 1)
+        results.append((float(acc), wh))
+    # identical allreduced weights (bitwise) on both sides
+    assert results[0] == results[1]
