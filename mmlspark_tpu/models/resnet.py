@@ -19,6 +19,7 @@ from typing import Any, Callable, Optional, Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 ModuleDef = Any
 
@@ -160,8 +161,27 @@ def init_resnet(
     small_inputs: bool = False,
     dtype: Any = jnp.bfloat16,
 ) -> tuple:
-    """Build a ResNet and init variables. Returns (module, variables)."""
+    """Build a ResNet and init variables. Returns (module, variables).
+
+    Init always runs on the host CPU backend: weight materialization is a
+    one-off that needs no accelerator, and routing it through a remote TPU
+    compile path makes model *loading* hostage to accelerator availability
+    (the exact failure that killed round-2's benchmark mid-``model.init``).
+    """
     model = RESNETS[name](num_classes=num_classes, small_inputs=small_inputs, dtype=dtype)
-    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
-    variables = model.init(jax.random.PRNGKey(seed), dummy, train=False)
+    # host-side allocation: a jnp.zeros here would already dispatch to the
+    # default (possibly dead-remote) backend before the CPU scope below
+    dummy = np.zeros((1, image_size, image_size, 3), np.float32)
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        cpu = None
+    if cpu is not None:
+        with jax.default_device(cpu):
+            variables = jax.jit(
+                lambda: model.init(jax.random.PRNGKey(seed), dummy, train=False)
+            )()
+        variables = jax.tree_util.tree_map(np.asarray, variables)
+    else:
+        variables = model.init(jax.random.PRNGKey(seed), dummy, train=False)
     return model, variables

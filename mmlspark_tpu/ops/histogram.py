@@ -8,10 +8,11 @@ scatter the (g, h, count) stats of the masked rows into a
 Two lowerings:
 
 - **Pallas (TPU, single chip)**: grid over (feature-blocks, row-chunks);
-  each step builds a one-hot (rows, DF*B) matrix in VMEM and accumulates
-  ``one_hot.T @ stats`` into the output block — the scatter becomes an MXU
-  matmul, which is how TPUs like their histograms. Rows stream chunk by
-  chunk so VMEM holds only (NC, DF*B) one-hots.
+  each step builds a bf16 one-hot (DF, B, rows) block in VMEM (rows on the
+  128-lane dim) and accumulates ``one_hot @ stats_hi/lo`` into the output
+  block — the scatter becomes an MXU matmul, which is how TPUs like their
+  histograms. Stats are split hi+lo bf16 so two native MXU passes recover
+  f32-grade sums. Rows stream chunk by chunk so VMEM stays bounded.
 - **XLA scatter-add (CPU, or sharded meshes)**: GSPMD partitions the
   scatter across the mesh and inserts the ICI allreduce (LightGBM's
   data_parallel mode); the Pallas kernel would need a shard_map wrapper to
@@ -33,8 +34,10 @@ import numpy as np
 NUM_BINS = 256
 
 # block sizes: DF features x NC rows per grid step; the one-hot block is
-# (NC, DF * B) f32 = 512 x 2048 x 4B = 4 MB VMEM by default. Env-tunable
-# (MMLSPARK_TPU_HIST_DF / _NC) so on-chip sweeps need no code edits.
+# (DF, B, NC) bf16 = 8 x 256 x 512 x 2B = 2 MB VMEM by default, with rows
+# on the 128-lane dim (NC must be a multiple of 128 on real TPU; DF a
+# multiple of 8). Env-tunable (MMLSPARK_TPU_HIST_DF / _NC) so on-chip
+# sweeps need no code edits.
 _DF = int(os.environ.get("MMLSPARK_TPU_HIST_DF", "8"))
 _NC = int(os.environ.get("MMLSPARK_TPU_HIST_NC", "512"))
 
@@ -50,7 +53,7 @@ def use_pallas() -> bool:
 
 
 def _hist_kernel(bins_ref, stats_ref, out_ref):
-    """One (feature-block, row-chunk) step: accumulate one-hot.T @ stats."""
+    """One (feature-block, row-chunk) step: accumulate one-hot @ stats."""
     import jax.experimental.pallas as pl
 
     row_chunk = pl.program_id(1)
@@ -59,22 +62,29 @@ def _hist_kernel(bins_ref, stats_ref, out_ref):
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bins = bins_ref[:]          # (NC, DF) int32; out-of-range = contribute nowhere
+    bins = bins_ref[:]          # (DF, NC) int32; out-of-range = contribute nowhere
     stats = stats_ref[:]        # (NC, 3) f32 (already mask-scaled; 0 rows inert)
-    nc, df = bins.shape
+    df, nc = bins.shape
     b = NUM_BINS
-    # row r contributes to flat column f * B + bins[r, f] for each feature f.
-    # One-hot built by comparing every column id against the row's target,
-    # replicated across each feature's B-wide stripe.
-    flat = bins + (jnp.arange(df, dtype=jnp.int32) * b)[None, :]   # (NC, DF)
-    target = jnp.repeat(flat, b, axis=1)                           # (NC, DF*B)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (nc, df * b), 1)
-    one_hot = (cols == target).astype(jnp.float32)
-    out_ref[:] += jax.lax.dot_general(
-        one_hot, stats,
-        dimension_numbers=(((0,), (0,)), ((), ())),  # contract over rows -> (DF*B, 3)
+    # one_hot[f, v, r] = (bins[f, r] == v): a 3-D iota compare instead of a
+    # repeat — Mosaic lowers the broadcast/compare on the VPU, and the
+    # (features, rows) layout keeps the 128-lane dim on rows so the block
+    # shape tiles legally on real TPU hardware (rows % 128 == 0).
+    v = jax.lax.broadcasted_iota(jnp.int32, (df, b, nc), 1)
+    one_hot = (bins[:, None, :] == v).astype(jnp.bfloat16)  # 0/1: exact in bf16
+    # bf16-split matmul: the MXU's native pass truncates f32 operands to
+    # bf16, which visibly perturbs gradient sums (and split decisions).
+    # Stats split as hi + lo bf16 terms recovers ~f32 accuracy in 2 native
+    # passes instead of Precision.HIGHEST's 6 (one-hot needs no split).
+    hi = stats.astype(jnp.bfloat16)
+    lo = (stats - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    both = jnp.concatenate([hi, lo], axis=1)  # (NC, 6)
+    acc = jax.lax.dot_general(
+        one_hot.reshape(df * b, nc), both,
+        dimension_numbers=(((1,), (0,)), ((), ())),  # contract over rows -> (DF*B, 6)
         preferred_element_type=jnp.float32,
     )
+    out_ref[:] += acc[:, :3] + acc[:, 3:]
 
 
 def _plane_histogram_pallas(bins: jnp.ndarray, stats: jnp.ndarray) -> jnp.ndarray:
@@ -85,29 +95,29 @@ def _plane_histogram_pallas(bins: jnp.ndarray, stats: jnp.ndarray) -> jnp.ndarra
     b = NUM_BINS
     d_pad = ((d + _DF - 1) // _DF) * _DF
     n_pad = ((n + _NC - 1) // _NC) * _NC
-    # sentinel: a bin whose flat column (f*B + sentinel) lies beyond every
-    # real column, so it matches nothing. Used for padded features AND for
-    # out-of-range caller bins — the scatter lowering drops those
-    # (mode='drop') and the two lowerings must agree exactly.
-    sentinel = d_pad * b
+    # sentinel: any value outside [0, B) matches no one-hot column, so the
+    # row contributes nowhere. Used for padded features AND for out-of-range
+    # caller bins — the scatter lowering drops those (mode='drop') and the
+    # two lowerings must agree exactly.
+    sentinel = b
     bins = jnp.where((bins >= 0) & (bins < b), bins, sentinel)
     if d_pad != d:
         bins = jnp.pad(bins, ((0, 0), (0, d_pad - d)), constant_values=sentinel)
     if n_pad != n:
-        bins = jnp.pad(bins, ((0, n_pad - n), (0, 0)), constant_values=0)
+        bins = jnp.pad(bins, ((0, n_pad - n), (0, 0)), constant_values=sentinel)
         stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))
 
     out = pl.pallas_call(
         _hist_kernel,
         grid=(d_pad // _DF, n_pad // _NC),
         in_specs=[
-            pl.BlockSpec((_NC, _DF), lambda f, r: (r, f)),
+            pl.BlockSpec((_DF, _NC), lambda f, r: (f, r)),
             pl.BlockSpec((_NC, 3), lambda f, r: (r, 0)),
         ],
         out_specs=pl.BlockSpec((_DF * b, 3), lambda f, r: (f, 0)),
         out_shape=jax.ShapeDtypeStruct((d_pad * b, 3), jnp.float32),
         interpret=jax.default_backend() == "cpu",
-    )(bins.astype(jnp.int32), stats.astype(jnp.float32))
+    )(bins.T.astype(jnp.int32), stats.astype(jnp.float32))
     return out[: d * b]
 
 

@@ -23,7 +23,11 @@ import numpy as np
 
 def resize(images: jnp.ndarray, height: int, width: int, method: str = "linear") -> jnp.ndarray:
     """Batched resize (ResizeImage stage analogue). images: (N,H,W,C)."""
-    n, _, _, c = images.shape
+    n, h, w, c = images.shape
+    if (h, w) == (height, width):
+        # already at target size: a same-size jax.image.resize is NOT free
+        # (XLA can't fold the gather/weighting away) — skip it entirely
+        return images.astype(jnp.float32)
     out = jax.image.resize(
         images.astype(jnp.float32), (n, height, width, c), method=method
     )

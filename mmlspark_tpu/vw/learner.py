@@ -145,7 +145,10 @@ def train_sparse_sgd(
         # ALL sizing must come from the allgathered target, never local n:
         # processes hold unequal row counts but must compile the same
         # static-batch SPMD program over the same global shape
-        target = multihost_pad_target(n)
+        # floor of 1: if EVERY process holds zero rows the program still
+        # needs one inert zero-weight chunk (matching the single-host
+        # max(n, 1) path) instead of zero-length sharded arrays
+        target = max(1, multihost_pad_target(n))
         ldc = jax.local_device_count()
         batch = max(1, min(batch, max(1, target // ldc)))
         gran = ldc * batch  # whole per-device minibatches per process block
