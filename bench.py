@@ -50,7 +50,17 @@ def _retry(fn, what: str, tries: int = 4, base_sleep: float = 20.0):
             time.sleep(base_sleep * (i + 1))
 
 
-def _bench_featurizer(on_accel: bool, n_dev: int) -> float:
+def _bench_featurizer(on_accel: bool, n_dev: int) -> tuple:
+    """Returns (e2e images/sec/chip, diagnostics dict).
+
+    e2e drives the full DataFrame -> features path (host batches shipped to
+    the device per minibatch). The diagnostics separate the two regimes the
+    tunnel conflates: device-resident model throughput (what the chip does
+    once data is in HBM) and the host->device uplink rate (which, over the
+    axon relay, is often the only limiter and varies 30x minute to minute).
+    """
+    import jax
+
     from mmlspark_tpu import DataFrame
     from mmlspark_tpu.models import ImageFeaturizer
 
@@ -77,7 +87,37 @@ def _bench_featurizer(on_accel: bool, n_dev: int) -> float:
         _ = out["features"]  # materialize
         dt = time.perf_counter() - t0
         best = max(best, n_rows / dt)
-    return best / n_dev
+    diag: dict = {}
+    try:
+        # device-resident rate: pre-staged batch, N dispatches, fetch the
+        # last output (block_until_ready under-reports over the relay)
+        inner = feat._build()
+        from mmlspark_tpu.parallel.mesh import get_mesh
+        from mmlspark_tpu.parallel.sharding import shard_batch
+
+        mesh = get_mesh()
+        vs = inner._device_variables(mesh)
+        dev = shard_batch(imgs[:batch], mesh)
+        fn = inner._compiled((batch, size, size, 3), mesh)
+        np.asarray(fn(vs, dev))
+        reps = 40 if on_accel else 4
+        t0 = time.perf_counter()
+        outs = [fn(vs, dev) for _ in range(reps)]
+        _ = np.asarray(outs[-1])
+        dres = reps * batch / (time.perf_counter() - t0) / n_dev
+        diag["device_resident_img_s_chip"] = round(dres, 1)
+        # uplink probe: put + reduce-to-scalar forces the bytes across
+        red = jax.jit(lambda x: x.sum())
+        _ = float(red(jax.device_put(imgs[:batch])))
+        t0 = time.perf_counter()
+        _ = float(red(jax.device_put(imgs[:batch * 2])))
+        diag["uplink_mb_s"] = round(
+            imgs[: batch * 2].nbytes / 1e6 / (time.perf_counter() - t0), 1
+        )
+        diag["tunnel_limited"] = bool(dres > 2.0 * best / n_dev)
+    except Exception as e:  # noqa: BLE001
+        diag["diag_error"] = str(e)[:200]
+    return best / n_dev, diag
 
 
 def _bench_histogram(on_accel: bool) -> dict:
@@ -93,12 +133,13 @@ def _bench_histogram(on_accel: bool) -> dict:
     bins = jnp.asarray(rng.integers(0, NUM_BINS, size=(n, d), dtype=np.int32))
     stats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
     hist = jax.jit(plane_histogram)
-    _retry(lambda: hist(bins, stats).block_until_ready(), "histogram compile")
+    _retry(lambda: np.asarray(hist(bins, stats)), "histogram compile")
     reps = 20
     t0 = time.perf_counter()
-    for _ in range(reps):
-        out = hist(bins, stats)
-    out.block_until_ready()
+    outs = [hist(bins, stats) for _ in range(reps)]
+    # fetch (not block_until_ready): the remote relay resolves readiness
+    # before execution completes, which inflated rates 1000x in round 2
+    _ = np.asarray(outs[-1])
     dt = time.perf_counter() - t0
     return {
         "hist_rows": n,
@@ -290,8 +331,9 @@ def run_bench() -> None:
         base_sleep=30.0,
     )
 
-    per_chip = _bench_featurizer(on_accel, n_dev)
+    per_chip, feat_diag = _bench_featurizer(on_accel, n_dev)
     extra = {"fallback": not on_accel}
+    extra.update(feat_diag)
     try:
         extra.update(_bench_histogram(on_accel))
     except Exception as e:  # noqa: BLE001

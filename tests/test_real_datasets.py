@@ -123,12 +123,22 @@ class TestRealDatasetGoldens:
 
 # gbdt rows are covered by the TestRealDatasetGoldens class tests above
 # (same params/splits/golden keys plus the sklearn parity check), so the
-# matrix only adds the other three modes
+# matrix only adds the other three modes; iris runs all four
 MATRIX = [
     (ds, mode)
     for ds in ("breast_cancer", "digits_binary", "wine")
     for mode in ("goss", "dart", "rf")
-]
+] + [("iris", mode) for mode in ("gbdt", "goss", "dart", "rf")]
+
+
+def _matrix_params(dataset: str, mode: str) -> dict:
+    if dataset == "iris":
+        return dict(num_iterations=40, num_leaves=15, min_data_in_leaf=3)
+    return dict(
+        num_iterations=50 if dataset == "digits_binary" else 60,
+        num_leaves=15 if dataset == "wine" else 31,
+        min_data_in_leaf=3 if dataset == "wine" else 5,
+    )
 
 
 @pytest.mark.parametrize("dataset,mode", MATRIX)
@@ -139,21 +149,41 @@ def test_dataset_mode_golden(dataset, mode):
     if dataset == "digits_binary":
         y = (y >= 5).astype(np.float64)
     xtr, xte, ytr, yte = stratified_split(x, y)
-    params = dict(
-        num_iterations=50 if dataset == "digits_binary" else 60,
-        num_leaves=15 if dataset == "wine" else 31,
-        min_data_in_leaf=3 if dataset == "wine" else 5,
-        seed=7,
-        boosting_type=mode,
-    )
+    params = dict(seed=7, boosting_type=mode, **_matrix_params(dataset, mode))
     m = LightGBMClassifier(**params).fit(
         DataFrame.from_dict({"features": xtr, "label": ytr})
     )
     out = m.transform(DataFrame.from_dict({"features": xte, "label": yte}))
-    if dataset == "wine":
+    if dataset in ("wine", "iris"):
         value = float((out["prediction"] == yte).mean())
         key = f"{dataset}.{mode}.accuracy"
     else:
         value = binary_auc(yte, out["probability"][:, 1])
         key = f"{dataset}.{mode}.AUC"
     assert_golden(goldens, key, value)
+
+
+# -- regression matrix: diabetes (real UCI) x boosting mode ----------------
+# reference regressor goldens: benchmarks_VerifyLightGBMRegressor.csv
+
+
+@pytest.mark.parametrize("mode", ["gbdt", "goss", "dart", "rf"])
+def test_diabetes_regression_golden(mode):
+    from mmlspark_tpu.models.gbdt import LightGBMRegressor
+
+    goldens = load_goldens("VerifyLightGBMRegressor")
+    x, y = load_xy("diabetes")
+    rng = np.random.default_rng(7)
+    test = rng.permutation(len(y))[: int(0.3 * len(y))]
+    mask = np.zeros(len(y), bool)
+    mask[test] = True
+    xtr, xte, ytr, yte = x[~mask], x[mask], y[~mask], y[mask]
+    m = LightGBMRegressor(
+        num_iterations=60, num_leaves=15, min_data_in_leaf=5, seed=7,
+        boosting_type=mode,
+    ).fit(DataFrame.from_dict({"features": xtr, "label": ytr}))
+    pred = m.transform(DataFrame.from_dict({"features": xte, "label": yte}))[
+        "prediction"
+    ]
+    r2 = 1 - np.sum((yte - pred) ** 2) / np.sum((yte - yte.mean()) ** 2)
+    assert_golden(goldens, f"diabetes.{mode}.R2", r2)
