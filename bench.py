@@ -249,7 +249,51 @@ def _bench_serving() -> dict:
     from mmlspark_tpu.serving.server import WorkerServer
 
     dim = 64
-    w = jnp.asarray(np.random.default_rng(2).normal(size=(dim, dim)).astype(np.float32))
+    w_host = np.random.default_rng(2).normal(size=(dim, dim)).astype(np.float32)
+
+    def measure(model) -> tuple:
+        def handler(reqs):
+            x = np.stack(
+                [np.asarray(json.loads(r.body)["x"], np.float32) for r in reqs]
+            )
+            pad = -len(x) % 8  # fixed-shape batch: pad to the 8-row bucket
+            if pad:
+                x = np.pad(x, ((0, pad), (0, 0)))
+            y = np.asarray(model(x))[: len(reqs)]
+            return {
+                r.id: (200, json.dumps({"y": float(v)}).encode(), {})
+                for r, v in zip(reqs, y)
+            }
+
+        srv = WorkerServer()
+        info = srv.start()
+        # max_wait_ms=0: no batch-accumulation wait — the continuous
+        # low-latency mode; throughput deployments raise it to batch harder
+        q = ServingQuery(srv, handler, max_wait_ms=0).start()
+        try:
+            payload = json.dumps({"x": [0.1] * dim})
+            conn = http.client.HTTPConnection("127.0.0.1", info.port, timeout=10)
+            lat = []
+            for i in range(300):
+                t0 = time.perf_counter()
+                conn.request(
+                    "POST", "/", body=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                lat.append((time.perf_counter() - t0) * 1e3)
+            conn.close()
+            lat = np.sort(np.asarray(lat[50:]))  # drop warmup requests
+            return (
+                round(float(lat[len(lat) // 2]), 3),
+                round(float(lat[int(len(lat) * 0.99)]), 3),
+            )
+        finally:
+            q.stop()
+            srv.stop()
+
+    w = jnp.asarray(w_host)
 
     @jax.jit
     def model(x):
@@ -259,46 +303,31 @@ def _bench_serving() -> dict:
         lambda: model(jnp.zeros((8, dim), jnp.float32)).block_until_ready(),
         "serving-model compile",
     )
-
-    def handler(reqs):
-        x = np.stack(
-            [np.asarray(json.loads(r.body)["x"], np.float32) for r in reqs]
-        )
-        pad = -len(x) % 8  # fixed-shape batch: pad to the 8-row bucket
-        if pad:
-            x = np.pad(x, ((0, pad), (0, 0)))
-        y = np.asarray(model(jnp.asarray(x)))[: len(reqs)]
-        return {
-            r.id: (200, json.dumps({"y": float(v)}).encode(), {})
-            for r, v in zip(reqs, y)
-        }
-
-    srv = WorkerServer()
-    info = srv.start()
-    # max_wait_ms=0: no batch-accumulation wait — the continuous low-latency
-    # mode; throughput-oriented deployments raise it to batch harder
-    q = ServingQuery(srv, handler, max_wait_ms=0).start()
+    p50, p99 = measure(lambda x: model(jnp.asarray(x)))
+    out = {"serving_p50_ms": p50, "serving_p99_ms": p99}
+    # the reference's sub-ms claim is for EXECUTOR-LOCAL serving (model on
+    # the machine answering the request, docs/mmlspark-serving.md:142-146).
+    # When the accelerator is behind a remote relay, every request pays the
+    # relay's RPC floor; measure the model-on-serving-host deployment shape
+    # separately so the capability is visible next to the remote number.
     try:
-        payload = json.dumps({"x": [0.1] * dim})
-        conn = http.client.HTTPConnection("127.0.0.1", info.port, timeout=10)
-        lat = []
-        for i in range(300):
-            t0 = time.perf_counter()
-            conn.request(
-                "POST", "/", body=payload, headers={"Content-Type": "application/json"}
-            )
-            resp = conn.getresponse()
-            resp.read()
-            lat.append((time.perf_counter() - t0) * 1e3)
-        conn.close()
-        lat = np.sort(np.asarray(lat[50:]))  # drop warmup requests
-        return {
-            "serving_p50_ms": round(float(lat[len(lat) // 2]), 3),
-            "serving_p99_ms": round(float(lat[int(len(lat) * 0.99)]), 3),
-        }
-    finally:
-        q.stop()
-        srv.stop()
+        cpu = jax.local_devices(backend="cpu")[0]
+        w_cpu = jax.device_put(w_host, cpu)
+        local_model = jax.jit(lambda x: jnp.tanh(x @ w_cpu).sum(axis=-1))
+
+        def run_local(x):
+            # explicit placement: the serving handler runs in its own
+            # thread, where a default_device context would not apply
+            return local_model(jax.device_put(np.asarray(x, np.float32), cpu))
+
+        run_local(np.zeros((8, dim), np.float32)).block_until_ready()
+        p50l, p99l = measure(run_local)
+        if abs(p50l - p50) > 1e-9:
+            out["serving_local_p50_ms"] = p50l
+            out["serving_local_p99_ms"] = p99l
+    except Exception as e:  # noqa: BLE001
+        out["serving_local_error"] = str(e)[:200]
+    return out
 
 
 def run_bench() -> None:

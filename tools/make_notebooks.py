@@ -1,0 +1,329 @@
+"""Generate the sample notebooks under notebooks/samples/.
+
+The reference ships ~25 runnable sample notebooks exercised end-to-end by
+its CI (notebooks/samples/*.ipynb, nbtest/NotebookTests.scala:16-51). The
+TPU rebuild keeps the same idea: every notebook here is executed by
+tests/test_notebooks.py on every run. Notebooks are generated from this
+script so content stays reviewable and regenerable:
+
+    python tools/make_notebooks.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "notebooks", "samples")
+
+
+def nb(cells: list) -> dict:
+    return {
+        "cells": [
+            {
+                "cell_type": kind,
+                "metadata": {},
+                **(
+                    {"source": src.splitlines(keepends=True)}
+                    if kind == "markdown"
+                    else {
+                        "source": src.splitlines(keepends=True),
+                        "outputs": [],
+                        "execution_count": None,
+                    }
+                ),
+            }
+            for kind, src in cells
+        ],
+        "metadata": {
+            "kernelspec": {"display_name": "Python 3", "language": "python",
+                           "name": "python3"},
+            "language_info": {"name": "python", "version": "3"},
+        },
+        "nbformat": 4,
+        "nbformat_minor": 5,
+    }
+
+
+# every notebook resolves committed datasets relative to the repo root (the
+# runner test sets cwd to the repo root, like the reference's nbtest runs
+# notebooks from the workspace root)
+_DATA = (
+    "import os\n"
+    "data_dir = os.path.join(os.getcwd(), 'tests', 'resources', 'data')\n"
+)
+
+NOTEBOOKS = {
+    # reference: Classification - Adult Census.ipynb (TrainClassifier flow)
+    "Classification - Breast Cancer with GBDT.ipynb": [
+        ("markdown",
+         "# Classification with the GBDT (LightGBM equivalent)\n\n"
+         "The reference's *Classification - Adult Census* flow: load a real\n"
+         "tabular dataset, train a boosted-tree classifier, and compute a\n"
+         "full metrics DataFrame with `ComputeModelStatistics`."),
+        ("code",
+         _DATA +
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.io.csv import read_csv\n\n"
+         "raw = read_csv(os.path.join(data_dir, 'breast_cancer.csv'))\n"
+         "feat_cols = [c for c in raw.columns if c != 'label']\n"
+         "x = np.stack([np.asarray(raw[c], np.float64) for c in feat_cols], 1)\n"
+         "df = DataFrame.from_dict({'features': x.astype(np.float32),\n"
+         "                          'label': np.asarray(raw['label'])})\n"
+         "len(df.columns), df.count()"),
+        ("code",
+         "from mmlspark_tpu.models.gbdt import LightGBMClassifier\n\n"
+         "model = LightGBMClassifier(num_iterations=30, num_leaves=31,\n"
+         "                           boosting_type='goss').fit(df)\n"
+         "scored = model.transform(df)\n"
+         "scored['prediction'][:10]"),
+        ("code",
+         "from mmlspark_tpu.train import ComputeModelStatistics\n\n"
+         "stats = ComputeModelStatistics(\n"
+         "    label_col='label', scored_probabilities_col='probability'\n"
+         ").transform(scored)\n"
+         "auc = float(stats['AUC'][0])\n"
+         "assert auc > 0.98, auc\n"
+         "print('AUC', auc)"),
+    ],
+    # reference: Classification - Twitter Sentiment with Vowpal Wabbit.ipynb
+    "Classification - Text with Vowpal Wabbit.ipynb": [
+        ("markdown",
+         "# Online text classification with the VW-equivalent learner\n\n"
+         "Hashed sparse text features -> device SGD with per-pass weight\n"
+         "averaging (the spanning-tree allreduce analogue). Mirrors the\n"
+         "reference's Twitter-sentiment VW notebook."),
+        ("code",
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer\n\n"
+         "rng = np.random.default_rng(0)\n"
+         "pos = 'great fantastic love wonderful best amazing superb'.split()\n"
+         "neg = 'terrible awful hate worst broken horrible useless'.split()\n"
+         "texts, labels = [], []\n"
+         "for i in range(400):\n"
+         "    words = rng.choice(pos if i % 2 == 0 else neg, size=4)\n"
+         "    texts.append(' '.join(words))\n"
+         "    labels.append(float(i % 2 == 0))\n"
+         "labels = np.array(labels)\n"
+         "df = DataFrame.from_dict({'text': np.array(texts, object), 'label': labels})\n"
+         "fdf = VowpalWabbitFeaturizer(input_cols=['text'],\n"
+         "                             output_col='features').transform(df)"),
+        ("code",
+         "model = VowpalWabbitClassifier(num_passes=3).fit(fdf)\n"
+         "pred = model.transform(fdf)['prediction']\n"
+         "acc = float((pred == labels).mean())\n"
+         "assert acc > 0.95, acc\n"
+         "print('accuracy', acc)"),
+        ("code",
+         "# per-partition training diagnostics (TrainingStats analogue)\n"
+         "model.get_performance_statistics().to_dict()"),
+    ],
+    # reference: DeepLearning - Flowers.ipynb (transfer learning)
+    "DeepLearning - Transfer Learning with ImageFeaturizer.ipynb": [
+        ("markdown",
+         "# Transfer learning with ImageFeaturizer\n\n"
+         "The reference's flagship flow (*DeepLearning - Flowers*): a\n"
+         "headless zoo backbone featurizes images, a cheap linear head\n"
+         "trains on top. The packaged `ResNet8_Digits` checkpoint ships\n"
+         "TRAINED weights, so features carry real semantic content."),
+        ("code",
+         _DATA +
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.io.csv import read_csv\n\n"
+         "raw = read_csv(os.path.join(data_dir, 'digits.csv'))\n"
+         "feat_cols = [c for c in raw.columns if c != 'label']\n"
+         "x = np.stack([np.asarray(raw[c], np.float64) for c in feat_cols], 1)\n"
+         "imgs = np.repeat((x.reshape(-1, 8, 8, 1) * (255 / 16)).astype(np.uint8),\n"
+         "                 3, axis=-1)  # grayscale -> RGB\n"
+         "y = np.asarray(raw['label'])\n"
+         "df = DataFrame.from_dict({'image': imgs, 'label': y})\n"
+         "imgs.shape"),
+        ("code",
+         "from mmlspark_tpu.core.pipeline import Pipeline\n"
+         "from mmlspark_tpu.models import ImageFeaturizer\n"
+         "from mmlspark_tpu.models.linear import LogisticRegression\n\n"
+         "pipe = Pipeline(stages=[\n"
+         "    ImageFeaturizer(input_col='image', output_col='features',\n"
+         "                    model_name='ResNet8_Digits', cut_output_layers=1),\n"
+         "    LogisticRegression(max_iter=200),\n"
+         "])\n"
+         "model = pipe.fit(df)\n"
+         "pred = model.transform(df)['prediction']\n"
+         "acc = float((pred == y).mean())\n"
+         "assert acc > 0.9, acc\n"
+         "print('transfer-learning accuracy', acc)"),
+    ],
+    # reference: Interpretability - LIME explainers
+    "Interpretability - Tabular LIME.ipynb": [
+        ("markdown",
+         "# Model interpretability with Tabular LIME\n\n"
+         "Sample perturbation masks, score them with the trained model, and\n"
+         "solve a local lasso per row (vmapped ISTA on device) — the\n"
+         "reference's LIME flow."),
+        ("code",
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.lime import TabularLIME\n"
+         "from mmlspark_tpu.models.gbdt import LightGBMClassifier\n\n"
+         "rng = np.random.default_rng(1)\n"
+         "x = rng.normal(size=(400, 6)).astype(np.float32)\n"
+         "y = (x[:, 0] > 0).astype(np.float64)  # only feature 0 matters\n"
+         "df = DataFrame.from_dict({'features': x, 'label': y})\n"
+         "model = LightGBMClassifier(num_iterations=20).fit(df)"),
+        ("code",
+         "limed = TabularLIME(input_col='features', model=model,\n"
+         "                    n_samples=512, seed=0).fit(df)\n"
+         "explained = limed.transform(DataFrame.from_dict({'features': x[:16]}))\n"
+         "w = np.stack([np.asarray(r) for r in explained['weights']])\n"
+         "dominant = np.abs(w).argmax(axis=1)\n"
+         "assert (dominant == 0).mean() > 0.8, dominant\n"
+         "print('feature-0 dominance', float((dominant == 0).mean()))"),
+    ],
+    # reference: SparkServing - Deploying a Classifier.ipynb
+    "Serving - Low Latency Model Endpoints.ipynb": [
+        ("markdown",
+         "# Low-latency model serving\n\n"
+         "The Spark-Serving analogue: an HTTP ingress feeds fixed-shape\n"
+         "minibatches to a jitted model; replies return on the same\n"
+         "connection. Epoch queues + history replay give failure recovery."),
+        ("code",
+         "import json\n"
+         "import http.client\n"
+         "import numpy as np\n"
+         "import jax, jax.numpy as jnp\n"
+         "from mmlspark_tpu.serving.query import ServingQuery\n"
+         "from mmlspark_tpu.serving.server import WorkerServer\n\n"
+         "w = jnp.asarray(np.random.default_rng(2).normal(size=(8, 8)).astype(np.float32))\n"
+         "model = jax.jit(lambda x: jnp.tanh(x @ w).sum(axis=-1))\n\n"
+         "def handler(reqs):\n"
+         "    x = np.stack([np.asarray(json.loads(r.body)['x'], np.float32)\n"
+         "                  for r in reqs])\n"
+         "    pad = -len(x) % 8\n"
+         "    if pad:\n"
+         "        x = np.pad(x, ((0, pad), (0, 0)))\n"
+         "    y = np.asarray(model(jnp.asarray(x)))[: len(reqs)]\n"
+         "    return {r.id: (200, json.dumps({'y': float(v)}).encode(), {})\n"
+         "            for r, v in zip(reqs, y)}\n\n"
+         "srv = WorkerServer()\n"
+         "info = srv.start()\n"
+         "q = ServingQuery(srv, handler, max_wait_ms=0).start()"),
+        ("code",
+         "conn = http.client.HTTPConnection('127.0.0.1', info.port, timeout=10)\n"
+         "conn.request('POST', '/', body=json.dumps({'x': [0.1] * 8}))\n"
+         "reply = json.loads(conn.getresponse().read())\n"
+         "conn.close()\n"
+         "q.stop(); srv.stop()\n"
+         "assert 'y' in reply\n"
+         "reply"),
+    ],
+    # reference: HyperParameterTuning - Fighting Breast Cancer.ipynb
+    "HyperParameterTuning - Fighting Breast Cancer.ipynb": [
+        ("markdown",
+         "# Hyperparameter tuning\n\n"
+         "`TuneHyperparameters` runs a randomized search with k-fold CV and\n"
+         "a thread pool — the reference's AutoML notebook on the same\n"
+         "dataset (UCI breast cancer)."),
+        ("code",
+         _DATA +
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.automl import (DiscreteHyperParam, HyperparamBuilder,\n"
+         "                                 RangeHyperParam, TuneHyperparameters)\n"
+         "from mmlspark_tpu.io.csv import read_csv\n"
+         "from mmlspark_tpu.models.gbdt import LightGBMClassifier\n\n"
+         "raw = read_csv(os.path.join(data_dir, 'breast_cancer.csv'))\n"
+         "feat_cols = [c for c in raw.columns if c != 'label']\n"
+         "x = np.stack([np.asarray(raw[c], np.float64) for c in feat_cols], 1)\n"
+         "df = DataFrame.from_dict({'features': x.astype(np.float32),\n"
+         "                          'label': np.asarray(raw['label'])})\n"
+         "space = (HyperparamBuilder()\n"
+         "         .add_hyperparam('num_leaves', DiscreteHyperParam([7, 15, 31]))\n"
+         "         .add_hyperparam('learning_rate', RangeHyperParam(0.05, 0.3))\n"
+         "         .build())\n"
+         "tuner = TuneHyperparameters(\n"
+         "    models=[LightGBMClassifier(num_iterations=15)], hyperparams=space,\n"
+         "    evaluation_metric='AUC', number_of_folds=3, number_of_runs=4,\n"
+         "    label_col='label', seed=0)\n"
+         "best = tuner.fit(df)\n"
+         "print('best AUC', best.get('best_metric'), best.get('best_params'))\n"
+         "assert best.get('best_metric') > 0.97"),
+    ],
+    # reference: CyberML - Anomalous Access Detection.ipynb
+    "CyberML - Anomalous Access Detection.ipynb": [
+        ("markdown",
+         "# CyberML: anomalous access detection\n\n"
+         "Per-tenant collaborative filtering on user->resource access\n"
+         "counts; cross-department accesses score anomalously high. The\n"
+         "reference's python-only CyberML flow on its synthetic dataset."),
+        ("code",
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.cyber import AccessAnomaly, synthetic_access_df\n\n"
+         "df = synthetic_access_df(n_departments=3, users_per_dept=8,\n"
+         "                         resources_per_dept=6, accesses_per_user=25,\n"
+         "                         cross_dept_prob=0.0, seed=0)\n"
+         "model = AccessAnomaly(rank=6, max_iter=10, seed=1).fit(df)"),
+        ("code",
+         "normal = DataFrame.from_dict({\n"
+         "    'tenant': np.zeros(3, np.int64),\n"
+         "    'user': np.array(['t0_d0_u0', 't0_d1_u1', 't0_d2_u2'], object),\n"
+         "    'res': np.array(['t0_d0_r0', 't0_d1_r1', 't0_d2_r2'], object)})\n"
+         "abnormal = DataFrame.from_dict({\n"
+         "    'tenant': np.zeros(3, np.int64),\n"
+         "    'user': np.array(['t0_d0_u0', 't0_d1_u1', 't0_d2_u2'], object),\n"
+         "    'res': np.array(['t0_d1_r0', 't0_d2_r1', 't0_d0_r2'], object)})\n"
+         "lo = float(np.mean(model.transform(normal)['anomaly_score']))\n"
+         "hi = float(np.mean(model.transform(abnormal)['anomaly_score']))\n"
+         "print('in-department', lo, 'cross-department', hi)\n"
+         "assert hi > lo"),
+    ],
+    # reference: Recommendation - SAR.ipynb
+    "Recommendation - SAR Item Recommender.ipynb": [
+        ("markdown",
+         "# SAR recommender\n\n"
+         "Item-item co-occurrence similarity (jaccard) x time-decayed user\n"
+         "affinity, scored as one device matmul — the reference's SAR\n"
+         "notebook flow."),
+        ("code",
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.recommendation import SAR\n\n"
+         "rng = np.random.default_rng(3)\n"
+         "n_users, n_items = 50, 30\n"
+         "rows = []\n"
+         "for u in range(n_users):\n"
+         "    liked = rng.choice(n_items // 2, size=6, replace=False)\n"
+         "    liked = liked * 2 + (u % 2)  # even users like even items\n"
+         "    rows += [(u, int(i), 1.0, 1_600_000_000.0 + u) for i in liked]\n"
+         "arr = np.array(rows)\n"
+         "df = DataFrame.from_dict({'user_idx': arr[:, 0].astype(np.int64),\n"
+         "                          'item_idx': arr[:, 1].astype(np.int64),\n"
+         "                          'rating': arr[:, 2],\n"
+         "                          'time': arr[:, 3]})\n"
+         "model = SAR(time_col='time', similarity_function='jaccard',\n"
+         "            support_threshold=1).fit(df)\n"
+         "recs = model.recommend_for_all_users(k=5)\n"
+         "users = np.asarray(recs['user_idx'])\n"
+         "match = np.concatenate([np.asarray(r) % 2 == u % 2\n"
+         "                        for u, r in zip(users, recs['recommendations'])])\n"
+         "print('same-parity recommendation rate', float(match.mean()))\n"
+         "assert match.mean() > 0.9"),
+    ],
+}
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    for name, cells in NOTEBOOKS.items():
+        path = os.path.join(OUT, name)
+        with open(path, "w") as f:
+            json.dump(nb(cells), f, indent=1)
+        print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()
