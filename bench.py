@@ -310,6 +310,8 @@ def _bench_serving() -> dict:
     # When the accelerator is behind a remote relay, every request pays the
     # relay's RPC floor; measure the model-on-serving-host deployment shape
     # separately so the capability is visible next to the remote number.
+    if jax.default_backend() == "cpu":
+        return out  # the measurement above already IS model-on-host
     try:
         cpu = jax.local_devices(backend="cpu")[0]
         w_cpu = jax.device_put(w_host, cpu)
@@ -322,9 +324,8 @@ def _bench_serving() -> dict:
 
         run_local(np.zeros((8, dim), np.float32)).block_until_ready()
         p50l, p99l = measure(run_local)
-        if abs(p50l - p50) > 1e-9:
-            out["serving_local_p50_ms"] = p50l
-            out["serving_local_p99_ms"] = p99l
+        out["serving_local_p50_ms"] = p50l
+        out["serving_local_p99_ms"] = p99l
     except Exception as e:  # noqa: BLE001
         out["serving_local_error"] = str(e)[:200]
     return out

@@ -206,6 +206,33 @@ def grouped_ndcg(
     return total / max(n_groups, 1)
 
 
+def _local_block_rows(garr: Any, n: int) -> np.ndarray:
+    """First ``n`` rows of THIS process's block of a process-stacked global
+    array (the layout shard_batch_multihost builds: one contiguous block
+    per process, local padding at the block tail)."""
+    shards = sorted(
+        garr.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    block = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    return block[:n]
+
+
+def _gather_rows(local: np.ndarray, n: int, share: int) -> np.ndarray:
+    """Pad this process's first-n rows to the common block size and
+    allgather -> (nproc * share, ...) global rows (padding rows are 0).
+    Every process computes validation metrics on the identical gathered
+    arrays, so early-stopping decisions stay convergent across SPMD
+    processes (divergent control flow would deadlock the next collective).
+    """
+    import jax.experimental.multihost_utils as mhu
+
+    local = local.reshape(n, -1).astype(np.float64)
+    buf = np.zeros((share, local.shape[1]), np.float64)
+    buf[:n] = local
+    ga = np.asarray(mhu.process_allgather(buf))
+    return ga.reshape(-1, local.shape[1])
+
+
 def _eval_metric(
     cfg: TrainConfig,
     scores: np.ndarray,
@@ -399,13 +426,10 @@ def train(
         unsupported = [
             name
             for flag, name in (
-                (cfg.boosting_type == "dart", "dart"),
+                # lambdarank gradients need group-contiguous global sorts;
+                # voting's shard_map grower is untested across processes
                 (cfg.objective == "lambdarank", "lambdarank"),
-                (init_booster is not None, "continued training"),
-                (valid_mask is not None, "validation/early stopping"),
                 (cfg.parallelism == "voting_parallel", "voting_parallel"),
-                (sparse_input, "sparse input"),
-                (bool(cat_features), "categorical features"),
             )
             if flag
         ]
@@ -417,7 +441,8 @@ def train(
     if multihost:
         # bin bounds must be IDENTICAL on every process: fit the mapper on
         # a NaN-padded sample allgathered from all processes (NaN rows are
-        # ignored by quantile fitting)
+        # ignored by quantile fitting; for sparse inputs absent entries
+        # densify to NaN, matching the missing-bin transform semantics)
         import jax.experimental.multihost_utils as mhu
 
         # FIXED buffer size (process-count-based only): processes may hold
@@ -428,10 +453,46 @@ def train(
         take = np.random.default_rng(cfg.seed).choice(
             n, min(n, k_s), replace=False
         )
-        samp[: len(take)] = np.asarray(x[take], np.float32)
+        samp[: len(take)] = (
+            _densify(x[take]) if sparse_input else np.asarray(x[take], np.float32)
+        )
+        if cat_features:
+            if sparse_input:
+                # match the single-host BinMapper error exactly — the
+                # sample-densified path must not silently accept what one
+                # process would reject
+                raise ValueError(
+                    "categorical features require dense input (sparse "
+                    "columns have no stable category<->bin identity for "
+                    "absent entries)"
+                )
+            # categorical hi must cover every category present ANYWHERE,
+            # not just in the capped sample: allgather full-column extrema
+            # (also makes the range validation a globally identical
+            # decision — a raise on one process only would desync SPMD)
+            ext = np.zeros((len(cat_features), 2), np.float64)
+            for j, f in enumerate(cat_features):
+                col = np.asarray(x[:, f], np.float64)
+                col = col[~np.isnan(col)]
+                ext[j] = (col.min(), col.max()) if len(col) else (0.0, 0.0)
+            gext = np.asarray(mhu.process_allgather(ext))
+            gmin = gext[..., 0].min(axis=0)
+            gmax = gext[..., 1].max(axis=0)
+            bad = np.flatnonzero((gmin < 0) | (gmax > cfg.max_bin - 2))
+            if len(bad):
+                raise ValueError(
+                    f"categorical features {[cat_features[b] for b in bad]} "
+                    f"have values outside [0, {cfg.max_bin - 2}] — "
+                    "re-index categories first"
+                )
+            # plant the global max into this process's sample so the
+            # fitted identity range covers the unsampled tail everywhere
+            for j, f in enumerate(cat_features):
+                samp[0, f] = gmax[j]
         global_sample = np.asarray(mhu.process_allgather(samp)).reshape(-1, d)
         mapper = BinMapper.fit(
-            global_sample, max_bin=cfg.max_bin, seed=cfg.seed
+            global_sample, max_bin=cfg.max_bin, seed=cfg.seed,
+            categorical_features=cat_features,
         )
     else:
         mapper = BinMapper.fit(
@@ -596,6 +657,7 @@ def train(
     best_iter = -1
     rounds_no_improve = 0
     bag = None
+    mh_eval_ctx = None  # lazily gathered (y, valid) global eval arrays
 
     for it in range(cfg.num_iterations):
         it_key = jax.random.fold_in(base_key, it)
@@ -704,12 +766,32 @@ def train(
                     t.values = (t.values * nf_drop).astype(t.values.dtype)
             scores = scores - padded(drop_contrib * (1.0 - nf_drop))
 
-        # eval + early stopping on validation rows (the only host sync)
-        if valid_mask is not None and valid_mask.any():
-            s_eval = np.asarray(scores)[:n]
-            if is_rf:
-                s_eval = np.asarray(rf_base)[:n] + s_eval / (it + 1)
-            name, val, higher = _eval_metric(cfg, s_eval, y, valid_mask, group_ids)
+        # eval + early stopping on validation rows (the only host sync).
+        # Multihost: every process must take this branch together — the
+        # allgather inside is a collective
+        if valid_mask is not None and (multihost or valid_mask.any()):
+            if multihost:
+                s_eval = _local_block_rows(scores, n)
+                if is_rf:
+                    s_eval = _local_block_rows(rf_base, n) + s_eval / (it + 1)
+                if mh_eval_ctx is None:
+                    # y and the valid mask are loop-invariant: one gather
+                    ym = _gather_rows(
+                        np.stack([y, valid_mask.astype(np.float64)], 1),
+                        n, share,
+                    )
+                    mh_eval_ctx = (ym[:, 0], ym[:, 1] > 0.5)
+                y_g, m_g = mh_eval_ctx
+                sg2 = _gather_rows(s_eval, n, share)
+                s_g = sg2 if k > 1 else sg2[:, 0]
+                if not m_g.any():
+                    continue
+                name, val, higher = _eval_metric(cfg, s_g, y_g, m_g, None)
+            else:
+                s_eval = np.asarray(scores)[:n]
+                if is_rf:
+                    s_eval = np.asarray(rf_base)[:n] + s_eval / (it + 1)
+                name, val, higher = _eval_metric(cfg, s_eval, y, valid_mask, group_ids)
             if cfg.verbosity > 0:
                 log.info("iter %d %s=%.6f", it, name, val)
             improved = (

@@ -109,12 +109,44 @@ GBDT_WORKER = textwrap.dedent(
                       min_data_in_leaf=5, seed=3)
     b = train(x_all[lo:hi], y_all[lo:hi], cfg)
     print("MODEL:" + b.to_model_string(), flush=True)
-    # the replicated-mask paths: goss sampling and rf's forced bagging
-    for mode in ("goss", "rf"):
+    # the replicated-mask paths: goss sampling, rf's forced bagging, and
+    # dart's replicated drop draws + eager tree rescaling
+    for mode in ("goss", "rf", "dart"):
         cfg2 = TrainConfig(objective="binary", num_iterations=3, num_leaves=7,
                            min_data_in_leaf=5, seed=3, boosting_type=mode)
         bm = train(x_all[lo:hi], y_all[lo:hi], cfg2)
         print(f"MODE:{mode}:" + bm.to_model_string()[:64], flush=True)
+
+    # categorical feature split across processes (identity binning must
+    # agree through the allgathered mapper sample)
+    xc = x_all.copy()
+    xc[:, 7] = np.floor(np.abs(xc[:, 7]) * 2) % 4
+    cfgc = TrainConfig(objective="binary", num_iterations=3, num_leaves=7,
+                       min_data_in_leaf=5, seed=3, categorical_features=(7,))
+    bc = train(xc[lo:hi], y_all[lo:hi], cfgc)
+    print("MODE:cat:" + bc.to_model_string()[:64], flush=True)
+
+    # sparse CSR input (absent entries -> missing bin) across processes
+    import scipy.sparse as sp
+    xs = x_all.copy(); xs[np.abs(xs) < 0.3] = 0.0
+    bs_ = train(sp.csr_matrix(xs[lo:hi]), y_all[lo:hi], cfg)
+    print("MODE:sparse:" + bs_.to_model_string()[:64], flush=True)
+
+    # continued training: merge must replay identically on every process
+    b2 = train(x_all[lo:hi], y_all[lo:hi],
+               TrainConfig(objective="binary", num_iterations=2, num_leaves=7,
+                           min_data_in_leaf=5, seed=4),
+               init_booster=b)
+    print("MODE:cont:%d:" % len(b2.trees) + b2.to_model_string()[:48], flush=True)
+
+    # validation + early stopping: the metric is allgathered, so both
+    # processes must stop at the SAME iteration
+    vm = np.zeros(hi - lo, bool); vm[-60:] = True
+    be = train(x_all[lo:hi], y_all[lo:hi],
+               TrainConfig(objective="binary", num_iterations=25, num_leaves=7,
+                           min_data_in_leaf=5, seed=3, early_stopping_round=2),
+               valid_mask=vm)
+    print("MODE:es:%d:" % be.best_iteration + be.to_model_string()[:48], flush=True)
     """
 )
 
@@ -154,9 +186,9 @@ def test_two_process_gbdt_training(tmp_path):
     for i, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"proc{i} rc={rc}\n{err[-3000:]}"
         models.append(out.split("MODEL:", 1)[1].splitlines()[0].strip())
-    # SPMD determinism: same trees on every process
+    # SPMD determinism: same trees on every process, for every capability
     assert models[0] == models[1]
-    for mode in ("goss", "rf"):
+    for mode in ("goss", "rf", "dart", "cat", "sparse", "cont", "es"):
         tags = [out.split(f"MODE:{mode}:", 1)[1].splitlines()[0]
                 for _, out, _ in outs]
         assert tags[0] == tags[1], mode
