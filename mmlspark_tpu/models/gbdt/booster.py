@@ -142,6 +142,10 @@ class Booster:
 
     @staticmethod
     def from_model_string(s: str) -> "Booster":
+        if not s.lstrip().startswith("{"):
+            # LightGBM's own text format (starts with the "tree" section):
+            # accept it transparently so reference-trained models load
+            return Booster.from_lightgbm_string(s)
         d = json.loads(s)
         b = Booster(
             trees=[Tree.from_dict(t) for t in d["trees"]],
@@ -154,6 +158,22 @@ class Booster:
             boosting_type=d.get("boosting_type", "gbdt"),
         )
         return b
+
+    def to_lightgbm_string(self) -> str:
+        """Serialize in LightGBM's native text format (saveNativeModel
+        analogue, LightGBMBooster.scala) — loadable by python ``lightgbm``,
+        the CLI, and the reference."""
+        from mmlspark_tpu.models.gbdt.lgbm_format import to_lightgbm_string
+
+        return to_lightgbm_string(self)
+
+    @staticmethod
+    def from_lightgbm_string(s: str) -> "Booster":
+        """Parse a native LightGBM text model (loadNativeModelFromString
+        analogue) — models trained with the reference carry over."""
+        from mmlspark_tpu.models.gbdt.lgbm_format import from_lightgbm_string
+
+        return from_lightgbm_string(s)
 
     def merge(self, other: "Booster") -> "Booster":
         """Continued training: append other's trees (BoosterMerge analogue)."""

@@ -192,6 +192,31 @@ class _LightGBMParams(
         )
 
 
+class _NativeModelIO:
+    """Native LightGBM model interop on every model facade — the
+    reference's saveNativeModel / loadNativeModelFromFile / ...FromString
+    (lightgbm/LightGBMClassifier.scala). ``model_string`` transparently
+    accepts BOTH our JSON format and LightGBM's text format, so a model
+    trained with the reference (or python lightgbm) drops straight in."""
+
+    def save_native_model(self, path: str) -> None:
+        """Write the booster in LightGBM's own text format."""
+        with open(path, "w") as f:
+            f.write(self.booster.to_lightgbm_string())
+
+    @classmethod
+    def load_native_model_from_string(cls, text: str, **kw: Any):
+        m = cls(**kw)
+        m.set(model_string=text)
+        m.booster  # parse eagerly: malformed input fails here, not at transform
+        return m
+
+    @classmethod
+    def load_native_model_from_file(cls, path: str, **kw: Any):
+        with open(path) as f:
+            return cls.load_native_model_from_string(f.read(), **kw)
+
+
 class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPredictionCol, HasPredictionCol):
     objective = Param("binary | multiclass", default="binary", type_=str)
 
@@ -226,7 +251,7 @@ class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPr
 
 
 class LightGBMClassificationModel(
-    Model, HasFeaturesCol, HasPredictionCol, HasProbabilityCol, HasRawPredictionCol
+    Model, _NativeModelIO, HasFeaturesCol, HasPredictionCol, HasProbabilityCol, HasRawPredictionCol
 ):
     model_string = Param("serialized booster", default="", type_=str)
 
@@ -297,7 +322,7 @@ class LightGBMRegressor(Estimator, _LightGBMParams, HasPredictionCol):
         return m
 
 
-class LightGBMRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
+class LightGBMRegressionModel(Model, _NativeModelIO, HasFeaturesCol, HasPredictionCol):
     model_string = Param("serialized booster", default="", type_=str)
 
     def __init__(self, **kw: Any):
@@ -354,7 +379,7 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol, HasPredictionCol):
         return m
 
 
-class LightGBMRankerModel(Model, HasFeaturesCol, HasPredictionCol):
+class LightGBMRankerModel(Model, _NativeModelIO, HasFeaturesCol, HasPredictionCol):
     model_string = Param("serialized booster", default="", type_=str)
 
     def __init__(self, **kw: Any):
