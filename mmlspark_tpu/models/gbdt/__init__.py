@@ -1,5 +1,6 @@
 from mmlspark_tpu.models.gbdt.binning import BinMapper
 from mmlspark_tpu.models.gbdt.booster import Booster, Tree
+from mmlspark_tpu.models.gbdt.delegate import LightGBMDelegate
 from mmlspark_tpu.models.gbdt.train import TrainConfig, train
 from mmlspark_tpu.models.gbdt.estimators import (
     LightGBMClassificationModel,
@@ -14,6 +15,7 @@ __all__ = [
     "BinMapper",
     "Booster",
     "Tree",
+    "LightGBMDelegate",
     "TrainConfig",
     "train",
     "LightGBMClassifier",

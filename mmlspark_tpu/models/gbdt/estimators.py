@@ -96,6 +96,9 @@ class _LightGBMParams(
     )
     model_string = Param("initial model for continued training", default="", type_=str)
     num_batches = Param("fold training into k sequential batches", default=0, type_=int)
+    delegate = ComplexParam(
+        "LightGBMDelegate: lifecycle callbacks + dynamic learning rate"
+    )
     seed = Param("rng seed", default=0, type_=int)
     verbosity = Param("log level", default=-1, type_=int)
 
@@ -124,6 +127,7 @@ class _LightGBMParams(
             verbosity=self.get("verbosity"),
             categorical_features=tuple(self.get("categorical_slot_indexes") or ()),
             boosting_type=self.get("boosting_type"),
+            delegate=self.get("delegate"),
             drop_rate=self.get("drop_rate"),
             max_drop=self.get("max_drop"),
             skip_drop=self.get("skip_drop"),
@@ -159,6 +163,7 @@ class _LightGBMParams(
         segments continue from a booster whose predictions include it)."""
         nb = self.get("num_batches")
         booster = self._init_booster()
+        delegate = self.get("delegate")
         if nb and nb > 1:
             n = len(data["y"])
             bounds = np.linspace(0, n, nb + 1).astype(int)
@@ -167,6 +172,8 @@ class _LightGBMParams(
                 kw_sl = {
                     k: (v[sl] if isinstance(v, np.ndarray) else v) for k, v in kw.items()
                 }
+                if delegate is not None:
+                    delegate.before_train_batch(i, bounds[i + 1] - bounds[i], booster)
                 booster = train(
                     data["x"][sl],
                     data["y"][sl],
@@ -178,6 +185,8 @@ class _LightGBMParams(
                     base_score=0.0 if booster is not None else base_score,
                     **kw_sl,
                 )
+                if delegate is not None:
+                    delegate.after_train_batch(i, booster)
             return booster
         return train(
             data["x"],

@@ -93,6 +93,9 @@ class TrainConfig:
     other_rate: float = 0.1
     # lambdarank eval truncation: NDCG@eval_at on the validation rows
     eval_at: int = 5
+    # training-lifecycle callbacks + dynamic learning rate
+    # (LightGBMDelegate analogue, models/gbdt/delegate.py)
+    delegate: Optional[Any] = None
 
 
 _TREE_FIELDS = (
@@ -659,7 +662,15 @@ def train(
     bag = None
     mh_eval_ctx = None  # lazily gathered (y, valid) global eval arrays
 
+    delegate = cfg.delegate
+    lr_cur = float(cfg.learning_rate)
+
     for it in range(cfg.num_iterations):
+        if delegate is not None:
+            delegate.before_train_iteration(it)
+            # dynamic learning rate (getLearningRate delegate semantics);
+            # lr is a dynamic jit arg, so no recompile on change
+            lr_cur = float(delegate.get_learning_rate(it, lr_cur))
         it_key = jax.random.fold_in(base_key, it)
         # bagging for this iteration (device mask, no host transfer)
         if bagging_freq > 0 and bagging_fraction < 1.0:
@@ -720,7 +731,7 @@ def train(
             float(cfg.top_rate), float(cfg.other_rate),
             float(cfg.lambda_l2), float(cfg.lambda_l1),
             float(cfg.min_sum_hessian_in_leaf), float(cfg.min_gain_to_split),
-            1.0 if is_rf else float(cfg.learning_rate),
+            1.0 if is_rf else lr_cur,
             objective=cfg.objective, k=k, grad_pre=grad_pre, is_goss=is_goss,
             use_voting=use_voting, has_cat=cat_mask_dev is not None,
             num_leaves=int(cfg.num_leaves), max_depth=int(cfg.max_depth),
@@ -769,7 +780,10 @@ def train(
         # eval + early stopping on validation rows (the only host sync).
         # Multihost: every process must take this branch together — the
         # allgather inside is a collective
+        eval_result = None
+        stop_now = False
         if valid_mask is not None and (multihost or valid_mask.any()):
+            name = None
             if multihost:
                 s_eval = _local_block_rows(scores, n)
                 if is_rf:
@@ -784,29 +798,36 @@ def train(
                 y_g, m_g = mh_eval_ctx
                 sg2 = _gather_rows(s_eval, n, share)
                 s_g = sg2 if k > 1 else sg2[:, 0]
-                if not m_g.any():
-                    continue
-                name, val, higher = _eval_metric(cfg, s_g, y_g, m_g, None)
+                if m_g.any():
+                    name, val, higher = _eval_metric(cfg, s_g, y_g, m_g, None)
             else:
                 s_eval = np.asarray(scores)[:n]
                 if is_rf:
                     s_eval = np.asarray(rf_base)[:n] + s_eval / (it + 1)
                 name, val, higher = _eval_metric(cfg, s_eval, y, valid_mask, group_ids)
-            if cfg.verbosity > 0:
-                log.info("iter %d %s=%.6f", it, name, val)
-            improved = (
-                best_val is None
-                or (higher and val > best_val)
-                or (not higher and val < best_val)
+            if name is not None:
+                eval_result = (name, val, higher)
+                if cfg.verbosity > 0:
+                    log.info("iter %d %s=%.6f", it, name, val)
+                improved = (
+                    best_val is None
+                    or (higher and val > best_val)
+                    or (not higher and val < best_val)
+                )
+                if improved:
+                    best_val, best_iter, rounds_no_improve = val, it + 1, 0
+                else:
+                    rounds_no_improve += 1
+                    if early_stopping_round > 0 and rounds_no_improve >= early_stopping_round:
+                        log.info("early stop at iter %d (best %d)", it, best_iter)
+                        booster.best_iteration = best_iter
+                        stop_now = True
+        if delegate is not None:
+            delegate.after_train_iteration(
+                it, eval_result, stop_now or it == cfg.num_iterations - 1
             )
-            if improved:
-                best_val, best_iter, rounds_no_improve = val, it + 1, 0
-            else:
-                rounds_no_improve += 1
-                if early_stopping_round > 0 and rounds_no_improve >= early_stopping_round:
-                    log.info("early stop at iter %d (best %d)", it, best_iter)
-                    booster.best_iteration = best_iter
-                    break
+        if stop_now:
+            break
 
     booster.trees.extend(_trees_from_device_batched(pending_trees, mapper))
     # dart never records best_iteration: later dropouts rescale trees inside

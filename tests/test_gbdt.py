@@ -669,3 +669,101 @@ def test_min_sum_hessian_blocks_splits():
     s_few = sum(t.num_splits for t in few.trees)
     s_many = sum(t.num_splits for t in many.trees)
     assert s_few < s_many  # large hessian floor prunes candidate splits
+
+
+class TestDelegate:
+    """LightGBMDelegate parity: lifecycle callbacks + dynamic learning rate
+    (lightgbm/LightGBMDelegate.scala, invoked at TrainUtils.scala:192-218)."""
+
+    def test_iteration_hooks_and_dynamic_lr(self):
+        from mmlspark_tpu.models.gbdt import (
+            LightGBMDelegate,
+            TrainConfig,
+            train,
+        )
+
+        events = []
+
+        class Recorder(LightGBMDelegate):
+            def before_train_iteration(self, it):
+                events.append(("before", it))
+
+            def after_train_iteration(self, it, eval_result, is_finished):
+                events.append(("after", it, is_finished))
+
+            def get_learning_rate(self, it, prev):
+                return prev * 0.5  # halve every iteration
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(300, 5)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float64)
+        cfg = TrainConfig(objective="binary", num_iterations=3, num_leaves=7,
+                          min_data_in_leaf=5, seed=0, learning_rate=0.4,
+                          delegate=Recorder())
+        b = train(x, y, cfg)
+        assert [e for e in events if e[0] == "before"] == [
+            ("before", 0), ("before", 1), ("before", 2)]
+        assert events[-1] == ("after", 2, True)
+        # halved lr shrinks later trees: compare leaf magnitude vs fixed lr
+        b_fixed = train(x, y, TrainConfig(
+            objective="binary", num_iterations=3, num_leaves=7,
+            min_data_in_leaf=5, seed=0, learning_rate=0.4))
+        dyn = np.abs(b.trees[2].values).max()
+        fixed = np.abs(b_fixed.trees[2].values).max()
+        assert dyn < fixed * 0.6, (dyn, fixed)
+        # iteration 0 used lr 0.2 (halved before the first tree)
+        np.testing.assert_allclose(
+            b.trees[0].values, b_fixed.trees[0].values * 0.5, rtol=1e-5)
+
+    def test_early_stop_reports_finished(self):
+        from mmlspark_tpu.models.gbdt import (
+            LightGBMDelegate,
+            TrainConfig,
+            train,
+        )
+
+        finishes = []
+
+        class Watcher(LightGBMDelegate):
+            def after_train_iteration(self, it, eval_result, is_finished):
+                if eval_result is not None:
+                    assert len(eval_result) == 3
+                finishes.append((it, is_finished))
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(400, 5)).astype(np.float32)
+        # label noise: validation loss degrades fast, forcing the stop
+        y = (rng.random(400) < 0.5).astype(np.float64)
+        vm = rng.random(400) < 0.3
+        cfg = TrainConfig(objective="binary", num_iterations=50, num_leaves=7,
+                          min_data_in_leaf=5, seed=1, early_stopping_round=2,
+                          delegate=Watcher())
+        b = train(x, y, cfg, valid_mask=vm)
+        assert b.best_iteration > 0
+        assert finishes[-1][1] is True        # stop signalled
+        assert len(finishes) < 50             # actually stopped early
+
+    def test_batch_hooks(self):
+        from mmlspark_tpu.models.gbdt import LightGBMClassifier, LightGBMDelegate
+
+        batches = []
+
+        class BatchWatcher(LightGBMDelegate):
+            def before_train_batch(self, i, n_rows, prev):
+                batches.append(("before", i, prev is not None))
+
+            def after_train_batch(self, i, booster):
+                batches.append(("after", i, len(booster.trees)))
+
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(400, 5)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float64)
+        df = DataFrame.from_dict({"features": x, "label": y})
+        LightGBMClassifier(
+            num_iterations=2, num_leaves=7, num_batches=2, seed=0,
+            delegate=BatchWatcher(),
+        ).fit(df)
+        assert batches[0] == ("before", 0, False)
+        assert batches[1][0] == "after" and batches[1][2] == 2
+        assert batches[2] == ("before", 1, True)
+        assert batches[3][0] == "after" and batches[3][2] == 4
