@@ -151,30 +151,32 @@ def _bench_histogram(on_accel: bool) -> dict:
 
 
 def _bench_gbdt(on_accel: bool) -> dict:
-    """Boosting throughput (trees/sec) with the device-resident loop."""
+    """Boosting throughput (trees/sec) with the device-resident loop, for
+    both growth policies: lossguide (LightGBM leaf-wise parity; O(num_leaves)
+    histogram passes under static shapes) and depthwise (one multi-leaf
+    histogram pass per level — the TPU-shaped policy)."""
     from mmlspark_tpu.models.gbdt import TrainConfig, train
 
     n, d = (200_000, 64) if on_accel else (20_000, 32)
     rng = np.random.default_rng(3)
     x = rng.normal(size=(n, d)).astype(np.float32)
     y = (x[:, 0] + x[:, 1] * x[:, 2] > 0).astype(np.float64)
-    # warm up at the EXACT timed shape: _grow_tree compiles per (n, d)
-    cfg = TrainConfig(objective="binary", num_iterations=1, num_leaves=63,
-                      min_data_in_leaf=20, seed=0)
-    _retry(lambda: train(x, y, cfg), "gbdt compile")
+    out = {"gbdt_rows": n, "gbdt_features": d}
     reps = 20
-    t0 = time.perf_counter()
-    train(
-        x, y,
-        TrainConfig(objective="binary", num_iterations=reps, num_leaves=63,
-                    min_data_in_leaf=20, seed=0),
-    )
-    dt = time.perf_counter() - t0
-    return {
-        "gbdt_rows": n,
-        "gbdt_features": d,
-        "gbdt_trees_per_sec": round(reps / dt, 2),
-    }
+    for policy, key in (("lossguide", "gbdt_trees_per_sec"),
+                        ("depthwise", "gbdt_depthwise_trees_per_sec")):
+        # warm up at the EXACT timed shape: the grower compiles per (n, d)
+        cfg = TrainConfig(objective="binary", num_iterations=1, num_leaves=63,
+                          min_data_in_leaf=20, seed=0, growth_policy=policy)
+        _retry(lambda c=cfg: train(x, y, c), f"gbdt {policy} compile")
+        t0 = time.perf_counter()
+        train(
+            x, y,
+            TrainConfig(objective="binary", num_iterations=reps, num_leaves=63,
+                        min_data_in_leaf=20, seed=0, growth_policy=policy),
+        )
+        out[key] = round(reps / (time.perf_counter() - t0), 2)
+    return out
 
 
 def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
@@ -188,18 +190,25 @@ def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
     rng = np.random.default_rng(7)
     x = rng.normal(size=(n, d)).astype(np.float32)
     y = (np.sin(2 * x[:, 0]) + x[:, 1] * x[:, 2] > 0).astype(np.float64)
-    cfg = TrainConfig(objective="binary", num_iterations=iters,
-                      num_leaves=leaves, min_data_in_leaf=20, seed=7)
-    _retry(lambda: train(x, y, TrainConfig(
-        objective="binary", num_iterations=1, num_leaves=leaves,
-        min_data_in_leaf=20, seed=7)), "gbdt-vs-sklearn compile")
-    t0 = time.perf_counter()
-    train(x, y, cfg)
-    ours_s = time.perf_counter() - t0
+    out: dict = {}
+    raw: dict = {}
+    for policy, key in (("lossguide", "gbdt_train_s"),
+                        ("depthwise", "gbdt_depthwise_train_s")):
+        cfg = TrainConfig(objective="binary", num_iterations=iters,
+                          num_leaves=leaves, min_data_in_leaf=20, seed=7,
+                          growth_policy=policy)
+        _retry(lambda p=policy: train(x, y, TrainConfig(
+            objective="binary", num_iterations=1, num_leaves=leaves,
+            min_data_in_leaf=20, seed=7, growth_policy=p)),
+            f"gbdt-vs-sklearn {policy} compile")
+        t0 = time.perf_counter()
+        train(x, y, cfg)
+        raw[key] = time.perf_counter() - t0
+        out[key] = round(raw[key], 2)
     try:
         from sklearn.ensemble import HistGradientBoostingClassifier
     except ImportError:
-        return {"gbdt_train_s": round(ours_s, 2)}
+        return out
     sk = HistGradientBoostingClassifier(
         max_iter=iters, max_leaf_nodes=leaves, min_samples_leaf=20,
         learning_rate=cfg.learning_rate, early_stopping=False, random_state=7,
@@ -207,11 +216,13 @@ def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
     t0 = time.perf_counter()
     sk.fit(x, y)
     sk_s = time.perf_counter() - t0
-    return {
-        "gbdt_train_s": round(ours_s, 2),
-        "sklearn_train_s": round(sk_s, 2),
-        "gbdt_vs_sklearn_speedup": round(sk_s / ours_s, 3),
-    }
+    out["sklearn_train_s"] = round(sk_s, 2)
+    # ratios divide the RAW seconds (rounded values skew, and can be 0.0)
+    out["gbdt_vs_sklearn_speedup"] = round(sk_s / raw["gbdt_train_s"], 3)
+    out["gbdt_depthwise_vs_sklearn_speedup"] = round(
+        sk_s / raw["gbdt_depthwise_train_s"], 3
+    )
+    return out
 
 
 def _bench_vw(on_accel: bool) -> dict:

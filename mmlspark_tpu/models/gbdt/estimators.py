@@ -72,6 +72,13 @@ class _LightGBMParams(
         default="data_parallel",
         type_=str,
     )
+    growth_policy = Param(
+        "lossguide (LightGBM leaf-wise, default) | depthwise (level-wise; "
+        "one multi-leaf histogram pass per level — O(depth) row passes)",
+        default="lossguide",
+        type_=str,
+        validator=lambda v: v in ("lossguide", "depthwise"),
+    )
     default_listen_port = Param("parity no-op (no sockets on TPU)", default=12400, type_=int)
     use_barrier_execution_mode = Param("parity no-op (SPMD is the gang)", default=False, type_=bool)
     top_k = Param("voting_parallel K (parity)", default=20, type_=int)
@@ -123,6 +130,7 @@ class _LightGBMParams(
             metric=self.get("metric"),
             seed=self.get("seed"),
             parallelism=self.get("parallelism"),
+            growth_policy=self.get("growth_policy"),
             top_k=self.get("top_k"),
             verbosity=self.get("verbosity"),
             categorical_features=tuple(self.get("categorical_slot_indexes") or ()),

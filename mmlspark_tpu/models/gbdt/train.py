@@ -78,6 +78,10 @@ class TrainConfig:
     metric: str = ""                   # default chosen by objective
     seed: int = 0
     parallelism: str = "data_parallel"  # accepted for parity
+    # lossguide = LightGBM's leaf-wise best-first growth (default);
+    # depthwise = level-wise growth whose histograms batch into one
+    # multi-leaf pass per level (XGBoost-hist policy; O(depth) row passes)
+    growth_policy: str = "lossguide"
     top_k: int = 20                     # voting_parallel K (parity)
     verbosity: int = -1
     # feature indices treated as categorical (LightGBM categoricalSlotIndexes
@@ -281,6 +285,7 @@ def _eval_metric(
     static_argnames=(
         "objective", "k", "grad_pre", "is_goss", "use_voting", "has_cat",
         "num_leaves", "max_depth", "min_data_in_leaf", "top_k", "mesh",
+        "depthwise",
     ),
 )
 def _fused_iteration(
@@ -312,6 +317,7 @@ def _fused_iteration(
     min_data_in_leaf: int,
     top_k: int,
     mesh: Any,
+    depthwise: bool = False,
 ) -> tuple:
     """One whole boosting iteration as ONE XLA program: gradients, GOSS
     weights, k tree grows and the score update. Collapsing the per-iteration
@@ -350,6 +356,12 @@ def _fused_iteration(
 
             grown = grow_tree_voting(
                 bins, gc, hc, w_it, top_k=top_k, mesh=mesh, **grow_kw
+            )
+        elif depthwise:
+            from mmlspark_tpu.models.gbdt.treegrow import grow_tree_depthwise
+
+            grown = grow_tree_depthwise(
+                bins, gc, hc, w_it, categorical_mask=cat_mask, **grow_kw
             )
         else:
             grown = grow_tree(bins, gc, hc, w_it, categorical_mask=cat_mask, **grow_kw)
@@ -407,6 +419,14 @@ def train(
     prediction replays it."""
     if cfg.boosting_type not in BOOSTING_TYPES:
         raise ValueError(f"boosting_type must be one of {BOOSTING_TYPES}")
+    if cfg.growth_policy not in ("lossguide", "depthwise"):
+        raise ValueError(
+            f"growth_policy must be 'lossguide' or 'depthwise', got {cfg.growth_policy!r}"
+        )
+    if cfg.growth_policy == "depthwise" and cfg.parallelism == "voting_parallel":
+        # the voting grower is leaf-wise; silently dropping an explicit
+        # depthwise request would benchmark/deploy the wrong policy
+        raise ValueError("growth_policy='depthwise' is incompatible with voting_parallel")
     if cfg.boosting_type == "goss" and cfg.top_rate + cfg.other_rate > 1.0:
         # LightGBM hard-errors here too: the sampler's unbiasedness
         # guarantee needs b/(1-a) <= 1
@@ -737,6 +757,7 @@ def train(
             num_leaves=int(cfg.num_leaves), max_depth=int(cfg.max_depth),
             min_data_in_leaf=int(cfg.min_data_in_leaf),
             top_k=int(cfg.top_k), mesh=mesh if use_voting else None,
+            depthwise=cfg.growth_policy == "depthwise",
         )
         # the fused step fit against eff_scores (dart: scores minus dropped
         # trees); the running total keeps the dropped contribution

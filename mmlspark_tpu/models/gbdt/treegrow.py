@@ -60,6 +60,87 @@ def split_gain_term(G: jnp.ndarray, H: jnp.ndarray, lam: Any, l1: Any) -> jnp.nd
     return t * t / (H + lam)
 
 
+def make_leaf_best(
+    d: int,
+    feature_mask: jnp.ndarray,
+    min_data_in_leaf: int,
+    msh: Any,
+    lam: Any,
+    l1: Any,
+    cat_f: jnp.ndarray,
+    has_categorical: bool,
+):
+    """Best-split search over ONE leaf's (d*B, 3) histogram plane — the
+    single source of split semantics shared by the leaf-wise (lossguide)
+    and depthwise growers. Returns (gain, feature, bin/prefix, catmask)."""
+    B = NUM_BINS
+
+    def gscore(Gv: jnp.ndarray, Hv: jnp.ndarray) -> jnp.ndarray:
+        return split_gain_term(Gv, Hv, lam, l1)
+
+    def leaf_best(plane: jnp.ndarray) -> tuple:
+        cube = plane.reshape(d, B, 3)
+        hg, hh, hc = cube[..., 0], cube[..., 1], cube[..., 2]
+        cg = jnp.cumsum(hg, axis=1)
+        ch = jnp.cumsum(hh, axis=1)
+        cc = jnp.cumsum(hc, axis=1)
+        G, H, C = cg[:, -1:], ch[:, -1:], cc[:, -1:]
+        GL, HL, CL = cg, ch, cc
+        GR, HR, CR = G - GL, H - HL, C - CL
+        gain_num = gscore(GL, HL) + gscore(GR, HR) - gscore(G, H)
+        feat_ok = (feature_mask > 0)[:, None]
+        valid_num = (
+            feat_ok
+            & (CL >= min_data_in_leaf) & (CR >= min_data_in_leaf)
+            & (HL >= msh) & (HR >= msh)
+        )
+        if has_categorical:
+            # categorical subset split (LightGBM's sorted-by-ratio scan:
+            # order category bins by G/H, then the best LEFT set is some
+            # prefix — Fisher's optimal-partition result for convex
+            # losses). ``bb`` for a categorical split is the PREFIX LENGTH
+            # in this order, not a bin.
+            ratio = jnp.where(hc > 0, hg / (hh + 1e-12), -jnp.inf)
+            order = jnp.argsort(-ratio, axis=1)  # (d, B) bin ids, best first
+            sgs = jnp.take_along_axis(hg, order, 1)
+            shs = jnp.take_along_axis(hh, order, 1)
+            scs = jnp.take_along_axis(hc, order, 1)
+            cgs = jnp.cumsum(sgs, axis=1)
+            chs = jnp.cumsum(shs, axis=1)
+            ccs = jnp.cumsum(scs, axis=1)
+            gain_cat = (
+                gscore(cgs, chs) + gscore(G - cgs, H - chs) - gscore(G, H)
+            )
+            valid_cat = (
+                feat_ok
+                & (ccs >= min_data_in_leaf)
+                & ((C - ccs) >= min_data_in_leaf)
+                & (chs >= msh) & ((H - chs) >= msh)
+            )
+            gain = jnp.where(
+                cat_f[:, None],
+                jnp.where(valid_cat, gain_cat, -jnp.inf),
+                jnp.where(valid_num, gain_num, -jnp.inf),
+            )
+        else:
+            gain = jnp.where(valid_num, gain_num, -jnp.inf)
+        flat = gain.reshape(-1)
+        best = jnp.argmax(flat)
+        bf = (best // B).astype(jnp.int32)
+        bb = (best % B).astype(jnp.int32)
+        if has_categorical:
+            # left-set membership per bin for the chosen feature:
+            # rank[bin] = position of bin in the sorted order; prefix <= bb
+            order_sel = order[bf]                 # (B,)
+            rank = jnp.argsort(order_sel)         # inverse permutation
+            catmask = rank <= bb                  # (B,) bool: LEFT bins
+        else:
+            catmask = jnp.zeros((B,), bool)
+        return flat[best], bf, bb, catmask
+
+    return leaf_best
+
+
 def grow_tree(
     bins: jnp.ndarray,            # (n, d) uint8/int32
     grad: jnp.ndarray,            # (n,) f32
@@ -135,9 +216,6 @@ def _grow_tree(
     def soft(Gv: jnp.ndarray) -> jnp.ndarray:
         return threshold_l1(Gv, l1)
 
-    def gscore(Gv: jnp.ndarray, Hv: jnp.ndarray) -> jnp.ndarray:
-        return split_gain_term(Gv, Hv, lam, l1)
-
     # per-row (g, h, count) stats; the histogram op picks its lowering
     # (Pallas one-hot matmul on single-chip TPU, GSPMD-partitioned scatter
     # under sharded meshes / CPU) — see ops/histogram.py
@@ -149,71 +227,13 @@ def _grow_tree(
         """Histogram of the rows selected by ``mask`` -> (d*B, 3)."""
         return plane_histogram(bins, row_stats, mask)
 
-    def leaf_best(plane: jnp.ndarray) -> tuple:
-        """Best split of ONE leaf from its (d*B, 3) histogram plane.
-
-        Returns (gain, feature, bin/prefix, catmask). Only state-free
-        validity (min_data, feature_fraction) is applied here; per-leaf
-        state (activity, depth) is applied at selection time, so cached
-        results stay exact until the leaf's histogram changes."""
-        cube = plane.reshape(d, B, 3)
-        hg, hh, hc = cube[..., 0], cube[..., 1], cube[..., 2]
-        cg = jnp.cumsum(hg, axis=1)
-        ch = jnp.cumsum(hh, axis=1)
-        cc = jnp.cumsum(hc, axis=1)
-        G, H, C = cg[:, -1:], ch[:, -1:], cc[:, -1:]
-        GL, HL, CL = cg, ch, cc
-        GR, HR, CR = G - GL, H - HL, C - CL
-        gain_num = gscore(GL, HL) + gscore(GR, HR) - gscore(G, H)
-        feat_ok = (feature_mask > 0)[:, None]
-        valid_num = (
-            feat_ok
-            & (CL >= min_data_in_leaf) & (CR >= min_data_in_leaf)
-            & (HL >= msh) & (HR >= msh)
-        )
-        if has_categorical:
-            # categorical subset split (LightGBM's sorted-by-ratio scan:
-            # order category bins by G/H, then the best LEFT set is some
-            # prefix — Fisher's optimal-partition result for convex
-            # losses). ``bb`` for a categorical split is the PREFIX LENGTH
-            # in this order, not a bin.
-            ratio = jnp.where(hc > 0, hg / (hh + 1e-12), -jnp.inf)
-            order = jnp.argsort(-ratio, axis=1)  # (d, B) bin ids, best first
-            sgs = jnp.take_along_axis(hg, order, 1)
-            shs = jnp.take_along_axis(hh, order, 1)
-            scs = jnp.take_along_axis(hc, order, 1)
-            cgs = jnp.cumsum(sgs, axis=1)
-            chs = jnp.cumsum(shs, axis=1)
-            ccs = jnp.cumsum(scs, axis=1)
-            gain_cat = (
-                gscore(cgs, chs) + gscore(G - cgs, H - chs) - gscore(G, H)
-            )
-            valid_cat = (
-                feat_ok
-                & (ccs >= min_data_in_leaf)
-                & ((C - ccs) >= min_data_in_leaf)
-                & (chs >= msh) & ((H - chs) >= msh)
-            )
-            gain = jnp.where(
-                cat_f[:, None],
-                jnp.where(valid_cat, gain_cat, -jnp.inf),
-                jnp.where(valid_num, gain_num, -jnp.inf),
-            )
-        else:
-            gain = jnp.where(valid_num, gain_num, -jnp.inf)
-        flat = gain.reshape(-1)
-        best = jnp.argmax(flat)
-        bf = (best // B).astype(jnp.int32)
-        bb = (best % B).astype(jnp.int32)
-        if has_categorical:
-            # left-set membership per bin for the chosen feature:
-            # rank[bin] = position of bin in the sorted order; prefix <= bb
-            order_sel = order[bf]                 # (B,)
-            rank = jnp.argsort(order_sel)         # inverse permutation
-            catmask = rank <= bb                  # (B,) bool: LEFT bins
-        else:
-            catmask = jnp.zeros((B,), bool)
-        return flat[best], bf, bb, catmask
+    # best split of ONE leaf from its plane. Only state-free validity
+    # (min_data, feature_fraction) is applied there; per-leaf state
+    # (activity, depth) is applied at selection time, so cached results
+    # stay exact until the leaf's histogram changes.
+    leaf_best = make_leaf_best(
+        d, feature_mask, min_data_in_leaf, msh, lam, l1, cat_f, has_categorical
+    )
 
     def step(k: int, state: tuple) -> tuple:
         (hist, row_leaf, leaf_depth, done,
@@ -327,6 +347,196 @@ def _grow_tree(
     return GrownTree(
         rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
         leaf_values, Cl.astype(jnp.int32), row_leaf,
+        rec_is_cat, rec_catmask,
+    )
+
+
+def grow_tree_depthwise(
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    row_weight: jnp.ndarray,
+    num_leaves: int,
+    lambda_l2: float,
+    min_gain: float,
+    learning_rate: float,
+    feature_mask: jnp.ndarray,
+    max_depth: int = -1,
+    min_data_in_leaf: int = 20,
+    categorical_mask: Optional[jnp.ndarray] = None,
+    lambda_l1: float = 0.0,
+    min_sum_hessian: float = 1e-3,
+) -> GrownTree:
+    """Depthwise (level-wise) growth — the XGBoost-hist/SparkML-GBT grow
+    policy, built for the TPU cost model: every level's leaf histograms
+    come from ONE ``multi_plane_histogram`` pass over the rows, so a tree
+    costs O(depth) row passes instead of lossguide's O(num_leaves). Split
+    semantics (gain, min_data, L1/hessian floors, categorical subsets)
+    come from the same ``make_leaf_best`` as the leaf-wise grower; output
+    is the identical GrownTree record format.
+
+    With ``max_depth`` unset, depth caps at ceil(log2(num_leaves)) — the
+    balanced depth that can realize the leaf budget."""
+    has_categorical = categorical_mask is not None
+    if not has_categorical:
+        categorical_mask = jnp.zeros((bins.shape[1],), bool)
+    L = int(num_leaves)
+    # levels beyond the leaf budget can never split anything: cap the
+    # static unroll so a huge max_depth doesn't emit useless row passes
+    n_levels = (
+        min(int(max_depth), L - 1) if max_depth > 0
+        else max(1, int(np.ceil(np.log2(L))))
+    )
+    return _grow_tree_depthwise(
+        bins, grad, hess, row_weight,
+        num_leaves=L, lambda_l2=lambda_l2, min_gain=min_gain,
+        learning_rate=learning_rate, feature_mask=feature_mask,
+        n_levels=n_levels, min_data_in_leaf=min_data_in_leaf,
+        categorical_mask=categorical_mask, has_categorical=has_categorical,
+        lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_leaves", "n_levels", "min_data_in_leaf", "has_categorical",
+    ),
+)
+def _grow_tree_depthwise(
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    row_weight: jnp.ndarray,
+    num_leaves: int,
+    lambda_l2: float,
+    min_gain: float,
+    learning_rate: float,
+    feature_mask: jnp.ndarray,
+    n_levels: int,
+    min_data_in_leaf: int,
+    categorical_mask: jnp.ndarray,
+    has_categorical: bool,
+    lambda_l1: float = 0.0,
+    min_sum_hessian: float = 1e-3,
+) -> GrownTree:
+    from mmlspark_tpu.ops.histogram import multi_plane_histogram
+
+    n, d = bins.shape
+    L = num_leaves
+    B = NUM_BINS
+    bins = bins.astype(jnp.int32)
+    cat_f = categorical_mask.astype(bool)
+    g = grad * row_weight
+    h = hess * row_weight
+    cnt_w = row_weight
+    row_stats = jnp.stack([g, h, cnt_w], axis=-1)
+    leaf_best = make_leaf_best(
+        d, feature_mask, min_data_in_leaf, min_sum_hessian,
+        lambda_l2, lambda_l1, cat_f, has_categorical,
+    )
+
+    row_slot = jnp.zeros((n,), jnp.int32)
+    k = jnp.int32(0)                       # splits made so far (record cursor)
+    rec_leaf = jnp.full((L - 1,), -1, jnp.int32)
+    rec_feature = jnp.full((L - 1,), -1, jnp.int32)
+    rec_bin = jnp.full((L - 1,), -1, jnp.int32)
+    rec_active = jnp.zeros((L - 1,), bool)
+    rec_gain = jnp.zeros((L - 1,), jnp.float32)
+    rec_is_cat = jnp.zeros((L - 1,), bool)
+    rec_catmask = jnp.zeros((L - 1, B), bool)
+    # frontier of the CURRENT level: lut maps record-slot -> local plane
+    # index (sentinel = not in frontier); inv maps plane index -> slot
+    lut = jnp.where(jnp.arange(L) == 0, 0, L).astype(jnp.int32)
+    inv = jnp.full((1,), 0, jnp.int32)     # level 0: just the root
+
+    for level in range(n_levels):
+        S = int(inv.shape[0])
+        slot_local = jnp.where(row_slot < L, lut[jnp.clip(row_slot, 0, L - 1)], S)
+        cube = multi_plane_histogram(bins, row_stats, slot_local, S)
+        gains, feats, bbs, catms = jax.vmap(leaf_best)(cube)
+        # budget: when fewer than S splits remain, best-gain nodes win
+        order = jnp.argsort(-gains)
+        S_next = min(2 * S, L)
+        lut_next0 = jnp.full((L,), L, jnp.int32)
+        inv_next0 = jnp.full((S_next,), -1, jnp.int32)
+
+        def split_one(i: int, carry: tuple) -> tuple:
+            (k, n_split, row_slot, lut_next, inv_next,
+             rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+             rec_is_cat, rec_catmask) = carry
+            j = order[i]
+            slot_j = inv[j]
+            gain = gains[j]
+            valid = (
+                (slot_j >= 0)
+                & jnp.isfinite(gain)
+                & (gain > min_gain)
+                & (k < L - 1)
+            )
+            bf, bb, cm = feats[j], bbs[j], catms[j]
+            new_id = k + 1
+            in_leaf = row_slot == slot_j
+            row_bins = bins[:, bf]
+            if has_categorical:
+                goes_right = in_leaf & jnp.where(
+                    cat_f[bf], ~cm[row_bins], row_bins > bb
+                )
+                is_cat_split = cat_f[bf]
+            else:
+                goes_right = in_leaf & (row_bins > bb)
+                is_cat_split = jnp.asarray(False)
+            row_slot = jnp.where(valid & goes_right, new_id, row_slot)
+            ks = jnp.clip(k, 0, L - 2)
+            rec_leaf = rec_leaf.at[ks].set(jnp.where(valid, slot_j, rec_leaf[ks]))
+            rec_feature = rec_feature.at[ks].set(jnp.where(valid, bf, rec_feature[ks]))
+            rec_bin = rec_bin.at[ks].set(jnp.where(valid, bb, rec_bin[ks]))
+            rec_active = rec_active.at[ks].set(rec_active[ks] | valid)
+            rec_gain = rec_gain.at[ks].set(jnp.where(valid, gain, rec_gain[ks]))
+            rec_is_cat = rec_is_cat.at[ks].set(
+                rec_is_cat[ks] | (valid & is_cat_split)
+            )
+            rec_catmask = rec_catmask.at[ks].set(
+                jnp.where(valid & is_cat_split, cm, rec_catmask[ks])
+            )
+            # children join the next level's frontier
+            both_ok = valid
+            lut_next = jnp.where(
+                both_ok,
+                lut_next.at[slot_j].set(2 * n_split).at[new_id].set(2 * n_split + 1),
+                lut_next,
+            )
+            inv_next = jnp.where(
+                both_ok,
+                inv_next.at[2 * n_split].set(slot_j).at[2 * n_split + 1].set(new_id),
+                inv_next,
+            )
+            k = k + valid.astype(jnp.int32)
+            n_split = n_split + valid.astype(jnp.int32)
+            return (k, n_split, row_slot, lut_next, inv_next,
+                    rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+                    rec_is_cat, rec_catmask)
+
+        (k, _, row_slot, lut, inv,
+         rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+         rec_is_cat, rec_catmask) = jax.lax.fori_loop(
+            0, S,
+            split_one,
+            (k, jnp.int32(0), row_slot, lut_next0, inv_next0,
+             rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+             rec_is_cat, rec_catmask),
+        )
+
+    Gl = jnp.zeros((L,), jnp.float32).at[row_slot].add(g)
+    Hl = jnp.zeros((L,), jnp.float32).at[row_slot].add(h)
+    Cl = jnp.zeros((L,), jnp.float32).at[row_slot].add(cnt_w)
+    leaf_values = (
+        -threshold_l1(Gl, lambda_l1) / (Hl + lambda_l2) * learning_rate
+    )
+    leaf_values = jnp.where(Cl > 0, leaf_values, 0.0)
+    return GrownTree(
+        rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+        leaf_values, Cl.astype(jnp.int32), row_slot,
         rec_is_cat, rec_catmask,
     )
 

@@ -121,6 +121,124 @@ def _plane_histogram_pallas(bins: jnp.ndarray, stats: jnp.ndarray) -> jnp.ndarra
     return out[: d * b]
 
 
+def _multi_kernel(bins_ref, stats_ref, slot_ref, out_ref, *, num_slots: int):
+    """One (feature-block, row-chunk) step of the multi-leaf build: the
+    bin one-hot is built ONCE and contracted against slot-masked stats
+    columns, producing every leaf's plane stripe in a single wide matmul
+    (rhs column s*6+j = [slot==s] * stats_hi/lo[j])."""
+    import jax.experimental.pallas as pl
+
+    row_chunk = pl.program_id(1)
+
+    @pl.when(row_chunk == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[:]          # (DF, NC) int32
+    stats = stats_ref[:]        # (NC, 3) f32
+    slot = slot_ref[:]          # (1, NC) int32; out-of-range = no plane
+    df, nc = bins.shape
+    b = NUM_BINS
+    v = jax.lax.broadcasted_iota(jnp.int32, (df, b, nc), 1)
+    one_hot = (bins[:, None, :] == v).astype(jnp.bfloat16)
+    s_hi = stats.astype(jnp.bfloat16).astype(jnp.float32)
+    s_lo = stats - s_hi
+    both = jnp.concatenate([s_hi, s_lo], axis=1)                  # (NC, 6)
+    w = num_slots * 6
+    both_wide = jnp.concatenate([both] * num_slots, axis=1)       # (NC, S*6)
+    s_iota = jax.lax.broadcasted_iota(jnp.int32, (nc, w), 1) // 6
+    slot_match = (slot[0][:, None] == s_iota).astype(jnp.float32)
+    rhs = (slot_match * both_wide).astype(jnp.bfloat16)           # (NC, S*6)
+    out_ref[:] += jax.lax.dot_general(
+        one_hot.reshape(df * b, nc), rhs,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _multi_plane_pallas(
+    bins: jnp.ndarray, stats: jnp.ndarray, slot: jnp.ndarray, num_slots: int
+) -> jnp.ndarray:
+    import functools as _ft
+
+    import jax.experimental.pallas as pl
+
+    n, d = bins.shape
+    b = NUM_BINS
+    d_pad = ((d + _DF - 1) // _DF) * _DF
+    n_pad = ((n + _NC - 1) // _NC) * _NC
+    sentinel = b
+    bins = jnp.where((bins >= 0) & (bins < b), bins, sentinel)
+    if d_pad != d:
+        bins = jnp.pad(bins, ((0, 0), (0, d_pad - d)), constant_values=sentinel)
+    if n_pad != n:
+        bins = jnp.pad(bins, ((0, n_pad - n), (0, 0)), constant_values=sentinel)
+        stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))
+        slot = jnp.pad(slot, (0, n_pad - n), constant_values=num_slots)
+    packed = pl.pallas_call(
+        _ft.partial(_multi_kernel, num_slots=num_slots),
+        grid=(d_pad // _DF, n_pad // _NC),
+        in_specs=[
+            pl.BlockSpec((_DF, _NC), lambda f, r: (f, r)),
+            pl.BlockSpec((_NC, 3), lambda f, r: (r, 0)),
+            pl.BlockSpec((1, _NC), lambda f, r: (0, r)),
+        ],
+        out_specs=pl.BlockSpec((_DF * b, num_slots * 6), lambda f, r: (f, 0)),
+        out_shape=jax.ShapeDtypeStruct((d_pad * b, num_slots * 6), jnp.float32),
+        interpret=jax.default_backend() == "cpu",
+    )(
+        bins.T.astype(jnp.int32),
+        stats.astype(jnp.float32),
+        slot.astype(jnp.int32)[None, :],
+    )
+    # (f*B+v, s*6+j) -> (s, f*B+v, j), summing hi/lo halves
+    un = packed.reshape(d_pad * b, num_slots, 6)
+    out = jnp.transpose(un[..., :3] + un[..., 3:], (1, 0, 2))
+    return out[:, : d * b]
+
+
+def _multi_plane_scatter(
+    bins: jnp.ndarray, stats: jnp.ndarray, slot: jnp.ndarray, num_slots: int
+) -> jnp.ndarray:
+    n, d = bins.shape
+    b = NUM_BINS
+    plane_idx = (jnp.arange(d, dtype=jnp.int32) * b)[None, :] + bins   # (n, d)
+    flat = slot[:, None] * (d * b) + plane_idx
+    oob = (
+        (bins < 0) | (bins >= b) | (slot[:, None] < 0) | (slot[:, None] >= num_slots)
+    )
+    flat = jnp.where(oob, num_slots * d * b, flat)
+    contrib = jnp.broadcast_to(stats[:, None, :], (n, d, 3))
+    out = (
+        jnp.zeros((num_slots * d * b, 3), jnp.float32)
+        .at[flat]
+        .add(contrib, mode="drop")
+    )
+    return out.reshape(num_slots, d * b, 3)
+
+
+def multi_plane_histogram(
+    bins: jnp.ndarray,
+    stats: jnp.ndarray,
+    slot: jnp.ndarray,
+    num_slots: int,
+) -> jnp.ndarray:
+    """Histogram planes for MANY leaves in one pass over the rows.
+
+    ``slot``: (n,) int leaf-plane index per row; out-of-range = the row
+    contributes to no plane. Returns (num_slots, d*NUM_BINS, 3). This is
+    the depthwise grower's workhorse: one row pass per LEVEL instead of
+    one per leaf, with the bin one-hot (the VPU-bound part) amortized
+    across all the level's leaves."""
+    if use_pallas():
+        return _multi_plane_pallas(
+            bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots
+        )
+    return _multi_plane_scatter(
+        bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots
+    )
+
+
 def _plane_histogram_scatter(bins: jnp.ndarray, stats: jnp.ndarray) -> jnp.ndarray:
     n, d = bins.shape
     b = NUM_BINS

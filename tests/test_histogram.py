@@ -60,3 +60,39 @@ class TestPlaneHistogram:
         assert not H.use_pallas()
         monkeypatch.setenv("MMLSPARK_TPU_PALLAS", "1")
         assert H.use_pallas()
+
+
+class TestMultiPlane:
+    def test_matches_per_slot_single_planes(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.ops.histogram import (
+            multi_plane_histogram,
+            plane_histogram,
+        )
+
+        rng = np.random.default_rng(9)
+        from mmlspark_tpu.ops.histogram import NUM_BINS
+
+        n, d, S = 1000, 6, 5
+        bins = jnp.asarray(rng.integers(-2, NUM_BINS + 2, size=(n, d)).astype(np.int32))
+        stats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+        slot = jnp.asarray(rng.integers(-1, S + 1, size=(n,)).astype(np.int32))
+        cube = np.asarray(multi_plane_histogram(bins, stats, slot, S))
+        assert cube.shape == (S, d * 256, 3)
+        for s in range(S):
+            mask = (np.asarray(slot) == s).astype(np.float32)
+            single = np.asarray(plane_histogram(bins, stats, jnp.asarray(mask)))
+            np.testing.assert_allclose(cube[s], single, atol=2e-4)
+
+    def test_out_of_range_slots_drop(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.ops.histogram import multi_plane_histogram
+
+        bins = jnp.zeros((4, 2), jnp.int32)
+        stats = jnp.ones((4, 3), jnp.float32)
+        slot = jnp.asarray([0, 1, -1, 99], jnp.int32)
+        cube = np.asarray(multi_plane_histogram(bins, stats, slot, 2))
+        # only the two in-range rows land: each hits d=2 features x 3 stats
+        assert cube.sum() == 2 * 2 * 3
