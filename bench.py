@@ -169,13 +169,17 @@ def _bench_gbdt(on_accel: bool) -> dict:
         cfg = TrainConfig(objective="binary", num_iterations=1, num_leaves=63,
                           min_data_in_leaf=20, seed=0, growth_policy=policy)
         _retry(lambda c=cfg: train(x, y, c), f"gbdt {policy} compile")
-        t0 = time.perf_counter()
-        train(
-            x, y,
-            TrainConfig(objective="binary", num_iterations=reps, num_leaves=63,
-                        min_data_in_leaf=20, seed=0, growth_policy=policy),
-        )
-        out[key] = round(reps / (time.perf_counter() - t0), 2)
+        best = np.inf
+        for _ in range(2):  # best-of-2: the relay stalls for whole minutes
+            t0 = time.perf_counter()
+            train(
+                x, y,
+                TrainConfig(objective="binary", num_iterations=reps,
+                            num_leaves=63, min_data_in_leaf=20, seed=0,
+                            growth_policy=policy),
+            )
+            best = min(best, time.perf_counter() - t0)
+        out[key] = round(reps / best, 2)
     return out
 
 
@@ -201,9 +205,11 @@ def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
             objective="binary", num_iterations=1, num_leaves=leaves,
             min_data_in_leaf=20, seed=7, growth_policy=p)),
             f"gbdt-vs-sklearn {policy} compile")
-        t0 = time.perf_counter()
-        train(x, y, cfg)
-        raw[key] = time.perf_counter() - t0
+        raw[key] = np.inf
+        for _ in range(2):  # best-of-2: the relay stalls for whole minutes
+            t0 = time.perf_counter()
+            train(x, y, cfg)
+            raw[key] = min(raw[key], time.perf_counter() - t0)
         out[key] = round(raw[key], 2)
     try:
         from sklearn.ensemble import HistGradientBoostingClassifier
