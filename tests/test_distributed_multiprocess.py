@@ -139,6 +139,13 @@ GBDT_WORKER = textwrap.dedent(
                init_booster=b)
     print("MODE:cont:%d:" % len(b2.trees) + b2.to_model_string()[:48], flush=True)
 
+    # depthwise growth across processes: the multi-leaf histogram lowers to
+    # the GSPMD scatter + allreduce under the cross-process mesh
+    cfgd = TrainConfig(objective="binary", num_iterations=3, num_leaves=15,
+                       min_data_in_leaf=5, seed=3, growth_policy="depthwise")
+    bdp = train(x_all[lo:hi], y_all[lo:hi], cfgd)
+    print("MODE:depthwise:" + bdp.to_model_string()[:64], flush=True)
+
     # validation + early stopping: the metric is allgathered, so both
     # processes must stop at the SAME iteration
     vm = np.zeros(hi - lo, bool); vm[-60:] = True
@@ -188,7 +195,7 @@ def test_two_process_gbdt_training(tmp_path):
         models.append(out.split("MODEL:", 1)[1].splitlines()[0].strip())
     # SPMD determinism: same trees on every process, for every capability
     assert models[0] == models[1]
-    for mode in ("goss", "rf", "dart", "cat", "sparse", "cont", "es"):
+    for mode in ("goss", "rf", "dart", "cat", "sparse", "cont", "depthwise", "es"):
         tags = [out.split(f"MODE:{mode}:", 1)[1].splitlines()[0]
                 for _, out, _ in outs]
         assert tags[0] == tags[1], mode
