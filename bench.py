@@ -192,10 +192,12 @@ def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
 
     n, d, iters, leaves = (100_000, 32, 50, 63) if on_accel else (20_000, 16, 20, 31)
     rng = np.random.default_rng(7)
-    x = rng.normal(size=(n, d)).astype(np.float32)
+    x = rng.normal(size=(n + n // 4, d)).astype(np.float32)
     y = (np.sin(2 * x[:, 0]) + x[:, 1] * x[:, 2] > 0).astype(np.float64)
+    x, xte, y, yte = x[:n], x[n:], y[:n], y[n:]  # held-out quality check
     out: dict = {}
     raw: dict = {}
+    boosters: dict = {}
     for policy, key in (("lossguide", "gbdt_train_s"),
                         ("depthwise", "gbdt_depthwise_train_s")):
         cfg = TrainConfig(objective="binary", num_iterations=iters,
@@ -208,7 +210,7 @@ def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
         raw[key] = np.inf
         for _ in range(2):  # best-of-2: the relay stalls for whole minutes
             t0 = time.perf_counter()
-            train(x, y, cfg)
+            boosters[policy] = train(x, y, cfg)
             raw[key] = min(raw[key], time.perf_counter() - t0)
         out[key] = round(raw[key], 2)
     try:
@@ -223,6 +225,23 @@ def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
     sk.fit(x, y)
     sk_s = time.perf_counter() - t0
     out["sklearn_train_s"] = round(sk_s, 2)
+    # held-out quality next to the wall-clock: the speedup claim only
+    # counts if the models are comparably good
+    try:
+        from mmlspark_tpu.core.metrics import binary_auc
+        from mmlspark_tpu.models.gbdt.objectives import sigmoid
+
+        out["gbdt_auc"] = round(
+            binary_auc(yte, sigmoid(boosters["lossguide"].predict_raw(xte))), 4
+        )
+        out["gbdt_depthwise_auc"] = round(
+            binary_auc(yte, sigmoid(boosters["depthwise"].predict_raw(xte))), 4
+        )
+        out["sklearn_auc"] = round(
+            binary_auc(yte, sk.predict_proba(xte)[:, 1]), 4
+        )
+    except Exception as e:  # noqa: BLE001
+        out["auc_error"] = str(e)[:120]
     # ratios divide the RAW seconds (rounded values skew, and can be 0.0)
     out["gbdt_vs_sklearn_speedup"] = round(sk_s / raw["gbdt_train_s"], 3)
     out["gbdt_depthwise_vs_sklearn_speedup"] = round(
