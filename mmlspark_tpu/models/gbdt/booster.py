@@ -36,6 +36,10 @@ class Tree:
     # v+1) goes LEFT iff catmask[k, v+1]. None = all-numerical tree.
     is_cat: Optional[np.ndarray] = None     # (S,) bool
     catmask: Optional[np.ndarray] = None    # (S, B) bool
+    # per-split missing-value direction (LightGBM decision_type default-left
+    # bit): NaN routes LEFT iff default_left[k]. None = all left (the
+    # native trainer's convention; imports may carry default-right splits)
+    default_left: Optional[np.ndarray] = None  # (S,) bool
 
     @property
     def num_splits(self) -> int:
@@ -68,6 +72,9 @@ class Tree:
                 str(k): np.flatnonzero(self.catmask[k]).tolist()
                 for k in np.flatnonzero(self.is_cat)
             }
+        if self.default_left is not None and not self.default_left.all():
+            # compact: only the default-RIGHT split ids (rare; import-only)
+            out["default_right"] = np.flatnonzero(~self.default_left).tolist()
         return out
 
     @staticmethod
@@ -80,6 +87,10 @@ class Tree:
             return float(t)
 
         thr = np.array([dec(t) for t in d["threshold"]], dtype=np.float64)
+        default_left = None
+        if d.get("default_right"):
+            default_left = np.ones(len(d["leaf"]), bool)
+            default_left[np.asarray(d["default_right"], np.int64)] = False
         is_cat = catmask = None
         if d.get("cat_splits"):
             from mmlspark_tpu.ops.histogram import NUM_BINS
@@ -101,6 +112,7 @@ class Tree:
             counts=np.asarray(d["counts"], np.int32),
             is_cat=is_cat,
             catmask=catmask,
+            default_left=default_left,
         )
 
 
@@ -118,6 +130,12 @@ class Booster:
     # gbdt|goss|dart|rf — rf predictions AVERAGE trees instead of summing
     # (LightGBM boostingType, lightgbm/LightGBMParams.scala)
     boosting_type: str = "gbdt"
+    # binary sigmoid slope: p = sigmoid(sigmoid * score). Trained models use
+    # 1.0; imported LightGBM models may carry e.g. "binary sigmoid:2"
+    sigmoid: float = 1.0
+    # regression-objective knob round-tripped through model text (quantile/
+    # huber alpha, tweedie variance power, fair c); None = objective default
+    objective_param: Optional[float] = None
 
     # -- serialization ------------------------------------------------------
 
@@ -136,6 +154,8 @@ class Booster:
                     else self.base_score
                 ),
                 "boosting_type": self.boosting_type,
+                "sigmoid": self.sigmoid,
+                "objective_param": self.objective_param,
                 "trees": [t.to_dict() for t in self.trees],
             }
         )
@@ -156,6 +176,8 @@ class Booster:
             feature_names=d.get("feature_names"),
             base_score=d.get("base_score", 0.0),
             boosting_type=d.get("boosting_type", "gbdt"),
+            sigmoid=d.get("sigmoid", 1.0),
+            objective_param=d.get("objective_param"),
         )
         return b
 
@@ -188,6 +210,13 @@ class Booster:
             # which already include self's baseline — keep it
             base_score=self.base_score,
             boosting_type=self.boosting_type,
+            # imported prediction semantics ride the ORIGINAL model
+            sigmoid=self.sigmoid,
+            objective_param=(
+                self.objective_param
+                if self.objective_param is not None
+                else other.objective_param
+            ),
         )
 
     # -- device scoring ------------------------------------------------------
@@ -214,6 +243,17 @@ class Booster:
         for c in range(k):
             out[:, c] = per_tree[:, c::k].sum(axis=1) / denom
         return out + base
+
+    def predict(self, x: np.ndarray, num_iteration: Optional[int] = None) -> np.ndarray:
+        """Raw scores through the objective's output transform: log-link
+        objectives (poisson/tweedie/gamma) train in log space and predict
+        exp(score) (LightGBM's convert_output); everything else is raw."""
+        from mmlspark_tpu.models.gbdt.objectives import LOG_LINK_KINDS
+
+        raw = self.predict_raw(x, num_iteration=num_iteration)
+        if self.objective in LOG_LINK_KINDS:
+            return np.exp(raw)
+        return raw
 
     def predict_leaf(self, x: np.ndarray) -> np.ndarray:
         """(n, d) -> (n, T) leaf index per tree (predictLeaf analogue)."""
@@ -290,6 +330,15 @@ def _stack_trees(trees: list) -> Optional[tuple]:
     )
     rec_active = np.stack([pad(t.active, S, False) for t in trees])
     values = np.stack([pad(t.values, L, np.float32(0)) for t in trees])
+    rec_default_left = None
+    if any(
+        t.default_left is not None and not np.asarray(t.default_left).all()
+        for t in trees
+    ):
+        rec_default_left = np.ones((T, S), bool)
+        for i, t in enumerate(trees):
+            if t.default_left is not None:
+                rec_default_left[i, : len(t.default_left)] = t.default_left
     rec_is_cat = rec_catmask = None
     if any(t.has_categorical for t in trees):
         from mmlspark_tpu.ops.histogram import NUM_BINS
@@ -300,13 +349,17 @@ def _stack_trees(trees: list) -> Optional[tuple]:
             if t.is_cat is not None:
                 rec_is_cat[i, : len(t.is_cat)] = t.is_cat
                 rec_catmask[i, : t.catmask.shape[0]] = t.catmask
-    return rec_leaf, rec_feature, rec_threshold, rec_active, values, rec_is_cat, rec_catmask
+    return (
+        rec_leaf, rec_feature, rec_threshold, rec_active, values,
+        rec_is_cat, rec_catmask, rec_default_left,
+    )
 
 
 def _leaves_from_stacked(stacked: tuple, x: np.ndarray) -> np.ndarray:
     import jax.numpy as jnp
 
-    rec_leaf, rec_feature, rec_threshold, rec_active, _, is_cat, catmask = stacked
+    (rec_leaf, rec_feature, rec_threshold, rec_active, _, is_cat, catmask,
+     default_left) = stacked
     return np.asarray(
         treegrow.predict_leaves(
             jnp.asarray(x, jnp.float32),
@@ -316,6 +369,7 @@ def _leaves_from_stacked(stacked: tuple, x: np.ndarray) -> np.ndarray:
             jnp.asarray(rec_active),
             jnp.asarray(is_cat) if is_cat is not None else None,
             jnp.asarray(catmask) if catmask is not None else None,
+            jnp.asarray(default_left) if default_left is not None else None,
         )
     )
 
@@ -388,7 +442,12 @@ def _tree_contribs(tree: Tree, x: np.ndarray) -> np.ndarray:
             vbin = treegrow.category_bin_slot(vals, tree.catmask.shape[1], np)
             goes_right = in_leaf & ~tree.catmask[k][vbin]
         else:
-            goes_right = in_leaf & (vals > thr) & ~np.isnan(vals)
+            nan_right = not (
+                tree.default_left is None or bool(tree.default_left[k])
+            )
+            goes_right = in_leaf & np.where(
+                np.isnan(vals), nan_right, vals > thr
+            )
         stays_left = in_leaf & ~goes_right
         before = exp_steps[k][parent]
         # after this split the row is at (parent|right); its new expectation

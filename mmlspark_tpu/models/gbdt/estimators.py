@@ -102,6 +102,17 @@ class _LightGBMParams(
         default=None,
     )
     model_string = Param("initial model for continued training", default="", type_=str)
+    alpha = Param(
+        "quantile level (objective=quantile) / huber delta (objective=huber)",
+        default=0.9, type_=float,
+    )
+    tweedie_variance_power = Param(
+        "tweedie variance power in (1, 2)", default=1.5, type_=float
+    )
+    poisson_max_delta_step = Param(
+        "poisson hessian stabilizer exp(score + step)", default=0.7, type_=float
+    )
+    fair_c = Param("fair-loss scale c", default=1.0, type_=float)
     num_batches = Param("fold training into k sequential batches", default=0, type_=int)
     delegate = ComplexParam(
         "LightGBMDelegate: lifecycle callbacks + dynamic learning rate"
@@ -142,6 +153,10 @@ class _LightGBMParams(
             top_rate=self.get("top_rate"),
             other_rate=self.get("other_rate"),
             eval_at=self.get("eval_at"),
+            alpha=self.get("alpha"),
+            tweedie_variance_power=self.get("tweedie_variance_power"),
+            poisson_max_delta_step=self.get("poisson_max_delta_step"),
+            fair_c=self.get("fair_c"),
         )
 
     def _gather(self, df: DataFrame) -> dict:
@@ -293,7 +308,9 @@ class LightGBMClassificationModel(
             raw = booster.predict_raw(x)
             q = dict(p)
             if booster.num_class == 1:
-                probs1 = objectives.sigmoid(raw)
+                # imported models may carry a non-default sigmoid slope
+                # ("binary sigmoid:s"): p = sigmoid(s * score)
+                probs1 = objectives.sigmoid(booster.sigmoid * raw)
                 probs = np.stack([1 - probs1, probs1], axis=1)
                 raw2 = np.stack([-raw, raw], axis=1)
             else:
@@ -321,15 +338,32 @@ class LightGBMClassificationModel(
 
 
 class LightGBMRegressor(Estimator, _LightGBMParams, HasPredictionCol):
-    objective = Param("regression", default="regression", type_=str)
+    objective = Param(
+        "regression | regression_l1 | quantile | huber | fair | poisson | "
+        "tweedie | gamma | mape (LightGBM objective passthrough, "
+        "TrainParams.scala:8-40)",
+        default="regression", type_=str,
+    )
 
     def fit(self, df: DataFrame) -> "LightGBMRegressionModel":
         data = self._gather(df)
+        obj = objectives.canonical_objective(self.get("objective"))
         base = 0.0
-        if self.get("boost_from_average") and data["init"] is None and len(data["y"]):
-            base = float(data["y"].mean())
+        y = data["y"]
+        if self.get("boost_from_average") and data["init"] is None and len(y):
+            # LightGBM's BoostFromScore per objective family: log-link
+            # objectives start at log(mean) (scores live in log space),
+            # quantile at the alpha-percentile, l1/mape at the median
+            if obj in objectives.LOG_LINK_KINDS:
+                base = float(np.log(np.clip(y.mean(), 1e-9, None)))
+            elif obj == "quantile":
+                base = float(np.percentile(y, self.get("alpha") * 100.0))
+            elif obj in ("regression_l1", "mape"):
+                base = float(np.median(y))
+            else:
+                base = float(y.mean())
         booster = self._fit_batches(
-            data, lambda: self._config("regression"), base_score=base
+            data, lambda: self._config(obj), base_score=base
         )
         m = LightGBMRegressionModel(
             features_col=self.get("features_col"),
@@ -360,7 +394,7 @@ class LightGBMRegressionModel(Model, _NativeModelIO, HasFeaturesCol, HasPredicti
         fc = self.get("features_col")
         return df.with_column(
             self.get("prediction_col"),
-            lambda p: booster.predict_raw(np.asarray(p[fc], np.float32)).astype(np.float64),
+            lambda p: booster.predict(np.asarray(p[fc], np.float32)).astype(np.float64),
         )
 
     def features_shap(self, x: np.ndarray, approximate: bool = False) -> np.ndarray:

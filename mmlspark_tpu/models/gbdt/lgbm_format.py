@@ -16,10 +16,11 @@ This module gives the TPU rebuild the same interop surface:
   ``k + 1`` — any parent-before-child emission order is valid).
 
 Semantics notes:
-- Missing values: our replay always routes NaN left. LightGBM records
-  missing handling per split (``decision_type`` default-left bit); imports
-  with default-right splits emit a warning — finite-valued prediction is
-  unaffected.
+- Missing values: the replay honors each split's ``decision_type``
+  default-left bit (NaN routes by the recorded direction; trained trees
+  are all default-left). What it cannot reproduce is missing_type None
+  (real LightGBM compares NaN as 0.0) and Zero (zero-as-missing); those
+  imports warn once per model.
 - Categorical values are capped at NUM_BINS - 2 (the identity-binning
   range); imported bitsets beyond that raise.
 """
@@ -40,29 +41,62 @@ _DEFAULT_LEFT = 2  # decision_type bit 1: missing goes left
 _MISSING_NAN = 2 << 2  # bits 2-3: missing_type (0=None, 1=Zero, 2=NaN)
 
 
-def _objective_string(objective: str, num_class: int) -> str:
-    return {
-        "binary": "binary sigmoid:1",
-        "multiclass": f"multiclass num_class:{num_class}",
-        "regression": "regression",
-        "lambdarank": "lambdarank",
-    }.get(objective, objective)
+def _objective_string(booster: Any) -> str:
+    """LightGBM's objective header line, with the objective's knobs in
+    LightGBM's own key:value token format."""
+    objective, num_class = booster.objective, booster.num_class
+    p = booster.objective_param
+    if objective == "binary":
+        return f"binary sigmoid:{booster.sigmoid:g}"
+    if objective == "multiclass":
+        return f"multiclass num_class:{num_class}"
+    if objective == "quantile":
+        return f"quantile alpha:{0.9 if p is None else p:g}"
+    if objective == "huber":
+        return f"huber alpha:{0.9 if p is None else p:g}"
+    if objective == "fair":
+        return f"fair fair_c:{1.0 if p is None else p:g}"
+    if objective == "tweedie":
+        return (
+            f"tweedie tweedie_variance_power:{1.5 if p is None else p:g}"
+        )
+    return objective
 
 
 def _parse_objective(s: str) -> tuple:
+    """objective= header -> (canonical name, num_class, param, sigmoid).
+
+    ``param`` is the regression knob (alpha / tweedie_variance_power /
+    fair_c) when present; ``sigmoid`` is the binary slope (default 1.0 —
+    models trained with a non-default slope must predict through it or
+    probabilities silently differ from real LightGBM)."""
+    from mmlspark_tpu.models.gbdt.objectives import (
+        REGRESSION_KINDS,
+        canonical_objective,
+    )
+
     parts = s.split()
     name = parts[0]
     num_class = 1
+    param = None
+    sigmoid = 1.0
     for p in parts[1:]:
         if p.startswith("num_class:"):
             num_class = int(p.split(":", 1)[1])
+        elif p.startswith("sigmoid:"):
+            sigmoid = float(p.split(":", 1)[1])
+        elif p.startswith(("alpha:", "tweedie_variance_power:", "fair_c:")):
+            param = float(p.split(":", 1)[1])
     if name.startswith("binary"):
-        return "binary", 1
-    if name.startswith("multiclass"):
-        return "multiclass", num_class
+        return "binary", 1, None, sigmoid
+    if name.startswith("multiclass") or name.startswith("softmax"):
+        return "multiclass", num_class, None, 1.0
     if name.startswith("lambdarank") or name.startswith("rank"):
-        return "lambdarank", 1
-    return "regression", 1
+        return "lambdarank", 1, None, 1.0
+    canon = canonical_objective(name)
+    if canon in REGRESSION_KINDS:
+        return canon, 1, param, 1.0
+    return "regression", 1, None, 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -113,10 +147,11 @@ def _tree_to_explicit(tree: Any) -> dict:
             cat_sets.append(vals)
             threshold.append(len(cat_sets) - 1)  # index into cat bitsets
         else:
-            # default-left + missing_type NaN: real lightgbm then routes
-            # NaN left, matching this replay (with missing_type None it
-            # would compare NaN as 0.0 instead)
-            decision_type.append(_DEFAULT_LEFT | _MISSING_NAN)
+            # missing_type NaN + the split's default direction (trained
+            # trees are all default-left; imported default-right splits
+            # round-trip their bit)
+            dl = tree.default_left is None or bool(tree.default_left[k])
+            decision_type.append((_DEFAULT_LEFT if dl else 0) | _MISSING_NAN)
             threshold.append(float(tree.threshold[k]))
         if parent[0] != "root":
             set_child(parent[0], parent[1], i)
@@ -134,23 +169,30 @@ def _tree_to_explicit(tree: Any) -> dict:
         leaf_value[idx] = float(tree.values[slot])
         leaf_count[idx] = int(tree.counts[slot])
 
-    # internal aggregates (bottom-up): value = count-weighted mean of leaves
+    # internal aggregates (bottom-up): value = count-weighted mean of
+    # leaves. Iterative post-order — a chain-shaped leaf-wise tree can be
+    # thousands of levels deep, past Python's recursion limit
     int_count = [0] * n_int
     int_value = [0.0] * n_int
-    def agg(node: int) -> tuple:
+    stack = [(0, False)]
+    while stack:
+        node, expanded = stack.pop()
+        if not expanded:
+            stack.append((node, True))
+            for child in (left_child[node], right_child[node]):
+                if child >= 0:
+                    stack.append((child, False))
+            continue
         c_tot, v_tot = 0.0, 0.0
         for child in (left_child[node], right_child[node]):
             if child < 0:
                 c, v = leaf_count[~child], leaf_value[~child]
-            else:
-                c, v = agg(child)
+            else:  # post-order: children already aggregated
+                c, v = int_count[child], int_value[child]
             c_tot += c
             v_tot += v * c
         int_count[node] = int(c_tot)
         int_value[node] = v_tot / c_tot if c_tot else 0.0
-        return c_tot, int_value[node]
-
-    agg(0)
     out = {
         "num_leaves": len(leaf_ids),
         "split_feature": split_feature,
@@ -203,7 +245,7 @@ def to_lightgbm_string(booster: Any) -> str:
         f"num_tree_per_iteration={booster.num_class}",
         "label_index=0",
         f"max_feature_idx={booster.num_features - 1}",
-        f"objective={_objective_string(booster.objective, booster.num_class)}",
+        f"objective={_objective_string(booster)}",
     ]
     if booster.boosting_type == "rf":
         lines.append("average_output")
@@ -267,7 +309,7 @@ def to_lightgbm_string(booster: Any) -> str:
 # ---------------------------------------------------------------------------
 
 
-def _explicit_to_tree(fields: dict) -> Any:
+def _explicit_to_tree(fields: dict, notes: Optional[set] = None) -> Any:
     from mmlspark_tpu.models.gbdt.booster import Tree
 
     num_leaves = int(fields["num_leaves"][0])
@@ -298,20 +340,20 @@ def _explicit_to_tree(fields: dict) -> Any:
     has_cat = bool((decision_type & _CAT_BIT).any())
     numerical = (decision_type & _CAT_BIT) == 0
     missing_type = (decision_type >> 2) & 3
-    # this replay's fixed semantics: NaN routes left, zeros compare
-    # numerically — i.e. missing_type NaN + default_left. Anything else
-    # (default-right, missing_type None's NaN-as-0.0, zero_as_missing)
-    # diverges for missing-valued rows; say so once per tree
-    if (
-        numerical
-        & (((decision_type & _DEFAULT_LEFT) == 0) | (missing_type != 2))
-    ).any():
-        log.warning(
-            "imported LightGBM tree has splits whose missing-value handling "
-            "(default-right, missing_type None or Zero) differs from this "
-            "replay's NaN-goes-left; rows with missing values may route "
-            "differently — finite-valued prediction is unaffected"
+    # the replay honors each split's default-left bit (NaN direction); what
+    # it cannot reproduce is missing_type None (LightGBM compares NaN as
+    # 0.0) and Zero (zeros routed as missing) — collect the note, the
+    # caller warns ONCE per model, not once per tree
+    if notes is not None and (numerical & (missing_type != 2)).any():
+        notes.add(
+            "imported LightGBM tree has numerical splits with missing_type "
+            "None or Zero (NaN-as-0.0 / zero-as-missing); this replay "
+            "compares NaN by the default-left bit and zeros numerically — "
+            "rows with missing values may route differently"
         )
+    has_dright = bool(
+        (numerical & ((decision_type & _DEFAULT_LEFT) == 0)).any()
+    )
 
     S = n_int
     rec_leaf = np.full(S, -1, np.int32)
@@ -323,6 +365,7 @@ def _explicit_to_tree(fields: dict) -> Any:
     counts = np.zeros(S + 1, np.int32)
     is_cat = np.zeros(S, bool) if has_cat else None
     catmask = np.zeros((S, NUM_BINS), bool) if has_cat else None
+    default_left = np.ones(S, bool) if has_dright else None
 
     queue = [(0, 0)]  # (internal node id, slot)
     k = 0
@@ -332,6 +375,8 @@ def _explicit_to_tree(fields: dict) -> Any:
         rec_feature[k] = split_feature[node]
         rec_active[k] = True
         rec_gain[k] = gain[node]
+        if default_left is not None and not (decision_type[node] & _CAT_BIT):
+            default_left[k] = bool(decision_type[node] & _DEFAULT_LEFT)
         if decision_type[node] & _CAT_BIT:
             ti = int(raw_threshold[node])
             words = cat_threshold[cat_boundaries[ti]: cat_boundaries[ti + 1]]
@@ -366,6 +411,7 @@ def _explicit_to_tree(fields: dict) -> Any:
         leaf=rec_leaf, feature=rec_feature, threshold=rec_threshold,
         active=rec_active, gain=rec_gain.astype(np.float32),
         values=values, counts=counts, is_cat=is_cat, catmask=catmask,
+        default_left=default_left,
     )
 
 
@@ -402,15 +448,23 @@ def from_lightgbm_string(text: str) -> Any:
         trees.append(cur)
     if "objective" not in header:
         raise ValueError("not a LightGBM model string (no objective= header)")
-    objective, num_class = _parse_objective(header["objective"])
+    objective, num_class, obj_param, sigmoid = _parse_objective(
+        header["objective"]
+    )
     num_class = int(header.get("num_class", num_class))
+    notes: set = set()
+    parsed = [_explicit_to_tree(t, notes) for t in trees]
+    for note in sorted(notes):
+        log.warning(note)
     booster = Booster(
-        trees=[_explicit_to_tree(t) for t in trees],
+        trees=parsed,
         objective=objective,
         num_class=num_class,
         num_features=int(header.get("max_feature_idx", -1)) + 1,
         feature_names=header.get("feature_names", "").split() or None,
         base_score=0.0,  # LightGBM bakes the average into leaf values
         boosting_type="rf" if average_output else "gbdt",
+        sigmoid=sigmoid,
+        objective_param=obj_param,
     )
     return booster

@@ -26,6 +26,147 @@ def l2_grad_hess(scores: jnp.ndarray, y: jnp.ndarray) -> tuple:
     return scores - y, jnp.ones_like(scores)
 
 
+# canonical regression objective kinds (LightGBM TrainParams.scala:8-40
+# objective passthrough; notebook "LightGBM - Quantile Regression for Drug
+# Discovery" exercises quantile). ``p1`` is the objective's knob:
+# quantile/huber -> alpha, tweedie -> tweedie_variance_power,
+# poisson -> poisson_max_delta_step, fair -> fair_c.
+REGRESSION_KINDS = (
+    "regression", "regression_l1", "quantile", "huber", "fair",
+    "poisson", "tweedie", "gamma", "mape",
+)
+
+# objectives whose raw score lives in log space: prediction applies exp
+# (LightGBM's convert_output for poisson/gamma/tweedie)
+LOG_LINK_KINDS = ("poisson", "tweedie", "gamma")
+
+_OBJECTIVE_ALIASES = {
+    "regression_l2": "regression", "l2": "regression", "mse": "regression",
+    "mean_squared_error": "regression", "root_mean_squared_error": "regression",
+    "rmse": "regression", "l2_root": "regression",
+    "l1": "regression_l1", "mae": "regression_l1",
+    "mean_absolute_error": "regression_l1",
+    "mean_absolute_percentage_error": "mape",
+}
+
+
+def canonical_objective(name: str) -> str:
+    """LightGBM objective aliases -> the canonical kind string."""
+    return _OBJECTIVE_ALIASES.get(name, name)
+
+
+def regression_grad_hess(
+    kind: str, scores: jnp.ndarray, y: jnp.ndarray, p1: jnp.ndarray
+) -> tuple:
+    """Gradient/hessian pairs for the regression objective zoo, formula-
+    matched to LightGBM's regression_objective.hpp (traced; ``kind`` is
+    static at the jit boundary)."""
+    r = scores - y
+    one = jnp.ones_like(scores)
+    if kind == "regression_l1":
+        # LightGBM keeps hess=1 for l1 (leaf renewal is its refinement;
+        # the Newton step with unit hessian is the same gradient boost)
+        return jnp.sign(r), one
+    if kind == "quantile":
+        # pinball: score >= label contributes (1-alpha), else -alpha
+        return jnp.where(r >= 0, 1.0 - p1, -p1), one
+    if kind == "huber":
+        return jnp.clip(r, -p1, p1), one
+    if kind == "fair":
+        a = jnp.abs(r) + p1
+        return p1 * r / a, p1 * p1 / (a * a)
+    if kind == "poisson":
+        # scores in log space; p1 = poisson_max_delta_step stabilizes the
+        # hessian exactly as LightGBM's exp(score + max_delta_step)
+        return jnp.exp(scores) - y, jnp.exp(scores + p1)
+    if kind == "tweedie":
+        e1 = jnp.exp((1.0 - p1) * scores)
+        e2 = jnp.exp((2.0 - p1) * scores)
+        return -y * e1 + e2, -y * (1.0 - p1) * e1 + (2.0 - p1) * e2
+    if kind == "gamma":
+        ei = jnp.exp(-scores)
+        return 1.0 - y * ei, y * ei
+    if kind == "mape":
+        w = 1.0 / jnp.maximum(1.0, jnp.abs(y))
+        return jnp.sign(r) * w, w
+    return r, one  # regression (l2)
+
+
+def regression_loss(kind: str, s: Any, y: Any, p1: float, xp: Any = np) -> Any:
+    """Pointwise loss of each regression objective — the eval metric the
+    trainer reports/early-stops on (``xp``: numpy on host, jnp on device so
+    the scan-fused path computes the identical number)."""
+    r = s - y
+    if kind == "regression_l1":
+        return xp.abs(r)
+    if kind == "quantile":
+        return xp.maximum(p1 * (y - s), (p1 - 1.0) * (y - s))
+    if kind == "huber":
+        a = xp.abs(r)
+        return xp.where(a <= p1, 0.5 * r * r, p1 * (a - 0.5 * p1))
+    if kind == "fair":
+        a = xp.abs(r)
+        return p1 * p1 * (a / p1 - xp.log1p(a / p1))
+    if kind == "poisson":
+        return xp.exp(s) - y * s
+    if kind == "tweedie":
+        return -y * xp.exp((1.0 - p1) * s) / (1.0 - p1) + xp.exp(
+            (2.0 - p1) * s
+        ) / (2.0 - p1)
+    if kind == "gamma":
+        return y * xp.exp(-s) + s
+    if kind == "mape":
+        return xp.abs(r) / xp.maximum(1.0, xp.abs(y))
+    return r * r  # l2
+
+
+# objectives whose leaf values LightGBM "renews" after growth: the Newton
+# step with unit hessian under-shoots the percentile these losses target,
+# so leaf outputs are recomputed as the weighted alpha-percentile of the
+# leaf's residuals (RegressionL1loss/QuantileLoss RenewTreeOutput)
+RENEWED_KINDS = ("regression_l1", "quantile", "mape")
+
+
+def leaf_quantile_renewal(
+    row_leaf: jnp.ndarray,   # (n,) int32 leaf of every row
+    resid: jnp.ndarray,      # (n,) f32 y - score (pre-update residuals)
+    w: jnp.ndarray,          # (n,) f32 row weights (0 = excluded)
+    num_leaves: int,
+    alpha: Any,
+) -> jnp.ndarray:
+    """Weighted alpha-percentile of residuals per leaf, on device.
+
+    Two-key stable sort (residual, then leaf) puts each leaf's rows in
+    residual order; the per-leaf crossing of cumulative weight past
+    alpha * total_weight is the weighted percentile — one scatter picks
+    all leaves' values at once. Returns (L,) f32 (0 for empty leaves)."""
+    L = num_leaves
+    ord1 = jnp.argsort(resid)
+    leaf1 = row_leaf[ord1]
+    ord2 = jnp.argsort(leaf1, stable=True)
+    order = ord1[ord2]
+    leaf_s = row_leaf[order]
+    r_s = resid[order]
+    w_s = w[order]
+    Wl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(w)
+    leaf_base = jnp.cumsum(Wl) - Wl                     # weight mass before leaf
+    within = jnp.cumsum(w_s) - leaf_base[leaf_s]        # cum weight inside leaf
+    target = jnp.maximum(alpha, 1e-12) * Wl[leaf_s]
+    crossing = (w_s > 0) & (within >= target) & (within - w_s < target)
+    vals = jnp.zeros((L,), jnp.float32).at[leaf_s].add(
+        jnp.where(crossing, r_s, 0.0)
+    )
+    return jnp.where(Wl > 0, vals, 0.0)
+
+
+def regression_metric_name(kind: str) -> str:
+    return {
+        "regression": "l2", "regression_l1": "l1", "quantile": "quantile",
+        "huber": "huber", "fair": "fair", "poisson": "poisson",
+        "tweedie": "tweedie", "gamma": "gamma", "mape": "mape",
+    }.get(kind, "l2")
+
+
 @jax.jit
 def multiclass_grad_hess(scores: jnp.ndarray, y_onehot: jnp.ndarray) -> tuple:
     """scores (n, k) -> grads/hess (n, k)."""

@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import functools
 import logging
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as _dc_replace
 from typing import Any, Callable, Optional
 
 import jax
@@ -49,6 +49,7 @@ import numpy as np
 
 from mmlspark_tpu.models.gbdt import objectives
 from mmlspark_tpu.models.gbdt.binning import BinMapper
+from mmlspark_tpu.ops.histogram import NUM_BINS
 from mmlspark_tpu.models.gbdt.booster import Booster, Tree, per_tree_raw
 from mmlspark_tpu.models.gbdt.treegrow import grow_tree
 
@@ -97,9 +98,25 @@ class TrainConfig:
     other_rate: float = 0.1
     # lambdarank eval truncation: NDCG@eval_at on the validation rows
     eval_at: int = 5
+    # regression-objective knobs (LightGBM TrainParams.scala:8-40)
+    alpha: float = 0.9                 # quantile level / huber delta
+    tweedie_variance_power: float = 1.5
+    poisson_max_delta_step: float = 0.7
+    fair_c: float = 1.0
     # training-lifecycle callbacks + dynamic learning rate
     # (LightGBMDelegate analogue, models/gbdt/delegate.py)
     delegate: Optional[Any] = None
+
+
+def _objective_p1(cfg: "TrainConfig") -> float:
+    """The (single) knob each regression objective consumes."""
+    return {
+        "quantile": cfg.alpha,
+        "huber": cfg.alpha,
+        "fair": cfg.fair_c,
+        "poisson": cfg.poisson_max_delta_step,
+        "tweedie": cfg.tweedie_variance_power,
+    }.get(cfg.objective, 0.0)
 
 
 _TREE_FIELDS = (
@@ -127,6 +144,20 @@ def _trees_from_device_batched(pending: list, mapper: BinMapper) -> list:
     ]
 
 
+def _pad_catmask(cm: np.ndarray) -> np.ndarray:
+    """Histogram-space catmask (S, B_hist) -> record-space (S, NUM_BINS).
+
+    Training histograms use the smallest tile-aligned bin space covering
+    ``max_bin``; stored trees keep the full uint8 space so prediction's
+    category->bin lookup (category_bin_slot, clipped to NUM_BINS-1) can
+    never index out of the mask. Padding bins carry no categories -> False
+    (unseen categories route RIGHT, LightGBM's other-category default)."""
+    if cm.shape[-1] >= NUM_BINS:
+        return cm
+    pad = [(0, 0)] * (cm.ndim - 1) + [(0, NUM_BINS - cm.shape[-1])]
+    return np.pad(cm, pad)
+
+
 def _tree_from_host_records(rec: dict, mapper: BinMapper) -> Tree:
     rec_leaf = rec["rec_leaf"]
     rec_feature = rec["rec_feature"]
@@ -149,7 +180,7 @@ def _tree_from_host_records(rec: dict, mapper: BinMapper) -> Tree:
         values=rec["leaf_values"],
         counts=rec["leaf_counts"],
         is_cat=is_cat if has_cat else None,
-        catmask=rec["rec_catmask"] if has_cat else None,
+        catmask=_pad_catmask(rec["rec_catmask"]) if has_cat else None,
     )
 
 
@@ -180,7 +211,7 @@ def _tree_from_device(grown: Any, mapper: BinMapper, value_scale: float = 1.0) -
         values=values,
         counts=np.asarray(grown.leaf_counts),
         is_cat=is_cat if has_cat else None,
-        catmask=np.asarray(grown.rec_catmask) if has_cat else None,
+        catmask=_pad_catmask(np.asarray(grown.rec_catmask)) if has_cat else None,
     )
 
 
@@ -277,18 +308,16 @@ def _eval_metric(
             k = int(metric.split("@", 1)[1])
         g = group_ids[mask] if group_ids is not None else np.zeros(len(yy), np.int64)
         return (f"ndcg@{k}", grouped_ndcg(s, yy, g, k=k), True)
-    return ("l2", float(((s - yy) ** 2).mean()), False)
+    return (
+        objectives.regression_metric_name(obj),
+        float(
+            objectives.regression_loss(obj, s, yy, _objective_p1(cfg)).mean()
+        ),
+        False,
+    )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "objective", "k", "grad_pre", "is_goss", "use_voting", "has_cat",
-        "num_leaves", "max_depth", "min_data_in_leaf", "top_k", "mesh",
-        "depthwise",
-    ),
-)
-def _fused_iteration(
+def _iteration_core(
     bins: jnp.ndarray,
     scores: jnp.ndarray,
     y_enc: Optional[jnp.ndarray],
@@ -298,6 +327,7 @@ def _fused_iteration(
     cat_mask: Optional[jnp.ndarray],
     g_pre: Optional[jnp.ndarray],
     h_pre: Optional[jnp.ndarray],
+    obj_p1: Any,
     top_rate: float,
     other_rate: float,
     lambda_l2: float,
@@ -318,12 +348,12 @@ def _fused_iteration(
     top_k: int,
     mesh: Any,
     depthwise: bool = False,
+    num_bins: int = NUM_BINS,
 ) -> tuple:
-    """One whole boosting iteration as ONE XLA program: gradients, GOSS
-    weights, k tree grows and the score update. Collapsing the per-iteration
-    dispatch chain matters on remote/tunneled devices (each dispatch is a
-    ~35 ms round trip) and saves scheduling overhead everywhere else.
-    Returns (new_scores, tuple of GrownTree per class)."""
+    """One boosting iteration (traced): gradients, GOSS weights, k tree
+    grows and the score update. Shared by the per-iteration dispatch path
+    (:func:`_fused_iteration`) and the scan-fused chunk path
+    (:func:`_scan_chunk`). Returns (new_scores, list of GrownTree)."""
     if grad_pre:
         g_dev, h_dev = g_pre, h_pre
     elif objective == "binary":
@@ -331,7 +361,9 @@ def _fused_iteration(
     elif objective == "multiclass":
         g_dev, h_dev = objectives.multiclass_grad_hess(scores, y_enc)
     else:
-        g_dev, h_dev = objectives.l2_grad_hess(scores, y_enc)
+        g_dev, h_dev = objectives.regression_grad_hess(
+            objective, scores, y_enc, obj_p1
+        )
     if is_goss:
         g_abs = jnp.abs(g_dev).sum(axis=1) if k > 1 else jnp.abs(g_dev)
         u = jax.random.uniform(jax.random.fold_in(it_key, 2), w_it.shape)
@@ -346,6 +378,7 @@ def _fused_iteration(
         feature_mask=fm,
         max_depth=max_depth,
         min_data_in_leaf=min_data_in_leaf,
+        num_bins=num_bins,
     )
     grown_list, deltas = [], []
     for c in range(k) if k > 1 else [0]:
@@ -365,10 +398,303 @@ def _fused_iteration(
             )
         else:
             grown = grow_tree(bins, gc, hc, w_it, categorical_mask=cat_mask, **grow_kw)
+        if (
+            objective in objectives.RENEWED_KINDS
+            and not grad_pre
+            and not use_voting
+        ):
+            # LightGBM's RenewTreeOutput: quantile-family leaf values are
+            # the weighted alpha-percentile of the leaf's residuals, not
+            # the unit-hessian Newton step (which undershoots the target
+            # percentile). Voting keeps Newton values: its row_leaf stays
+            # shard-local and a global sort would defeat the reduced-
+            # communication design.
+            q = obj_p1 if objective == "quantile" else 0.5
+            w_q = (
+                w_it / jnp.maximum(1.0, jnp.abs(y_enc))
+                if objective == "mape" else w_it
+            )
+            renewed = objectives.leaf_quantile_renewal(
+                grown.row_leaf, y_enc - scores, w_q, num_leaves, q
+            ) * learning_rate
+            grown = grown._replace(
+                leaf_values=jnp.where(grown.leaf_counts > 0, renewed, 0.0)
+            )
         grown_list.append(grown)
         deltas.append(grown.leaf_values[grown.row_leaf])
     new_scores = scores + (jnp.stack(deltas, axis=1) if k > 1 else deltas[0])
+    return new_scores, grown_list
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "k", "grad_pre", "is_goss", "use_voting", "has_cat",
+        "num_leaves", "max_depth", "min_data_in_leaf", "top_k", "mesh",
+        "depthwise", "num_bins",
+    ),
+)
+def _fused_iteration(
+    bins: jnp.ndarray,
+    scores: jnp.ndarray,
+    y_enc: Optional[jnp.ndarray],
+    w_it: jnp.ndarray,
+    it_key: jnp.ndarray,
+    fm: jnp.ndarray,
+    cat_mask: Optional[jnp.ndarray],
+    g_pre: Optional[jnp.ndarray],
+    h_pre: Optional[jnp.ndarray],
+    obj_p1: Any,
+    top_rate: float,
+    other_rate: float,
+    lambda_l2: float,
+    lambda_l1: float,
+    min_sum_hessian: float,
+    min_gain: float,
+    learning_rate: float,
+    *,
+    objective: str,
+    k: int,
+    grad_pre: bool,
+    is_goss: bool,
+    use_voting: bool,
+    has_cat: bool,
+    num_leaves: int,
+    max_depth: int,
+    min_data_in_leaf: int,
+    top_k: int,
+    mesh: Any,
+    depthwise: bool = False,
+    num_bins: int = NUM_BINS,
+) -> tuple:
+    """One whole boosting iteration as ONE XLA program — the dispatch-per-
+    iteration path kept for the modes whose loop does host work between
+    iterations (dart's tree mutation, lambdarank's host gradients,
+    delegates, multihost's replicated reads). Everything else trains
+    through :func:`_scan_chunk`, which fuses MANY iterations per dispatch.
+    Returns (new_scores, tuple of GrownTree per class)."""
+    new_scores, grown_list = _iteration_core(
+        bins, scores, y_enc, w_it, it_key, fm, cat_mask, g_pre, h_pre,
+        obj_p1, top_rate, other_rate, lambda_l2, lambda_l1, min_sum_hessian,
+        min_gain, learning_rate,
+        objective=objective, k=k, grad_pre=grad_pre, is_goss=is_goss,
+        use_voting=use_voting, has_cat=has_cat, num_leaves=num_leaves,
+        max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
+        top_k=top_k, mesh=mesh, depthwise=depthwise, num_bins=num_bins,
+    )
     return new_scores, tuple(grown_list)
+
+
+# all lower-is-better; computed on device inside the scan so eval costs no
+# extra host round trip (the host only reads the (C,) metric vector)
+_DEVICE_METRICS = (
+    "binary_logloss", "binary_error", "multi_logloss",
+) + objectives.REGRESSION_KINDS
+
+
+def _device_metric(
+    s: jnp.ndarray, y: jnp.ndarray, vw: jnp.ndarray, eval_kind: str,
+    obj_p1: Any = 0.0,
+) -> jnp.ndarray:
+    """Masked-mean validation metric, formula-matched to :func:`_eval_metric`
+    (same clips/logs so early-stopping decisions agree across paths)."""
+    wsum = jnp.maximum(vw.sum(), 1.0)
+    if eval_kind == "binary_logloss":
+        p = jnp.clip(jax.nn.sigmoid(s), 1e-15, 1 - 1e-15)
+        loss = -(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p))
+    elif eval_kind == "binary_error":
+        p = jax.nn.sigmoid(s)
+        loss = ((p > 0.5) != (y > 0.5)).astype(jnp.float32)
+    elif eval_kind == "multi_logloss":
+        p = jax.nn.softmax(s, axis=-1)
+        picked = jnp.clip((p * y).sum(axis=-1), 1e-15, 1.0)
+        loss = -jnp.log(picked)
+    else:  # the regression-objective zoo's own pointwise loss
+        loss = objectives.regression_loss(eval_kind, s, y, obj_p1, xp=jnp)
+    return (loss * vw).sum() / wsum
+
+
+# fields packed (in this order) into the one per-chunk host fetch;
+# rec_catmask is appended only when the model has categorical splits
+_PACK_FIELDS = (
+    "rec_leaf", "rec_feature", "rec_bin", "rec_active", "rec_gain",
+    "leaf_values", "leaf_counts", "rec_is_cat",
+)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "objective", "k", "grad_pre", "is_goss", "use_voting", "has_cat",
+        "num_leaves", "max_depth", "min_data_in_leaf", "top_k", "mesh",
+        "depthwise", "bagging_freq", "eval_kind", "is_rf", "num_bins",
+    ),
+)
+def _scan_chunk(
+    bins: jnp.ndarray,
+    scores0: jnp.ndarray,
+    y_enc: Optional[jnp.ndarray],
+    w_base: jnp.ndarray,
+    bag0: jnp.ndarray,
+    base_key: jnp.ndarray,
+    it_idx: jnp.ndarray,          # (C,) int32 absolute iteration numbers
+    fms: jnp.ndarray,             # (C, d) f32 feature-fraction masks
+    cat_mask: Optional[jnp.ndarray],
+    g_pre: Optional[jnp.ndarray],
+    h_pre: Optional[jnp.ndarray],
+    y_eval: Optional[jnp.ndarray],
+    valid_w: Optional[jnp.ndarray],
+    rf_base: Optional[jnp.ndarray],
+    obj_p1: Any,
+    bagging_fraction: float,
+    top_rate: float,
+    other_rate: float,
+    lambda_l2: float,
+    lambda_l1: float,
+    min_sum_hessian: float,
+    min_gain: float,
+    learning_rate: float,
+    *,
+    objective: str,
+    k: int,
+    grad_pre: bool,
+    is_goss: bool,
+    use_voting: bool,
+    has_cat: bool,
+    num_leaves: int,
+    max_depth: int,
+    min_data_in_leaf: int,
+    top_k: int,
+    mesh: Any,
+    depthwise: bool,
+    bagging_freq: int,
+    eval_kind: str,
+    is_rf: bool,
+    num_bins: int = NUM_BINS,
+) -> tuple:
+    """C whole boosting iterations as ONE XLA program (``lax.scan`` over
+    iterations). On a relay-attached TPU every dispatch costs ~35 ms and
+    every fetch ~70 ms, so the per-iteration loop pays
+    O(iterations) round trips; this pays ONE dispatch per chunk, computes
+    the eval metric on device, and packs every tree record of the chunk
+    into a single f32 buffer so the host does exactly one fetch.
+
+    Returns (final_scores, final_bag, packed (C, k, W) f32, metrics (C,)).
+    """
+    L = num_leaves
+
+    def body(carry: tuple, xs: tuple) -> tuple:
+        scores, bag = carry
+        it, fm = xs
+        it_key = jax.random.fold_in(base_key, it)
+        if bagging_freq > 0:
+            u = jax.random.uniform(jax.random.fold_in(it_key, 1), bag.shape)
+            newbag = (u < bagging_fraction).astype(jnp.float32)
+            bag = jnp.where(it % bagging_freq == 0, newbag, bag)
+            w_it = w_base * bag
+        else:
+            w_it = w_base
+        new_scores, grown_list = _iteration_core(
+            bins, scores, y_enc, w_it, it_key, fm, cat_mask, g_pre, h_pre,
+            obj_p1, top_rate, other_rate, lambda_l2, lambda_l1,
+            min_sum_hessian, min_gain, learning_rate,
+            objective=objective, k=k, grad_pre=grad_pre, is_goss=is_goss,
+            use_voting=use_voting, has_cat=has_cat, num_leaves=num_leaves,
+            max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
+            top_k=top_k, mesh=mesh, depthwise=depthwise, num_bins=num_bins,
+        )
+        recs = tuple(
+            tuple(
+                # counts split hi/lo so the f32 buffer stays exact past
+                # 2^24 rows per leaf (a single f32 would round them)
+                (getattr(g, f) // 4096, getattr(g, f) % 4096)
+                if f == "leaf_counts"
+                else (getattr(g, f),)
+                for f in _PACK_FIELDS
+            )
+            for g in grown_list
+        )
+        recs = tuple(
+            tuple(a for grp in r for a in grp)
+            + ((g.rec_catmask,) if has_cat else ())
+            for r, g in zip(recs, grown_list)
+        )
+        if eval_kind == "none":
+            m = jnp.float32(0.0)
+        else:
+            s_eval = new_scores
+            if is_rf:
+                s_eval = rf_base + new_scores / (it.astype(jnp.float32) + 1.0)
+            m = _device_metric(s_eval, y_eval, valid_w, eval_kind, obj_p1)
+        return (new_scores, bag), (recs, m)
+
+    (scores, bag), (recs, metrics) = jax.lax.scan(
+        body, (scores0, bag0), (it_idx, fms)
+    )
+    C = it_idx.shape[0]
+
+    def flat(i: int, a: jnp.ndarray) -> jnp.ndarray:
+        if has_cat and i == len(recs[0]) - 1:
+            # categorical bitmask: 16 bools per f32 word (exact: < 2^16),
+            # a 32x smaller fetch than one f32 per bool
+            bits = a.reshape(C, -1, 16).astype(jnp.float32)
+            return (bits * (2.0 ** jnp.arange(16, dtype=jnp.float32))).sum(-1)
+        return a.astype(jnp.float32).reshape(C, -1)
+
+    packed = jnp.stack(
+        [
+            jnp.concatenate(
+                [flat(i, a) for i, a in enumerate(recs[c])], axis=1
+            )
+            for c in range(len(recs))
+        ],
+        axis=1,
+    )  # (C, k, W)
+    return scores, bag, packed, metrics
+
+
+def _unpack_chunk_trees(
+    packed: np.ndarray, keep: int, k: int, L: int, has_cat: bool,
+    num_bins: int, mapper: BinMapper,
+) -> list:
+    """Split the chunk's packed f32 record buffer back into host Trees."""
+    widths = (
+        [L - 1] * 5 + [L, L, L, L - 1]
+        + ([(L - 1) * num_bins // 16] if has_cat else [])
+    )
+    offs = np.cumsum([0] + widths)
+    trees = []
+    for i in range(keep):
+        for c in range(k):
+            row = packed[i, c]
+            parts = [
+                row[offs[j]: offs[j + 1]] for j in range(len(widths))
+            ]
+            counts = (
+                parts[6].astype(np.int64) * 4096 + parts[7].astype(np.int64)
+            )
+            rec = {
+                "rec_leaf": parts[0].astype(np.int32),
+                "rec_feature": parts[1].astype(np.int32),
+                "rec_bin": parts[2].astype(np.int32),
+                "rec_active": parts[3] > 0.5,
+                "rec_gain": parts[4].astype(np.float32),
+                "leaf_values": parts[5].astype(np.float32),
+                "leaf_counts": counts.astype(np.int32),
+                "rec_is_cat": parts[8] > 0.5,
+                "rec_catmask": (
+                    (
+                        (
+                            parts[9].astype(np.int64)[:, None]
+                            >> np.arange(16)
+                        ) & 1
+                    ).astype(bool).reshape(L - 1, num_bins)
+                    if has_cat
+                    else np.zeros((L - 1, num_bins), bool)
+                ),
+            }
+            trees.append(_tree_from_host_records(rec, mapper))
+    return trees
 
 
 @jax.jit
@@ -419,6 +745,14 @@ def train(
     prediction replays it."""
     if cfg.boosting_type not in BOOSTING_TYPES:
         raise ValueError(f"boosting_type must be one of {BOOSTING_TYPES}")
+    canon = objectives.canonical_objective(cfg.objective)
+    if canon not in ("binary", "multiclass", "lambdarank") + objectives.REGRESSION_KINDS:
+        raise ValueError(f"unknown objective {cfg.objective!r}")
+    if canon != cfg.objective:
+        cfg = _dc_replace(cfg, objective=canon)
+    if canon in objectives.LOG_LINK_KINDS and np.any(np.asarray(y) < 0):
+        # log-link objectives model a nonnegative mean; LightGBM errors too
+        raise ValueError(f"objective {canon!r} requires non-negative labels")
     if cfg.growth_policy not in ("lossguide", "depthwise"):
         raise ValueError(
             f"growth_policy must be 'lossguide' or 'depthwise', got {cfg.growth_policy!r}"
@@ -522,6 +856,12 @@ def train(
             x, max_bin=cfg.max_bin, seed=cfg.seed, categorical_features=cat_features
         )
     bins_host = mapper.transform(x)
+    # histogram bin space: the smallest MXU-tile-aligned width covering
+    # every bin code (codes live in [0, max_bin-1]). At the default
+    # max_bin=255 this is the full uint8 space (256); smaller max_bin
+    # shrinks the one-hot compare loop — the VPU-bound part of the Pallas
+    # kernel — nearly proportionally. 16-aligned: bf16 sublane tile.
+    hist_bins = max(16, ((cfg.max_bin + 15) // 16) * 16)
     cat_mask_dev = None
     if cat_features:
         cat_mask_host = np.zeros(d, bool)
@@ -649,7 +989,10 @@ def train(
             )
             g_rf, h_rf = padded(g_np.astype(np.float32)), padded(h_np.astype(np.float32))
         else:
-            g_rf, h_rf = objectives.l2_grad_hess(rf_base, y_dev)
+            g_rf, h_rf = objectives.regression_grad_hess(
+                cfg.objective, rf_base, y_dev,
+                jnp.float32(_objective_p1(cfg)),
+            )
 
     rng = np.random.default_rng(cfg.seed)
     base_key = jax.random.PRNGKey(cfg.seed)
@@ -672,6 +1015,11 @@ def train(
     booster = Booster(
         trees=[], objective=cfg.objective, num_class=k, num_features=d,
         base_score=base_score, boosting_type=cfg.boosting_type,
+        objective_param=(
+            _objective_p1(cfg)
+            if cfg.objective in ("quantile", "huber", "fair", "tweedie")
+            else None
+        ),
     )
     pending_trees: list = []  # device-grown records, materialized after the loop
     x_host_dense: Optional[np.ndarray] = None  # dart re-predicts dropped trees
@@ -685,7 +1033,126 @@ def train(
     delegate = cfg.delegate
     lr_cur = float(cfg.learning_rate)
 
-    for it in range(cfg.num_iterations):
+    # -- scan-fused fast path ------------------------------------------------
+    # Everything whose loop needs no host work between iterations trains as
+    # chunked lax.scan programs: ONE dispatch (and one packed record fetch)
+    # per chunk instead of one per iteration. Excluded: dart (mutates past
+    # trees on host), lambdarank (host gradients), delegates (host
+    # callbacks), multihost (replicated small-read choreography), and
+    # host-only eval metrics (auc/ndcg need sorts we keep on host).
+    fast = (
+        delegate is None and not multihost and not is_dart
+        and cfg.objective != "lambdarank"
+    )
+    eval_needed = valid_mask is not None and bool(np.any(valid_mask))
+    eval_kind = "none"
+    if eval_needed:
+        if cfg.objective == "binary":
+            eval_kind = (
+                "binary_logloss" if cfg.metric in ("", "binary_logloss")
+                else "auc" if cfg.metric == "auc" else "binary_error"
+            )
+        elif cfg.objective == "multiclass":
+            eval_kind = "multi_logloss"
+        elif cfg.objective == "lambdarank":
+            eval_kind = "ndcg"
+        else:
+            eval_kind = cfg.objective
+        if eval_kind not in _DEVICE_METRICS:
+            fast = False
+
+    if fast:
+        eval_on = eval_kind != "none"
+        use_bag = bagging_freq > 0 and bagging_fraction < 1.0
+        # without early stopping the whole run is ONE chunk; with it, chunk
+        # so overshoot past the stopping point is bounded (surplus trees
+        # are computed then discarded — stopping decisions replay the (C,)
+        # device metric vector and match the sequential path exactly)
+        C_full = (
+            cfg.num_iterations if early_stopping_round == 0
+            else min(cfg.num_iterations, max(16, early_stopping_round))
+        )
+        bag_dev = jnp.ones_like(w_dev)
+        y_eval = valid_w = rf_base_dev = None
+        if eval_on:
+            y_eval = y_onehot_dev if k > 1 else y_dev
+            valid_w = padded(valid_mask.astype(np.float32))
+        grad_pre_f = is_rf
+        if is_rf:
+            g_pre_f, h_pre_f = g_rf, h_rf
+            rf_base_dev = rf_base if eval_on else None
+        else:
+            g_pre_f = h_pre_f = None
+        y_enc_f = None if grad_pre_f else (y_onehot_dev if k > 1 else y_dev)
+        it0 = 0
+        stopped = False
+        while it0 < cfg.num_iterations and not stopped:
+            C = min(C_full, cfg.num_iterations - it0)
+            if cfg.feature_fraction < 1.0:
+                fms = np.empty((C, d), np.float32)
+                for i in range(C):
+                    fm = (rng.random(d) < cfg.feature_fraction).astype(np.float32)
+                    if fm.sum() == 0:
+                        fm[rng.integers(d)] = 1.0
+                    fms[i] = fm
+            else:
+                fms = np.ones((C, d), np.float32)
+            scores, bag_dev, packed, metrics = _scan_chunk(
+                bins_dev, scores, y_enc_f, w_dev, bag_dev, base_key,
+                jnp.arange(it0, it0 + C, dtype=jnp.int32), jnp.asarray(fms),
+                cat_mask_dev, g_pre_f, h_pre_f, y_eval, valid_w, rf_base_dev,
+                float(_objective_p1(cfg)),
+                float(bagging_fraction),
+                float(cfg.top_rate), float(cfg.other_rate),
+                float(cfg.lambda_l2), float(cfg.lambda_l1),
+                float(cfg.min_sum_hessian_in_leaf),
+                float(cfg.min_gain_to_split),
+                1.0 if is_rf else lr_cur,
+                objective=cfg.objective, k=k, grad_pre=grad_pre_f,
+                is_goss=is_goss, use_voting=use_voting,
+                has_cat=cat_mask_dev is not None,
+                num_leaves=int(cfg.num_leaves), max_depth=int(cfg.max_depth),
+                min_data_in_leaf=int(cfg.min_data_in_leaf),
+                top_k=int(cfg.top_k), mesh=mesh if use_voting else None,
+                depthwise=cfg.growth_policy == "depthwise",
+                bagging_freq=int(bagging_freq) if use_bag else 0,
+                eval_kind=eval_kind, is_rf=is_rf, num_bins=hist_bins,
+            )
+            keep = C
+            if eval_on:
+                mvals = np.asarray(metrics)
+                for i in range(C):
+                    val = float(mvals[i])
+                    if cfg.verbosity > 0:
+                        log.info("iter %d %s=%.6f", it0 + i, eval_kind, val)
+                    if best_val is None or val < best_val:
+                        best_val, best_iter = val, it0 + i + 1
+                        rounds_no_improve = 0
+                    else:
+                        rounds_no_improve += 1
+                        if (
+                            early_stopping_round > 0
+                            and rounds_no_improve >= early_stopping_round
+                        ):
+                            log.info(
+                                "early stop at iter %d (best %d)",
+                                it0 + i, best_iter,
+                            )
+                            booster.best_iteration = best_iter
+                            stopped = True
+                            keep = i + 1
+                            break
+            booster.trees.extend(
+                _unpack_chunk_trees(
+                    np.asarray(packed), keep, k, int(cfg.num_leaves),
+                    cat_mask_dev is not None, hist_bins, mapper,
+                )
+            )
+            it0 += C
+
+    # dispatch-per-iteration path (dart / lambdarank / multihost /
+    # delegates / host-only eval metrics)
+    for it in range(0 if fast else cfg.num_iterations):
         if delegate is not None:
             delegate.before_train_iteration(it)
             # dynamic learning rate (getLearningRate delegate semantics);
@@ -748,6 +1215,7 @@ def train(
         new_scores, grown_all = _fused_iteration(
             bins_dev, eff_scores, y_enc, w_it, it_key, fm_dev, cat_mask_dev,
             g_pre, h_pre,
+            float(_objective_p1(cfg)),
             float(cfg.top_rate), float(cfg.other_rate),
             float(cfg.lambda_l2), float(cfg.lambda_l1),
             float(cfg.min_sum_hessian_in_leaf), float(cfg.min_gain_to_split),
@@ -757,7 +1225,7 @@ def train(
             num_leaves=int(cfg.num_leaves), max_depth=int(cfg.max_depth),
             min_data_in_leaf=int(cfg.min_data_in_leaf),
             top_k=int(cfg.top_k), mesh=mesh if use_voting else None,
-            depthwise=cfg.growth_policy == "depthwise",
+            depthwise=cfg.growth_policy == "depthwise", num_bins=hist_bins,
         )
         # the fused step fit against eff_scores (dart: scores minus dropped
         # trees); the running total keeps the dropped contribution
@@ -787,8 +1255,11 @@ def train(
                 )
             else:
                 # deferred materialization: split records stay on device;
-                # the host fetch happens ONCE, batched, after the loop
-                pending_trees.append(grown)
+                # the host fetch happens ONCE, batched, after the loop.
+                # row_leaf (an (n,)-sized device buffer) is dropped here —
+                # keeping it pinned per pending tree would hold
+                # O(n_rows x num_iterations) accelerator memory
+                pending_trees.append(grown._replace(row_leaf=None))
         if drop_set:
             # dropped trees shrink to k/(k+1): mutate their stored values
             # and fold the same correction into the running scores

@@ -69,11 +69,12 @@ def make_leaf_best(
     l1: Any,
     cat_f: jnp.ndarray,
     has_categorical: bool,
+    num_bins: int = NUM_BINS,
 ):
     """Best-split search over ONE leaf's (d*B, 3) histogram plane — the
     single source of split semantics shared by the leaf-wise (lossguide)
     and depthwise growers. Returns (gain, feature, bin/prefix, catmask)."""
-    B = NUM_BINS
+    B = num_bins
 
     def gscore(Gv: jnp.ndarray, Hv: jnp.ndarray) -> jnp.ndarray:
         return split_gain_term(Gv, Hv, lam, l1)
@@ -156,6 +157,7 @@ def grow_tree(
     categorical_mask: Optional[jnp.ndarray] = None,  # (d,) bool
     lambda_l1: float = 0.0,
     min_sum_hessian: float = 1e-3,
+    num_bins: int = NUM_BINS,
 ) -> GrownTree:
     """Grow one tree. The categorical-split machinery (per-leaf argsort of
     category bins) is statically compiled OUT when ``categorical_mask`` is
@@ -175,6 +177,7 @@ def grow_tree(
         max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
         categorical_mask=categorical_mask, has_categorical=has_categorical,
         lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
+        num_bins=num_bins,
     )
 
 
@@ -182,6 +185,7 @@ def grow_tree(
     jax.jit,
     static_argnames=(
         "num_leaves", "max_depth", "min_data_in_leaf", "has_categorical",
+        "num_bins",
     ),
 )
 def _grow_tree(
@@ -200,10 +204,11 @@ def _grow_tree(
     has_categorical: bool,
     lambda_l1: float = 0.0,
     min_sum_hessian: float = 1e-3,
+    num_bins: int = NUM_BINS,
 ) -> GrownTree:
     n, d = bins.shape
     L = num_leaves
-    B = NUM_BINS
+    B = num_bins
     bins = bins.astype(jnp.int32)
     cat_f = categorical_mask.astype(bool)
     lam = lambda_l2
@@ -225,14 +230,15 @@ def _grow_tree(
 
     def plane_hist(mask: jnp.ndarray) -> jnp.ndarray:
         """Histogram of the rows selected by ``mask`` -> (d*B, 3)."""
-        return plane_histogram(bins, row_stats, mask)
+        return plane_histogram(bins, row_stats, mask, num_bins=B)
 
     # best split of ONE leaf from its plane. Only state-free validity
     # (min_data, feature_fraction) is applied there; per-leaf state
     # (activity, depth) is applied at selection time, so cached results
     # stay exact until the leaf's histogram changes.
     leaf_best = make_leaf_best(
-        d, feature_mask, min_data_in_leaf, msh, lam, l1, cat_f, has_categorical
+        d, feature_mask, min_data_in_leaf, msh, lam, l1, cat_f,
+        has_categorical, num_bins=B,
     )
 
     def step(k: int, state: tuple) -> tuple:
@@ -366,6 +372,7 @@ def grow_tree_depthwise(
     categorical_mask: Optional[jnp.ndarray] = None,
     lambda_l1: float = 0.0,
     min_sum_hessian: float = 1e-3,
+    num_bins: int = NUM_BINS,
 ) -> GrownTree:
     """Depthwise (level-wise) growth — the XGBoost-hist/SparkML-GBT grow
     policy, built for the TPU cost model: every level's leaf histograms
@@ -394,6 +401,7 @@ def grow_tree_depthwise(
         n_levels=n_levels, min_data_in_leaf=min_data_in_leaf,
         categorical_mask=categorical_mask, has_categorical=has_categorical,
         lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
+        num_bins=num_bins,
     )
 
 
@@ -401,6 +409,7 @@ def grow_tree_depthwise(
     jax.jit,
     static_argnames=(
         "num_leaves", "n_levels", "min_data_in_leaf", "has_categorical",
+        "num_bins",
     ),
 )
 def _grow_tree_depthwise(
@@ -419,12 +428,13 @@ def _grow_tree_depthwise(
     has_categorical: bool,
     lambda_l1: float = 0.0,
     min_sum_hessian: float = 1e-3,
+    num_bins: int = NUM_BINS,
 ) -> GrownTree:
     from mmlspark_tpu.ops.histogram import multi_plane_histogram
 
     n, d = bins.shape
     L = num_leaves
-    B = NUM_BINS
+    B = num_bins
     bins = bins.astype(jnp.int32)
     cat_f = categorical_mask.astype(bool)
     g = grad * row_weight
@@ -433,7 +443,7 @@ def _grow_tree_depthwise(
     row_stats = jnp.stack([g, h, cnt_w], axis=-1)
     leaf_best = make_leaf_best(
         d, feature_mask, min_data_in_leaf, min_sum_hessian,
-        lambda_l2, lambda_l1, cat_f, has_categorical,
+        lambda_l2, lambda_l1, cat_f, has_categorical, num_bins=B,
     )
 
     row_slot = jnp.zeros((n,), jnp.int32)
@@ -453,7 +463,7 @@ def _grow_tree_depthwise(
     for level in range(n_levels):
         S = int(inv.shape[0])
         slot_local = jnp.where(row_slot < L, lut[jnp.clip(row_slot, 0, L - 1)], S)
-        cube = multi_plane_histogram(bins, row_stats, slot_local, S)
+        cube = multi_plane_histogram(bins, row_stats, slot_local, S, num_bins=B)
         gains, feats, bbs, catms = jax.vmap(leaf_best)(cube)
         # budget: when fewer than S splits remain, best-gain nodes win
         order = jnp.argsort(-gains)
@@ -565,33 +575,45 @@ def predict_leaves(
     rec_active: jnp.ndarray,   # (T, S) bool
     rec_is_cat: Optional[jnp.ndarray] = None,   # (T, S) bool
     rec_catmask: Optional[jnp.ndarray] = None,  # (T, S, B) bool; index = value+1
+    rec_default_left: Optional[jnp.ndarray] = None,  # (T, S) bool; NaN direction
 ) -> jnp.ndarray:
     """Replay split logs for all trees at once -> (n, T) leaf indices.
 
-    Numerical: NaN goes LEFT (missing-bin semantics). Categorical splits
-    route by set membership — a category value v looks up catmask[v + 1]
-    (identity binning; NaN -> slot 0, the missing category). Passing
-    rec_is_cat=None statically compiles the categorical machinery OUT —
-    all-numerical models pay nothing for it (mirrors grow_tree's gating)."""
+    Numerical: NaN goes LEFT by default (missing-bin semantics);
+    ``rec_default_left`` overrides the direction per split (LightGBM's
+    decision_type default-left bit — imported default-right splits route
+    NaN right). Categorical splits route by set membership — a category
+    value v looks up catmask[v + 1] (identity binning; NaN -> slot 0, the
+    missing category). Passing rec_is_cat/rec_default_left as None
+    statically compiles that machinery OUT — the common case pays nothing
+    for it (mirrors grow_tree's gating)."""
     n = x.shape[0]
     T, S = rec_leaf.shape
     B = NUM_BINS
     row_leaf = jnp.zeros((n, T), jnp.int32)
     has_cat = rec_is_cat is not None
+    has_dl = rec_default_left is not None
     if has_cat and rec_catmask is None:
         rec_catmask = jnp.zeros((T, S, B), bool)
 
     # scan over split steps: right child id of step k is k+1
     def body(row_leaf: jnp.ndarray, inputs: tuple) -> tuple:
+        it = iter(inputs)
+        k, leaf, feat, thr, active = (next(it) for _ in range(5))
         if has_cat:
-            k, leaf, feat, thr, active, is_cat, catmask = inputs
-        else:
-            k, leaf, feat, thr, active = inputs
+            is_cat, catmask = next(it), next(it)
+        if has_dl:
+            dleft = next(it)
         vals = jnp.take_along_axis(
             x, jnp.broadcast_to(jnp.clip(feat, 0, x.shape[1] - 1)[None, :], (n, T)), axis=1
         )
         in_leaf = row_leaf == leaf[None, :]
-        right_num = (vals > thr[None, :]) & ~jnp.isnan(vals)
+        if has_dl:
+            right_num = jnp.where(
+                jnp.isnan(vals), ~dleft[None, :], vals > thr[None, :]
+            )
+        else:
+            right_num = (vals > thr[None, :]) & ~jnp.isnan(vals)
         if has_cat:
             vbin = category_bin_slot(vals, B, jnp)  # (n, T)
             left_cat = jnp.take_along_axis(
@@ -608,6 +630,8 @@ def predict_leaves(
     xs = (ks, rec_leaf.T, rec_feature.T, rec_threshold.T, rec_active.T)
     if has_cat:
         xs = xs + (rec_is_cat.T, jnp.moveaxis(rec_catmask, 1, 0))
+    if has_dl:
+        xs = xs + (rec_default_left.T,)
     row_leaf, _ = jax.lax.scan(body, row_leaf, xs)
     return row_leaf
 

@@ -30,7 +30,7 @@ class _BinaryTree:
 
     __slots__ = (
         "left", "right", "feature", "threshold", "is_cat", "catmask",
-        "value", "cover",
+        "value", "cover", "default_left",
     )
 
     def __init__(self, tree) -> None:
@@ -42,6 +42,7 @@ class _BinaryTree:
         self.threshold = np.zeros(max_nodes, np.float64)
         self.is_cat = np.zeros(max_nodes, bool)
         self.catmask = [None] * max_nodes
+        self.default_left = np.ones(max_nodes, bool)
         self.value = np.zeros(max_nodes, np.float64)
         self.cover = np.zeros(max_nodes, np.float64)
 
@@ -61,6 +62,8 @@ class _BinaryTree:
             if tree.is_cat is not None and tree.is_cat[k]:
                 self.is_cat[node] = True
                 self.catmask[node] = tree.catmask[k]
+            if tree.default_left is not None:
+                self.default_left[node] = bool(tree.default_left[k])
             node_of_leaf[parent_leaf] = l_node
             node_of_leaf[k + 1] = r_node
         for leaf_id, node in node_of_leaf.items():
@@ -80,8 +83,11 @@ class _BinaryTree:
         if self.is_cat[node]:
             vbin = treegrow.category_bin_slot(np.asarray([v]), len(self.catmask[node]), np)[0]
             return bool(self.catmask[node][vbin])
-        # NaN routes LEFT, matching predict_leaves and the Saabas walk
-        return bool(np.isnan(v) or v <= self.threshold[node])
+        # NaN routes by the split's default direction (left unless an
+        # imported default-right split), matching predict_leaves/Saabas
+        if np.isnan(v):
+            return bool(self.default_left[node])
+        return bool(v <= self.threshold[node])
 
 
 def shap_values(tree, x: np.ndarray) -> np.ndarray:

@@ -59,6 +59,7 @@ def grow_tree_voting(
     axis: str = DATA_AXIS,
     lambda_l1: float = 0.0,
     min_sum_hessian: float = 1e-3,
+    num_bins: int = NUM_BINS,
 ) -> GrownTree:
     """Grow one tree with PV-Tree voting over ``mesh``'s ``axis``."""
     if mesh is None:
@@ -67,7 +68,7 @@ def grow_tree_voting(
         mesh = get_mesh()
     program = _voting_program(
         mesh, axis, int(num_leaves), int(max_depth), int(min_data_in_leaf),
-        int(top_k),
+        int(top_k), int(num_bins),
     )
     return program(
         bins, grad, hess, row_weight,
@@ -78,9 +79,12 @@ def grow_tree_voting(
 
 
 @functools.lru_cache(maxsize=None)
-def _voting_program(mesh, axis, num_leaves, max_depth, min_data_in_leaf, top_k):
+def _voting_program(
+    mesh, axis, num_leaves, max_depth, min_data_in_leaf, top_k,
+    num_bins=NUM_BINS,
+):
     L = num_leaves
-    B = NUM_BINS
+    B = num_bins
 
     def program(bins, grad, hess, row_weight, lambda_l2, min_gain,
                 learning_rate, feature_mask, lambda_l1, min_sum_hessian):
@@ -106,7 +110,7 @@ def _voting_program(mesh, axis, num_leaves, max_depth, min_data_in_leaf, top_k):
         def plane_hist(mask):
             # LOCAL histogram plane — stays on the shard (scatter lowering;
             # single-shard shapes, no GSPMD collectives inside shard_map)
-            return plane_histogram(bins, row_stats, mask)
+            return plane_histogram(bins, row_stats, mask, num_bins=B)
 
         def local_feature_gains(plane):
             """(d*B, 3) LOCAL plane -> (d,) best local gain per feature

@@ -52,7 +52,7 @@ def use_pallas() -> bool:
         return False
 
 
-def _hist_kernel(bins_ref, stats_ref, out_ref):
+def _hist_kernel(bins_ref, stats_ref, out_ref, *, num_bins: int):
     """One (feature-block, row-chunk) step: accumulate one-hot @ stats."""
     import jax.experimental.pallas as pl
 
@@ -65,7 +65,7 @@ def _hist_kernel(bins_ref, stats_ref, out_ref):
     bins = bins_ref[:]          # (DF, NC) int32; out-of-range = contribute nowhere
     stats = stats_ref[:]        # (NC, 3) f32 (already mask-scaled; 0 rows inert)
     df, nc = bins.shape
-    b = NUM_BINS
+    b = num_bins
     # one_hot[f, v, r] = (bins[f, r] == v): a 3-D iota compare instead of a
     # repeat — Mosaic lowers the broadcast/compare on the VPU, and the
     # (features, rows) layout keeps the 128-lane dim on rows so the block
@@ -87,12 +87,14 @@ def _hist_kernel(bins_ref, stats_ref, out_ref):
     out_ref[:] += acc[:, :3] + acc[:, 3:]
 
 
-def _plane_histogram_pallas(bins: jnp.ndarray, stats: jnp.ndarray) -> jnp.ndarray:
+def _plane_histogram_pallas(
+    bins: jnp.ndarray, stats: jnp.ndarray, num_bins: int = NUM_BINS
+) -> jnp.ndarray:
     """(n, d) int32 bins + (n, 3) stats -> (d * B, 3) plane via Pallas."""
     import jax.experimental.pallas as pl
 
     n, d = bins.shape
-    b = NUM_BINS
+    b = num_bins
     d_pad = ((d + _DF - 1) // _DF) * _DF
     n_pad = ((n + _NC - 1) // _NC) * _NC
     # sentinel: any value outside [0, B) matches no one-hot column, so the
@@ -108,7 +110,7 @@ def _plane_histogram_pallas(bins: jnp.ndarray, stats: jnp.ndarray) -> jnp.ndarra
         stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))
 
     out = pl.pallas_call(
-        _hist_kernel,
+        functools.partial(_hist_kernel, num_bins=b),
         grid=(d_pad // _DF, n_pad // _NC),
         in_specs=[
             pl.BlockSpec((_DF, _NC), lambda f, r: (f, r)),
@@ -121,7 +123,9 @@ def _plane_histogram_pallas(bins: jnp.ndarray, stats: jnp.ndarray) -> jnp.ndarra
     return out[: d * b]
 
 
-def _multi_kernel(bins_ref, stats_ref, slot_ref, out_ref, *, num_slots: int):
+def _multi_kernel(
+    bins_ref, stats_ref, slot_ref, out_ref, *, num_slots: int, num_bins: int
+):
     """One (feature-block, row-chunk) step of the multi-leaf build: the
     bin one-hot is built ONCE and contracted against slot-masked stats
     columns, producing every leaf's plane stripe in a single wide matmul
@@ -138,7 +142,7 @@ def _multi_kernel(bins_ref, stats_ref, slot_ref, out_ref, *, num_slots: int):
     stats = stats_ref[:]        # (NC, 3) f32
     slot = slot_ref[:]          # (1, NC) int32; out-of-range = no plane
     df, nc = bins.shape
-    b = NUM_BINS
+    b = num_bins
     v = jax.lax.broadcasted_iota(jnp.int32, (df, b, nc), 1)
     one_hot = (bins[:, None, :] == v).astype(jnp.bfloat16)
     s_hi = stats.astype(jnp.bfloat16).astype(jnp.float32)
@@ -157,14 +161,15 @@ def _multi_kernel(bins_ref, stats_ref, slot_ref, out_ref, *, num_slots: int):
 
 
 def _multi_plane_pallas(
-    bins: jnp.ndarray, stats: jnp.ndarray, slot: jnp.ndarray, num_slots: int
+    bins: jnp.ndarray, stats: jnp.ndarray, slot: jnp.ndarray, num_slots: int,
+    num_bins: int = NUM_BINS,
 ) -> jnp.ndarray:
     import functools as _ft
 
     import jax.experimental.pallas as pl
 
     n, d = bins.shape
-    b = NUM_BINS
+    b = num_bins
     d_pad = ((d + _DF - 1) // _DF) * _DF
     n_pad = ((n + _NC - 1) // _NC) * _NC
     sentinel = b
@@ -176,7 +181,7 @@ def _multi_plane_pallas(
         stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))
         slot = jnp.pad(slot, (0, n_pad - n), constant_values=num_slots)
     packed = pl.pallas_call(
-        _ft.partial(_multi_kernel, num_slots=num_slots),
+        _ft.partial(_multi_kernel, num_slots=num_slots, num_bins=b),
         grid=(d_pad // _DF, n_pad // _NC),
         in_specs=[
             pl.BlockSpec((_DF, _NC), lambda f, r: (f, r)),
@@ -198,10 +203,11 @@ def _multi_plane_pallas(
 
 
 def _multi_plane_scatter(
-    bins: jnp.ndarray, stats: jnp.ndarray, slot: jnp.ndarray, num_slots: int
+    bins: jnp.ndarray, stats: jnp.ndarray, slot: jnp.ndarray, num_slots: int,
+    num_bins: int = NUM_BINS,
 ) -> jnp.ndarray:
     n, d = bins.shape
-    b = NUM_BINS
+    b = num_bins
     plane_idx = (jnp.arange(d, dtype=jnp.int32) * b)[None, :] + bins   # (n, d)
     flat = slot[:, None] * (d * b) + plane_idx
     oob = (
@@ -222,6 +228,7 @@ def multi_plane_histogram(
     stats: jnp.ndarray,
     slot: jnp.ndarray,
     num_slots: int,
+    num_bins: int = NUM_BINS,
 ) -> jnp.ndarray:
     """Histogram planes for MANY leaves in one pass over the rows.
 
@@ -232,16 +239,20 @@ def multi_plane_histogram(
     across all the level's leaves."""
     if use_pallas():
         return _multi_plane_pallas(
-            bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots
+            bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots,
+            num_bins,
         )
     return _multi_plane_scatter(
-        bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots
+        bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots,
+        num_bins,
     )
 
 
-def _plane_histogram_scatter(bins: jnp.ndarray, stats: jnp.ndarray) -> jnp.ndarray:
+def _plane_histogram_scatter(
+    bins: jnp.ndarray, stats: jnp.ndarray, num_bins: int = NUM_BINS
+) -> jnp.ndarray:
     n, d = bins.shape
-    b = NUM_BINS
+    b = num_bins
     plane_idx = (jnp.arange(d, dtype=jnp.int32) * b)[None, :] + bins  # (n, d)
     # out-of-range bins contribute nowhere (a negative bin would otherwise
     # alias into the previous feature's stripe; matches the Pallas lowering)
@@ -253,7 +264,8 @@ def _plane_histogram_scatter(bins: jnp.ndarray, stats: jnp.ndarray) -> jnp.ndarr
 
 
 def plane_histogram(
-    bins: jnp.ndarray, stats: jnp.ndarray, mask: jnp.ndarray | None = None
+    bins: jnp.ndarray, stats: jnp.ndarray, mask: jnp.ndarray | None = None,
+    num_bins: int = NUM_BINS,
 ) -> jnp.ndarray:
     """(d * NUM_BINS, 3) gradient-histogram plane of the masked rows.
 
@@ -263,5 +275,5 @@ def plane_histogram(
     if mask is not None:
         stats = stats * mask[:, None]
     if use_pallas():
-        return _plane_histogram_pallas(bins.astype(jnp.int32), stats)
-    return _plane_histogram_scatter(bins.astype(jnp.int32), stats)
+        return _plane_histogram_pallas(bins.astype(jnp.int32), stats, num_bins)
+    return _plane_histogram_scatter(bins.astype(jnp.int32), stats, num_bins)

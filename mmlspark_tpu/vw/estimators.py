@@ -27,10 +27,13 @@ from mmlspark_tpu.core.params import (
     Param,
 )
 from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.ops.hashing import murmur3_bytes
 from mmlspark_tpu.vw.featurizer import HasNumBits, combine_namespaces
 from mmlspark_tpu.vw.learner import (
     LOSS_LOGISTIC,
+    LOSS_QUANTILE,
     LOSS_SQUARED,
+    LOSSES,
     predict_margin,
     train_sparse_sgd,
 )
@@ -44,11 +47,30 @@ class _VowpalWabbitBase(
     VowpalWabbitBase.scala:139-169 — params map 1:1 to VW flags)."""
 
     num_passes = Param("passes over the data (--passes)", default=1, type_=int)
+    loss_function = Param(
+        "logistic | squared | quantile ('' = estimator default; "
+        "--loss_function)", default="", type_=str,
+    )
+    quantile_tau = Param(
+        "pinball level for loss_function=quantile (--quantile_tau)",
+        default=0.5, type_=float,
+    )
+    pass_through_args = Param(
+        "VW-style argument string (passThroughArgs, "
+        "VowpalWabbitBase.scala:77-81): recognized flags (--loss_function, "
+        "--quantile_tau, -l/--learning_rate, --power_t, --l2, --passes, "
+        "--adaptive, -b/--bit_precision) override the matching params; "
+        "unknown flags warn and are ignored",
+        default="", type_=str,
+    )
     learning_rate = Param("initial learning rate (-l)", default=0.5, type_=float)
     power_t = Param("lr decay exponent (--power_t)", default=0.5, type_=float)
     l2 = Param("L2 regularization (--l2)", default=0.0, type_=float)
     adaptive = Param("AdaGrad per-coordinate rates (--adaptive)", default=True, type_=bool)
-    batch_size = Param("device minibatch size per shard", default=64, type_=int)
+    batch_size = Param(
+        "device minibatch size per shard (0 = auto: 1024 on TPU, 64 "
+        "elsewhere)", default=0, type_=int,
+    )
     additional_features = Param(
         "extra sparse namespace columns concatenated into the example",
         default=[],
@@ -60,25 +82,98 @@ class _VowpalWabbitBase(
         default=False,
         type_=bool,
     )
+    no_constant = Param(
+        "drop VW's always-present intercept feature (--noconstant)",
+        default=False, type_=bool,
+    )
 
     _loss = LOSS_LOGISTIC
 
-    def _gather(self, df: DataFrame) -> tuple:
+    def _resolve_args(self) -> dict:
+        """Param values with the pass-through arg string folded in."""
+        out = {
+            "loss": self.get("loss_function") or self._loss,
+            "tau": self.get("quantile_tau"),
+            "lr": self.get("learning_rate"),
+            "power_t": self.get("power_t"),
+            "l2": self.get("l2"),
+            "passes": self.get("num_passes"),
+            "adaptive": self.get("adaptive"),
+            "bits": None,
+        }
+        args = (self.get("pass_through_args") or "").split()
+        i = 0
+        import logging
+
+        log = logging.getLogger("mmlspark_tpu.vw")
+        flag_map = {
+            "--loss_function": ("loss", str),
+            "--quantile_tau": ("tau", float),
+            "-l": ("lr", float), "--learning_rate": ("lr", float),
+            "--power_t": ("power_t", float),
+            "--l2": ("l2", float),
+            "--passes": ("passes", int),
+            "-b": ("bits", int), "--bit_precision": ("bits", int),
+        }
+        while i < len(args):
+            a = args[i]
+            if a == "--adaptive":
+                out["adaptive"] = True
+                i += 1
+            elif a == "--no_adaptive":
+                out["adaptive"] = False
+                i += 1
+            elif a in flag_map and i + 1 < len(args):
+                key, conv = flag_map[a]
+                out[key] = conv(args[i + 1])
+                i += 2
+            else:
+                log.warning("pass_through_args: ignoring unrecognized %r", a)
+                i += 1
+        if out["loss"] not in LOSSES:
+            raise ValueError(
+                f"loss_function must be one of {LOSSES}, got {out['loss']!r}"
+            )
+        return out
+
+    def _gather(self, df: DataFrame, bits_override: Optional[int] = None) -> tuple:
         fc = self.get("features_col")
         cols = [fc] + list(self.get("additional_features"))
         sparse_rows = combine_namespaces({c: df[c] for c in cols}, cols)
-        num_bits = df.column_metadata(fc).get(NUM_BITS_META) or self.get("num_bits")
+        feat_bits = int(
+            df.column_metadata(fc).get(NUM_BITS_META) or self.get("num_bits")
+        )
+        num_bits = feat_bits
+        if bits_override is not None:
+            # -b/--bit_precision resizes the weight table, but features
+            # were already hashed into the featurizer's space — a smaller
+            # table would silently alias every overflowing index
+            if bits_override < feat_bits:
+                raise ValueError(
+                    f"bit_precision {bits_override} is smaller than the "
+                    f"featurized space ({feat_bits} bits); re-featurize "
+                    "with the smaller num_bits instead"
+                )
+            num_bits = int(bits_override)
         idx, val = pad_sparse_batch(sparse_rows)
+        if not self.get("no_constant"):
+            # VW's intercept: every example carries the hashed "Constant"
+            # feature with value 1 unless --noconstant (vw core behavior;
+            # without it, e.g. quantile loss cannot shift its level).
+            # Hashed in the FINAL bit space so training and scoring (which
+            # reads the model's num_bits) agree on the slot.
+            idx, val = _append_constant(idx, val, num_bits)
         y = df[self.get("label_col")].astype(np.float32)
         wc = self.get("weight_col")
         wt = df[wc].astype(np.float32) if wc else None
-        return idx, val, y, wt, int(num_bits)
+        return idx, val, y, wt, num_bits
 
     def _train_weights(self, df: DataFrame) -> tuple:
         if df.count() == 0:
             raise ValueError(f"{type(self).__name__}: empty training dataframe")
-        idx, val, y, wt, num_bits = self._gather(df)
-        if self._loss == LOSS_LOGISTIC:
+        args = self._resolve_args()
+        idx, val, y, wt, num_bits = self._gather(df, bits_override=args["bits"])
+        if args["loss"] == LOSS_LOGISTIC:
             y = np.where(y > 0, 1.0, -1.0).astype(np.float32)
         t0 = time.perf_counter_ns()
         w = train_sparse_sgd(
@@ -87,14 +182,15 @@ class _VowpalWabbitBase(
             y,
             wt,
             num_bits,
-            loss=self._loss,
-            num_passes=self.get("num_passes"),
+            loss=args["loss"],
+            num_passes=args["passes"],
             batch=self.get("batch_size"),
-            lr=self.get("learning_rate"),
-            power_t=self.get("power_t"),
-            l2=self.get("l2"),
-            adaptive=self.get("adaptive"),
+            lr=args["lr"],
+            power_t=args["power_t"],
+            l2=args["l2"],
+            adaptive=args["adaptive"],
             initial_weights=self.get("initial_model"),
+            quantile_tau=args["tau"],
         )
         t1 = time.perf_counter_ns()
         from mmlspark_tpu.parallel.mesh import cluster_summary
@@ -117,8 +213,21 @@ class _VowpalWabbitBase(
             num_bits=num_bits,
             features_col=self.get("features_col"),
             additional_features=self.get("additional_features"),
+            no_constant=self.get("no_constant"),
             performance_statistics=stats,
         )
+
+
+def _constant_slot(num_bits: int) -> int:
+    """The hashed index of VW's intercept feature in this bit space."""
+    return int(murmur3_bytes(b"Constant", 0)) & ((1 << num_bits) - 1)
+
+
+def _append_constant(idx: np.ndarray, val: np.ndarray, num_bits: int) -> tuple:
+    n = len(idx)
+    c = np.full((n, 1), _constant_slot(num_bits), idx.dtype)
+    v = np.ones((n, 1), val.dtype)
+    return np.concatenate([idx, c], axis=1), np.concatenate([val, v], axis=1)
 
 
 class _VowpalWabbitBaseModel(Model, HasFeaturesCol, HasPredictionCol):
@@ -128,6 +237,7 @@ class _VowpalWabbitBaseModel(Model, HasFeaturesCol, HasPredictionCol):
     weights = ComplexParam("(2^num_bits,) learned weights")
     num_bits = Param("hashed space width", default=18, type_=int)
     additional_features = Param("extra namespace columns", default=[], type_=list)
+    no_constant = Param("intercept feature absent (--noconstant)", default=False, type_=bool)
     performance_statistics = ComplexParam("per-shard training diagnostics DataFrame")
 
     def get_performance_statistics(self) -> DataFrame:
@@ -142,6 +252,8 @@ class _VowpalWabbitBaseModel(Model, HasFeaturesCol, HasPredictionCol):
     def _margins(self, p: dict) -> np.ndarray:
         cols = [self.get("features_col")] + list(self.get("additional_features"))
         idx, val = pad_sparse_batch(combine_namespaces(p, cols))
+        if not self.get("no_constant"):
+            idx, val = _append_constant(idx, val, self.get("num_bits"))
         return predict_margin(idx, val, np.asarray(self.get_or_fail("weights")))
 
 

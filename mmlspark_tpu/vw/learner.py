@@ -27,14 +27,20 @@ from mmlspark_tpu.parallel.mesh import DATA_AXIS, get_mesh
 
 LOSS_LOGISTIC = "logistic"
 LOSS_SQUARED = "squared"
+LOSS_QUANTILE = "quantile"
+LOSSES = (LOSS_LOGISTIC, LOSS_SQUARED, LOSS_QUANTILE)
 
 
-def _dloss(loss: str, margin: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
-    """d(loss)/d(margin). logistic expects y in {-1,+1}; squared raw y."""
+def _dloss(loss: str, margin: jnp.ndarray, y: jnp.ndarray, tau: float) -> jnp.ndarray:
+    """d(loss)/d(margin). logistic expects y in {-1,+1}; squared/quantile
+    raw y. ``tau`` is the pinball level (--quantile_tau, VW's
+    quantile loss: VowpalWabbitBase.scala:495-508 passes the flag through)."""
     if loss == LOSS_LOGISTIC:
         return -y * jax.nn.sigmoid(-y * margin)
     if loss == LOSS_SQUARED:
         return margin - y
+    if loss == LOSS_QUANTILE:
+        return jnp.where(margin >= y, 1.0 - tau, -tau)
     raise ValueError(f"unknown loss {loss!r}")
 
 
@@ -48,6 +54,7 @@ def _shard_train(
     y: jnp.ndarray,  # (n,) f32
     wt: jnp.ndarray,  # (n,) f32 example weights, 0 for padding rows
     w0: jnp.ndarray,  # (D,) f32 initial weights
+    tau: jnp.ndarray,  # pinball level (quantile loss only)
     *,
     loss: str,
     num_passes: int,
@@ -70,9 +77,13 @@ def _shard_train(
         bi, bv, by, bw = xs
         gathered = w[bi]  # (B, K) gather from HBM
         margin = (gathered * bv).sum(-1)
-        dl = _dloss(loss, margin, by) * bw  # (B,)
+        dl = _dloss(loss, margin, by, tau) * bw  # (B,)
         g = dl[:, None] * bv + l2 * gathered * (bv != 0)  # (B, K)
         if adaptive:
+            # the accumulator scatter runs BEFORE the denominator gather so
+            # a feature repeated across the minibatch sees the whole
+            # batch's g^2 — the aggressive-step blowup a fused
+            # single-scatter variant suffers on duplicate-heavy data
             g2 = g2.at[bi].add(g * g)
             denom = jnp.sqrt(g2[bi]) + 1e-6
             w = w.at[bi].add(-lr * g / denom)
@@ -117,20 +128,27 @@ def train_sparse_sgd(
     *,
     loss: str = LOSS_LOGISTIC,
     num_passes: int = 1,
-    batch: int = 64,
+    batch: int = 0,
     lr: float = 0.5,
     power_t: float = 0.5,
     l2: float = 0.0,
     adaptive: bool = True,
     initial_weights: Optional[np.ndarray] = None,
     distributed: bool = True,
+    quantile_tau: float = 0.5,
 ) -> np.ndarray:
     """Train on the (padded) sparse batch; returns the (2^num_bits,) weights.
 
     ``distributed=True`` shards rows over the mesh ``data`` axis via
-    ``shard_map`` so every pass ends in an ICI ``pmean``."""
+    ``shard_map`` so every pass ends in an ICI ``pmean``.
+
+    ``batch <= 0`` = auto: 1024 on TPU (the gather/scatter SGD step is
+    latency-bound there — bigger minibatches keep the chip busy), 64
+    elsewhere (closer to VW's per-example updates)."""
     d = 1 << num_bits
     n = len(y)
+    if batch <= 0:
+        batch = 1024 if jax.default_backend() == "tpu" else 64
     wt = np.ones(n, np.float32) if wt is None else np.asarray(wt, np.float32)
     mesh = get_mesh()
     n_shards = mesh.shape[DATA_AXIS] if distributed else 1
@@ -180,6 +198,7 @@ def train_sparse_sgd(
         l2=l2,
         adaptive=adaptive,
     )
+    tau = np.float32(quantile_tau)
     if not distributed or n_shards == 1:
         w = _shard_train(
             jnp.asarray(idx, jnp.int32),
@@ -187,6 +206,7 @@ def train_sparse_sgd(
             jnp.asarray(y, jnp.float32),
             jnp.asarray(wt),
             jnp.asarray(w0),
+            tau,
             axis=None,
             **kwargs,
         )
@@ -195,7 +215,7 @@ def train_sparse_sgd(
     fn = shard_apply(
         functools.partial(_shard_train, axis=DATA_AXIS, **kwargs),
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
         out_specs=P(),
     )
     if multihost:
@@ -206,7 +226,7 @@ def train_sparse_sgd(
              np.asarray(y, np.float32), wt.astype(np.float32)),
             mesh,
         )
-        w = jax.jit(fn)(*rows, w0)  # w0: identical host array == replicated
+        w = jax.jit(fn)(*rows, w0, tau)  # w0: identical host array == replicated
         return np.asarray(w)
     w = jax.jit(fn)(
         jnp.asarray(idx, jnp.int32),
@@ -214,6 +234,7 @@ def train_sparse_sgd(
         jnp.asarray(y, jnp.float32),
         jnp.asarray(wt),
         jnp.asarray(w0),
+        tau,
     )
     return np.asarray(w)
 

@@ -96,3 +96,37 @@ class TestMultiPlane:
         cube = np.asarray(multi_plane_histogram(bins, stats, slot, 2))
         # only the two in-range rows land: each hits d=2 features x 3 stats
         assert cube.sum() == 2 * 2 * 3
+
+
+def test_plane_histogram_num_bins_variants():
+    """Parameterized bin space: B=64/16 planes must equal the dense-256
+    plane restricted to the live bins (same scatter/Pallas agreement)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    n, d = 1000, 5
+    for b in (64, 16):
+        bins = jnp.asarray(rng.integers(0, b, size=(n, d)).astype(np.int32))
+        stats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+        small = np.asarray(H.plane_histogram(bins, stats, num_bins=b))
+        full = np.asarray(H.plane_histogram(bins, stats)).reshape(d, 256, 3)
+        np.testing.assert_allclose(
+            small.reshape(d, b, 3), full[:, :b], rtol=1e-5, atol=1e-5
+        )
+
+
+def test_multi_plane_histogram_num_bins_variants():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(10)
+    n, d, S, b = 800, 4, 3, 32
+    bins = jnp.asarray(rng.integers(0, b, size=(n, d)).astype(np.int32))
+    stats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+    slot = jnp.asarray(rng.integers(0, S, size=(n,)).astype(np.int32))
+    small = np.asarray(H.multi_plane_histogram(bins, stats, slot, S, num_bins=b))
+    full = np.asarray(H.multi_plane_histogram(bins, stats, slot, S)).reshape(
+        S, d, 256, 3
+    )
+    np.testing.assert_allclose(
+        small.reshape(S, d, b, 3), full[:, :, :b], rtol=1e-5, atol=1e-5
+    )

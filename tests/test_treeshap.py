@@ -94,9 +94,16 @@ def test_saabas_and_exact_share_sum_but_differ():
 
 
 def test_exact_with_categorical_splits():
-    booster, x = small_model(cat=(3,), seed=2)
-    if not any(t.has_categorical for t in booster.trees):
-        pytest.skip("grower produced no categorical split")
+    # label carries a categorical component so the grower reliably makes a
+    # categorical split (no data-dependent skip)
+    r = np.random.default_rng(2)
+    x = r.normal(size=(300, 4)).astype(np.float32)
+    x[:, 3] = r.integers(0, 4, size=300)
+    y = (x[:, 0] + 2.0 * np.isin(x[:, 3], (0, 2)) > 0.5).astype(np.float64)
+    cfg = TrainConfig(objective="binary", num_iterations=3, num_leaves=8,
+                      min_data_in_leaf=10, seed=2, categorical_features=(3,))
+    booster = train(x, y, cfg)
+    assert any(t.has_categorical for t in booster.trees)
     contribs = booster.feature_contribs(x[:10])
     raw = booster.predict_raw(x[:10])
     np.testing.assert_allclose(contribs.sum(axis=1), raw, rtol=1e-5, atol=1e-5)
@@ -112,8 +119,7 @@ def test_brute_force_on_categorical_tree():
                       min_data_in_leaf=10, categorical_features=(2,))
     booster = train(x, y, cfg)
     tree = booster.trees[0]
-    if not tree.has_categorical:
-        pytest.skip("grower produced no categorical split")
+    assert tree.has_categorical
     got = shap_values(tree, x[:3].astype(np.float64))
     for i in range(3):
         want = brute_shapley(tree, x[i], 3)
