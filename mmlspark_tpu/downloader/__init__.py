@@ -1,3 +1,13 @@
 from mmlspark_tpu.downloader.zoo import ModelDownloader, ModelSchema, RemoteRepository
+from mmlspark_tpu.downloader.torch_import import (
+    import_torch_resnet,
+    install_torch_checkpoint,
+)
 
-__all__ = ["ModelDownloader", "ModelSchema", "RemoteRepository"]
+__all__ = [
+    "ModelDownloader",
+    "ModelSchema",
+    "RemoteRepository",
+    "import_torch_resnet",
+    "install_torch_checkpoint",
+]

@@ -56,6 +56,9 @@ class ModelSchema:
     uri: Optional[str] = None
     sha256: Optional[str] = None
     seed: int = 0
+    # torch-exact strided padding: set for torchvision-imported weights so
+    # the flax module reproduces torchvision feature maps (torch_import.py)
+    torch_padding: bool = False
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=1)
@@ -193,7 +196,8 @@ class ModelDownloader:
             raise IOError(f"checksum mismatch for model {name}")
         variables = fser.msgpack_restore(blob)
         module = RESNETS[schema.variant](
-            num_classes=schema.num_classes, small_inputs=schema.small_inputs
+            num_classes=schema.num_classes, small_inputs=schema.small_inputs,
+            torch_padding=schema.torch_padding,
         )
         return module, variables, schema
 

@@ -779,21 +779,12 @@ def train(
     # the histogram allreduce over DCN (the reference's per-machine dataset
     # build + socket allreduce, TrainUtils.scala:26-66,496-512)
     multihost = shard and jax.process_count() > 1
-    if multihost:
-        unsupported = [
-            name
-            for flag, name in (
-                # lambdarank gradients need group-contiguous global sorts;
-                # voting's shard_map grower is untested across processes
-                (cfg.objective == "lambdarank", "lambdarank"),
-                (cfg.parallelism == "voting_parallel", "voting_parallel"),
-            )
-            if flag
-        ]
-        if unsupported:
-            raise NotImplementedError(
-                f"multi-host training does not yet support: {unsupported}"
-            )
+    # lambdarank across processes: each process computes its own groups'
+    # pairwise gradients on host — a query group must live ENTIRELY on one
+    # process (the reference has the same contract: LightGBMRanker requires
+    # a query's rows on a single partition, LightGBMRanker.scala).
+    # voting_parallel across processes: the shard_map grower's psums simply
+    # ride DCN instead of ICI — same program, bigger mesh.
 
     if multihost:
         # bin bounds must be IDENTICAL on every process: fit the mapper on
@@ -903,6 +894,14 @@ def train(
         )
         w_dev = shard_batch_multihost(np.pad(w, (0, pad)), mesh)
         n_pad = share * jax.process_count()  # GLOBAL padded row count
+        if cfg.parallelism == "voting_parallel":
+            if not cat_features:
+                use_voting = True
+            else:
+                log.info(
+                    "voting_parallel needs numerical features; "
+                    "falling back to data_parallel"
+                )
     elif shard:
         from mmlspark_tpu.parallel.mesh import DATA_AXIS, get_mesh
         from mmlspark_tpu.parallel.sharding import pad_batch, shard_batch
@@ -1205,7 +1204,12 @@ def train(
         if is_rf:
             g_pre, h_pre = g_rf, h_rf
         elif cfg.objective == "lambdarank":
-            s_host = np.asarray(eff_scores)[:n]
+            # multihost: this process's score block only — its groups are
+            # process-local by contract, so the pairwise grads are exact
+            s_host = (
+                _local_block_rows(eff_scores, n)
+                if multihost else np.asarray(eff_scores)[:n]
+            )
             g_np, h_np = objectives.lambdarank_grad_hess(
                 s_host.astype(np.float64), y.astype(np.float64), group_ids
             )
@@ -1281,17 +1285,33 @@ def train(
                 if is_rf:
                     s_eval = _local_block_rows(rf_base, n) + s_eval / (it + 1)
                 if mh_eval_ctx is None:
-                    # y and the valid mask are loop-invariant: one gather
+                    # y, the valid mask and (ranking) group ids are
+                    # loop-invariant: one gather. Group labels are only
+                    # unique per process — offset by process index so two
+                    # processes' query 0s stay distinct queries globally
+                    gid_l = (
+                        group_ids.astype(np.float64) * jax.process_count()
+                        + jax.process_index()
+                        if group_ids is not None
+                        else np.zeros(n, np.float64)
+                    )
                     ym = _gather_rows(
-                        np.stack([y, valid_mask.astype(np.float64)], 1),
+                        np.stack(
+                            [y, valid_mask.astype(np.float64), gid_l], 1
+                        ),
                         n, share,
                     )
-                    mh_eval_ctx = (ym[:, 0], ym[:, 1] > 0.5)
-                y_g, m_g = mh_eval_ctx
+                    mh_eval_ctx = (
+                        ym[:, 0], ym[:, 1] > 0.5, ym[:, 2].astype(np.int64)
+                    )
+                y_g, m_g, gid_g = mh_eval_ctx
                 sg2 = _gather_rows(s_eval, n, share)
                 s_g = sg2 if k > 1 else sg2[:, 0]
                 if m_g.any():
-                    name, val, higher = _eval_metric(cfg, s_g, y_g, m_g, None)
+                    name, val, higher = _eval_metric(
+                        cfg, s_g, y_g, m_g,
+                        gid_g if group_ids is not None else None,
+                    )
             else:
                 s_eval = np.asarray(scores)[:n]
                 if is_rf:

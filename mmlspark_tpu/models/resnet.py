@@ -24,10 +24,24 @@ import numpy as np
 ModuleDef = Any
 
 
+def _conv_padding(kernel: int, strides: int, torch_padding: bool):
+    """'SAME' unless torch parity is requested on a STRIDED conv.
+
+    At stride 1 XLA's SAME padding equals torch's symmetric (k-1)//2; at
+    stride 2 SAME becomes asymmetric ((0,1) for 3x3, (2,3) for 7x7) while
+    torch stays symmetric — importing torchvision weights without matching
+    this shifts every strided feature map by a pixel."""
+    if torch_padding and strides > 1:
+        p = (kernel - 1) // 2
+        return ((p, p), (p, p))
+    return "SAME"
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: Any = jnp.bfloat16
+    torch_padding: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -38,7 +52,10 @@ class BottleneckBlock(nn.Module):
         residual = x
         y = conv(self.filters, (1, 1))(x)
         y = nn.relu(norm()(y))
-        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding=_conv_padding(3, self.strides, self.torch_padding),
+        )(y)
         y = nn.relu(norm()(y))
         y = conv(self.filters * 4, (1, 1))(y)
         y = norm(scale_init=nn.initializers.zeros)(y)
@@ -52,6 +69,7 @@ class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: Any = jnp.bfloat16
+    torch_padding: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -60,7 +78,10 @@ class BasicBlock(nn.Module):
             nn.BatchNorm, use_running_average=not train, momentum=0.9, dtype=self.dtype
         )
         residual = x
-        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding=_conv_padding(3, self.strides, self.torch_padding),
+        )(x)
         y = nn.relu(norm()(y))
         y = conv(self.filters, (3, 3))(y)
         y = norm(scale_init=nn.initializers.zeros)(y)
@@ -84,6 +105,9 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     small_inputs: bool = False  # CIFAR-style stem (3x3, no maxpool)
+    # torch-exact padding on strided convs/pool so torchvision-imported
+    # weights reproduce torchvision features (see _conv_padding)
+    torch_padding: bool = False
 
     LAYER_NAMES = ("logits", "pool", "layer4", "layer3", "layer2", "layer1", "stem")
 
@@ -98,10 +122,14 @@ class ResNet(nn.Module):
         if self.small_inputs:
             x = conv(self.num_filters, (3, 3), name="conv_init")(x)
         else:
-            x = conv(self.num_filters, (7, 7), strides=(2, 2), name="conv_init")(x)
+            x = conv(
+                self.num_filters, (7, 7), strides=(2, 2), name="conv_init",
+                padding=_conv_padding(7, 2, self.torch_padding),
+            )(x)
         x = nn.relu(norm(name="bn_init")(x))
         if not self.small_inputs:
-            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            pool_pad = ((1, 1), (1, 1)) if self.torch_padding else "SAME"
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=pool_pad)
         outputs["stem"] = x
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
@@ -110,6 +138,7 @@ class ResNet(nn.Module):
                     filters=self.num_filters * 2 ** i,
                     strides=strides,
                     dtype=self.dtype,
+                    torch_padding=self.torch_padding,
                 )(x, train=train)
             outputs[f"layer{i + 1}"] = x
         x = jnp.mean(x, axis=(1, 2))

@@ -16,12 +16,17 @@ id on the same machine, and crashed partitions replay their queue history
   never recompiles (the load-bearing TPU detail).
 - :class:`DriverRegistry` — the driver-side registration service workers
   report their ``ServiceInfo`` to (DriverServiceUtils analogue).
+- :class:`ServingGateway` / :class:`BackendPool` — the distributed mode:
+  N workers behind ONE endpoint with registry discovery, round-robin
+  dispatch and cross-worker re-dispatch when a worker dies mid-request
+  (DistributedHTTPSource analogue).
 - ``make_reply`` / ``request_to_row`` — ServingUDFs analogues.
 """
 
 from mmlspark_tpu.serving.server import CachedRequest, ServiceInfo, WorkerServer
 from mmlspark_tpu.serving.query import ServingQuery, serve_transformer
 from mmlspark_tpu.serving.registry import DriverRegistry
+from mmlspark_tpu.serving.distributed import Backend, BackendPool, ServingGateway
 from mmlspark_tpu.serving.udfs import make_reply, request_to_json, request_to_text
 
 __all__ = [
@@ -31,6 +36,9 @@ __all__ = [
     "ServingQuery",
     "serve_transformer",
     "DriverRegistry",
+    "Backend",
+    "BackendPool",
+    "ServingGateway",
     "make_reply",
     "request_to_json",
     "request_to_text",

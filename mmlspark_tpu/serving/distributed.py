@@ -1,0 +1,312 @@
+"""Distributed serving: N WorkerServers behind ONE gateway endpoint.
+
+The reference runs one HTTP source per executor with the driver
+aggregating ServiceInfos and fronting them with a load balancer
+(DistributedHTTPSource.scala:26-130; deployment modes in
+docs/mmlspark-serving.md:93-160). The TPU rebuild keeps the per-worker
+WorkerServer/ServingQuery pair unchanged and adds:
+
+- :class:`BackendPool` — the live-worker roster with round-robin pick and
+  failure cooldown;
+- :class:`ServingGateway` — a front door (itself a WorkerServer, so the
+  epoch/history/replay machinery guards the client-facing queue) whose
+  dispatcher threads forward each request to a backend worker and reply on
+  the originating socket;
+- cross-worker recovery: a request forwarded to a worker that dies
+  mid-flight is re-dispatched to ANOTHER worker — the uncommitted-epoch
+  replay of HTTPSourceV2.scala:470-487, landing on a different worker, so
+  a worker crash loses zero accepted requests;
+- :class:`DriverRegistry` discovery: pass ``registry_url`` and the pool
+  refreshes from the roster, picking up workers that (re)register.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from mmlspark_tpu.serving.server import ServiceInfo, WorkerServer
+
+log = logging.getLogger("mmlspark_tpu.serving")
+
+
+@dataclass(frozen=True)
+class Backend:
+    host: str
+    port: int
+    path: str = "/"
+
+    @staticmethod
+    def from_info(info: dict) -> "Backend":
+        # prefer the public (forwarded) endpoint when the worker fronted
+        # itself with an ssh tunnel
+        return Backend(
+            host=info.get("forwarded_host") or info["host"],
+            port=int(info.get("forwarded_port") or info["port"]),
+            path=info.get("path") or "/",
+        )
+
+
+class BackendPool:
+    """Round-robin roster with failure cooldown + dead-entry eviction.
+
+    A worker that fails ``evict_after`` consecutive times is marked DEAD:
+    registry refreshes skip it until its registration timestamp changes
+    (i.e. the worker actually re-registered) — a crashed worker's stale
+    ephemeral-port entry cannot keep adding failed-connect latency forever.
+    """
+
+    def __init__(
+        self, backends: Optional[list] = None, cooldown_s: float = 5.0,
+        evict_after: int = 3,
+    ):
+        self._lock = threading.Lock()
+        self._backends: list = list(backends or ())
+        self._cooldown: dict = {}
+        self._fails: dict = {}
+        self._dead: dict = {}    # backend -> roster stamp at eviction
+        self._stamps: dict = {}  # backend -> latest roster stamp
+        self._rr = 0
+        self.cooldown_s = cooldown_s
+        self.evict_after = evict_after
+
+    def refresh(self, backends: list, stamps: Optional[dict] = None) -> None:
+        with self._lock:
+            self._stamps = dict(stamps or {})
+            live = []
+            for b in backends:
+                dead_at = self._dead.get(b)
+                if dead_at is not None:
+                    if self._stamps.get(b, 0.0) > dead_at:
+                        # re-registered since eviction: give it another life
+                        del self._dead[b]
+                        self._fails.pop(b, None)
+                    else:
+                        continue
+                live.append(b)
+            self._backends = live
+            self._cooldown = {
+                b: t for b, t in self._cooldown.items() if b in self._backends
+            }
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._backends)
+
+    def next(self, exclude: Optional[set] = None) -> Optional[Backend]:
+        """The next live backend, skipping cooled-down and ``exclude``d
+        ones; falls back to a cooled-down backend rather than none (it may
+        have recovered — better one retry than a refused request)."""
+        now = time.monotonic()
+        exclude = exclude or set()
+        with self._lock:
+            n = len(self._backends)
+            fallback = None
+            for i in range(n):
+                b = self._backends[(self._rr + i) % n]
+                if b in exclude:
+                    continue
+                if self._cooldown.get(b, 0.0) > now:
+                    fallback = fallback or b
+                    continue
+                self._rr = (self._rr + i + 1) % n
+                return b
+            return fallback
+
+    def report_failure(self, b: Backend) -> None:
+        with self._lock:
+            self._cooldown[b] = time.monotonic() + self.cooldown_s
+            self._fails[b] = self._fails.get(b, 0) + 1
+            if self._fails[b] >= self.evict_after:
+                self._dead[b] = self._stamps.get(b, 0.0)
+                self._backends = [x for x in self._backends if x != b]
+
+    def report_ok(self, b: Backend) -> None:
+        with self._lock:
+            self._cooldown.pop(b, None)
+            self._fails.pop(b, None)
+
+
+class ServingGateway:
+    """One client-facing endpoint dispatching onto N serving workers.
+
+    ``workers``: static list of :class:`ServiceInfo`/dict/:class:`Backend`;
+    and/or ``registry_url``: a :class:`DriverRegistry` endpoint polled
+    every ``refresh_s`` so late-registering or restarted workers join the
+    pool without a gateway restart."""
+
+    # hop-by-hop headers that must not be forwarded verbatim
+    _SKIP_HEADERS = {"connection", "content-length", "host", "keep-alive"}
+
+    def __init__(
+        self,
+        workers: Optional[list] = None,
+        registry_url: Optional[str] = None,
+        service_name: str = "serving",
+        host: str = "127.0.0.1",
+        port: int = 0,
+        num_dispatchers: int = 4,
+        request_timeout_s: float = 10.0,
+        refresh_s: float = 1.0,
+        cooldown_s: float = 5.0,
+        max_attempts: Optional[int] = None,
+    ):
+        self.service_name = service_name
+        self._ingress = WorkerServer(
+            host=host, port=port, name=f"{service_name}-gateway"
+        )
+        self._pool = BackendPool(
+            [self._as_backend(w) for w in (workers or ())],
+            cooldown_s=cooldown_s,
+        )
+        self._registry_url = registry_url
+        self._refresh_s = refresh_s
+        self._timeout = request_timeout_s
+        self._num_dispatchers = num_dispatchers
+        self._max_attempts = max_attempts
+        self._threads: list = []
+        self._stop = threading.Event()
+        self.forwarded = 0
+        self.retried = 0
+        self.failed = 0
+
+    @staticmethod
+    def _as_backend(w) -> Backend:
+        if isinstance(w, Backend):
+            return w
+        if isinstance(w, ServiceInfo):
+            return Backend(
+                host=w.forwarded_host or w.host,
+                port=int(w.forwarded_port or w.port),
+                path=w.path,
+            )
+        return Backend.from_info(dict(w))
+
+    @property
+    def pool(self) -> BackendPool:
+        return self._pool
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> ServiceInfo:
+        if self._registry_url:
+            self._refresh_once()
+            t = threading.Thread(
+                target=self._refresh_loop, name="gateway-refresh", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        info = self._ingress.start()
+        for i in range(self._num_dispatchers):
+            t = threading.Thread(
+                target=self._dispatch_loop, name=f"gateway-dispatch-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        return info
+
+    def stop(self) -> None:
+        # order matters: dispatchers drain and 503 the queue while the
+        # ingress can still deliver replies; only then does the ingress
+        # close client sockets
+        self._stop.set()
+        for t in self._threads:
+            t.join(5.0)
+        self._ingress.stop()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._ingress.host}:{self._ingress.port}/"
+
+    # -- registry discovery ---------------------------------------------------
+
+    def _refresh_once(self) -> None:
+        from mmlspark_tpu.io.clients import send_request
+        from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+        try:
+            resp = send_request(
+                HTTPRequestData(self._registry_url, "GET"), timeout=5.0
+            )
+            roster = json.loads(resp["entity"])
+        except Exception as e:  # noqa: BLE001 — discovery must never crash
+            log.warning("gateway: registry refresh failed: %s", e)
+            return
+        infos = roster.get(self.service_name, [])
+        if infos:
+            self._pool.refresh(
+                [Backend.from_info(i) for i in infos],
+                stamps={
+                    Backend.from_info(i): float(i.get("ts") or 0.0)
+                    for i in infos
+                },
+            )
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self._refresh_s):
+            self._refresh_once()
+
+    # -- dispatch -------------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            reqs = self._ingress.get_next_batch(max_n=16, timeout_s=0.2)
+            for r in reqs:
+                if self._stop.is_set():
+                    # a popped request must still get an answer
+                    self._ingress.reply_to(r.id, b"gateway stopping", 503)
+                    continue
+                self._forward(r)
+        # drain: answer whatever is still queued so clients aren't hung
+        # (stop() joins dispatchers BEFORE closing the ingress, so these
+        # replies still reach their sockets)
+        for r in self._ingress.get_next_batch(max_n=1_000_000, timeout_s=0.0):
+            self._ingress.reply_to(r.id, b"gateway stopping", 503)
+
+    def _forward(self, req) -> None:
+        attempts = self._max_attempts or max(2, self._pool.size() + 1)
+        tried: set = set()
+        headers = {
+            k: v for k, v in req.headers.items()
+            if k.lower() not in self._SKIP_HEADERS
+        }
+        for attempt in range(attempts):
+            b = self._pool.next(exclude=tried)
+            if b is None:
+                break
+            try:
+                conn = http.client.HTTPConnection(
+                    b.host, b.port, timeout=self._timeout
+                )
+                conn.request(req.method, b.path, body=req.body, headers=headers)
+                resp = conn.getresponse()
+                body = resp.read()
+                conn.close()
+            except (OSError, http.client.HTTPException):
+                # the cross-worker replay: this worker is down or died
+                # mid-request (refused connect OR a half-written response
+                # — IncompleteRead/BadStatusLine are HTTPException, not
+                # OSError); cool it down and re-dispatch elsewhere
+                tried.add(b)
+                self._pool.report_failure(b)
+                self.retried += 1
+                continue
+            self._pool.report_ok(b)
+            self.forwarded += 1
+            out_headers = {}
+            ct = resp.getheader("Content-Type")
+            if ct:
+                out_headers["Content-Type"] = ct
+            self._ingress.reply_to(req.id, body, resp.status, out_headers)
+            return
+        self.failed += 1
+        self._ingress.reply_to(
+            req.id, b'{"error": "no live serving workers"}', 503,
+            {"Content-Type": "application/json"},
+        )

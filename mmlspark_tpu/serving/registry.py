@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -40,10 +41,16 @@ class DriverRegistry:
                     self.end_headers()
                     return
                 with registry._lock:
-                    # re-registration replaces the same host (a restarted
-                    # worker's stale port must not linger in the roster)
+                    # re-registration replaces the same (host, port) — a
+                    # restarted worker must not linger twice, but several
+                    # workers on one host (distinct ports) all coexist
                     entries = registry._services.setdefault(name, [])
-                    entries[:] = [e for e in entries if e.get("host") != info.get("host")]
+                    key = (info.get("host"), info.get("port"))
+                    entries[:] = [
+                        e for e in entries
+                        if (e.get("host"), e.get("port")) != key
+                    ]
+                    info["ts"] = time.time()  # consumers detect re-registration
                     entries.append(info)
                 body = b'{"registered": true}'
                 self.send_response(200)

@@ -154,6 +154,36 @@ GBDT_WORKER = textwrap.dedent(
                            min_data_in_leaf=5, seed=3, early_stopping_round=2),
                valid_mask=vm)
     print("MODE:es:%d:" % be.best_iteration + be.to_model_string()[:48], flush=True)
+
+    # voting_parallel across processes: PV-Tree feature votes + candidate
+    # histogram psums ride the cross-process mesh (DCN leg)
+    cfgv = TrainConfig(objective="binary", num_iterations=3, num_leaves=15,
+                       min_data_in_leaf=5, seed=3,
+                       parallelism="voting_parallel", top_k=3)
+    bv = train(x_all[lo:hi], y_all[lo:hi], cfgv)
+    print("MODE:voting:" + bv.to_model_string()[:64], flush=True)
+
+    # lambdarank across processes: every query group lives wholly on one
+    # process (the reference's partition contract); host pairwise grads
+    # feed the sharded grower, models must be identical
+    gid = np.repeat(np.arange((hi - lo) // 25), 25)
+    rel = ((x_all[lo:hi, 0] > 0).astype(np.float64)
+           + (x_all[lo:hi, 1] > 0).astype(np.float64))
+    br = train(x_all[lo:hi], rel,
+               TrainConfig(objective="lambdarank", num_iterations=3,
+                           num_leaves=7, min_data_in_leaf=5, seed=3),
+               group_ids=gid)
+    print("MODE:rank:" + br.to_model_string()[:64], flush=True)
+
+    # lambdarank early stopping: gathered grouped NDCG, convergent stop
+    vm2 = np.zeros(hi - lo, bool); vm2[-50:] = True
+    bre = train(x_all[lo:hi], rel,
+                TrainConfig(objective="lambdarank", num_iterations=8,
+                            num_leaves=7, min_data_in_leaf=5, seed=3,
+                            early_stopping_round=3),
+                valid_mask=vm2, group_ids=gid)
+    print("MODE:rankes:%d:" % bre.best_iteration
+          + bre.to_model_string()[:48], flush=True)
     """
 )
 
@@ -195,7 +225,8 @@ def test_two_process_gbdt_training(tmp_path):
         models.append(out.split("MODEL:", 1)[1].splitlines()[0].strip())
     # SPMD determinism: same trees on every process, for every capability
     assert models[0] == models[1]
-    for mode in ("goss", "rf", "dart", "cat", "sparse", "cont", "depthwise", "es"):
+    for mode in ("goss", "rf", "dart", "cat", "sparse", "cont", "depthwise",
+                 "es", "voting", "rank", "rankes"):
         tags = [out.split(f"MODE:{mode}:", 1)[1].splitlines()[0]
                 for _, out, _ in outs]
         assert tags[0] == tags[1], mode

@@ -96,9 +96,10 @@ def test_concurrent_clients_and_latency():
     lat = q.latency_quantiles_ms()
     assert lat["n"] >= 100
     # reference claims ~1ms end-to-end on cluster hardware
-    # (docs/mmlspark-serving.md:142-146); CPU-under-test gate is single-digit
-    # ms server-side, and bench.py tracks the real loopback p50 per round
-    assert lat["p50"] < 10.0, lat
+    # (docs/mmlspark-serving.md:142-146); measured local p50 is ~0.8 ms
+    # (BENCH_r03), so gate at 2 ms server-side — a regression into
+    # multi-ms territory must fail CI, not hide under a loose bound
+    assert lat["p50"] < 2.0, lat
     q.stop()
     srv.stop()
 
@@ -332,3 +333,151 @@ def test_worker_server_forwarding_option(monkeypatch):
     finally:
         srv.stop()
     assert started.get("stopped")
+
+
+# -- distributed mode: N workers behind one gateway --------------------------
+
+
+def _worker_with_handler(tag):
+    """A backend WorkerServer+ServingQuery replying with its tag."""
+    srv = WorkerServer()
+    info = srv.start()
+
+    def handler(reqs):
+        out = {}
+        for r in reqs:
+            try:
+                v = json.loads(r.body)["x"]
+            except (ValueError, KeyError):
+                out[r.id] = (400, b"bad body", {})
+                continue
+            out[r.id] = (
+                200,
+                json.dumps({"y": v * 2, "worker": tag}).encode(),
+                {"Content-Type": "application/json"},
+            )
+        return out
+
+    q = ServingQuery(srv, handler, max_wait_ms=0).start()
+    return srv, q, info
+
+
+def test_gateway_round_robins_over_workers():
+    from mmlspark_tpu.serving import ServingGateway
+
+    backends = [_worker_with_handler(f"w{i}") for i in range(3)]
+    gw = ServingGateway(workers=[b[2] for b in backends])
+    ginfo = gw.start()
+    try:
+        seen = set()
+        for i in range(30):
+            status, data = _post(ginfo.port, "/", {"x": i})
+            assert status == 200
+            d = json.loads(data)
+            assert d["y"] == i * 2
+            seen.add(d["worker"])
+        assert seen == {"w0", "w1", "w2"}  # all workers share the load
+    finally:
+        gw.stop()
+        for srv, q, _ in backends:
+            q.stop()
+            srv.stop()
+
+
+def test_gateway_survives_worker_death_zero_lost():
+    """Kill one worker mid-stream: every accepted request still gets a
+    correct reply from a DIFFERENT worker (the cross-worker replay of the
+    reference's uncommitted-epoch recovery, DistributedHTTPSource)."""
+    from mmlspark_tpu.serving import ServingGateway
+
+    backends = [_worker_with_handler(f"w{i}") for i in range(3)]
+    gw = ServingGateway(workers=[b[2] for b in backends], request_timeout_s=3.0)
+    ginfo = gw.start()
+    errs = []
+    answers = {}
+    lock = threading.Lock()
+
+    def client(k):
+        try:
+            for i in range(40):
+                x = k * 1000 + i
+                status, data = _post(ginfo.port, "/", {"x": x})
+                assert status == 200, (status, data)
+                d = json.loads(data)
+                assert d["y"] == x * 2
+                with lock:
+                    answers[x] = d["worker"]
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    # kill worker 0 while traffic is in flight
+    time.sleep(0.05)
+    backends[0][1].stop()
+    backends[0][0].stop()
+    for t in threads:
+        t.join()
+    gw.stop()
+    for srv, q, _ in backends[1:]:
+        q.stop()
+        srv.stop()
+    assert not errs, errs[:3]
+    assert len(answers) == 160  # zero lost requests
+    survivors = {w for w in answers.values()}
+    assert {"w1", "w2"} <= survivors  # the load moved to live workers
+
+
+def test_gateway_discovers_workers_from_registry():
+    from mmlspark_tpu.serving import DriverRegistry, ServingGateway
+
+    reg = DriverRegistry()
+    backends = [_worker_with_handler(f"r{i}") for i in range(2)]
+    try:
+        for _, _, info in backends:
+            assert DriverRegistry.register(reg.url, info)
+        gw = ServingGateway(registry_url=reg.url, refresh_s=0.2)
+        ginfo = gw.start()
+        try:
+            assert gw.pool.size() == 2
+            status, data = _post(ginfo.port, "/", {"x": 21})
+            assert status == 200 and json.loads(data)["y"] == 42
+            # a THIRD worker registering later joins without a restart
+            late = _worker_with_handler("late")
+            backends.append(late)
+            assert DriverRegistry.register(reg.url, late[2])
+            deadline = time.monotonic() + 5.0
+            while gw.pool.size() < 3 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert gw.pool.size() == 3
+            seen = set()
+            for i in range(30):
+                _, data = _post(ginfo.port, "/", {"x": i})
+                seen.add(json.loads(data)["worker"])
+            assert "late" in seen
+        finally:
+            gw.stop()
+    finally:
+        reg.stop()
+        for srv, q, _ in backends:
+            q.stop()
+            srv.stop()
+
+
+def test_gateway_all_workers_down_503():
+    from mmlspark_tpu.serving import ServingGateway
+
+    srv, q, info = _worker_with_handler("only")
+    gw = ServingGateway(workers=[info], request_timeout_s=1.0, max_attempts=2)
+    ginfo = gw.start()
+    try:
+        status, _ = _post(ginfo.port, "/", {"x": 1})
+        assert status == 200
+        q.stop()
+        srv.stop()
+        status, data = _post(ginfo.port, "/", {"x": 2})
+        assert status == 503
+        assert b"no live" in data
+    finally:
+        gw.stop()

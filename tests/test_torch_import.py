@@ -1,0 +1,205 @@
+"""torchvision-checkpoint import parity.
+
+A minimal in-repo torch ResNet (exactly torchvision's layer/naming layout,
+v1.5 strides) provides the ground truth: random-init torch weights are
+exported as a state_dict, imported into the flax backbone, and BOTH models
+must produce the same features — proving any externally trained
+torchvision ResNet drops into ImageFeaturizer with its semantics intact
+(ref ImageFeaturizer.scala:133-178, Schema.scala:54-66).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+from mmlspark_tpu.downloader.torch_import import import_torch_resnet  # noqa: E402
+from mmlspark_tpu.models.resnet import RESNETS  # noqa: E402
+
+
+class _TorchBottleneck(tnn.Module):
+    expansion = 4
+
+    def __init__(self, cin, filters, stride=1):
+        super().__init__()
+        cout = filters * 4
+        self.conv1 = tnn.Conv2d(cin, filters, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(filters)
+        self.conv2 = tnn.Conv2d(filters, filters, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(filters)
+        self.conv3 = tnn.Conv2d(filters, cout, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(cout)
+        self.relu = tnn.ReLU(inplace=True)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout),
+            )
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return self.relu(y + idn)
+
+
+class _TorchBasic(tnn.Module):
+    expansion = 1
+
+    def __init__(self, cin, filters, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, filters, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(filters)
+        self.conv2 = tnn.Conv2d(filters, filters, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(filters)
+        self.relu = tnn.ReLU(inplace=True)
+        self.downsample = None
+        if stride != 1 or cin != filters:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, filters, 1, stride, bias=False),
+                tnn.BatchNorm2d(filters),
+            )
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return self.relu(y + idn)
+
+
+class _TorchResNet(tnn.Module):
+    """torchvision-layout ResNet (same state_dict keys + v1.5 strides)."""
+
+    def __init__(self, block, stages, num_classes=16):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.relu = tnn.ReLU(inplace=True)
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        cin = 64
+        for i, n in enumerate(stages):
+            filters = 64 * 2 ** i
+            blocks = []
+            for j in range(n):
+                stride = 2 if i > 0 and j == 0 else 1
+                blocks.append(block(cin, filters, stride))
+                cin = filters * block.expansion
+            setattr(self, f"layer{i + 1}", tnn.Sequential(*blocks))
+        self.avgpool = tnn.AdaptiveAvgPool2d(1)
+        self.fc = tnn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        feats = {}
+        for i in range(4):
+            x = getattr(self, f"layer{i + 1}")(x)
+            feats[f"layer{i + 1}"] = x
+        pool = self.avgpool(x).flatten(1)
+        feats["pool"] = pool
+        feats["logits"] = self.fc(pool)
+        return feats
+
+
+def _randomize_bn_stats(model, seed):
+    """Non-trivial running stats: parity must hold through real BN math."""
+    g = torch.Generator().manual_seed(seed)
+    for m in model.modules():
+        if isinstance(m, tnn.BatchNorm2d):
+            m.running_mean.copy_(torch.randn(m.running_mean.shape, generator=g) * 0.1)
+            m.running_var.copy_(torch.rand(m.running_var.shape, generator=g) + 0.5)
+            with torch.no_grad():
+                m.weight.copy_(torch.rand(m.weight.shape, generator=g) + 0.5)
+                m.bias.copy_(torch.randn(m.bias.shape, generator=g) * 0.1)
+
+
+@pytest.mark.parametrize(
+    "variant,block,stages",
+    [
+        ("ResNet50", _TorchBottleneck, [3, 4, 6, 3]),
+        ("ResNet18", _TorchBasic, [2, 2, 2, 2]),
+    ],
+)
+def test_torch_state_dict_import_feature_parity(variant, block, stages):
+    import jax.numpy as jnp
+
+    torch.manual_seed(0)
+    tm = _TorchResNet(block, stages, num_classes=16)
+    _randomize_bn_stats(tm, 1)
+    tm.eval()
+
+    x = np.random.default_rng(2).normal(size=(2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+
+    variables = import_torch_resnet(tm.state_dict(), variant=variant)
+    fm = RESNETS[variant](
+        num_classes=16, dtype=jnp.float32, torch_padding=True
+    )
+    out = fm.apply(
+        {"params": variables["params"],
+         "batch_stats": variables["batch_stats"]},
+        jnp.asarray(x), train=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["pool"]), ref["pool"].numpy(), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["logits"]), ref["logits"].numpy(), rtol=2e-4, atol=2e-4
+    )
+    # intermediate stages too: padding parity must hold at every stride
+    got3 = np.asarray(out["layer3"]).transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(
+        got3, ref["layer3"].numpy(), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_import_rejects_architecture_mismatch():
+    tm = _TorchResNet(_TorchBasic, [2, 2, 2, 2])
+    with pytest.raises(ValueError, match="layer"):
+        import_torch_resnet(tm.state_dict(), variant="ResNet50")
+    sd = tm.state_dict()
+    sd["layer1.0.extra.weight"] = torch.zeros(1)
+    with pytest.raises(ValueError, match="unconsumed|layer"):
+        import_torch_resnet(sd, variant="ResNet18")
+
+
+def test_install_and_featurize_through_the_zoo(tmp_path):
+    """install_torch_checkpoint -> ImageFeaturizer(model_name=...) serves
+    the imported model's features (the reference's zoo-by-name flow)."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.downloader import install_torch_checkpoint
+    from mmlspark_tpu.downloader.zoo import ModelDownloader
+    from mmlspark_tpu.models import ImageFeaturizer
+
+    torch.manual_seed(3)
+    tm = _TorchResNet(_TorchBasic, [2, 2, 2, 2], num_classes=12)
+    _randomize_bn_stats(tm, 4)
+    tm.eval()
+    pth = tmp_path / "r18.pth"
+    torch.save(tm.state_dict(), pth)
+
+    dl = ModelDownloader(repo_dir=str(tmp_path / "zoo"))
+    schema = install_torch_checkpoint(
+        str(pth), name="ResNet18_Imported", image_size=64, downloader=dl
+    )
+    assert schema.torch_padding and schema.num_classes == 12
+
+    rng = np.random.default_rng(5)
+    imgs = rng.integers(0, 255, size=(4, 64, 64, 3), dtype=np.uint8)
+    df = DataFrame.from_dict({"image": imgs})
+    feat = ImageFeaturizer(
+        input_col="image", output_col="features", model_name="ResNet18_Imported",
+        cut_output_layers=1, image_size=64, repo_dir=str(tmp_path / "zoo"),
+    )
+    out = np.stack(feat.transform(df)["features"])
+    assert out.shape == (4, 512)
+    # parity with torch on the SAME preprocessed pixels
+    from mmlspark_tpu.ops import image as image_ops
+
+    pix = image_ops.normalize(imgs.astype(np.float32))
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(np.asarray(pix).transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(out, ref["pool"].numpy(), rtol=2e-2, atol=2e-2)

@@ -525,6 +525,249 @@ NOTEBOOKS = {
          "print('same-parity recommendation rate', float(match.mean()))\n"
          "assert match.mean() > 0.9"),
     ],
+    # reference: Regression - Flight Delays.ipynb (TrainRegressor flow)
+    "Regression - Flight Delays.ipynb": [
+        ("markdown",
+         "# Flight delay regression with TrainRegressor\n\n"
+         "The reference's *Regression - Flight Delays* flow: a tabular\n"
+         "flight table (carrier, origin, departure hour, distance) ->\n"
+         "`TrainRegressor` promotion -> `ComputeModelStatistics`."),
+        ("code",
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n\n"
+         "rng = np.random.default_rng(0)\n"
+         "n = 3000\n"
+         "carrier = rng.integers(0, 8, n)      # airline id\n"
+         "origin = rng.integers(0, 20, n)      # airport id\n"
+         "dep_hour = rng.integers(5, 23, n)\n"
+         "distance = rng.uniform(100, 2500, n)\n"
+         "# delays grow with evening departures + congested airports\n"
+         "delay = (2.0 * np.maximum(dep_hour - 15, 0)\n"
+         "         + 0.8 * (origin % 5) + 0.3 * carrier\n"
+         "         + rng.exponential(6.0, n))\n"
+         "x = np.stack([carrier, origin, dep_hour, distance], 1).astype(np.float32)\n"
+         "df = DataFrame.from_dict({'features': x, 'label': delay})\n"
+         "df.count()"),
+        ("code",
+         "from mmlspark_tpu.models.gbdt import LightGBMRegressor\n"
+         "from mmlspark_tpu.train import TrainRegressor\n\n"
+         "model = TrainRegressor(\n"
+         "    model=LightGBMRegressor(num_iterations=40, num_leaves=31),\n"
+         "    label_col='label').fit(df)\n"
+         "scored = model.transform(df)\n"
+         "scored['prediction'][:5]"),
+        ("code",
+         "from mmlspark_tpu.train import ComputeModelStatistics\n\n"
+         "stats = ComputeModelStatistics(label_col='label',\n"
+         "                               scores_col='prediction').transform(scored)\n"
+         "r2 = float(stats['R^2'][0]) if 'R^2' in stats.columns else None\n"
+         "mse = float(stats['mean_squared_error'][0])\n"
+         "base = float(((np.asarray(df['label']) - np.asarray(df['label']).mean()) ** 2).mean())\n"
+         "assert mse < base * 0.5, (mse, base)\n"
+         "print('MSE', round(mse, 2), 'vs variance', round(base, 2))"),
+    ],
+    # reference: Regression - Auto Imports.ipynb (CleanMissingData flow)
+    "Regression - Auto Imports.ipynb": [
+        ("markdown",
+         "# Auto imports price regression\n\n"
+         "The reference's *Regression - Auto Imports* flow: a messy autos\n"
+         "table with missing values and categorical columns ->\n"
+         "`CleanMissingData` -> GBDT with categorical splits."),
+        ("code",
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n\n"
+         "rng = np.random.default_rng(1)\n"
+         "n = 2000\n"
+         "make = rng.integers(0, 12, n).astype(np.float64)   # categorical\n"
+         "horsepower = rng.uniform(48, 288, n)\n"
+         "curb_weight = rng.uniform(1500, 4000, n)\n"
+         "mpg = 60 - horsepower * 0.12 + rng.normal(0, 2, n)\n"
+         "price = (horsepower * 80 + curb_weight * 2 + make * 500\n"
+         "         + rng.normal(0, 900, n))\n"
+         "# real-world mess: some horsepower/mpg readings are missing\n"
+         "horsepower[rng.random(n) < 0.08] = np.nan\n"
+         "mpg[rng.random(n) < 0.05] = np.nan\n"
+         "df = DataFrame.from_dict({'make': make, 'horsepower': horsepower,\n"
+         "                          'curb_weight': curb_weight, 'mpg': mpg,\n"
+         "                          'price': price})\n"
+         "df.count()"),
+        ("code",
+         "from mmlspark_tpu.featurize import CleanMissingData\n\n"
+         "clean = CleanMissingData(input_cols=['horsepower', 'mpg'],\n"
+         "                         output_cols=['horsepower', 'mpg'],\n"
+         "                         cleaning_mode='Median').fit(df)\n"
+         "cdf = clean.transform(df)\n"
+         "assert not np.isnan(np.asarray(cdf['horsepower'])).any()"),
+        ("code",
+         "from mmlspark_tpu.models.gbdt import LightGBMRegressor\n\n"
+         "x = np.stack([np.asarray(cdf[c], np.float32) for c in\n"
+         "              ('make', 'horsepower', 'curb_weight', 'mpg')], 1)\n"
+         "tdf = DataFrame.from_dict({'features': x,\n"
+         "                           'label': np.asarray(cdf['price'])})\n"
+         "model = LightGBMRegressor(num_iterations=40, num_leaves=31,\n"
+         "                          categorical_slot_indexes=[0]).fit(tdf)\n"
+         "pred = model.transform(tdf)['prediction']\n"
+         "y = np.asarray(tdf['label'])\n"
+         "r2 = 1 - ((pred - y) ** 2).mean() / y.var()\n"
+         "assert r2 > 0.9, r2\n"
+         "print('R^2', round(float(r2), 4))"),
+    ],
+    # reference: Regression - Vowpal Wabbit vs. LightGBM vs. Linear Regressor.ipynb
+    "Regression - Vowpal Wabbit vs. LightGBM vs. Linear Regressor.ipynb": [
+        ("markdown",
+         "# Three regressors head-to-head\n\n"
+         "The reference's comparison notebook on the diabetes dataset:\n"
+         "VW-style online SGD vs GBDT vs closed-form linear regression,\n"
+         "all through the same DataFrame API."),
+        ("code",
+         _DATA +
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.io.csv import read_csv\n\n"
+         "raw = read_csv(os.path.join(data_dir, 'diabetes.csv'))\n"
+         "feat_cols = [c for c in raw.columns if c != 'label']\n"
+         "x = np.stack([np.asarray(raw[c], np.float64) for c in feat_cols], 1)\n"
+         "y = np.asarray(raw['label'], np.float64)\n"
+         "df = DataFrame.from_dict({'features': x.astype(np.float32), 'label': y})\n"
+         "results = {}"),
+        ("code",
+         "from mmlspark_tpu.models.gbdt import LightGBMRegressor\n\n"
+         "pred = LightGBMRegressor(num_iterations=60, num_leaves=15,\n"
+         "                         min_data_in_leaf=10).fit(df).transform(df)['prediction']\n"
+         "results['gbdt'] = float(((pred - y) ** 2).mean())"),
+        ("code",
+         "from mmlspark_tpu.vw import VowpalWabbitFeaturizer, VowpalWabbitRegressor\n\n"
+         "fdf = VowpalWabbitFeaturizer(input_cols=['features'],\n"
+         "                             num_bits=15).transform(df)\n"
+         "# AdaGrad normalizes per-coordinate scale, but the wide target\n"
+         "# range (~25-350) still wants a hot learning rate + many passes\n"
+         "pred = VowpalWabbitRegressor(num_passes=200,\n"
+         "                             learning_rate=20.0).fit(fdf).transform(fdf)['prediction']\n"
+         "results['vw'] = float(((pred - y) ** 2).mean())"),
+        ("code",
+         "# closed-form ridge as the linear baseline\n"
+         "xb = np.concatenate([x, np.ones((len(x), 1))], 1)\n"
+         "w = np.linalg.solve(xb.T @ xb + 1e-3 * np.eye(xb.shape[1]), xb.T @ y)\n"
+         "results['linear'] = float(((xb @ w - y) ** 2).mean())\n"
+         "print({k: round(v, 1) for k, v in results.items()})\n"
+         "assert results['gbdt'] < results['linear']  # trees beat linear here\n"
+         "assert results['vw'] < y.var()              # vw beats the mean"),
+    ],
+    # reference: LightGBM - Quantile Regression for Drug Discovery.ipynb
+    "LightGBM - Quantile Regression for Drug Discovery.ipynb": [
+        ("markdown",
+         "# Quantile regression for drug discovery\n\n"
+         "The reference's flagship quantile notebook: predict an interval\n"
+         "(10th/90th percentile) of a compound's activity instead of a\n"
+         "point estimate — `objective='quantile'` with `alpha`."),
+        ("code",
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n\n"
+         "rng = np.random.default_rng(4)\n"
+         "n, d = 4000, 12\n"
+         "x = rng.normal(size=(n, d)).astype(np.float32)  # molecular descriptors\n"
+         "activity = (x[:, 0] * 2 + x[:, 1] * x[:, 2]\n"
+         "            + (0.5 + np.abs(x[:, 3])) * rng.normal(size=n))\n"
+         "df = DataFrame.from_dict({'features': x, 'label': activity})"),
+        ("code",
+         "from mmlspark_tpu.models.gbdt import LightGBMRegressor\n\n"
+         "bands = {}\n"
+         "for alpha in (0.1, 0.9):\n"
+         "    m = LightGBMRegressor(objective='quantile', alpha=alpha,\n"
+         "                          num_iterations=40, num_leaves=15).fit(df)\n"
+         "    bands[alpha] = m.transform(df)['prediction']"),
+        ("code",
+         "inside = ((activity >= bands[0.1]) & (activity <= bands[0.9])).mean()\n"
+         "low_cover = (activity <= bands[0.1]).mean()\n"
+         "print('80% interval covers', round(float(inside), 3))\n"
+         "assert abs(inside - 0.8) < 0.08, inside\n"
+         "assert abs(low_cover - 0.1) < 0.06, low_cover"),
+    ],
+    # reference: Vowpal Wabbit - Quantile Regression for Drug Discovery.ipynb
+    "Vowpal Wabbit - Quantile Regression for Drug Discovery.ipynb": [
+        ("markdown",
+         "# VW quantile regression\n\n"
+         "The same interval-prediction workload through the online\n"
+         "learner: `loss_function='quantile'` with `quantile_tau`\n"
+         "(`--loss_function quantile --quantile_tau` passthrough)."),
+        ("code",
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.vw import VowpalWabbitFeaturizer, VowpalWabbitRegressor\n\n"
+         "rng = np.random.default_rng(5)\n"
+         "n, d = 3000, 8\n"
+         "x = rng.normal(size=(n, d)).astype(np.float32)\n"
+         "activity = x[:, 0] * 2 - x[:, 1] + rng.exponential(1.0, n)\n"
+         "df = DataFrame.from_dict({'features': x, 'label': activity})\n"
+         "fdf = VowpalWabbitFeaturizer(input_cols=['features'],\n"
+         "                             num_bits=15).transform(df)"),
+        ("code",
+         "preds = {}\n"
+         "for tau in (0.5, 0.9):\n"
+         "    m = VowpalWabbitRegressor(\n"
+         "        pass_through_args=f'--loss_function quantile --quantile_tau {tau}',\n"
+         "        num_passes=30).fit(fdf)\n"
+         "    preds[tau] = m.transform(fdf)['prediction']"),
+        ("code",
+         "for tau, p in preds.items():\n"
+         "    cover = float((activity <= p).mean())\n"
+         "    print(f'tau={tau}: empirical coverage {cover:.3f}')\n"
+         "    assert abs(cover - tau) < 0.08, (tau, cover)"),
+    ],
+    # reference: deployment modes in docs/mmlspark-serving.md:93-160
+    "Serving - Distributed Worker Fleet.ipynb": [
+        ("markdown",
+         "# Distributed serving: N workers behind one endpoint\n\n"
+         "The reference's `DistributedHTTPSource` deployment: several\n"
+         "serving workers register with a driver registry; a gateway\n"
+         "round-robins client requests and re-dispatches to a live worker\n"
+         "if one dies mid-request (zero lost requests)."),
+        ("code",
+         "import json\n"
+         "import numpy as np\n"
+         "from mmlspark_tpu.serving import (DriverRegistry, ServingGateway,\n"
+         "                                  ServingQuery, WorkerServer)\n\n"
+         "w = np.random.default_rng(0).normal(size=(8,)).astype(np.float32)\n\n"
+         "def make_worker(tag):\n"
+         "    srv = WorkerServer()\n"
+         "    info = srv.start()\n"
+         "    def handler(reqs):\n"
+         "        out = {}\n"
+         "        for r in reqs:\n"
+         "            x = np.asarray(json.loads(r.body)['x'], np.float32)\n"
+         "            y = float(x @ w)\n"
+         "            out[r.id] = (200, json.dumps({'y': y, 'worker': tag}).encode(), {})\n"
+         "        return out\n"
+         "    q = ServingQuery(srv, handler, max_wait_ms=0).start()\n"
+         "    return srv, q, info\n\n"
+         "registry = DriverRegistry()\n"
+         "workers = [make_worker(f'w{i}') for i in range(3)]\n"
+         "for _, _, info in workers:\n"
+         "    DriverRegistry.register(registry.url, info)\n"
+         "len(registry.services('serving'))"),
+        ("code",
+         "import http.client\n\n"
+         "gw = ServingGateway(registry_url=registry.url)\n"
+         "ginfo = gw.start()\n"
+         "def ask(x):\n"
+         "    conn = http.client.HTTPConnection('127.0.0.1', ginfo.port, timeout=10)\n"
+         "    conn.request('POST', '/', body=json.dumps({'x': x}))\n"
+         "    resp = conn.getresponse(); body = json.loads(resp.read()); conn.close()\n"
+         "    return body\n"
+         "seen = {ask([float(i)] * 8)['worker'] for i in range(12)}\n"
+         "print('workers serving:', sorted(seen))\n"
+         "assert len(seen) == 3  # the load spreads over the fleet"),
+        ("code",
+         "# kill a worker: traffic keeps flowing through the survivors\n"
+         "workers[0][1].stop(); workers[0][0].stop()\n"
+         "answers = [ask([float(i)] * 8) for i in range(20)]\n"
+         "assert all('y' in a for a in answers)  # zero lost requests\n"
+         "assert {a['worker'] for a in answers} <= {'w1', 'w2'}\n"
+         "gw.stop(); registry.stop()\n"
+         "for srv, q, _ in workers[1:]:\n"
+         "    q.stop(); srv.stop()\n"
+         "print('fleet survived a worker death')"),
+    ],
 }
 
 
