@@ -22,6 +22,7 @@ one pixel and features stop matching torchvision's).
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Optional
 
 import numpy as np
@@ -145,7 +146,7 @@ def install_torch_checkpoint(
     """
     from mmlspark_tpu.downloader.zoo import ModelDownloader, ModelSchema
 
-    if isinstance(src, (str, bytes)):
+    if isinstance(src, (str, bytes, os.PathLike)):
         import torch
 
         state_dict = torch.load(src, map_location="cpu", weights_only=True)

@@ -59,6 +59,9 @@ class ModelSchema:
     # torch-exact strided padding: set for torchvision-imported weights so
     # the flax module reproduces torchvision feature maps (torch_import.py)
     torch_padding: bool = False
+    # backbone width (ResNet num_filters); None = the variant's default
+    # (compact zoo entries train thinner)
+    num_filters: Optional[int] = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=1)
@@ -172,12 +175,16 @@ class ModelDownloader:
                 "checkpoints)",
                 name,
             )
+            width = {} if schema.num_filters is None else {
+                "num_filters": schema.num_filters
+            }
             _, variables = init_resnet(
                 schema.variant,
                 num_classes=schema.num_classes,
                 image_size=schema.image_size,
                 small_inputs=schema.small_inputs,
                 seed=schema.seed,
+                **width,
             )
             self.register(schema, variables)
         return schema
@@ -195,9 +202,22 @@ class ModelDownloader:
         if schema.sha256 and hashlib.sha256(blob).hexdigest() != schema.sha256:
             raise IOError(f"checksum mismatch for model {name}")
         variables = fser.msgpack_restore(blob)
+        # checkpoints may be stored float16 (half the repo weight); compute
+        # always runs f32/bf16
+        import jax as _jax
+        import numpy as _np
+
+        variables = _jax.tree_util.tree_map(
+            lambda a: a.astype(_np.float32)
+            if getattr(a, "dtype", None) == _np.float16 else a,
+            variables,
+        )
+        width = {} if schema.num_filters is None else {
+            "num_filters": schema.num_filters
+        }
         module = RESNETS[schema.variant](
             num_classes=schema.num_classes, small_inputs=schema.small_inputs,
-            torch_padding=schema.torch_padding,
+            torch_padding=schema.torch_padding, **width,
         )
         return module, variables, schema
 

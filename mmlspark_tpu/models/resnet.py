@@ -189,6 +189,7 @@ def init_resnet(
     seed: int = 0,
     small_inputs: bool = False,
     dtype: Any = jnp.bfloat16,
+    num_filters: int = 64,
 ) -> tuple:
     """Build a ResNet and init variables. Returns (module, variables).
 
@@ -197,7 +198,10 @@ def init_resnet(
     compile path makes model *loading* hostage to accelerator availability
     (the exact failure that killed round-2's benchmark mid-``model.init``).
     """
-    model = RESNETS[name](num_classes=num_classes, small_inputs=small_inputs, dtype=dtype)
+    model = RESNETS[name](
+        num_classes=num_classes, small_inputs=small_inputs, dtype=dtype,
+        num_filters=num_filters,
+    )
     # host-side allocation: a jnp.zeros here would already dispatch to the
     # default (possibly dead-remote) backend before the CPU scope below
     dummy = np.zeros((1, image_size, image_size, 3), np.float32)

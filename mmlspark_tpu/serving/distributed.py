@@ -59,6 +59,13 @@ class BackendPool:
     registry refreshes skip it until its registration timestamp changes
     (i.e. the worker actually re-registered) — a crashed worker's stale
     ephemeral-port entry cannot keep adding failed-connect latency forever.
+    ``evict_after=0`` disables eviction — the right setting for a STATIC
+    pool (no registry refresh would ever revive an evicted backend);
+    cooldown alone then rate-limits attempts on a down worker, and
+    ``next()``'s cooled-down fallback lets it rejoin when it recovers.
+
+    Statically configured backends (the constructor list) are pinned:
+    ``refresh`` merges them with the roster instead of replacing them.
     """
 
     def __init__(
@@ -66,7 +73,8 @@ class BackendPool:
         evict_after: int = 3,
     ):
         self._lock = threading.Lock()
-        self._backends: list = list(backends or ())
+        self._static: list = list(backends or ())
+        self._backends: list = list(self._static)
         self._cooldown: dict = {}
         self._fails: dict = {}
         self._dead: dict = {}    # backend -> roster stamp at eviction
@@ -79,7 +87,9 @@ class BackendPool:
         with self._lock:
             self._stamps = dict(stamps or {})
             live = []
-            for b in backends:
+            for b in self._static + [
+                b for b in backends if b not in self._static
+            ]:
                 dead_at = self._dead.get(b)
                 if dead_at is not None:
                     if self._stamps.get(b, 0.0) > dead_at:
@@ -122,7 +132,11 @@ class BackendPool:
         with self._lock:
             self._cooldown[b] = time.monotonic() + self.cooldown_s
             self._fails[b] = self._fails.get(b, 0) + 1
-            if self._fails[b] >= self.evict_after:
+            if (
+                self.evict_after
+                and self._fails[b] >= self.evict_after
+                and b not in self._static  # static backends only cool down
+            ):
                 self._dead[b] = self._stamps.get(b, 0.0)
                 self._backends = [x for x in self._backends if x != b]
 
@@ -155,14 +169,21 @@ class ServingGateway:
         refresh_s: float = 1.0,
         cooldown_s: float = 5.0,
         max_attempts: Optional[int] = None,
+        evict_after: Optional[int] = None,
     ):
         self.service_name = service_name
         self._ingress = WorkerServer(
             host=host, port=port, name=f"{service_name}-gateway"
         )
+        if evict_after is None:
+            # eviction only makes sense with a registry: its refresh is the
+            # revival path (re-registration). A static pool would lose a
+            # briefly-down worker FOREVER, so it relies on cooldown alone.
+            evict_after = 3 if registry_url else 0
         self._pool = BackendPool(
             [self._as_backend(w) for w in (workers or ())],
             cooldown_s=cooldown_s,
+            evict_after=evict_after,
         )
         self._registry_url = registry_url
         self._refresh_s = refresh_s

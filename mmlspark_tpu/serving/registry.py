@@ -20,8 +20,16 @@ from mmlspark_tpu.serving.server import ServiceInfo
 
 
 class DriverRegistry:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 0,
+        max_entries_per_service: int = 256,
+    ):
+        """``max_entries_per_service`` bounds each roster: crash-looping
+        workers on ephemeral ports register a NEW (host, port) every
+        restart, and without a cap the dead entries accumulate without
+        bound (oldest registrations are dropped first)."""
         self.host = host
+        self.max_entries_per_service = max_entries_per_service
         self._services: dict[str, list] = {}
         self._lock = threading.Lock()
         registry = self
@@ -52,6 +60,9 @@ class DriverRegistry:
                     ]
                     info["ts"] = time.time()  # consumers detect re-registration
                     entries.append(info)
+                    if len(entries) > registry.max_entries_per_service:
+                        entries.sort(key=lambda e: e.get("ts", 0.0))
+                        del entries[: len(entries) - registry.max_entries_per_service]
                 body = b'{"registered": true}'
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))

@@ -481,3 +481,55 @@ def test_gateway_all_workers_down_503():
         assert b"no live" in data
     finally:
         gw.stop()
+
+
+def test_static_pool_worker_recovers_after_cooldown():
+    """A static (no-registry) pool must let a briefly-down worker rejoin:
+    eviction is disabled there, cooldown alone rate-limits attempts."""
+    from mmlspark_tpu.serving import ServingGateway
+
+    srv, q, info = _worker_with_handler("w")
+    gw = ServingGateway(
+        workers=[info], request_timeout_s=1.0, cooldown_s=0.3, max_attempts=2
+    )
+    ginfo = gw.start()
+    try:
+        assert _post(ginfo.port, "/", {"x": 1})[0] == 200
+        port = info.port
+        q.stop()
+        srv.stop()
+        # many failures while down — would trip any eviction threshold
+        for _ in range(5):
+            assert _post(ginfo.port, "/", {"x": 2})[0] == 503
+        # worker comes back on the SAME port (static deployments pin ports)
+        srv2 = WorkerServer(port=port)
+        srv2.start()
+        q2 = ServingQuery(srv2, lambda reqs: {
+            r.id: (200, b'{"y": 42}', {}) for r in reqs
+        }, max_wait_ms=0).start()
+        time.sleep(0.4)  # let the cooldown lapse
+        try:
+            status, data = _post(ginfo.port, "/", {"x": 3})
+            assert status == 200 and json.loads(data)["y"] == 42
+        finally:
+            q2.stop()
+            srv2.stop()
+    finally:
+        gw.stop()
+
+
+def test_registry_roster_is_bounded():
+    from mmlspark_tpu.serving import DriverRegistry, ServiceInfo
+
+    reg = DriverRegistry(max_entries_per_service=5)
+    try:
+        for p in range(20):  # crash-looping worker on ephemeral ports
+            DriverRegistry.register(
+                reg.url, ServiceInfo("serving", "127.0.0.1", 40000 + p)
+            )
+        roster = reg.services("serving")
+        assert len(roster) == 5
+        # newest registrations survive
+        assert {e["port"] for e in roster} == set(range(40015, 40020))
+    finally:
+        reg.stop()

@@ -156,6 +156,41 @@ NOTEBOOKS = {
          "acc = float((pred == y).mean())\n"
          "assert acc > 0.9, acc\n"
          "print('transfer-learning accuracy', acc)"),
+        ("markdown",
+         "## Natural-image transfer with the RotNet-pretrained backbone\n\n"
+         "`ResNet18_Patches` ships weights pretrained SELF-SUPERVISED\n"
+         "(rotation prediction) on natural photograph patches\n"
+         "(tools/train_patch_backbone.py). With a handful of labels from a\n"
+         "never-seen image region, its features beat a random-init backbone\n"
+         "of the identical architecture."),
+        ("code",
+         "from sklearn.datasets import load_sample_images\n"
+         "from sklearn.linear_model import LogisticRegression as SkLR\n\n"
+         "images = load_sample_images().images\n"
+         "def patches(n, seed):\n"
+         "    r = np.random.default_rng(seed)\n"
+         "    xs = np.empty((n, 32, 32, 3), np.uint8); ys = np.empty(n, np.int64)\n"
+         "    for i in range(n):\n"
+         "        which = int(r.integers(2)); img = images[which]\n"
+         "        h, w = img.shape[:2]\n"
+         "        x0 = int(r.integers(int(w*0.75), w-32))  # held-out strip\n"
+         "        band = int(r.integers(4)); bh = h//4\n"
+         "        y0 = band*bh + int(r.integers(0, max(bh-32, 1)))\n"
+         "        xs[i] = img[y0:y0+32, x0:x0+32]; ys[i] = which*4 + band\n"
+         "    return xs, ys\n"
+         "xtr, ytr = patches(160, 1)\n"
+         "xte, yte = patches(400, 2)"),
+        ("code",
+         "feat = ImageFeaturizer(input_col='image', output_col='features',\n"
+         "                       model_name='ResNet18_Patches',\n"
+         "                       cut_output_layers=1, image_size=32)\n"
+         "ftr = np.stack(feat.transform(DataFrame.from_dict({'image': xtr}))['features'])\n"
+         "fte = np.stack(feat.transform(DataFrame.from_dict({'image': xte}))['features'])\n"
+         "mu, sd = ftr.mean(0), ftr.std(0) + 1e-6\n"
+         "probe = SkLR(max_iter=3000).fit((ftr-mu)/sd, ytr)\n"
+         "acc = probe.score((fte-mu)/sd, yte)\n"
+         "print('8-way patch localization from 160 labels:', round(acc, 3))\n"
+         "assert acc > 0.8, acc"),
     ],
     # reference: Interpretability - LIME explainers
     "Interpretability - Tabular LIME.ipynb": [
