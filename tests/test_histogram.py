@@ -130,3 +130,38 @@ def test_multi_plane_histogram_num_bins_variants():
     np.testing.assert_allclose(
         small.reshape(S, d, b, 3), full[:, :, :b], rtol=1e-5, atol=1e-5
     )
+
+
+def test_plain_and_split_pallas_kernels_agree(monkeypatch):
+    """Both Pallas lowerings of the 256-bin plane (plain one-hot and the
+    decomposed hi/lo kernel) must produce the same sums — the plain kernel
+    stays the production path for B < 128, so it needs its own coverage
+    now that B=256 auto-selects the split kernel."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    n, d = 1500, 6
+    bins = jnp.asarray(rng.integers(0, 256, size=(n, d)).astype(np.int32))
+    stats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_SPLIT", "0")
+    plain = np.asarray(H._plane_histogram_pallas(bins, stats, 256))
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_SPLIT", "1")
+    split = np.asarray(H._plane_histogram_pallas(bins, stats, 256))
+    np.testing.assert_allclose(plain, split, rtol=1e-4, atol=1e-3)
+    ref = np.asarray(H._plane_histogram_scatter(bins, stats, 256))
+    np.testing.assert_allclose(split, ref, rtol=1e-4, atol=1e-3)
+
+
+def test_split_force_safe_on_indivisible_bins(monkeypatch):
+    """MMLSPARK_TPU_HIST_SPLIT=1 must not crash when num_bins can't tile
+    the decomposition (e.g. 63): it falls back to the plain kernel."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(12)
+    bins = jnp.asarray(rng.integers(0, 63, size=(500, 4)).astype(np.int32))
+    stats = jnp.asarray(rng.normal(size=(500, 3)).astype(np.float32))
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_SPLIT", "1")
+    assert not H._use_split(63)
+    got = np.asarray(H._plane_histogram_pallas(bins, stats, 63))
+    ref = np.asarray(H._plane_histogram_scatter(bins, stats, 63))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-3)
