@@ -102,13 +102,20 @@ def generate_manifest() -> dict:
     return {"version": __version__, "stages": stages}
 
 
-def generate_api_docs(out_dir: str, manifest: Optional[dict] = None) -> list:
-    """Write one markdown file per package; returns written paths."""
-    manifest = manifest or generate_manifest()
+def _group_by_package(manifest: dict) -> dict:
+    """stage infos grouped by their top-level mmlspark_tpu subpackage —
+    the one grouping rule docs and R bindings must share."""
     by_pkg: dict[str, list] = {}
     for info in manifest["stages"].values():
         pkg = info["module"].split(".")[1] if "." in info["module"] else info["module"]
         by_pkg.setdefault(pkg, []).append(info)
+    return by_pkg
+
+
+def generate_api_docs(out_dir: str, manifest: Optional[dict] = None) -> list:
+    """Write one markdown file per package; returns written paths."""
+    manifest = manifest or generate_manifest()
+    by_pkg = _group_by_package(manifest)
 
     os.makedirs(out_dir, exist_ok=True)
     written = []
@@ -181,3 +188,120 @@ def write_manifest(out_path: str, manifest: Optional[dict] = None) -> str:
     with open(out_path, "w") as f:
         json.dump(manifest, f, indent=1, default=str)
     return out_path
+
+
+def _r_name(stage_name: str) -> str:
+    """CamelCase stage -> mt_snake_case R constructor (the reference's
+    SparklyRWrapper emits ml_/ft_-prefixed R functions the same way)."""
+    import re as _re
+
+    # acronym-aware camel -> snake: LightGBMClassifier -> light_gbm_classifier
+    s = _re.sub(
+        r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])", "_", stage_name
+    ).lower()
+    return f"mt_{s}"
+
+
+def _r_default(p: dict) -> str:
+    """Python param default -> R literal. Ints carry the L suffix so
+    reticulate passes Python ints (a bare 0 is an R double -> float, which
+    int-typed Params reject); non-scalar defaults (recorded by
+    reflect_stage as the "<complex>" placeholder) become NULL so the
+    python-side default applies."""
+    if not p["has_default"] or p["complex"]:
+        return "NULL"
+    v = p["default"]
+    if v is None or v == "<complex>":
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, int):
+        return f"{v}L"
+    if isinstance(v, float):
+        return repr(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, (list, tuple)):
+        if not v:
+            return "list()"
+        return "list(" + ", ".join(_r_default({"has_default": True, "complex": False, "default": x}) for x in v) + ")"
+    return "NULL"
+
+
+def generate_r_package(out_dir: str, manifest: Optional[dict] = None) -> list:
+    """Generate an R binding package (reticulate-backed) from the manifest.
+
+    The reference generates its R wrappers from Scala reflection
+    (SparklyRWrapper.scala:22-117); here the SAME stage registry that
+    feeds the manifest and docs emits one R constructor per stage:
+
+        model <- mt_lightgbm_classifier(num_iterations = 50L)$fit(df)
+
+    Each function imports the stage's python module through reticulate and
+    forwards its (defaulted) arguments; NULL arguments are dropped so
+    python defaults apply. Returns the written paths."""
+    manifest = manifest or generate_manifest()
+    os.makedirs(os.path.join(out_dir, "R"), exist_ok=True)
+    written = []
+
+    with open(os.path.join(out_dir, "DESCRIPTION"), "w") as f:
+        f.write(
+            "Package: mmlsparktpu\n"
+            "Type: Package\n"
+            "Title: R bindings for the mmlspark_tpu framework\n"
+            f"Version: {manifest['version']}\n"
+            "Description: Generated reticulate-backed wrappers for every\n"
+            "    registered pipeline stage (one constructor per stage).\n"
+            "Imports: reticulate\n"
+            "License: MIT\n"
+        )
+    written.append(os.path.join(out_dir, "DESCRIPTION"))
+    with open(os.path.join(out_dir, "NAMESPACE"), "w") as f:
+        f.write('exportPattern("^mt_")\nexport(mt_data_frame)\n')
+    written.append(os.path.join(out_dir, "NAMESPACE"))
+
+    core = [
+        "# Generated by mmlspark_tpu.codegen.generate_r_package — do not edit.",
+        "",
+        "#' Build a mmlspark_tpu DataFrame from a named list of vectors/arrays",
+        "#' @export",
+        "mt_data_frame <- function(columns, num_partitions = NULL) {",
+        '  core <- reticulate::import("mmlspark_tpu")',
+        "  if (is.null(num_partitions)) core$DataFrame$from_dict(columns)",
+        "  else core$DataFrame$from_dict(columns, num_partitions = as.integer(num_partitions))",
+        "}",
+        "",
+    ]
+    with open(os.path.join(out_dir, "R", "core.R"), "w") as f:
+        f.write("\n".join(core))
+    written.append(os.path.join(out_dir, "R", "core.R"))
+
+    by_pkg = _group_by_package(manifest)
+    for pkg, stages in sorted(by_pkg.items()):
+        lines = [
+            "# Generated by mmlspark_tpu.codegen.generate_r_package — do not edit.",
+            "",
+        ]
+        for info in sorted(stages, key=lambda s: s["name"]):
+            fn = _r_name(info["name"])
+            params = sorted(info["params"].items())
+            sig = ", ".join(f"{n} = {_r_default(p)}" for n, p in params)
+            doc1 = (info["doc"] or info["name"]).splitlines()[0].replace("'", "")
+            lines += [
+                f"#' {doc1}",
+                f"#' ({info['kind']}: mmlspark_tpu.{info['module'].split('.', 1)[-1]}.{info['name']})",
+                "#' @export",
+                f"{fn} <- function({sig}) {{",
+                "  # snapshot formals BEFORE any local assignment leaks in",
+                "  args <- as.list(environment())",
+                "  args <- args[!vapply(args, is.null, logical(1))]",
+                f'  m <- reticulate::import("{info["module"]}")',
+                f'  do.call(m${info["name"]}, args)',
+                "}",
+                "",
+            ]
+        path = os.path.join(out_dir, "R", f"{pkg}.R")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        written.append(path)
+    return written
