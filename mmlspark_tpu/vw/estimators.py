@@ -30,7 +30,9 @@ from mmlspark_tpu.core.pipeline import Estimator, Model
 from mmlspark_tpu.ops.hashing import murmur3_bytes
 from mmlspark_tpu.vw.featurizer import HasNumBits, combine_namespaces
 from mmlspark_tpu.vw.learner import (
+    LOSS_HINGE,
     LOSS_LOGISTIC,
+    LOSS_POISSON,
     LOSS_QUANTILE,
     LOSS_SQUARED,
     LOSSES,
@@ -48,8 +50,8 @@ class _VowpalWabbitBase(
 
     num_passes = Param("passes over the data (--passes)", default=1, type_=int)
     loss_function = Param(
-        "logistic | squared | quantile ('' = estimator default; "
-        "--loss_function)", default="", type_=str,
+        "logistic | squared | quantile | hinge | poisson "
+        "('' = estimator default; --loss_function)", default="", type_=str,
     )
     quantile_tau = Param(
         "pinball level for loss_function=quantile (--quantile_tau)",
@@ -169,11 +171,12 @@ class _VowpalWabbitBase(
         return idx, val, y, wt, num_bits
 
     def _train_weights(self, df: DataFrame) -> tuple:
+        """Returns (weights, num_bits, stats, resolved_args)."""
         if df.count() == 0:
             raise ValueError(f"{type(self).__name__}: empty training dataframe")
         args = self._resolve_args()
         idx, val, y, wt, num_bits = self._gather(df, bits_override=args["bits"])
-        if args["loss"] == LOSS_LOGISTIC:
+        if args["loss"] in (LOSS_LOGISTIC, LOSS_HINGE):
             y = np.where(y > 0, 1.0, -1.0).astype(np.float32)
         t0 = time.perf_counter_ns()
         w = train_sparse_sgd(
@@ -205,7 +208,7 @@ class _VowpalWabbitBase(
                 "passes": [self.get("num_passes")],
             }
         )
-        return w, num_bits, stats
+        return w, num_bits, stats, args
 
     def _apply_common(self, m: "_VowpalWabbitBaseModel", w: np.ndarray, num_bits: int, stats: DataFrame) -> None:
         m.set(
@@ -263,7 +266,7 @@ class VowpalWabbitClassifier(_VowpalWabbitBase):
     _loss = LOSS_LOGISTIC
 
     def fit(self, df: DataFrame) -> "VowpalWabbitClassificationModel":
-        w, num_bits, stats = self._train_weights(df)
+        w, num_bits, stats, _ = self._train_weights(df)
         m = VowpalWabbitClassificationModel()
         self._apply_common(m, w, num_bits, stats)
         return m
@@ -291,17 +294,25 @@ class VowpalWabbitRegressor(_VowpalWabbitBase):
     _loss = LOSS_SQUARED
 
     def fit(self, df: DataFrame) -> "VowpalWabbitRegressionModel":
-        w, num_bits, stats = self._train_weights(df)
+        w, num_bits, stats, args = self._train_weights(df)
         m = VowpalWabbitRegressionModel()
         self._apply_common(m, w, num_bits, stats)
+        m.set(loss_function=args["loss"])
         return m
 
 
 class VowpalWabbitRegressionModel(_VowpalWabbitBaseModel):
+    loss_function = Param("loss the model was trained with", default="", type_=str)
+
     def transform(self, df: DataFrame) -> DataFrame:
+        # poisson trains in log space: predictions are rates (VW's
+        # link=poisson convert-output behavior)
+        exp_link = self.get("loss_function") == LOSS_POISSON
+
         def fn(p: dict) -> dict:
             q = dict(p)
-            q[self.get("prediction_col")] = self._margins(p).astype(np.float64)
+            m = self._margins(p).astype(np.float64)
+            q[self.get("prediction_col")] = np.exp(m) if exp_link else m
             return q
 
         return df.map_partitions(fn, parallel=False)

@@ -28,19 +28,26 @@ from mmlspark_tpu.parallel.mesh import DATA_AXIS, get_mesh
 LOSS_LOGISTIC = "logistic"
 LOSS_SQUARED = "squared"
 LOSS_QUANTILE = "quantile"
-LOSSES = (LOSS_LOGISTIC, LOSS_SQUARED, LOSS_QUANTILE)
+LOSS_HINGE = "hinge"
+LOSS_POISSON = "poisson"
+LOSSES = (LOSS_LOGISTIC, LOSS_SQUARED, LOSS_QUANTILE, LOSS_HINGE, LOSS_POISSON)
 
 
 def _dloss(loss: str, margin: jnp.ndarray, y: jnp.ndarray, tau: float) -> jnp.ndarray:
-    """d(loss)/d(margin). logistic expects y in {-1,+1}; squared/quantile
-    raw y. ``tau`` is the pinball level (--quantile_tau, VW's
-    quantile loss: VowpalWabbitBase.scala:495-508 passes the flag through)."""
+    """d(loss)/d(margin) — VW's loss zoo. logistic/hinge expect y in
+    {-1,+1}; squared/quantile raw y; poisson log-space margins vs counts.
+    ``tau`` is the pinball level (--quantile_tau; VW passes loss flags
+    through its arg string, VowpalWabbitBase.scala:495-508)."""
     if loss == LOSS_LOGISTIC:
         return -y * jax.nn.sigmoid(-y * margin)
     if loss == LOSS_SQUARED:
         return margin - y
     if loss == LOSS_QUANTILE:
         return jnp.where(margin >= y, 1.0 - tau, -tau)
+    if loss == LOSS_HINGE:
+        return jnp.where(y * margin < 1.0, -y, 0.0)
+    if loss == LOSS_POISSON:
+        return jnp.exp(margin) - y
     raise ValueError(f"unknown loss {loss!r}")
 
 

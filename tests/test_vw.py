@@ -301,7 +301,7 @@ def test_pass_through_args_override_and_warn(caplog):
     pred = model.transform(fdf)["prediction"]
     assert (pred == y).mean() > 0.9
     with pytest.raises(ValueError, match="loss_function"):
-        VowpalWabbitClassifier(loss_function="hinge")._resolve_args()
+        VowpalWabbitClassifier(loss_function="squiggle")._resolve_args()
 
 
 def test_bit_precision_passthrough_consistent_constant():
@@ -320,3 +320,35 @@ def test_bit_precision_passthrough_consistent_constant():
     # shrinking below the featurized space must hard-error, not alias
     with pytest.raises(ValueError, match="bit_precision"):
         VowpalWabbitClassifier(pass_through_args="-b 12").fit(fdf)
+
+
+def test_hinge_loss_classifies():
+    x, r = _numeric_df(n=800, seed=11)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    fdf = VowpalWabbitFeaturizer(input_cols=["feat"], num_bits=14).transform(
+        DataFrame.from_dict({"feat": x, "label": y})
+    )
+    model = VowpalWabbitClassifier(
+        loss_function="hinge", num_passes=10
+    ).fit(fdf)
+    pred = model.transform(fdf)["prediction"]
+    assert (pred == y).mean() > 0.95
+
+
+def test_poisson_loss_recovers_rates():
+    x, r = _numeric_df(n=3000, seed=12)
+    lam = np.exp(0.5 * x[:, 0] - 0.3 * x[:, 1])
+    y = r.poisson(lam).astype(np.float32)
+    fdf = VowpalWabbitFeaturizer(input_cols=["feat"], num_bits=14).transform(
+        DataFrame.from_dict({"feat": x, "label": y})
+    )
+    model = VowpalWabbitRegressor(
+        loss_function="poisson", num_passes=30, learning_rate=0.2
+    ).fit(fdf)
+    pred = model.transform(fdf)["prediction"]
+    assert (pred > 0).all()  # rates, not log rates
+    # deviance beats the constant-mean baseline
+    def dev(mu):
+        mu = np.clip(mu, 1e-9, None)
+        return float(np.mean(mu - y * np.log(mu)))
+    assert dev(pred) < dev(np.full_like(pred, y.mean()))
