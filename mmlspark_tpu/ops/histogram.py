@@ -254,12 +254,17 @@ def _multi_df(num_slots: int, num_bins: int, d: int = 1 << 30) -> int:
     a 32-wide block would 4x the one-hot work on sentinel rows)."""
     budget = 8 << 20
     d_need = max(8, ((d + 7) // 8) * 8)
+    best = None
     for df in sorted({32, 16, _DF}, reverse=True):
-        if df > d_need:
+        if df * num_bins * num_slots * 6 * 4 > budget:
             continue
-        if df * num_bins * num_slots * 6 * 4 <= budget:
-            return df
-    return min(_DF, d_need) if _DF <= d_need else 8
+        # compare resulting PADDED widths: a wider block that pads to the
+        # same width does the same one-hot work in fewer grid steps (fewer
+        # slot-mask rebuilds), so prefer it
+        pad_w = ((d_need + df - 1) // df) * df
+        if best is None or pad_w < best[0] or (pad_w == best[0] and df > best[1]):
+            best = (pad_w, df)
+    return best[1] if best else 8
 
 
 def _multi_plane_pallas(

@@ -266,19 +266,31 @@ class VowpalWabbitClassifier(_VowpalWabbitBase):
     _loss = LOSS_LOGISTIC
 
     def fit(self, df: DataFrame) -> "VowpalWabbitClassificationModel":
-        w, num_bits, stats, _ = self._train_weights(df)
+        w, num_bits, stats, args = self._train_weights(df)
         m = VowpalWabbitClassificationModel()
         self._apply_common(m, w, num_bits, stats)
+        m.set(loss_function=args["loss"])
         return m
 
 
 class VowpalWabbitClassificationModel(
     _VowpalWabbitBaseModel, HasProbabilityCol, HasRawPredictionCol
 ):
+    loss_function = Param("loss the model was trained with", default="", type_=str)
+
     def transform(self, df: DataFrame) -> DataFrame:
+        # hinge margins are NOT log-odds: sigmoid(margin) would masquerade
+        # as a calibrated probability. Map them monotonically into [0, 1]
+        # via the standard (margin+1)/2 clip instead (uncalibrated, like
+        # VW's own hinge scores)
+        hinge = self.get("loss_function") == LOSS_HINGE
+
         def fn(p: dict) -> dict:
             margin = self._margins(p)
-            prob = 1.0 / (1.0 + np.exp(-margin))
+            if hinge:
+                prob = np.clip((margin + 1.0) / 2.0, 0.0, 1.0)
+            else:
+                prob = 1.0 / (1.0 + np.exp(-margin))
             q = dict(p)
             q[self.get("raw_prediction_col")] = margin.astype(np.float64)
             q[self.get("probability_col")] = prob.astype(np.float64)
@@ -312,7 +324,10 @@ class VowpalWabbitRegressionModel(_VowpalWabbitBaseModel):
         def fn(p: dict) -> dict:
             q = dict(p)
             m = self._margins(p).astype(np.float64)
-            q[self.get("prediction_col")] = np.exp(m) if exp_link else m
+            if exp_link:
+                # same clamp as the training link: rates, never inf
+                m = np.exp(np.clip(m, -30.0, 30.0))
+            q[self.get("prediction_col")] = m
             return q
 
         return df.map_partitions(fn, parallel=False)

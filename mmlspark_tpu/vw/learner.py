@@ -47,7 +47,9 @@ def _dloss(loss: str, margin: jnp.ndarray, y: jnp.ndarray, tau: float) -> jnp.nd
     if loss == LOSS_HINGE:
         return jnp.where(y * margin < 1.0, -y, 0.0)
     if loss == LOSS_POISSON:
-        return jnp.exp(margin) - y
+        # clamp like VW's poisson link: an unclamped exp overflows f32 for
+        # moderately scaled features and NaN-poisons the weights for good
+        return jnp.exp(jnp.clip(margin, -30.0, 30.0)) - y
     raise ValueError(f"unknown loss {loss!r}")
 
 

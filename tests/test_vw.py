@@ -352,3 +352,32 @@ def test_poisson_loss_recovers_rates():
         mu = np.clip(mu, 1e-9, None)
         return float(np.mean(mu - y * np.log(mu)))
     assert dev(pred) < dev(np.full_like(pred, y.mean()))
+
+
+def test_poisson_margin_clamped_no_nan():
+    """Moderately scaled features must not NaN-poison poisson training
+    (the exp link clamps like VW's)."""
+    r = np.random.default_rng(13)
+    x = (r.normal(size=(500, 4)) * 50).astype(np.float32)
+    y = r.poisson(2.0, size=500).astype(np.float32)
+    fdf = VowpalWabbitFeaturizer(input_cols=["feat"], num_bits=12).transform(
+        DataFrame.from_dict({"feat": x, "label": y})
+    )
+    m = VowpalWabbitRegressor(loss_function="poisson", num_passes=5).fit(fdf)
+    pred = m.transform(fdf)["prediction"]
+    assert np.isfinite(pred).all()
+
+
+def test_hinge_probability_is_margin_scaled_not_sigmoid():
+    x, r = _numeric_df(n=600, seed=14)
+    y = (x[:, 0] > 0).astype(np.float32)
+    fdf = VowpalWabbitFeaturizer(input_cols=["feat"], num_bits=13).transform(
+        DataFrame.from_dict({"feat": x, "label": y})
+    )
+    m = VowpalWabbitClassifier(loss_function="hinge", num_passes=8).fit(fdf)
+    assert m.get("loss_function") == "hinge"
+    out = m.transform(fdf)
+    margin = out["raw_prediction"]
+    np.testing.assert_allclose(
+        out["probability"], np.clip((margin + 1.0) / 2.0, 0.0, 1.0)
+    )

@@ -803,6 +803,148 @@ NOTEBOOKS = {
          "    q.stop(); srv.stop()\n"
          "print('fleet survived a worker death')"),
     ],
+    # reference: LightGBM - Overview.ipynb (boosting modes + SHAP + native IO)
+    "LightGBM - Overview.ipynb": [
+        ("markdown",
+         "# LightGBM-equivalent GBDT: a tour\n\n"
+         "The reference's *LightGBM - Overview*: boosting modes (gbdt, goss,\n"
+         "dart, rf), feature importances, SHAP explanations, and native\n"
+         "text-format model exchange — all on the TPU grower."),
+        ("code",
+         _DATA +
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.io.csv import read_csv\n\n"
+         "raw = read_csv(os.path.join(data_dir, 'breast_cancer.csv'))\n"
+         "feat_cols = [c for c in raw.columns if c != 'label']\n"
+         "x = np.stack([np.asarray(raw[c], np.float64) for c in feat_cols], 1)\n"
+         "y = np.asarray(raw['label'])\n"
+         "df = DataFrame.from_dict({'features': x.astype(np.float32), 'label': y})\n"
+         "df.count()"),
+        ("code",
+         "from mmlspark_tpu.models.gbdt import LightGBMClassifier\n"
+         "from mmlspark_tpu.core.metrics import binary_auc\n\n"
+         "aucs = {}\n"
+         "for mode in ('gbdt', 'goss', 'dart', 'rf'):\n"
+         "    m = LightGBMClassifier(num_iterations=25, num_leaves=15,\n"
+         "                           boosting_type=mode, seed=0).fit(df)\n"
+         "    p = m.transform(df)['probability'][:, 1]\n"
+         "    aucs[mode] = round(binary_auc(y, p), 4)\n"
+         "print(aucs)\n"
+         "assert min(aucs.values()) > 0.95, aucs"),
+        ("code",
+         "# feature importances + exact TreeSHAP on a handful of rows\n"
+         "model = LightGBMClassifier(num_iterations=25, num_leaves=15).fit(df)\n"
+         "imp = model.get_feature_importances('gain')\n"
+         "shap = model.features_shap(x[:5].astype(np.float32))\n"
+         "raw_pred = model.booster.predict_raw(x[:5].astype(np.float32))\n"
+         "np.testing.assert_allclose(shap.sum(1), raw_pred, rtol=1e-4, atol=1e-4)\n"
+         "print('top feature:', feat_cols[int(np.argmax(imp))])"),
+        ("code",
+         "# native LightGBM text format: save, reload, identical predictions\n"
+         "import tempfile, os as _os\n"
+         "with tempfile.TemporaryDirectory() as td:\n"
+         "    path = _os.path.join(td, 'model.txt')\n"
+         "    model.save_native_model(path)\n"
+         "    from mmlspark_tpu.models.gbdt import LightGBMClassificationModel\n"
+         "    back = LightGBMClassificationModel.load_native_model_from_file(path)\n"
+         "    np.testing.assert_allclose(\n"
+         "        back.booster.predict_raw(x[:20].astype(np.float32)),\n"
+         "        model.booster.predict_raw(x[:20].astype(np.float32)),\n"
+         "        rtol=1e-5, atol=1e-5)\n"
+         "print('native round-trip ok')"),
+    ],
+    # reference: CognitiveServices - Overview.ipynb (against a local mock)
+    "CognitiveServices - Overview.ipynb": [
+        ("markdown",
+         "# Cognitive-service enrichment in a pipeline\n\n"
+         "The reference's *CognitiveServices - Overview* flow: DataFrame\n"
+         "columns -> REST enrichment transformers (sentiment, language,\n"
+         "key phrases) with per-row error columns. This notebook runs\n"
+         "against a LOCAL mock service so it executes offline; point\n"
+         "``url`` at a real endpoint + subscription key in production."),
+        ("code",
+         "import json, threading\n"
+         "from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer\n\n"
+         "class Mock(BaseHTTPRequestHandler):\n"
+         "    def log_message(self, *a):\n"
+         "        pass\n"
+         "    def do_POST(self):\n"
+         "        n = int(self.headers.get('Content-Length') or 0)\n"
+         "        doc = json.loads(self.rfile.read(n))['documents'][0]\n"
+         "        path = self.path.split('?')[0]\n"
+         "        if path.endswith('/sentiment'):\n"
+         "            s = 'positive' if 'love' in doc['text'] else 'negative'\n"
+         "            body = {'documents': [{'id': '0', 'sentiment': s}], 'errors': []}\n"
+         "        else:\n"
+         "            body = {'documents': [{'id': '0',\n"
+         "                    'detectedLanguage': {'iso6391Name': 'en'}}], 'errors': []}\n"
+         "        raw = json.dumps(body).encode()\n"
+         "        self.send_response(200)\n"
+         "        self.send_header('Content-Length', str(len(raw)))\n"
+         "        self.end_headers()\n"
+         "        self.wfile.write(raw)\n\n"
+         "srv = ThreadingHTTPServer(('127.0.0.1', 0), Mock)\n"
+         "threading.Thread(target=srv.serve_forever, daemon=True).start()\n"
+         "url = f'http://127.0.0.1:{srv.server_port}'"),
+        ("code",
+         "import numpy as np\n"
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.cognitive import TextSentiment\n\n"
+         "df = DataFrame.from_dict({'text': np.array(\n"
+         "    ['i love this tpu', 'terrible latency'], dtype=object)})\n"
+         "scored = TextSentiment(url=url, output_col='sentiment',\n"
+         "                       subscription_key='demo-key'\n"
+         "                       ).set_col('text', 'text').transform(df)\n"
+         "sentiments = [s['sentiment'] for s in scored['sentiment']]\n"
+         "print(sentiments)\n"
+         "assert sentiments == ['positive', 'negative']\n"
+         "srv.shutdown()"),
+    ],
+    # zoo import flow: externally trained torchvision weights
+    "DeepLearning - Importing Torch Checkpoints.ipynb": [
+        ("markdown",
+         "# Importing torchvision ResNet checkpoints\n\n"
+         "The zoo accepts the de-facto standard serialized backbone format:\n"
+         "a torchvision ResNet ``state_dict``. Externally trained weights\n"
+         "(e.g. ImageNet ResNet-50) drop into `ImageFeaturizer` with their\n"
+         "semantics intact — strided padding is matched to torch exactly.\n"
+         "Here the 'external' model is a small torch network built inline."),
+        ("code",
+         "import numpy as np, tempfile, os, torch\n\n"
+         "# a torchvision-layout ResNet-18 (conv1/bn1/layer1..4/fc keys)\n"
+         "import sys\n"
+         "sys.path.insert(0, os.path.join(os.getcwd(), 'tests'))\n"
+         "from test_torch_import import _TorchResNet, _TorchBasic\n"
+         "torch.manual_seed(0)\n"
+         "tm = _TorchResNet(_TorchBasic, [2, 2, 2, 2], num_classes=10).eval()\n"
+         "tmpdir = tempfile.mkdtemp()\n"
+         "pth = os.path.join(tmpdir, 'resnet18.pth')\n"
+         "torch.save(tm.state_dict(), pth)"),
+        ("code",
+         "from mmlspark_tpu.downloader import install_torch_checkpoint\n"
+         "from mmlspark_tpu.downloader.zoo import ModelDownloader\n\n"
+         "dl = ModelDownloader(repo_dir=os.path.join(tmpdir, 'zoo'))\n"
+         "schema = install_torch_checkpoint(pth, name='ResNet18_External',\n"
+         "                                  image_size=64, downloader=dl)\n"
+         "print(schema.variant, schema.num_classes, 'torch_padding =', schema.torch_padding)"),
+        ("code",
+         "from mmlspark_tpu import DataFrame\n"
+         "from mmlspark_tpu.models import ImageFeaturizer\n"
+         "from mmlspark_tpu.ops.image import normalize\n\n"
+         "imgs = np.random.default_rng(1).integers(0, 255, (4, 64, 64, 3),\n"
+         "                                         dtype=np.uint8)\n"
+         "feats = ImageFeaturizer(input_col='image', output_col='features',\n"
+         "                        model_name='ResNet18_External', image_size=64,\n"
+         "                        repo_dir=os.path.join(tmpdir, 'zoo'))\n"
+         "out = np.stack(feats.transform(DataFrame.from_dict({'image': imgs}))['features'])\n"
+         "# parity with torch on the same preprocessed pixels\n"
+         "with torch.no_grad():\n"
+         "    ref = tm(torch.from_numpy(\n"
+         "        np.asarray(normalize(imgs.astype(np.float32))).transpose(0, 3, 1, 2)))\n"
+         "np.testing.assert_allclose(out, ref['pool'].numpy(), rtol=2e-2, atol=2e-2)\n"
+         "print('torch feature parity:', out.shape)"),
+    ],
 }
 
 
