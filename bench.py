@@ -3,42 +3,61 @@
 North-star config (BASELINE.md): ResNet-50 featurization over a DataFrame at
 >= 8,000 images/sec on v5e-32 => 250 images/sec/chip. ``vs_baseline`` is
 measured images/sec/chip / 250. The single JSON line also carries an
-``extra`` dict: Pallas histogram microbench (plane builds/sec), serving
-loopback p50/p99 (the reference's sub-ms claim, README.md:22-23), and an
-explicit ``fallback`` flag so a CPU number can never masquerade as a TPU
-regression.
+``extra`` dict: Pallas histogram microbench, GBDT-vs-sklearn head-to-head,
+VW throughput, serving loopback p50/p99, and explicit fallback flags so a
+CPU number can never masquerade as a TPU regression.
 
-Tunnel-failure model (learned from rounds 1-2): the axon TPU backend can
-(a) HANG forever inside backend init when the relay is down — the claim
-loop never times out — or (b) come up and then die at any later compile
-with ``remote_compile: Connection refused`` when the relay flaps. So:
-- every TPU attempt runs in a CHILD process with a hard wall-clock timeout;
-- the parent retries attempts with backoff until a total budget is spent;
-- inside the child, the first tiny-jit warmup and the model compile each
-  retry with backoff (a flapped relay often returns within a minute);
-- only after the budget is exhausted does a clean-CPU child run, and its
-  line says ``"fallback": true`` plus the last TPU error.
+Failure model (learned over rounds 1-4): the axon TPU backend can hang
+forever inside backend init, die at any compile when the relay flaps, or
+simply be slow enough that an all-or-nothing run exceeds the driver's wall
+clock (round 4 lost EVERY metric to one 1200 s hang). So this harness is
+**incremental and un-killable**:
+
+- the child process emits one JSON line PER SEGMENT as it completes
+  (cheap, CPU-startable segments first; the headline featurizer last);
+- the parent harvests lines with per-segment watchdog timeouts, kills a
+  hung child, and re-runs only the MISSING segments (one TPU retry, then
+  a clean-CPU fallback child) — completed metrics are never lost;
+- the parent traps SIGTERM/SIGINT and prints the partial assembly before
+  exiting, so even a driver-level timeout yields a parseable line;
+- total worst case (TPU budget + CPU fallback) stays under ~13 minutes;
+- the persistent XLA compile cache dir is exported into EVERY child env
+  so retries don't recompile from scratch.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
+import signal
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
 
-TPU_BUDGET_S = int(os.environ.get("MMLSPARK_BENCH_TPU_BUDGET", "2400"))
-ATTEMPT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_ATTEMPT_TIMEOUT", "1200"))
-# the CPU suite itself takes minutes; independent knob so a shortened
-# TPU-attempt timeout doesn't kill the fallback mid-run
-FALLBACK_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_FALLBACK_TIMEOUT", "1800"))
-CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+HERE = os.path.dirname(os.path.abspath(__file__))
+CACHE_DIR = os.path.join(HERE, ".jax_cache")
+PARTIAL_PATH = os.path.join(HERE, "bench_partial.json")
+
+# Parent-side budgets (seconds). Worst case = TPU_BUDGET + CPU_BUDGET plus
+# a few seconds of orchestration: 480 + 300 = 780 s (~13 min), inside the
+# driver's wall clock with margin. Every knob has an env override.
+TOTAL_TPU_BUDGET_S = int(os.environ.get("MMLSPARK_BENCH_TPU_BUDGET", "480"))
+CPU_BUDGET_S = int(os.environ.get("MMLSPARK_BENCH_FALLBACK_TIMEOUT", "300"))
+# watchdogs: first line covers backend init + first compile; later lines
+# cover one segment each (compile cache makes repeats cheap)
+FIRST_LINE_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_ATTEMPT_TIMEOUT", "300"))
+SEGMENT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_SEGMENT_TIMEOUT", "200"))
+
+# Cheap + CPU-startable first, headline throughput last, so a late hang
+# can only cost the segments not yet reached.
+SEGMENTS = ["serving", "hist", "vw", "gbdt", "sklearn", "featurizer"]
 
 
-def _retry(fn, what: str, tries: int = 4, base_sleep: float = 20.0):
+def _retry(fn, what: str, tries: int = 3, base_sleep: float = 10.0):
     """Retry a compile-bearing step: the remote-compile relay flaps."""
     for i in range(tries):
         try:
@@ -50,15 +69,16 @@ def _retry(fn, what: str, tries: int = 4, base_sleep: float = 20.0):
             time.sleep(base_sleep * (i + 1))
 
 
-def _bench_featurizer(on_accel: bool, n_dev: int) -> tuple:
-    """Returns (e2e images/sec/chip, diagnostics dict).
+# ---------------------------------------------------------------------------
+# segments (run inside the child process)
+# ---------------------------------------------------------------------------
 
-    e2e drives the full DataFrame -> features path (host batches shipped to
-    the device per minibatch). The diagnostics separate the two regimes the
-    tunnel conflates: device-resident model throughput (what the chip does
-    once data is in HBM) and the host->device uplink rate (which, over the
-    axon relay, is often the only limiter and varies 30x minute to minute).
-    """
+
+def _seg_featurizer(on_accel: bool, n_dev: int) -> dict:
+    """Full DataFrame -> features path plus diagnostics separating the two
+    regimes the tunnel conflates: device-resident model throughput and the
+    host->device uplink rate (often the only limiter over the axon relay,
+    varying 30x minute to minute)."""
     import jax
 
     from mmlspark_tpu import DataFrame
@@ -87,7 +107,7 @@ def _bench_featurizer(on_accel: bool, n_dev: int) -> tuple:
         _ = out["features"]  # materialize
         dt = time.perf_counter() - t0
         best = max(best, n_rows / dt)
-    diag: dict = {}
+    diag: dict = {"featurizer_img_s_chip": round(best / n_dev, 2)}
     try:
         # device-resident rate: pre-staged batch, N dispatches, fetch the
         # last output (block_until_ready under-reports over the relay)
@@ -117,10 +137,10 @@ def _bench_featurizer(on_accel: bool, n_dev: int) -> tuple:
         diag["tunnel_limited"] = bool(dres > 2.0 * best / n_dev)
     except Exception as e:  # noqa: BLE001
         diag["diag_error"] = str(e)[:200]
-    return best / n_dev, diag
+    return diag
 
 
-def _bench_histogram(on_accel: bool) -> dict:
+def _seg_hist(on_accel: bool, n_dev: int) -> dict:
     """Pallas histogram kernel: (n, d) bins -> (d*B, 3) plane, builds/sec."""
     import jax
     import jax.numpy as jnp
@@ -163,11 +183,10 @@ def _bench_histogram(on_accel: bool) -> dict:
     return out
 
 
-def _bench_gbdt(on_accel: bool) -> dict:
+def _seg_gbdt(on_accel: bool, n_dev: int) -> dict:
     """Boosting throughput (trees/sec) with the device-resident loop, for
-    both growth policies: lossguide (LightGBM leaf-wise parity; O(num_leaves)
-    histogram passes under static shapes) and depthwise (one multi-leaf
-    histogram pass per level — the TPU-shaped policy)."""
+    both growth policies: lossguide (LightGBM leaf-wise parity) and
+    depthwise (one multi-leaf histogram pass per level)."""
     from mmlspark_tpu.models.gbdt import TrainConfig, train
 
     n, d = (200_000, 64) if on_accel else (20_000, 32)
@@ -193,7 +212,7 @@ def _bench_gbdt(on_accel: bool) -> dict:
     return out
 
 
-def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
+def _seg_sklearn(on_accel: bool, n_dev: int) -> dict:
     """Wall-clock head-to-head vs sklearn HistGradientBoosting (the same
     histogram-GBDT family as LightGBM) with matched hyperparameters — the
     analogue of the reference's headline 'LightGBM 10-30% faster than
@@ -256,19 +275,16 @@ def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
     out["sklearn63_train_s"] = round(sk63_s, 2)
     out["gbdt63_vs_sklearn63_speedup"] = round(sk63_s / raw63, 3)
     try:
-        from mmlspark_tpu.core.metrics import binary_auc as _auc63
-        from mmlspark_tpu.models.gbdt.objectives import sigmoid as _sig63
+        from mmlspark_tpu.core.metrics import binary_auc
+        from mmlspark_tpu.models.gbdt.objectives import sigmoid
 
-        out["gbdt63_auc"] = round(
-            _auc63(yte, _sig63(b63.predict_raw(xte))), 4
-        )
-        out["sklearn63_auc"] = round(
-            _auc63(yte, sk63.predict_proba(xte)[:, 1]), 4
-        )
+        out["gbdt63_auc"] = round(binary_auc(yte, sigmoid(b63.predict_raw(xte))), 4)
+        out["sklearn63_auc"] = round(binary_auc(yte, sk63.predict_proba(xte)[:, 1]), 4)
     except Exception as e:  # noqa: BLE001
         out["auc63_error"] = str(e)[:120]
     # held-out quality next to the wall-clock: the speedup claim only
-    # counts if the models are comparably good
+    # counts if the models are comparably good. Independent try: a 63-bin
+    # predict failure must not suppress the headline AUC evidence
     try:
         from mmlspark_tpu.core.metrics import binary_auc
         from mmlspark_tpu.models.gbdt.objectives import sigmoid
@@ -279,9 +295,7 @@ def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
         out["gbdt_depthwise_auc"] = round(
             binary_auc(yte, sigmoid(boosters["depthwise"].predict_raw(xte))), 4
         )
-        out["sklearn_auc"] = round(
-            binary_auc(yte, sk.predict_proba(xte)[:, 1]), 4
-        )
+        out["sklearn_auc"] = round(binary_auc(yte, sk.predict_proba(xte)[:, 1]), 4)
     except Exception as e:  # noqa: BLE001
         out["auc_error"] = str(e)[:120]
     # ratios divide the RAW seconds (rounded values skew, and can be 0.0)
@@ -292,7 +306,7 @@ def _bench_gbdt_vs_sklearn(on_accel: bool) -> dict:
     return out
 
 
-def _bench_vw(on_accel: bool) -> dict:
+def _seg_vw(on_accel: bool, n_dev: int) -> dict:
     """Online-learning throughput: hashed sparse text rows/sec through the
     device SGD (the BASELINE 20-newsgroups-style tracked metric)."""
     from mmlspark_tpu import DataFrame
@@ -316,8 +330,7 @@ def _bench_vw(on_accel: bool) -> dict:
     out = {"vw_rows": n, "vw_rows_per_sec": round(n / dt, 1)}
     # device-resident rate: a multi-pass fit uploads the rows ONCE and
     # streams p passes over them on device — the e2e number above is
-    # uplink-bound over the tunneled chip (~10 MB of hashed rows at
-    # ~30 MB/s), this isolates what the SGD kernel sustains
+    # uplink-bound over the tunneled chip, this isolates the SGD kernel
     passes = 8
     clf_p = VowpalWabbitClassifier(num_passes=passes)
     _retry(lambda: clf_p.fit(fdf), "vw multipass compile")
@@ -325,8 +338,7 @@ def _bench_vw(on_accel: bool) -> dict:
     clf_p.fit(fdf)
     dtp = time.perf_counter() - t0
     # per-pass marginal time: subtract the 1-pass run (upload + fixed
-    # overheads) so the resident rate reflects pure device throughput. A
-    # relay stall in the 1-pass run can make the difference non-positive;
+    # overheads). A relay stall can make the difference non-positive;
     # report nothing rather than an absurd clamped rate
     if dtp > dt * 1.05:
         marginal = (dtp - dt) / (passes - 1)
@@ -334,7 +346,7 @@ def _bench_vw(on_accel: bool) -> dict:
     return out
 
 
-def _bench_serving() -> dict:
+def _seg_serving(on_accel: bool, n_dev: int) -> dict:
     """Loopback POST -> fixed-shape batch -> jitted model -> reply, ms."""
     import http.client
 
@@ -407,7 +419,9 @@ def _bench_serving() -> dict:
     # relay's RPC floor; measure the model-on-serving-host deployment shape
     # separately so the capability is visible next to the remote number.
     if jax.default_backend() == "cpu":
-        return out  # the measurement above already IS model-on-host
+        out["serving_local_p50_ms"] = p50  # the run above IS model-on-host
+        out["serving_local_p99_ms"] = p99
+        return out
     try:
         cpu = jax.local_devices(backend="cpu")[0]
         w_cpu = jax.device_put(w_host, cpu)
@@ -427,7 +441,22 @@ def _bench_serving() -> dict:
     return out
 
 
-def run_bench() -> None:
+SEGMENT_FNS = {
+    "serving": _seg_serving,
+    "hist": _seg_hist,
+    "vw": _seg_vw,
+    "gbdt": _seg_gbdt,
+    "sklearn": _seg_sklearn,
+    "featurizer": _seg_featurizer,
+}
+
+
+# ---------------------------------------------------------------------------
+# child driver: run requested segments, stream one JSON line per segment
+# ---------------------------------------------------------------------------
+
+
+def run_child() -> None:
     import jax
 
     try:
@@ -436,7 +465,11 @@ def run_bench() -> None:
     except Exception:
         pass  # older jax: cache is an optimization, not a requirement
 
-    devices = _retry(jax.devices, "backend init", tries=3, base_sleep=30.0)
+    def emit(seg: str, data: dict) -> None:
+        sys.stdout.write(json.dumps({"segment": seg, "data": data}) + "\n")
+        sys.stdout.flush()
+
+    devices = _retry(jax.devices, "backend init", tries=2, base_sleep=15.0)
     platform = devices[0].platform
     n_dev = len(devices)
     on_accel = platform not in ("cpu",)
@@ -446,120 +479,271 @@ def run_bench() -> None:
         sys.stderr.write("bench child: backend is cpu but TPU was required\n")
         raise SystemExit(3)
 
-    # trivial 1-op warmup first: proves the compile path end-to-end before
-    # spending minutes tracing ResNet, and retries through relay flaps
+    # trivial 1-op warmup: proves the compile path end-to-end before
+    # spending minutes tracing models, and retries through relay flaps
     import jax.numpy as jnp
 
     _retry(
         lambda: (jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready(),
         "warmup jit",
-        tries=5,
-        base_sleep=30.0,
+        tries=3,
+        base_sleep=15.0,
     )
+    emit("init", {"platform": platform, "n_dev": n_dev})
 
-    per_chip, feat_diag = _bench_featurizer(on_accel, n_dev)
-    extra = {"fallback": not on_accel}
-    extra.update(feat_diag)
-    try:
-        extra.update(_bench_histogram(on_accel))
-    except Exception as e:  # noqa: BLE001
-        extra["hist_error"] = str(e)[:200]
-    try:
-        extra.update(_bench_gbdt(on_accel))
-    except Exception as e:  # noqa: BLE001
-        extra["gbdt_error"] = str(e)[:200]
-    try:
-        extra.update(_bench_vw(on_accel))
-    except Exception as e:  # noqa: BLE001
-        extra["vw_error"] = str(e)[:200]
-    try:
-        extra.update(_bench_gbdt_vs_sklearn(on_accel))
-    except Exception as e:  # noqa: BLE001
-        extra["gbdt_vs_sklearn_error"] = str(e)[:200]
-    try:
-        extra.update(_bench_serving())
-    except Exception as e:  # noqa: BLE001
-        extra["serving_error"] = str(e)[:200]
-
-    result = {
-        "metric": "imagefeaturizer_resnet50_throughput",
-        "value": round(per_chip, 2),
-        "unit": f"images/sec/chip ({platform} x{n_dev})",
-        "vs_baseline": round(per_chip / 250.0, 3),
-        "extra": extra,
-    }
-    print(json.dumps(result))
+    wanted = [
+        s for s in os.environ.get(
+            "MMLSPARK_BENCH_SEGMENTS", ",".join(SEGMENTS)
+        ).split(",") if s in SEGMENT_FNS
+    ]
+    for seg in wanted:
+        try:
+            data = SEGMENT_FNS[seg](on_accel, n_dev)
+        except Exception as e:  # noqa: BLE001
+            data = {f"{seg}_error": str(e)[:200]}
+        emit(seg, data)
+    emit("done", {})
 
 
-def _run_child(env: dict, timeout_s: int) -> tuple:
-    """Returns (json_line or '', stderr_tail)."""
-    try:
-        proc = subprocess.run(
+# ---------------------------------------------------------------------------
+# parent orchestrator
+# ---------------------------------------------------------------------------
+
+
+class _Child:
+    """Child process whose stdout lines are harvested with timeouts."""
+
+    def __init__(self, segments: list, env: dict):
+        env = dict(env)
+        env["MMLSPARK_BENCH_SEGMENTS"] = ",".join(segments)
+        env["JAX_COMPILATION_CACHE_DIR"] = CACHE_DIR
+        self.proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child"],
             env=env,
-            timeout=timeout_s,
-            capture_output=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
             text=True,
         )
-        line = _json_line(proc.stdout)
-        if proc.returncode == 0 and line:
-            return line, proc.stderr[-2000:]
-        return "", proc.stderr[-2000:]
-    except subprocess.TimeoutExpired:
-        return "", f"child exceeded {timeout_s}s (backend init hang?)"
+        self.q: queue.Queue = queue.Queue()
+        self.err_chunks: list = []
+        threading.Thread(target=self._pump_out, daemon=True).start()
+        threading.Thread(target=self._pump_err, daemon=True).start()
+
+    def _pump_out(self):
+        for line in self.proc.stdout:
+            self.q.put(line)
+        self.q.put(None)  # EOF sentinel
+
+    def _pump_err(self):
+        for line in self.proc.stderr:
+            self.err_chunks.append(line)
+            if len(self.err_chunks) > 200:
+                del self.err_chunks[:100]
+
+    def next_record(self, timeout_s: float):
+        """Next parsed {segment, data} record, or None on EOF/timeout."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                line = self.q.get(timeout=min(remaining, 5.0))
+            except queue.Empty:
+                continue
+            if line is None:
+                return None
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "segment" in rec:
+                return rec
+
+    def kill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+    @property
+    def stderr_tail(self) -> str:
+        return "".join(self.err_chunks)[-2000:]
+
+
+class _Assembly:
+    """Accumulates segment results; can emit a valid JSON line at any time."""
+
+    def __init__(self):
+        self.extra: dict = {}
+        self.done: set = set()
+        self.platform = "unknown"
+        self.n_dev = 1
+        self.featurizer_platform = None
+        self.tpu_error = ""
+        self.segments_cpu: list = []
+        self._printed = False
+        self._lock = threading.Lock()
+
+    def absorb(self, rec: dict, on_cpu_fallback: bool) -> str:
+        seg = rec.get("segment", "")
+        data = rec.get("data", {}) or {}
+        if seg == "init":
+            self.platform = data.get("platform", self.platform)
+            self.n_dev = data.get("n_dev", self.n_dev)
+            return seg
+        if seg in SEGMENT_FNS and seg not in self.done:
+            # a record whose only payload is "<seg>_error" is a FAILED
+            # segment: keep the error visible but leave the segment
+            # incomplete so the CPU fallback child re-runs it
+            failed = set(data) == {f"{seg}_error"}
+            self.extra.update(data)
+            if failed and not on_cpu_fallback:
+                self._write_partial()
+                return ""  # not done — stays in `remaining`
+            if not failed:
+                self.extra.pop(f"{seg}_error", None)  # stale earlier error
+            self.done.add(seg)
+            if on_cpu_fallback:
+                self.segments_cpu.append(seg)
+            if seg == "featurizer" and not failed:
+                self.featurizer_platform = (self.platform, self.n_dev)
+            self._write_partial()
+        return seg
+
+    def _write_partial(self):
+        try:
+            with open(PARTIAL_PATH, "w") as f:
+                json.dump({"done": sorted(self.done), "extra": self.extra}, f)
+        except OSError:
+            pass
+
+    def emit(self) -> None:
+        with self._lock:
+            if self._printed:
+                return
+            self._printed = True
+        per_chip = float(self.extra.get("featurizer_img_s_chip", 0.0))
+        plat, n = self.featurizer_platform or (self.platform, self.n_dev)
+        # no featurizer number => value is 0.0, which must NEVER read as a
+        # measured TPU regression: force the fallback flag in that case
+        extra = {"fallback": "featurizer" in self.segments_cpu
+                 or self.featurizer_platform is None}
+        extra.update(self.extra)
+        extra.pop("featurizer_img_s_chip", None)
+        if self.segments_cpu:
+            extra["segments_on_cpu"] = sorted(self.segments_cpu)
+        if self.tpu_error:
+            extra["tpu_error"] = self.tpu_error[-300:]
+        missing = [s for s in SEGMENTS if s not in self.done]
+        if missing:
+            extra["segments_missing"] = missing
+        result = {
+            "metric": "imagefeaturizer_resnet50_throughput",
+            "value": round(per_chip, 2),
+            "unit": f"images/sec/chip ({plat} x{n})",
+            "vs_baseline": round(per_chip / 250.0, 3),
+            "extra": extra,
+        }
+        print(json.dumps(result))
+        sys.stdout.flush()
+
+
+def _harvest(child: _Child, asm: _Assembly, remaining: list,
+             deadline: float, on_cpu: bool) -> None:
+    """Drain records from a child until done/EOF/hang/deadline; removes
+    completed segments from ``remaining`` in place."""
+    saw_line = False
+    while remaining:
+        budget = deadline - time.monotonic()
+        if budget <= 0:
+            break
+        timeout = min(budget,
+                      SEGMENT_TIMEOUT_S if saw_line else FIRST_LINE_TIMEOUT_S)
+        rec = child.next_record(timeout)
+        if rec is None:
+            break  # EOF or watchdog timeout — caller decides what's next
+        saw_line = True
+        seg = asm.absorb(rec, on_cpu)
+        if seg in remaining:
+            remaining.remove(seg)
+        if seg == "done":
+            break
+    child.kill()
 
 
 def main() -> None:
-    deadline = time.monotonic() + TPU_BUDGET_S
+    asm = _Assembly()
+    start = time.monotonic()
+    live_child: list = []
+
+    def on_signal(signum, frame):  # driver timeout: flush what we have
+        asm.tpu_error = asm.tpu_error or f"killed by signal {signum}"
+        # emit FIRST: a driver may chase SIGTERM with SIGKILL, and waiting
+        # on a slow child reap must not cost us the output line
+        asm.emit()
+        for c in live_child:
+            try:
+                c.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    remaining = list(SEGMENTS)
+    tpu_deadline = start + TOTAL_TPU_BUDGET_S
     attempt = 0
-    cpu_fails = 0
-    last_err = ""
-    while time.monotonic() < deadline:
+    while (remaining and time.monotonic() < tpu_deadline - 30
+           and attempt < 2):
         attempt += 1
-        remaining = deadline - time.monotonic()
         env = dict(os.environ)
         env["MMLSPARK_BENCH_REQUIRE_TPU"] = "1"  # CPU-silent init fails fast
-        line, err = _run_child(
-            env, int(min(ATTEMPT_TIMEOUT_S, max(remaining, 60)))
+        child = _Child(remaining, env)
+        live_child[:] = [child]
+        before = set(remaining)
+        _harvest(child, asm, remaining, tpu_deadline, on_cpu=False)
+        live_child[:] = []
+        if not remaining:
+            break
+        err = child.stderr_tail
+        asm.tpu_error = err or f"tpu child attempt {attempt} hung"
+        sys.stderr.write(
+            f"bench: TPU attempt {attempt} ended with "
+            f"{len(before) - len(set(remaining))} new segments; "
+            f"stderr tail:\n{err[-600:]}\n"
         )
-        if line:
-            print(line)
-            return
         if "backend is cpu" in err:
-            cpu_fails += 1
-            if cpu_fails >= 2:
-                # deterministic plugin absence — stop burning the budget
-                last_err = "TPU plugin unavailable (child ran on CPU twice)"
-                break
-        last_err = err
-        sys.stderr.write(f"bench: TPU attempt {attempt} failed:\n{err}\n")
-        if time.monotonic() + 30 < deadline:
-            time.sleep(min(30 * attempt, 120))
-    # clean-CPU fallback: drop the axon sitecustomize and force cpu
-    sys.stderr.write("bench: TPU budget exhausted; running CPU fallback\n")
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
-    env.pop("MMLSPARK_BENCH_REQUIRE_TPU", None)
-    line, err = _run_child(env, FALLBACK_TIMEOUT_S)
-    if not line:
-        sys.stderr.write(err + "\n")
-        raise SystemExit(1)
-    d = json.loads(line)
-    d.setdefault("extra", {})["fallback"] = True
-    d["extra"]["tpu_error"] = last_err[-300:]
-    print(json.dumps(d))
-
-
-def _json_line(out: str) -> str:
-    for ln in reversed(out.strip().splitlines()):
-        if ln.startswith("{"):
-            return ln
-    return ""
+            break  # deterministic plugin absence — go straight to fallback
+    if remaining:
+        sys.stderr.write(
+            f"bench: CPU fallback for segments: {remaining}\n"
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = HERE
+        env.pop("MMLSPARK_BENCH_REQUIRE_TPU", None)
+        child = _Child(remaining, env)
+        live_child[:] = [child]
+        _harvest(child, asm, remaining,
+                 time.monotonic() + CPU_BUDGET_S, on_cpu=True)
+        live_child[:] = []
+        if remaining:
+            sys.stderr.write(
+                f"bench: segments never completed: {remaining}\n"
+                f"{child.stderr_tail[-600:]}\n"
+            )
+    asm.emit()
 
 
 if __name__ == "__main__":
     if "--child" in sys.argv:
-        run_bench()
+        run_child()
     else:
         main()
