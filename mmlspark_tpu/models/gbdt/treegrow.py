@@ -158,6 +158,9 @@ def grow_tree(
     lambda_l1: float = 0.0,
     min_sum_hessian: float = 1e-3,
     num_bins: int = NUM_BINS,
+    partitioned: bool = False,
+    mesh: Any = None,
+    shard_axis: Optional[str] = None,
 ) -> GrownTree:
     """Grow one tree. The categorical-split machinery (per-leaf argsort of
     category bins) is statically compiled OUT when ``categorical_mask`` is
@@ -166,10 +169,26 @@ def grow_tree(
     ``lambda_l1`` soft-thresholds gradient sums in both split gains and
     leaf values; ``min_sum_hessian`` invalidates splits whose child
     hessian mass is below it (LightGBM lambda_l1 /
-    min_sum_hessian_in_leaf semantics)."""
+    min_sum_hessian_in_leaf semantics).
+
+    ``partitioned=True`` selects the data-partitioned grower
+    (:func:`_grow_tree_partitioned`): rows kept physically grouped by leaf
+    so each split histograms only the smaller child's contiguous range —
+    LightGBM's DataPartition + sibling-subtraction design. Single-device
+    layouts only (the global row permutation would thrash a sharded mesh)."""
     has_categorical = categorical_mask is not None
     if not has_categorical:
         categorical_mask = jnp.zeros((bins.shape[1],), bool)
+    if partitioned:
+        return _grow_tree_partitioned(
+            bins, grad, hess, row_weight,
+            num_leaves=num_leaves, lambda_l2=lambda_l2, min_gain=min_gain,
+            learning_rate=learning_rate, feature_mask=feature_mask,
+            max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
+            categorical_mask=categorical_mask, has_categorical=has_categorical,
+            lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
+            num_bins=num_bins,
+        )
     return _grow_tree(
         bins, grad, hess, row_weight,
         num_leaves=num_leaves, lambda_l2=lambda_l2, min_gain=min_gain,
@@ -177,7 +196,7 @@ def grow_tree(
         max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
         categorical_mask=categorical_mask, has_categorical=has_categorical,
         lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
-        num_bins=num_bins,
+        num_bins=num_bins, mesh=mesh, shard_axis=shard_axis,
     )
 
 
@@ -185,7 +204,7 @@ def grow_tree(
     jax.jit,
     static_argnames=(
         "num_leaves", "max_depth", "min_data_in_leaf", "has_categorical",
-        "num_bins",
+        "num_bins", "mesh", "shard_axis",
     ),
 )
 def _grow_tree(
@@ -205,6 +224,8 @@ def _grow_tree(
     lambda_l1: float = 0.0,
     min_sum_hessian: float = 1e-3,
     num_bins: int = NUM_BINS,
+    mesh: Any = None,
+    shard_axis: Optional[str] = None,
 ) -> GrownTree:
     n, d = bins.shape
     L = num_leaves
@@ -230,7 +251,9 @@ def _grow_tree(
 
     def plane_hist(mask: jnp.ndarray) -> jnp.ndarray:
         """Histogram of the rows selected by ``mask`` -> (d*B, 3)."""
-        return plane_histogram(bins, row_stats, mask, num_bins=B)
+        return plane_histogram(
+            bins, row_stats, mask, num_bins=B, mesh=mesh, shard_axis=shard_axis
+        )
 
     # best split of ONE leaf from its plane. Only state-free validity
     # (min_data, feature_fraction) is applied there; per-leaf state
@@ -357,6 +380,257 @@ def _grow_tree(
     )
 
 
+def _range_sizes(n: int, min_size: int = 512) -> tuple:
+    """Static power-of-2 row-bucket sizes for the range histogram: the
+    smallest bucket covering a child's row count bounds overshoot at 2x."""
+    sizes = []
+    s = min(min_size, n)
+    while s < n:
+        sizes.append(s)
+        s *= 2
+    sizes.append(n)
+    return tuple(sizes)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_leaves", "max_depth", "min_data_in_leaf", "has_categorical",
+        "num_bins",
+    ),
+)
+def _grow_tree_partitioned(
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    row_weight: jnp.ndarray,
+    num_leaves: int,
+    lambda_l2: float,
+    min_gain: float,
+    learning_rate: float,
+    feature_mask: jnp.ndarray,
+    max_depth: int,
+    min_data_in_leaf: int,
+    categorical_mask: jnp.ndarray,
+    has_categorical: bool,
+    lambda_l1: float = 0.0,
+    min_sum_hessian: float = 1e-3,
+    num_bins: int = NUM_BINS,
+) -> GrownTree:
+    """Leaf-wise growth over data kept PARTITIONED by leaf — the TPU
+    expression of LightGBM's DataPartition + histogram-subtraction core
+    (the reason native LightGBM's per-split cost is O(leaf rows), not
+    O(dataset rows); TrainUtils.scala:220-315 drives that C++ engine).
+
+    Identical split semantics to :func:`_grow_tree` (same ``make_leaf_best``,
+    same records); only the histogram COST model changes:
+
+    - rows live in a permuted layout (``order``) where every leaf owns a
+      contiguous [start, start+count) range; each split stable-partitions
+      the parent's range in O(n) elementwise work;
+    - the new histogram pass covers ONLY the smaller child's range, sliced
+      to the smallest static power-of-2 bucket (``lax.switch`` keeps every
+      shape static for XLA) — the larger sibling is parent - smaller
+      (LightGBM's subtraction trick);
+    - per tree the histogram work sums to O(n * avg_depth) cells instead
+      of the masked full-pass grower's O(n * num_leaves).
+
+    Single-device layouts only: the per-split global permutation gathers
+    would become cross-device traffic under a sharded mesh (the caller
+    gates on mesh size; sharded meshes keep :func:`_grow_tree`, whose
+    scatter lowering GSPMD partitions + allreduces)."""
+    from mmlspark_tpu.ops.histogram import plane_histogram
+
+    n, d = bins.shape
+    L = num_leaves
+    B = num_bins
+    bins = bins.astype(jnp.int32)
+    cat_f = categorical_mask.astype(bool)
+    lam = lambda_l2
+    l1 = lambda_l1
+    msh = min_sum_hessian
+    g = grad * row_weight
+    h = hess * row_weight
+    cnt_w = row_weight
+    row_stats = jnp.stack([g, h, cnt_w], axis=-1)  # (n, 3) original order
+    sizes = _range_sizes(n)
+    sizes_arr = jnp.asarray(sizes, jnp.int32)
+
+    leaf_best = make_leaf_best(
+        d, feature_mask, min_data_in_leaf, msh, lam, l1, cat_f,
+        has_categorical, num_bins=B,
+    )
+
+    def step(k: int, state: tuple) -> tuple:
+        (hist, order, bins_ord, stats_ord, leaf_start, leaf_count,
+         leaf_depth, done,
+         cache_gain, cache_feat, cache_bin, cache_catmask, prev_pair,
+         rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+         rec_is_cat, rec_catmask) = state
+
+        # refresh the two planes the previous split changed (all other
+        # leaves' cached best splits are still exact)
+        pg, pf, pb, pcm = jax.vmap(leaf_best)(hist[prev_pair])
+        cache_gain = cache_gain.at[prev_pair].set(pg)
+        cache_feat = cache_feat.at[prev_pair].set(pf)
+        cache_bin = cache_bin.at[prev_pair].set(pb)
+        cache_catmask = cache_catmask.at[prev_pair].set(pcm)
+
+        num_active = k + 1
+        leaf_ids = jnp.arange(L, dtype=jnp.int32)
+        leaf_ok = leaf_ids < num_active
+        if max_depth > 0:
+            leaf_ok = leaf_ok & (leaf_depth < max_depth)
+        sel = jnp.where(leaf_ok, cache_gain, -jnp.inf)
+        bl = jnp.argmax(sel).astype(jnp.int32)
+        best_gain = sel[bl]
+        bf = cache_feat[bl]
+        bb = cache_bin[bl]
+        catmask = cache_catmask[bl]
+        do_split = (~done) & (best_gain > min_gain) & jnp.isfinite(best_gain)
+        new_id = jnp.int32(k + 1)
+
+        s = leaf_start[bl]
+        c = leaf_count[bl]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        in_range = (pos >= s) & (pos < s + c)
+        row_bins = bins_ord[:, bf]
+        if has_categorical:
+            is_cat_split = cat_f[bf]
+            decide = jnp.where(is_cat_split, ~catmask[row_bins], row_bins > bb)
+        else:
+            is_cat_split = jnp.asarray(False)
+            decide = row_bins > bb
+        right_m = in_range & decide & do_split
+        left_m = in_range & ~right_m & do_split
+        c_right = right_m.sum().astype(jnp.int32)
+        c_left = c - c_right
+
+        # stable partition of the parent's range: left block then right
+        # block; everything outside the range (and no-op steps) stays put
+        destL = s + jnp.cumsum(left_m.astype(jnp.int32)) - 1
+        destR = s + c_left + jnp.cumsum(right_m.astype(jnp.int32)) - 1
+        dest = jnp.where(left_m, destL, jnp.where(right_m, destR, pos))
+        inv = jnp.zeros((n,), jnp.int32).at[dest].set(pos)
+        order = jnp.take(order, inv)
+        bins_ord = jnp.take(bins_ord, inv, axis=0)
+        stats_ord = jnp.take(stats_ord, inv, axis=0)
+
+        # smaller child's histogram from its (now contiguous) range; the
+        # switch picks the smallest static bucket covering the count
+        small_left = c_left <= c_right
+        s_small = jnp.where(small_left, s, s + c_left)
+        c_small = jnp.where(do_split, jnp.minimum(c_left, c_right), 0)
+
+        def mk(sz: int):
+            def f(_arg: None) -> jnp.ndarray:
+                st = jnp.clip(s_small, 0, n - sz)
+                bsl = jax.lax.dynamic_slice_in_dim(bins_ord, st, sz, 0)
+                ssl = jax.lax.dynamic_slice_in_dim(stats_ord, st, sz, 0)
+                p = st + jnp.arange(sz, dtype=jnp.int32)
+                m = ((p >= s_small) & (p < s_small + c_small)).astype(
+                    jnp.float32
+                )
+                return plane_histogram(bsl, ssl, m, num_bins=B)
+            return f
+
+        idx = jnp.sum(c_small > sizes_arr).astype(jnp.int32)
+        small_plane = jax.lax.switch(idx, [mk(sz) for sz in sizes], None)
+        parent_plane = hist[bl]
+        big_plane = parent_plane - small_plane
+        left_plane = jnp.where(small_left, small_plane, big_plane)
+        right_plane = jnp.where(small_left, big_plane, small_plane)
+        hist = hist.at[bl].set(
+            jnp.where(do_split, left_plane, parent_plane)
+        ).at[new_id].set(
+            jnp.where(do_split, right_plane, hist[new_id])
+        )
+
+        leaf_start = jnp.where(
+            do_split, leaf_start.at[new_id].set(s + c_left), leaf_start
+        )
+        leaf_count = jnp.where(
+            do_split,
+            leaf_count.at[bl].set(c_left).at[new_id].set(c_right),
+            leaf_count,
+        )
+        child_depth = leaf_depth[bl] + 1
+        leaf_depth = jnp.where(
+            do_split,
+            leaf_depth.at[bl].set(child_depth).at[new_id].set(child_depth),
+            leaf_depth,
+        )
+        rec_leaf = rec_leaf.at[k].set(jnp.where(do_split, bl, -1))
+        rec_feature = rec_feature.at[k].set(jnp.where(do_split, bf, -1))
+        rec_bin = rec_bin.at[k].set(jnp.where(do_split, bb, -1))
+        rec_active = rec_active.at[k].set(do_split)
+        rec_gain = rec_gain.at[k].set(jnp.where(do_split, best_gain, 0.0))
+        rec_is_cat = rec_is_cat.at[k].set(do_split & is_cat_split)
+        rec_catmask = rec_catmask.at[k].set(
+            jnp.where(do_split & is_cat_split, catmask, False)
+        )
+        done = done | ~do_split
+        prev_pair = jnp.stack([bl, new_id])
+        return (hist, order, bins_ord, stats_ord, leaf_start, leaf_count,
+                leaf_depth, done,
+                cache_gain, cache_feat, cache_bin, cache_catmask, prev_pair,
+                rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+                rec_is_cat, rec_catmask)
+
+    hist0 = (
+        jnp.zeros((L, d * B, 3), jnp.float32)
+        .at[0]
+        .set(plane_histogram(bins, row_stats, num_bins=B))
+    )
+    init = (
+        hist0,
+        jnp.arange(n, dtype=jnp.int32),          # order: position -> row id
+        bins,                                     # bins_ord (starts unpermuted)
+        row_stats,                                # stats_ord
+        jnp.zeros((L,), jnp.int32),               # leaf_start
+        jnp.zeros((L,), jnp.int32).at[0].set(n),  # leaf_count
+        jnp.zeros((L,), jnp.int32),               # leaf_depth
+        jnp.asarray(False),
+        jnp.full((L,), -jnp.inf, jnp.float32),
+        jnp.zeros((L,), jnp.int32),
+        jnp.zeros((L,), jnp.int32),
+        jnp.zeros((L, B), bool),
+        jnp.zeros((2,), jnp.int32),
+        jnp.full((L - 1,), -1, jnp.int32),
+        jnp.full((L - 1,), -1, jnp.int32),
+        jnp.full((L - 1,), -1, jnp.int32),
+        jnp.zeros((L - 1,), bool),
+        jnp.zeros((L - 1,), jnp.float32),
+        jnp.zeros((L - 1,), bool),
+        jnp.zeros((L - 1, B), bool),
+    )
+    (_, order, _, _, leaf_start, leaf_count, _, _,
+     _, _, _, _, _,
+     rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+     rec_is_cat, rec_catmask) = jax.lax.fori_loop(0, L - 1, step, init)
+
+    # position -> leaf from the final ranges (ranges tile [0, n) exactly:
+    # each position lies in exactly one active leaf), then back to the
+    # original row order through the permutation
+    pos = jnp.arange(n, dtype=jnp.int32)[:, None]
+    in_leaf = (pos >= leaf_start[None, :]) & (
+        pos < (leaf_start + leaf_count)[None, :]
+    )
+    row_leaf_ord = jnp.argmax(in_leaf, axis=1).astype(jnp.int32)
+    row_leaf = jnp.zeros((n,), jnp.int32).at[order].set(row_leaf_ord)
+
+    Gl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(g)
+    Hl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(h)
+    Cl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(cnt_w)
+    leaf_values = -threshold_l1(Gl, lambda_l1) / (Hl + lambda_l2) * learning_rate
+    leaf_values = jnp.where(Cl > 0, leaf_values, 0.0)
+    return GrownTree(
+        rec_leaf, rec_feature, rec_bin, rec_active, rec_gain,
+        leaf_values, Cl.astype(jnp.int32), row_leaf,
+        rec_is_cat, rec_catmask,
+    )
+
+
 def grow_tree_depthwise(
     bins: jnp.ndarray,
     grad: jnp.ndarray,
@@ -373,6 +647,8 @@ def grow_tree_depthwise(
     lambda_l1: float = 0.0,
     min_sum_hessian: float = 1e-3,
     num_bins: int = NUM_BINS,
+    mesh: Any = None,
+    shard_axis: Optional[str] = None,
 ) -> GrownTree:
     """Depthwise (level-wise) growth — the XGBoost-hist/SparkML-GBT grow
     policy, built for the TPU cost model: every level's leaf histograms
@@ -401,7 +677,7 @@ def grow_tree_depthwise(
         n_levels=n_levels, min_data_in_leaf=min_data_in_leaf,
         categorical_mask=categorical_mask, has_categorical=has_categorical,
         lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
-        num_bins=num_bins,
+        num_bins=num_bins, mesh=mesh, shard_axis=shard_axis,
     )
 
 
@@ -409,7 +685,7 @@ def grow_tree_depthwise(
     jax.jit,
     static_argnames=(
         "num_leaves", "n_levels", "min_data_in_leaf", "has_categorical",
-        "num_bins",
+        "num_bins", "mesh", "shard_axis",
     ),
 )
 def _grow_tree_depthwise(
@@ -429,6 +705,8 @@ def _grow_tree_depthwise(
     lambda_l1: float = 0.0,
     min_sum_hessian: float = 1e-3,
     num_bins: int = NUM_BINS,
+    mesh: Any = None,
+    shard_axis: Optional[str] = None,
 ) -> GrownTree:
     from mmlspark_tpu.ops.histogram import multi_plane_histogram
 
@@ -463,7 +741,10 @@ def _grow_tree_depthwise(
     for level in range(n_levels):
         S = int(inv.shape[0])
         slot_local = jnp.where(row_slot < L, lut[jnp.clip(row_slot, 0, L - 1)], S)
-        cube = multi_plane_histogram(bins, row_stats, slot_local, S, num_bins=B)
+        cube = multi_plane_histogram(
+            bins, row_stats, slot_local, S, num_bins=B,
+            mesh=mesh, shard_axis=shard_axis,
+        )
         gains, feats, bbs, catms = jax.vmap(leaf_best)(cube)
         # budget: when fewer than S splits remain, best-gain nodes win
         order = jnp.argsort(-gains)
