@@ -22,9 +22,11 @@ Device residency: scores, gradients, labels and bagging/GOSS masks live on
 device (sharded over the mesh ``data`` axis) across all iterations — the
 host sees only the per-tree split records and the eval-metric scalar
 (lightgbm/TrainUtils.scala:220-315 keeps the equivalent state inside the
-native booster for the same reason). LambdaRank is the exception: its
-group-sorted pairwise gradients run on host, so scores round-trip per
-iteration on that objective only.
+native booster for the same reason). LambdaRank's pairwise gradients are
+device-resident too (objectives.lambdarank_grad_hess_device over padded
+contiguous groups), so ranking joins the scan-fused path; only multihost
+ranking (and pathological group sizes whose padded pair tensors exceed the
+device budget) falls back to host gradients.
 
 Distribution: rows are batch-sharded over the mesh ``data`` axis before the
 loop. ``data_parallel`` lets GSPMD partition the histogram scatter and
@@ -32,8 +34,8 @@ insert the full-plane ICI allreduce; ``voting_parallel`` switches to the
 PV-Tree grower (models/gbdt/voting.py) — local top-K feature votes, one
 tiny vote psum, and an allreduce of only the winning candidates' histogram
 columns (LightGBMParams.scala:13-18 semantics, real reduced communication).
-Voting needs >1 shard and all-numerical features; otherwise training falls
-back to data_parallel with a log note.
+Voting needs >1 shard; single-shard layouts fall back to data_parallel
+with a log note. Categorical features vote and split like anywhere else.
 """
 
 from __future__ import annotations
@@ -48,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mmlspark_tpu.models.gbdt import objectives
+from mmlspark_tpu.parallel.mesh import DATA_AXIS as _DATA_AXIS
 from mmlspark_tpu.models.gbdt.binning import BinMapper
 from mmlspark_tpu.ops.histogram import NUM_BINS
 from mmlspark_tpu.models.gbdt.booster import Booster, Tree, per_tree_raw
@@ -327,6 +330,8 @@ def _iteration_core(
     cat_mask: Optional[jnp.ndarray],
     g_pre: Optional[jnp.ndarray],
     h_pre: Optional[jnp.ndarray],
+    rank_idx: Optional[jnp.ndarray],
+    rank_valid: Optional[jnp.ndarray],
     obj_p1: Any,
     top_rate: float,
     other_rate: float,
@@ -348,6 +353,7 @@ def _iteration_core(
     top_k: int,
     mesh: Any,
     depthwise: bool = False,
+    partitioned: bool = False,
     num_bins: int = NUM_BINS,
 ) -> tuple:
     """One boosting iteration (traced): gradients, GOSS weights, k tree
@@ -360,10 +366,21 @@ def _iteration_core(
         g_dev, h_dev = objectives.binary_grad_hess(scores, y_enc)
     elif objective == "multiclass":
         g_dev, h_dev = objectives.multiclass_grad_hess(scores, y_enc)
+    elif objective == "lambdarank":
+        # device-resident pairwise gradients over padded contiguous groups
+        # — ranking trains scan-fused with zero per-iteration host syncs
+        g_dev, h_dev = objectives.lambdarank_grad_hess_device(
+            scores, y_enc, rank_idx, rank_valid
+        )
     else:
         g_dev, h_dev = objectives.regression_grad_hess(
             objective, scores, y_enc, obj_p1
         )
+    # pre-GOSS weights (bagging/user weights only): LightGBM's
+    # RenewTreeOutput computes the leaf percentile over the sampled rows at
+    # their ORIGINAL data weights — the (1-a)/b amplification is a
+    # histogram-unbiasedness device, not a data weight
+    w_renew = w_it
     if is_goss:
         g_abs = jnp.abs(g_dev).sum(axis=1) if k > 1 else jnp.abs(g_dev)
         u = jax.random.uniform(jax.random.fold_in(it_key, 2), w_it.shape)
@@ -388,16 +405,24 @@ def _iteration_core(
             from mmlspark_tpu.models.gbdt.voting import grow_tree_voting
 
             grown = grow_tree_voting(
-                bins, gc, hc, w_it, top_k=top_k, mesh=mesh, **grow_kw
+                bins, gc, hc, w_it, top_k=top_k, mesh=mesh,
+                categorical_mask=cat_mask, **grow_kw
             )
         elif depthwise:
             from mmlspark_tpu.models.gbdt.treegrow import grow_tree_depthwise
 
             grown = grow_tree_depthwise(
-                bins, gc, hc, w_it, categorical_mask=cat_mask, **grow_kw
+                bins, gc, hc, w_it, categorical_mask=cat_mask,
+                mesh=mesh, shard_axis=_DATA_AXIS if mesh is not None else None,
+                **grow_kw,
             )
         else:
-            grown = grow_tree(bins, gc, hc, w_it, categorical_mask=cat_mask, **grow_kw)
+            grown = grow_tree(
+                bins, gc, hc, w_it, categorical_mask=cat_mask,
+                partitioned=partitioned,
+                mesh=mesh, shard_axis=_DATA_AXIS if mesh is not None else None,
+                **grow_kw,
+            )
         if (
             objective in objectives.RENEWED_KINDS
             and not grad_pre
@@ -410,9 +435,12 @@ def _iteration_core(
             # shard-local and a global sort would defeat the reduced-
             # communication design.
             q = obj_p1 if objective == "quantile" else 0.5
+            # percentile over the SAMPLED rows (w_it > 0) at their
+            # pre-GOSS data weights (see w_renew above)
+            w_sel = jnp.where(w_it > 0, w_renew, 0.0)
             w_q = (
-                w_it / jnp.maximum(1.0, jnp.abs(y_enc))
-                if objective == "mape" else w_it
+                w_sel / jnp.maximum(1.0, jnp.abs(y_enc))
+                if objective == "mape" else w_sel
             )
             renewed = objectives.leaf_quantile_renewal(
                 grown.row_leaf, y_enc - scores, w_q, num_leaves, q
@@ -431,7 +459,7 @@ def _iteration_core(
     static_argnames=(
         "objective", "k", "grad_pre", "is_goss", "use_voting", "has_cat",
         "num_leaves", "max_depth", "min_data_in_leaf", "top_k", "mesh",
-        "depthwise", "num_bins",
+        "depthwise", "partitioned", "num_bins",
     ),
 )
 def _fused_iteration(
@@ -444,6 +472,8 @@ def _fused_iteration(
     cat_mask: Optional[jnp.ndarray],
     g_pre: Optional[jnp.ndarray],
     h_pre: Optional[jnp.ndarray],
+    rank_idx: Optional[jnp.ndarray],
+    rank_valid: Optional[jnp.ndarray],
     obj_p1: Any,
     top_rate: float,
     other_rate: float,
@@ -465,6 +495,7 @@ def _fused_iteration(
     top_k: int,
     mesh: Any,
     depthwise: bool = False,
+    partitioned: bool = False,
     num_bins: int = NUM_BINS,
 ) -> tuple:
     """One whole boosting iteration as ONE XLA program — the dispatch-per-
@@ -475,12 +506,14 @@ def _fused_iteration(
     Returns (new_scores, tuple of GrownTree per class)."""
     new_scores, grown_list = _iteration_core(
         bins, scores, y_enc, w_it, it_key, fm, cat_mask, g_pre, h_pre,
+        rank_idx, rank_valid,
         obj_p1, top_rate, other_rate, lambda_l2, lambda_l1, min_sum_hessian,
         min_gain, learning_rate,
         objective=objective, k=k, grad_pre=grad_pre, is_goss=is_goss,
         use_voting=use_voting, has_cat=has_cat, num_leaves=num_leaves,
         max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
-        top_k=top_k, mesh=mesh, depthwise=depthwise, num_bins=num_bins,
+        top_k=top_k, mesh=mesh, depthwise=depthwise,
+        partitioned=partitioned, num_bins=num_bins,
     )
     return new_scores, tuple(grown_list)
 
@@ -527,7 +560,8 @@ _PACK_FIELDS = (
     static_argnames=(
         "objective", "k", "grad_pre", "is_goss", "use_voting", "has_cat",
         "num_leaves", "max_depth", "min_data_in_leaf", "top_k", "mesh",
-        "depthwise", "bagging_freq", "eval_kind", "is_rf", "num_bins",
+        "depthwise", "partitioned", "bagging_freq", "eval_kind", "is_rf",
+        "num_bins", "eval_k",
     ),
 )
 def _scan_chunk(
@@ -542,6 +576,10 @@ def _scan_chunk(
     cat_mask: Optional[jnp.ndarray],
     g_pre: Optional[jnp.ndarray],
     h_pre: Optional[jnp.ndarray],
+    rank_idx: Optional[jnp.ndarray],
+    rank_valid: Optional[jnp.ndarray],
+    rank_idx_eval: Optional[jnp.ndarray],
+    rank_valid_eval: Optional[jnp.ndarray],
     y_eval: Optional[jnp.ndarray],
     valid_w: Optional[jnp.ndarray],
     rf_base: Optional[jnp.ndarray],
@@ -567,10 +605,12 @@ def _scan_chunk(
     top_k: int,
     mesh: Any,
     depthwise: bool,
+    partitioned: bool,
     bagging_freq: int,
     eval_kind: str,
     is_rf: bool,
     num_bins: int = NUM_BINS,
+    eval_k: int = 5,
 ) -> tuple:
     """C whole boosting iterations as ONE XLA program (``lax.scan`` over
     iterations). On a relay-attached TPU every dispatch costs ~35 ms and
@@ -596,12 +636,14 @@ def _scan_chunk(
             w_it = w_base
         new_scores, grown_list = _iteration_core(
             bins, scores, y_enc, w_it, it_key, fm, cat_mask, g_pre, h_pre,
+            rank_idx, rank_valid,
             obj_p1, top_rate, other_rate, lambda_l2, lambda_l1,
             min_sum_hessian, min_gain, learning_rate,
             objective=objective, k=k, grad_pre=grad_pre, is_goss=is_goss,
             use_voting=use_voting, has_cat=has_cat, num_leaves=num_leaves,
             max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
-            top_k=top_k, mesh=mesh, depthwise=depthwise, num_bins=num_bins,
+            top_k=top_k, mesh=mesh, depthwise=depthwise,
+            partitioned=partitioned, num_bins=num_bins,
         )
         recs = tuple(
             tuple(
@@ -625,7 +667,12 @@ def _scan_chunk(
             s_eval = new_scores
             if is_rf:
                 s_eval = rf_base + new_scores / (it.astype(jnp.float32) + 1.0)
-            m = _device_metric(s_eval, y_eval, valid_w, eval_kind, obj_p1)
+            if eval_kind == "ndcg":
+                m = objectives.grouped_ndcg_device(
+                    s_eval, y_eval, rank_idx_eval, rank_valid_eval, k=eval_k
+                )
+            else:
+                m = _device_metric(s_eval, y_eval, valid_w, eval_kind, obj_p1)
         return (new_scores, bag), (recs, m)
 
     (scores, bag), (recs, metrics) = jax.lax.scan(
@@ -895,13 +942,7 @@ def train(
         w_dev = shard_batch_multihost(np.pad(w, (0, pad)), mesh)
         n_pad = share * jax.process_count()  # GLOBAL padded row count
         if cfg.parallelism == "voting_parallel":
-            if not cat_features:
-                use_voting = True
-            else:
-                log.info(
-                    "voting_parallel needs numerical features; "
-                    "falling back to data_parallel"
-                )
+            use_voting = True
     elif shard:
         from mmlspark_tpu.parallel.mesh import DATA_AXIS, get_mesh
         from mmlspark_tpu.parallel.sharding import pad_batch, shard_batch
@@ -914,12 +955,12 @@ def train(
         w_dev = shard_batch(np.pad(w, (0, pad)), mesh)
         n_pad = n + pad
         if cfg.parallelism == "voting_parallel":
-            if dict(mesh.shape).get(DATA_AXIS, 1) > 1 and not cat_features:
+            if dict(mesh.shape).get(DATA_AXIS, 1) > 1:
                 use_voting = True
             else:
                 log.info(
-                    "voting_parallel needs >1 data shard and numerical "
-                    "features; falling back to data_parallel"
+                    "voting_parallel needs >1 data shard; "
+                    "falling back to data_parallel"
                 )
     else:
         pad = 0
@@ -939,6 +980,36 @@ def train(
 
             return shard_batch(a)
         return jnp.asarray(a)
+
+    # data-partitioned leaf-wise growth (LightGBM's DataPartition +
+    # histogram subtraction, treegrow._grow_tree_partitioned): single-device
+    # layouts only — the per-split global row permutation would become
+    # cross-device traffic on a sharded mesh, where the masked scatter +
+    # GSPMD allreduce path is the right cost model
+    import os as _os
+
+    # default on ONLY for the TPU backend: the partition win exists when the
+    # histogram pass costs ~B ops/cell (the one-hot kernel) and the row
+    # reorder costs O(1)/cell; on CPU's scatter lowering both are O(1)/cell
+    # and the reorder nets negative. Env forces either way (tests force on).
+    _part_env = _os.environ.get("MMLSPARK_TPU_GBDT_PARTITION")
+    _part_default = jax.default_backend() == "tpu"
+    partitioned = (
+        cfg.growth_policy == "lossguide"
+        and not multihost
+        and not use_voting
+        and (mesh is None or mesh.devices.size == 1)
+        and (
+            _part_env not in ("0", "false") if _part_env is not None
+            else _part_default
+        )
+    )
+    # rows sharded over the mesh data axis: hand the mesh to the growers so
+    # the histogram op can run its Pallas kernel per shard + psum the planes
+    # (ops/histogram.py shard_map lowering) instead of the GSPMD scatter
+    hist_sharded = (
+        mesh is not None and dict(mesh.shape).get(_DATA_AXIS, 1) > 1
+    )
 
     # -- device-resident loop state -----------------------------------------
     # scores, labels and per-iteration gradients stay sharded on device for
@@ -1036,15 +1107,31 @@ def train(
     # Everything whose loop needs no host work between iterations trains as
     # chunked lax.scan programs: ONE dispatch (and one packed record fetch)
     # per chunk instead of one per iteration. Excluded: dart (mutates past
-    # trees on host), lambdarank (host gradients), delegates (host
-    # callbacks), multihost (replicated small-read choreography), and
-    # host-only eval metrics (auc/ndcg need sorts we keep on host).
+    # trees on host), delegates (host callbacks), multihost (replicated
+    # small-read choreography), host-only eval metrics (auc needs sorts we
+    # keep on host), and lambdarank only when its groups are non-contiguous
+    # or too large for the padded device kernel (rank_fast above).
+    rank_fast = False
+    rank_pads = None
+    if cfg.objective == "lambdarank" and not multihost and group_ids is not None:
+        gids = np.asarray(group_ids)
+        runs = 1 + int((gids[1:] != gids[:-1]).sum()) if len(gids) else 0
+        # non-contiguous group ids would change grouping semantics — the
+        # host path handles those, so don't even build the pad grid
+        if runs == len(np.unique(gids)):
+            pi, va = objectives.lambdarank_pad_groups(group_ids)
+            # padded pairwise tensors are (G, M, M): bound device memory (a
+            # few hundred MB) or keep the host-gradient path
+            if pi.shape[0] * pi.shape[1] * pi.shape[1] <= (1 << 26):
+                rank_fast = True
+                rank_pads = (pi, va)
     fast = (
         delegate is None and not multihost and not is_dart
-        and cfg.objective != "lambdarank"
+        and (cfg.objective != "lambdarank" or rank_fast)
     )
     eval_needed = valid_mask is not None and bool(np.any(valid_mask))
     eval_kind = "none"
+    eval_k = cfg.eval_at
     if eval_needed:
         if cfg.objective == "binary":
             eval_kind = (
@@ -1055,9 +1142,13 @@ def train(
             eval_kind = "multi_logloss"
         elif cfg.objective == "lambdarank":
             eval_kind = "ndcg"
+            if cfg.metric.startswith("ndcg@"):
+                eval_k = int(cfg.metric.split("@", 1)[1])
         else:
             eval_kind = cfg.objective
-        if eval_kind not in _DEVICE_METRICS:
+        if eval_kind not in _DEVICE_METRICS and not (
+            eval_kind == "ndcg" and rank_fast
+        ):
             fast = False
 
     if fast:
@@ -1073,15 +1164,28 @@ def train(
         )
         bag_dev = jnp.ones_like(w_dev)
         y_eval = valid_w = rf_base_dev = None
+        rank_idx_dev = rank_valid_dev = None
+        rank_idx_eval_dev = rank_valid_eval_dev = None
+        if rank_fast:
+            pi, va = rank_pads
+            rank_idx_dev = jnp.asarray(pi)
+            rank_valid_dev = jnp.asarray(va)
         if eval_on:
             y_eval = y_onehot_dev if k > 1 else y_dev
             valid_w = padded(valid_mask.astype(np.float32))
+            if eval_kind == "ndcg":
+                pi, va = objectives.lambdarank_pad_groups(
+                    group_ids, keep=valid_mask
+                )
+                rank_idx_eval_dev = jnp.asarray(pi)
+                rank_valid_eval_dev = jnp.asarray(va)
         grad_pre_f = is_rf
         if is_rf:
             g_pre_f, h_pre_f = g_rf, h_rf
             rf_base_dev = rf_base if eval_on else None
         else:
             g_pre_f = h_pre_f = None
+        # lambdarank: y_dev is the relevance vector the device kernel reads
         y_enc_f = None if grad_pre_f else (y_onehot_dev if k > 1 else y_dev)
         it0 = 0
         stopped = False
@@ -1099,7 +1203,10 @@ def train(
             scores, bag_dev, packed, metrics = _scan_chunk(
                 bins_dev, scores, y_enc_f, w_dev, bag_dev, base_key,
                 jnp.arange(it0, it0 + C, dtype=jnp.int32), jnp.asarray(fms),
-                cat_mask_dev, g_pre_f, h_pre_f, y_eval, valid_w, rf_base_dev,
+                cat_mask_dev, g_pre_f, h_pre_f,
+                rank_idx_dev, rank_valid_dev,
+                rank_idx_eval_dev, rank_valid_eval_dev,
+                y_eval, valid_w, rf_base_dev,
                 float(_objective_p1(cfg)),
                 float(bagging_fraction),
                 float(cfg.top_rate), float(cfg.other_rate),
@@ -1112,19 +1219,25 @@ def train(
                 has_cat=cat_mask_dev is not None,
                 num_leaves=int(cfg.num_leaves), max_depth=int(cfg.max_depth),
                 min_data_in_leaf=int(cfg.min_data_in_leaf),
-                top_k=int(cfg.top_k), mesh=mesh if use_voting else None,
+                top_k=int(cfg.top_k),
+                mesh=mesh if (use_voting or hist_sharded) else None,
                 depthwise=cfg.growth_policy == "depthwise",
+                partitioned=partitioned,
                 bagging_freq=int(bagging_freq) if use_bag else 0,
                 eval_kind=eval_kind, is_rf=is_rf, num_bins=hist_bins,
+                eval_k=int(eval_k),
             )
             keep = C
             if eval_on:
+                higher = eval_kind == "ndcg"
                 mvals = np.asarray(metrics)
                 for i in range(C):
                     val = float(mvals[i])
                     if cfg.verbosity > 0:
                         log.info("iter %d %s=%.6f", it0 + i, eval_kind, val)
-                    if best_val is None or val < best_val:
+                    if best_val is None or (
+                        val > best_val if higher else val < best_val
+                    ):
                         best_val, best_iter = val, it0 + i + 1
                         rounds_no_improve = 0
                     else:
@@ -1218,7 +1331,7 @@ def train(
         y_enc = None if grad_pre else (y_onehot_dev if k > 1 else y_dev)
         new_scores, grown_all = _fused_iteration(
             bins_dev, eff_scores, y_enc, w_it, it_key, fm_dev, cat_mask_dev,
-            g_pre, h_pre,
+            g_pre, h_pre, None, None,
             float(_objective_p1(cfg)),
             float(cfg.top_rate), float(cfg.other_rate),
             float(cfg.lambda_l2), float(cfg.lambda_l1),
@@ -1228,8 +1341,10 @@ def train(
             use_voting=use_voting, has_cat=cat_mask_dev is not None,
             num_leaves=int(cfg.num_leaves), max_depth=int(cfg.max_depth),
             min_data_in_leaf=int(cfg.min_data_in_leaf),
-            top_k=int(cfg.top_k), mesh=mesh if use_voting else None,
-            depthwise=cfg.growth_policy == "depthwise", num_bins=hist_bins,
+            top_k=int(cfg.top_k),
+            mesh=mesh if (use_voting or hist_sharded) else None,
+            depthwise=cfg.growth_policy == "depthwise",
+            partitioned=partitioned, num_bins=hist_bins,
         )
         # the fused step fit against eff_scores (dart: scores minus dropped
         # trees); the running total keeps the dropped contribution

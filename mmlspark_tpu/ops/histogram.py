@@ -13,10 +13,15 @@ Two lowerings:
   block — the scatter becomes an MXU matmul, which is how TPUs like their
   histograms. Stats are split hi+lo bf16 so two native MXU passes recover
   f32-grade sums. Rows stream chunk by chunk so VMEM stays bounded.
-- **XLA scatter-add (CPU, or sharded meshes)**: GSPMD partitions the
-  scatter across the mesh and inserts the ICI allreduce (LightGBM's
-  data_parallel mode); the Pallas kernel would need a shard_map wrapper to
-  compose with sharding, so multi-device traces keep the scatter path.
+- **shard_map + Pallas (TPU, sharded meshes)**: when the caller passes the
+  mesh whose ``data`` axis shards the rows, the kernel runs PER SHARD under
+  ``jax.shard_map`` and the (d*B, 3) planes are combined with an explicit
+  ``psum`` riding ICI — exactly LightGBM's data_parallel per-iteration
+  histogram allreduce (lightgbm/TrainUtils.scala:496-512 NetworkInit +
+  socket rings), with the MXU kernel intact on every chip.
+- **XLA scatter-add (CPU, or sharded meshes without a mesh handle)**:
+  GSPMD partitions the scatter across the mesh and inserts the ICI
+  allreduce automatically.
 
 Selection is automatic (see :func:`use_pallas`) and overridable with
 ``MMLSPARK_TPU_PALLAS=0|1``.
@@ -42,12 +47,35 @@ _DF = int(os.environ.get("MMLSPARK_TPU_HIST_DF", "8"))
 _NC = int(os.environ.get("MMLSPARK_TPU_HIST_NC", "512"))
 
 
+def _pallas_enabled() -> bool:
+    """Is the Pallas lowering wanted at all (any device layout)?"""
+    env = os.environ.get("MMLSPARK_TPU_PALLAS")
+    if env is not None:
+        return env not in ("0", "false", "")
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def use_pallas() -> bool:
+    """Unsharded-trace lowering choice (single-chip; or env-forced)."""
     env = os.environ.get("MMLSPARK_TPU_PALLAS")
     if env is not None:
         return env not in ("0", "false", "")
     try:
         return jax.default_backend() == "tpu" and jax.device_count() == 1
+    except Exception:
+        return False
+
+
+def _rows_sharded(mesh, shard_axis) -> bool:
+    try:
+        return (
+            mesh is not None
+            and shard_axis is not None
+            and dict(mesh.shape).get(shard_axis, 1) > 1
+        )
     except Exception:
         return False
 
@@ -337,6 +365,8 @@ def multi_plane_histogram(
     slot: jnp.ndarray,
     num_slots: int,
     num_bins: int = NUM_BINS,
+    mesh=None,
+    shard_axis: str | None = None,
 ) -> jnp.ndarray:
     """Histogram planes for MANY leaves in one pass over the rows.
 
@@ -344,7 +374,25 @@ def multi_plane_histogram(
     contributes to no plane. Returns (num_slots, d*NUM_BINS, 3). This is
     the depthwise grower's workhorse: one row pass per LEVEL instead of
     one per leaf, with the bin one-hot (the VPU-bound part) amortized
-    across all the level's leaves."""
+    across all the level's leaves. ``mesh``/``shard_axis`` as in
+    :func:`plane_histogram` (per-shard kernel + psum of the cube)."""
+    if _rows_sharded(mesh, shard_axis) and _pallas_enabled():
+        from jax.sharding import PartitionSpec as P
+
+        def local(b, s, sl):
+            cube = _multi_plane_pallas(
+                b.astype(jnp.int32), s, sl.astype(jnp.int32), num_slots,
+                num_bins,
+            )
+            return jax.lax.psum(cube, shard_axis)
+
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(shard_axis, None), P(shard_axis, None), P(shard_axis)),
+            out_specs=P(),
+            check_vma=False,
+        )(bins, stats, slot)
     if use_pallas():
         return _multi_plane_pallas(
             bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots,
@@ -371,17 +419,46 @@ def _plane_histogram_scatter(
     )
 
 
+def _plane_histogram_shard_map(
+    bins: jnp.ndarray, stats: jnp.ndarray, mesh, shard_axis: str,
+    num_bins: int,
+) -> jnp.ndarray:
+    """Per-shard Pallas kernel + explicit psum of the planes — LightGBM
+    data_parallel's per-iteration histogram allreduce over ICI
+    (TrainUtils.scala:496-512), MXU kernel intact on every chip."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(b: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+        h = _plane_histogram_pallas(b.astype(jnp.int32), s, num_bins)
+        return jax.lax.psum(h, shard_axis)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(shard_axis, None), P(shard_axis, None)),
+        out_specs=P(),
+        check_vma=False,
+    )(bins, stats)
+
+
 def plane_histogram(
     bins: jnp.ndarray, stats: jnp.ndarray, mask: jnp.ndarray | None = None,
-    num_bins: int = NUM_BINS,
+    num_bins: int = NUM_BINS, mesh=None, shard_axis: str | None = None,
 ) -> jnp.ndarray:
     """(d * NUM_BINS, 3) gradient-histogram plane of the masked rows.
 
     ``bins``: (n, d) int bin codes; ``stats``: (n, 3) per-row (g, h, count);
     ``mask``: optional (n,) row selector (0 rows contribute nothing).
+    ``mesh``/``shard_axis``: when the rows are sharded over that mesh axis,
+    run the Pallas kernel per shard under shard_map and psum the planes
+    (falls back to the GSPMD-partitioned scatter when Pallas is off).
     """
     if mask is not None:
         stats = stats * mask[:, None]
+    if _rows_sharded(mesh, shard_axis) and _pallas_enabled():
+        return _plane_histogram_shard_map(
+            bins, stats, mesh, shard_axis, num_bins
+        )
     if use_pallas():
         return _plane_histogram_pallas(bins.astype(jnp.int32), stats, num_bins)
     return _plane_histogram_scatter(bins.astype(jnp.int32), stats, num_bins)

@@ -65,6 +65,9 @@ def test_two_process_rendezvous_and_reduction(tmp_path):
         if k not in ("PYTHONPATH", "PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
     }
     env["MMLSPARK_REPO"] = repo
+    # persistent compile cache: the workers' jitted programs are identical
+    # run to run — without this every suite run recompiles them all
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(repo, ".jax_cache")
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), str(i), str(port)],
@@ -163,6 +166,20 @@ GBDT_WORKER = textwrap.dedent(
     bv = train(x_all[lo:hi], y_all[lo:hi], cfgv)
     print("MODE:voting:" + bv.to_model_string()[:64], flush=True)
 
+    # voting with a CATEGORICAL column: subset splits from psum'd candidate
+    # histograms must be identical across processes (no fallback)
+    import logging as _lg
+    _rec = []
+    _h = _lg.Handler(); _h.emit = lambda rec: _rec.append(rec.getMessage())
+    _lg.getLogger("mmlspark_tpu.gbdt").addHandler(_h)
+    cfgvc = TrainConfig(objective="binary", num_iterations=3, num_leaves=7,
+                        min_data_in_leaf=5, seed=3,
+                        parallelism="voting_parallel", top_k=3,
+                        categorical_features=(7,))
+    bvc = train(xc[lo:hi], y_all[lo:hi], cfgvc)
+    assert not any("falling back" in m for m in _rec), _rec
+    print("MODE:votingcat:" + bvc.to_model_string()[:64], flush=True)
+
     # lambdarank across processes: every query group lives wholly on one
     # process (the reference's partition contract); host pairwise grads
     # feed the sharded grower, models must be identical
@@ -184,6 +201,26 @@ GBDT_WORKER = textwrap.dedent(
                 valid_mask=vm2, group_ids=gid)
     print("MODE:rankes:%d:" % bre.best_iteration
           + bre.to_model_string()[:48], flush=True)
+
+    # shard_map Pallas histogram across processes: force the Pallas
+    # lowering (interpret mode on the CPU mesh) so the per-shard kernel +
+    # explicit plane psum carries the cross-process allreduce — the
+    # reference's data_parallel hot path (TrainUtils.scala:496-512). The
+    # model must be SPMD-identical across processes and prediction-equal
+    # to the scatter-lowering model.
+    os.environ["MMLSPARK_TPU_PALLAS"] = "1"
+    from mmlspark_tpu.ops.histogram import _pallas_enabled, _rows_sharded
+    from mmlspark_tpu.parallel.mesh import get_mesh
+    assert _pallas_enabled()
+    assert _rows_sharded(get_mesh(), "data")
+    bp = train(x_all[lo:hi], y_all[lo:hi], cfg)
+    del os.environ["MMLSPARK_TPU_PALLAS"]
+    from mmlspark_tpu.models.gbdt.objectives import sigmoid as _sig
+    dp = float(np.mean(np.abs(
+        _sig(bp.predict_raw(x_all)) - _sig(b.predict_raw(x_all))
+    )))
+    assert dp < 1e-3, dp
+    print("MODE:pallas:" + bp.to_model_string()[:64], flush=True)
     """
 )
 
@@ -200,9 +237,13 @@ def test_two_process_gbdt_training(tmp_path):
     env = {
         k: v
         for k, v in os.environ.items()
+        # scrub the axon sitecustomize: children must be plain CPU
         if k not in ("PYTHONPATH", "PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
     }
     env["MMLSPARK_REPO"] = repo
+    # persistent compile cache: the workers' jitted programs are identical
+    # run to run — without this every suite run recompiles them all
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(repo, ".jax_cache")
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), str(i), str(port)],
@@ -226,7 +267,7 @@ def test_two_process_gbdt_training(tmp_path):
     # SPMD determinism: same trees on every process, for every capability
     assert models[0] == models[1]
     for mode in ("goss", "rf", "dart", "cat", "sparse", "cont", "depthwise",
-                 "es", "voting", "rank", "rankes"):
+                 "es", "voting", "votingcat", "rank", "rankes", "pallas"):
         tags = [out.split(f"MODE:{mode}:", 1)[1].splitlines()[0]
                 for _, out, _ in outs]
         assert tags[0] == tags[1], mode
@@ -306,9 +347,13 @@ def test_two_process_vw_training(tmp_path):
     env = {
         k: v
         for k, v in os.environ.items()
+        # scrub the axon sitecustomize: children must be plain CPU
         if k not in ("PYTHONPATH", "PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
     }
     env["MMLSPARK_REPO"] = repo
+    # persistent compile cache: the workers' jitted programs are identical
+    # run to run — without this every suite run recompiles them all
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(repo, ".jax_cache")
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), str(i), str(port)],
