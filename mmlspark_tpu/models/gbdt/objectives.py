@@ -223,6 +223,123 @@ def lambdarank_grad_hess(
     return grad.astype(np.float32), hess.astype(np.float32)
 
 
+def lambdarank_pad_groups(
+    group_ids: np.ndarray, keep: Optional[np.ndarray] = None
+) -> tuple:
+    """Contiguous query groups -> padded (G, M) row-index layout.
+
+    The device lambdarank kernel needs STATIC shapes, so groups are packed
+    into a (num_groups, max_group_len) index grid once on host (the
+    reference keeps the same contiguity contract: LightGBMRanker requires a
+    query's rows on one partition and passes group COUNTS to the native
+    trainer, LightGBMRankerParams groupCol). ``keep``: optional row filter
+    (e.g. validation rows) applied before grouping — matching
+    :func:`grouped_ndcg`'s mask-then-group semantics.
+
+    Returns (pad_idx (G, M) int32 with -1 padding, valid (G, M) bool)."""
+    gid = np.asarray(group_ids)
+    pos = np.arange(len(gid), dtype=np.int64)
+    if keep is not None:
+        pos = pos[keep]
+        gid = gid[keep]
+    if len(gid) == 0:
+        return np.full((1, 1), -1, np.int32), np.zeros((1, 1), bool)
+    starts = np.flatnonzero(np.r_[True, gid[1:] != gid[:-1]])
+    ends = np.r_[starts[1:], len(gid)]
+    sizes = ends - starts
+    G, M = len(starts), int(sizes.max())
+    pad_idx = np.full((G, M), -1, np.int64)
+    for i, (s0, e0) in enumerate(zip(starts, ends)):
+        pad_idx[i, : e0 - s0] = pos[s0:e0]
+    return pad_idx.astype(np.int32), pad_idx >= 0
+
+
+def lambdarank_grad_hess_device(
+    scores: jnp.ndarray,
+    rel: jnp.ndarray,
+    pad_idx: jnp.ndarray,
+    valid: jnp.ndarray,
+    sigma: float = 1.0,
+    truncation: int = 30,
+) -> tuple:
+    """LambdaRank gradients ON DEVICE over padded groups — the traced twin
+    of :func:`lambdarank_grad_hess` (formula-identical; goldens compare
+    them), so ranking joins the scan-fused training path with no
+    per-iteration host round-trip (TrainUtils.scala:220-315 likewise keeps
+    ranking gradients inside the native booster)."""
+    n = scores.shape[0]
+    G, M = pad_idx.shape
+    idx = jnp.clip(pad_idx, 0, n - 1)
+    s = jnp.where(valid, scores[idx], -jnp.inf)
+    r = jnp.where(valid, rel[idx], 0.0)
+    # rank of each slot within its group by descending score (stable);
+    # invalid slots (-inf) sink to the tail
+    order = jnp.argsort(-s, axis=1, stable=True)
+    ranks = jnp.argsort(order, axis=1)
+    gains = jnp.where(valid, 2.0 ** r - 1.0, 0.0)
+    disc = 1.0 / jnp.log2(ranks.astype(jnp.float32) + 2.0)
+    ideal = -jnp.sort(-gains, axis=1)
+    pos_disc = 1.0 / jnp.log2(jnp.arange(M, dtype=jnp.float32) + 2.0)
+    idcg = (ideal * pos_disc * (jnp.arange(M) < truncation)).sum(axis=1)
+    better = (
+        (r[:, :, None] - r[:, None, :] > 0)
+        & valid[:, :, None] & valid[:, None, :]
+    )
+    pair = better | jnp.transpose(better, (0, 2, 1))
+    sd = jnp.where(pair, s[:, :, None] - s[:, None, :], 0.0)
+    rho = jax.nn.sigmoid(-sigma * sd)
+    dndcg = jnp.abs(
+        (gains[:, :, None] - gains[:, None, :])
+        * (disc[:, :, None] - disc[:, None, :])
+    ) / jnp.maximum(idcg, 1e-12)[:, None, None]
+    lam = sigma * rho * dndcg
+    lam_h = sigma * sigma * rho * (1.0 - rho) * dndcg
+    g = -(lam * better).sum(axis=2) + (lam * better).sum(axis=1)
+    h = (lam_h * better).sum(axis=2) + (lam_h * better).sum(axis=1)
+    processed = (idcg > 0) & (valid.sum(axis=1) >= 2)
+    g = jnp.where(processed[:, None] & valid, g, 0.0)
+    h = jnp.where(processed[:, None] & valid, jnp.maximum(h, 1e-9), 0.0)
+    sink = jnp.where(valid, pad_idx, n)  # padding scatters into a dead slot
+    grad = jnp.zeros(n + 1, jnp.float32).at[sink.reshape(-1)].add(
+        g.reshape(-1), mode="drop"
+    )[:n]
+    hess = jnp.zeros(n + 1, jnp.float32).at[sink.reshape(-1)].add(
+        h.reshape(-1), mode="drop"
+    )[:n]
+    return grad, hess
+
+
+def grouped_ndcg_device(
+    scores: jnp.ndarray,
+    rel: jnp.ndarray,
+    pad_idx: jnp.ndarray,
+    valid: jnp.ndarray,
+    k: int = 5,
+) -> jnp.ndarray:
+    """Mean NDCG@k over padded groups on device — the traced twin of
+    train.grouped_ndcg (same 2^rel-1 gains, all-zero-relevance groups score
+    1.0), so ranking early stopping needs no host sync either."""
+    n = scores.shape[0]
+    G, M = pad_idx.shape
+    idx = jnp.clip(pad_idx, 0, n - 1)
+    s = jnp.where(valid, scores[idx], -jnp.inf)
+    r = jnp.where(valid, rel[idx], 0.0)
+    order = jnp.argsort(-s, axis=1, stable=True)
+    ranks = jnp.argsort(order, axis=1)
+    gains = jnp.where(valid, 2.0 ** r - 1.0, 0.0)
+    sizes = valid.sum(axis=1)
+    kk = jnp.minimum(k, sizes)[:, None]
+    disc = 1.0 / jnp.log2(ranks.astype(jnp.float32) + 2.0)
+    dcg = (gains * disc * (ranks < kk)).sum(axis=1)
+    ideal = -jnp.sort(-gains, axis=1)
+    pos = jnp.arange(M)[None, :]
+    pos_disc = 1.0 / jnp.log2(pos.astype(jnp.float32) + 2.0)
+    idcg = (ideal * pos_disc * (pos < kk)).sum(axis=1)
+    ndcg = jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 1.0)
+    nonempty = sizes > 0
+    return (ndcg * nonempty).sum() / jnp.maximum(nonempty.sum(), 1)
+
+
 def sigmoid(x: np.ndarray) -> np.ndarray:
     return 1.0 / (1.0 + np.exp(-x))
 
