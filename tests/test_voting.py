@@ -88,7 +88,7 @@ class TestVotingParallel:
         vp_hlo = vp.lower(
             bins, g, ones, ones,
             jnp.float32(1.0), jnp.float32(0.0), jnp.float32(0.1), fm,
-            jnp.float32(0.0), jnp.float32(1e-3),
+            jnp.float32(0.0), jnp.float32(1e-3), jnp.zeros(d, bool),
         ).compile().as_text()
 
         dp_elems = _allreduce_elements(dp_hlo)
@@ -113,14 +113,27 @@ class TestVotingParallel:
         b = train(x, y, cfg, shard=False)
         assert len(b.trees) == 3
 
-    def test_voting_with_categoricals_falls_back(self, devices8):
+    def test_voting_with_categoricals(self, devices8, caplog):
+        """Categorical features vote and split by subset membership in the
+        PV-Tree grower itself — no data_parallel fallback (the reference
+        imposes no such restriction, LightGBMParams.scala:13-18). The
+        model must pick the categorical subset split: membership of
+        {1, 5} is invisible to any single numeric threshold."""
+        import logging
+
         r = np.random.default_rng(1)
         cat = r.integers(0, 8, size=600).astype(np.float32)
         x = np.column_stack([cat, r.normal(size=(600, 3))]).astype(np.float32)
         y = np.isin(cat, [1, 5]).astype(np.float64)
-        m = LightGBMClassifier(
-            num_iterations=4, num_leaves=4, min_data_in_leaf=5,
-            parallelism="voting_parallel", categorical_slot_indexes=[0],
-        ).fit(DataFrame.from_dict({"features": x, "label": y}))
+        with caplog.at_level(logging.INFO, logger="mmlspark_tpu.gbdt"):
+            m = LightGBMClassifier(
+                num_iterations=4, num_leaves=4, min_data_in_leaf=5,
+                parallelism="voting_parallel", categorical_slot_indexes=[0],
+            ).fit(DataFrame.from_dict({"features": x, "label": y}))
+        assert not any("falling back" in r.message for r in caplog.records)
         p = m.transform(DataFrame.from_dict({"features": x, "label": y}))
-        assert binary_auc(y, p["probability"][:, 1]) > 0.9
+        assert binary_auc(y, p["probability"][:, 1]) > 0.95
+        # the grown trees actually used a categorical subset split
+        assert any(
+            t.is_cat is not None and t.is_cat.any() for t in m.booster.trees
+        )
