@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+from mmlspark_tpu.cognitive import schemas as S
 from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
 
 
@@ -48,9 +49,11 @@ class DetectLastAnomaly(_AnomalyBase):
     """Is the most recent point anomalous (DetectLastAnomaly)."""
 
     _path = "/anomalydetector/v1.0/timeseries/last/detect"
+    _response_schema = S.LastAnomalyResponse
 
 
 class DetectAnomalies(_AnomalyBase):
     """Anomaly flags for the whole series (DetectAnomalies)."""
 
     _path = "/anomalydetector/v1.0/timeseries/entire/detect"
+    _response_schema = S.AnomalyDetectResponse

@@ -9,12 +9,17 @@ from __future__ import annotations
 import json
 from typing import Any, Optional
 
+from typing import List
+
+from mmlspark_tpu.cognitive import schemas as S
 from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
 from mmlspark_tpu.io.http_schema import HTTPRequestData
 
 
 class DetectFace(CognitiveServiceBase):
     """Face detection (/face/v1.0/detect)."""
+
+    _response_schema = List[S.DetectedFace]
 
     image_url = ServiceParam("image URL (value or column)")
     return_face_id = ServiceParam("return face ids", default={"value": True})
@@ -41,6 +46,8 @@ class DetectFace(CognitiveServiceBase):
 class VerifyFaces(CognitiveServiceBase):
     """Same-person verification of two face ids (/face/v1.0/verify)."""
 
+    _response_schema = S.VerifyResponse
+
     face_id1 = ServiceParam("first face id")
     face_id2 = ServiceParam("second face id")
 
@@ -55,6 +62,8 @@ class VerifyFaces(CognitiveServiceBase):
 
 class IdentifyFaces(CognitiveServiceBase):
     """Identify face ids against a person group (/face/v1.0/identify)."""
+
+    _response_schema = List[S.IdentifiedFace]
 
     face_ids = ServiceParam("face ids to identify")
     person_group_id = ServiceParam("person group id")
@@ -78,6 +87,8 @@ class IdentifyFaces(CognitiveServiceBase):
 class GroupFaces(CognitiveServiceBase):
     """Group face ids by similarity (/face/v1.0/group)."""
 
+    _response_schema = S.GroupResponse
+
     face_ids = ServiceParam("face ids to group")
 
     def _build_request(self, vals: dict) -> Optional[dict]:
@@ -91,6 +102,8 @@ class GroupFaces(CognitiveServiceBase):
 
 class FindSimilarFace(CognitiveServiceBase):
     """Find similar faces to a query face id (/face/v1.0/findsimilars)."""
+
+    _response_schema = List[S.SimilarFace]
 
     face_id = ServiceParam("query face id")
     face_ids = ServiceParam("candidate face ids")

@@ -6,8 +6,9 @@ from __future__ import annotations
 import concurrent.futures as _futures
 import json
 import urllib.parse
-from typing import Any, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 
+from mmlspark_tpu.cognitive import schemas as S
 from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.io.clients import AdvancedHandler
@@ -41,8 +42,12 @@ class BingImageSearch(CognitiveServiceBase):
             headers["Ocp-Apim-Subscription-Key"] = key
         return HTTPRequestData(url, "GET", headers)
 
+    _response_schema = List[S.BingImage]
+
     def _project_response(self, obj: Any) -> Any:
-        return (obj or {}).get("value")
+        from typing import List as _L
+
+        return S.from_json(_L[S.BingImage], (obj or {}).get("value"))
 
     @staticmethod
     def downloadFromUrls(

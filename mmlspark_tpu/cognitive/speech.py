@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+from mmlspark_tpu.cognitive import schemas as S
 from mmlspark_tpu.cognitive.audio import CompressedStream, WavStream
 from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
 from mmlspark_tpu.core.params import Param
@@ -24,6 +25,8 @@ from mmlspark_tpu.io.http_schema import HTTPRequestData, response_to_json
 
 
 class SpeechToText(CognitiveServiceBase):
+    _response_schema = S.SpeechResponse
+
     audio_data = ServiceParam("raw audio bytes (value or column)")
     language = ServiceParam("recognition language", default={"value": "en-US"})
     format = ServiceParam("'simple' or 'detailed'", default={"value": "simple"})
@@ -84,6 +87,12 @@ class SpeechToTextSDK(SpeechToText):
                 reqs.append(r)
         return reqs
 
+    # the output column holds the ordered per-window segment list, not a
+    # single record — metadata must say so
+    from typing import List as _List
+
+    _response_schema = _List[S.SpeechResponse]
+
     def _row_output(self, resps: list) -> tuple:
         segs: list = []
         errors: list = []
@@ -93,7 +102,9 @@ class SpeechToTextSDK(SpeechToText):
                 continue
             if resp["status_code"] // 100 == 2:
                 try:
-                    segs.append(response_to_json(resp))
+                    segs.append(
+                        S.from_json(S.SpeechResponse, response_to_json(resp))
+                    )
                     continue
                 except (ValueError, KeyError, TypeError) as e:
                     errors.append({"window": w, "status_code": resp["status_code"],

@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 from typing import Any, Optional
 
+from mmlspark_tpu.cognitive import schemas as S
 from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
 from mmlspark_tpu.io.http_schema import HTTPRequestData
 
@@ -51,6 +52,7 @@ class AnalyzeImage(_VisionBase):
     /vision/v2.0/analyze)."""
 
     _path = "/vision/v2.0/analyze"
+    _response_schema = S.AnalyzeImageResponse
     visual_features = ServiceParam(
         "features to compute", default={"value": ["Categories", "Tags", "Description"]}
     )
@@ -71,6 +73,7 @@ class OCR(_VisionBase):
     """Printed-text OCR (OCR.scala; /vision/v2.0/ocr)."""
 
     _path = "/vision/v2.0/ocr"
+    _response_schema = S.OCRResponse
     detect_orientation = ServiceParam("detect text orientation", default={"value": True})
     language = ServiceParam("BCP-47 language", default={"value": "unk"})
 
@@ -86,6 +89,7 @@ class RecognizeDomainSpecificContent(_VisionBase):
     (RecognizeDomainSpecificContent; /vision/v2.0/models/{model}/analyze)."""
 
     model = ServiceParam("domain model name", default={"value": "celebrities"})
+    _response_schema = S.DomainModelResponse
 
     def _build_request(self, vals: dict) -> Optional[dict]:
         return self._image_request(
@@ -114,12 +118,14 @@ class TagImage(_VisionBase):
     """Image tags (TagImage; /vision/v2.0/tag)."""
 
     _path = "/vision/v2.0/tag"
+    _response_schema = S.TagImagesResponse
 
 
 class DescribeImage(_VisionBase):
     """Natural-language captions (DescribeImage; /vision/v2.0/describe)."""
 
     _path = "/vision/v2.0/describe"
+    _response_schema = S.DescribeImageResponse
     max_candidates = ServiceParam("number of caption candidates", default={"value": 1})
 
     def _query(self, vals: dict) -> str:

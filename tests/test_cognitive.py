@@ -61,15 +61,29 @@ class _Mock(BaseHTTPRequestHandler):
             return
         path = self.path.split("?")[0]
         if path.endswith("/sentiment"):
-            doc = json.loads(raw)["documents"][0]
-            sent = "positive" if "good" in doc["text"] else "negative"
-            self._send(200, {"documents": [{"id": "0", "sentiment": sent}], "errors": []})
+            docs, errs = [], []
+            for doc in json.loads(raw)["documents"]:
+                if doc["text"] == "BOOM":  # per-document error channel
+                    errs.append({"id": doc["id"], "message": "invalid document"})
+                else:
+                    sent = "positive" if "good" in doc["text"] else "negative"
+                    docs.append({
+                        "id": doc["id"], "sentiment": sent,
+                        "confidenceScores": {
+                            "positive": 0.9 if sent == "positive" else 0.1,
+                            "neutral": 0.0,
+                            "negative": 0.1 if sent == "positive" else 0.9,
+                        },
+                    })
+            self._send(200, {"documents": docs, "errors": errs})
         elif path.endswith("/languages"):
             self._send(200, {"documents": [
-                {"id": "0", "detectedLanguage": {"iso6391Name": "en"}}], "errors": []})
+                {"id": d["id"], "detectedLanguage": {"iso6391Name": "en"}}
+                for d in json.loads(raw)["documents"]], "errors": []})
         elif path.endswith("/keyPhrases"):
             self._send(200, {"documents": [
-                {"id": "0", "keyPhrases": ["tpu", "framework"]}], "errors": []})
+                {"id": d["id"], "keyPhrases": ["tpu", "framework"]}
+                for d in json.loads(raw)["documents"]], "errors": []})
         elif path.endswith("/analyze"):
             self._send(200, {"tags": [{"name": "cat", "confidence": 0.9}],
                              "description": {"captions": []}})
@@ -91,8 +105,8 @@ class _Mock(BaseHTTPRequestHandler):
                                   "faceRectangle": {"top": 1, "left": 2}}])
         elif path.endswith("/general"):
             self._send(200, {"documents": [
-                {"id": "0", "entities": [{"text": "TPU", "category": "Product"}]}],
-                "errors": []})
+                {"id": d["id"], "entities": [{"text": "TPU", "category": "Product"}]}
+                for d in json.loads(raw)["documents"]], "errors": []})
         elif path.endswith("/tag"):
             self._send(200, {"tags": [{"name": "chip", "confidence": 0.8}]})
         elif path.endswith("/describe"):
@@ -282,3 +296,70 @@ def test_azure_search_writer(svc):
     sent = json.loads(_Mock.log[-1][2])
     assert sent["value"][0]["@search.action"] == "upload"
     assert {d["id"] for d in sent["value"]} == {"1", "2"}
+
+
+def test_minibatched_documents_per_request(svc):
+    """The reference assembles minibatch->JSON->HTTP->flatten pipelines
+    (SimpleHTTPTransformer.scala:111-154): many documents must travel in ONE
+    POST and flatten back to rows by id."""
+    texts = np.array(
+        ["good a", "bad b", "good c", None, "bad d", "good e"], dtype=object
+    )
+    df = DataFrame.from_dict({"text": texts}, num_partitions=1)
+    _Mock.log.clear()
+    out = (
+        TextSentiment(url=svc, subscription_key="k", batch_size=4)
+        .set_col("text", "text")
+        .set(output_col="sent")
+        .transform(df)
+    )
+    posts = [(p, json.loads(raw)) for p, h, raw in _Mock.log if "sentiment" in p]
+    # 5 eligible rows at batch_size=4 -> exactly 2 POSTs, first carrying 4 docs
+    assert len(posts) == 2, posts
+    sizes = sorted(len(b["documents"]) for _, b in posts)
+    assert sizes == [1, 4]
+    sents = list(out["sent"])
+    assert [s and s["sentiment"] for s in sents] == [
+        "positive", "negative", "positive", None, "negative", "positive"
+    ]
+    assert sents[3] is None  # skipped row
+
+
+def test_minibatch_per_document_error(svc):
+    """A per-document service error lands in THAT row's error column; the
+    rest of the batch still succeeds."""
+    texts = np.array(["good a", "BOOM", "bad c"], dtype=object)
+    df = DataFrame.from_dict({"text": texts}, num_partitions=1)
+    out = (
+        TextSentiment(url=svc, subscription_key="k", batch_size=8)
+        .set_col("text", "text")
+        .set(output_col="sent")
+        .transform(df)
+    )
+    sents = list(out["sent"])
+    errs = list(out["sent_error"])
+    assert sents[0]["sentiment"] == "positive" and sents[2]["sentiment"] == "negative"
+    assert sents[1] is None and "invalid document" in errs[1]["reason"]
+    assert errs[0] is None and errs[2] is None
+
+
+def test_typed_response_schema_and_metadata(svc):
+    """Outputs are typed records (TextAnalyticsSchemas.scala SparkBindings
+    analogue) with the schema reflected into column metadata."""
+    from mmlspark_tpu.cognitive.schemas import SentimentDocument
+
+    df = _texts()
+    out = (
+        TextSentiment(url=svc, subscription_key="k")
+        .set_col("text", "text")
+        .set(output_col="sent")
+        .transform(df)
+    )
+    rec = list(out["sent"])[0]
+    assert isinstance(rec, SentimentDocument)
+    assert rec.sentiment == "positive"            # attribute access
+    assert rec["sentiment"] == "positive"         # mapping access kept
+    assert rec.confidenceScores.positive == 0.9   # nested record
+    md = out.column_metadata("sent")
+    assert md["response_schema"] == "SentimentDocument"
+    assert {"name": "sentiment", "type": "str"} in md["response_fields"]

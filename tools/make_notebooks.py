@@ -871,15 +871,19 @@ NOTEBOOKS = {
          "        pass\n"
          "    def do_POST(self):\n"
          "        n = int(self.headers.get('Content-Length') or 0)\n"
-         "        doc = json.loads(self.rfile.read(n))['documents'][0]\n"
+         "        # the client MINIBATCHES: many documents arrive per POST,\n"
+         "        # answered per id (the Text Analytics v3 wire format)\n"
+         "        docs = json.loads(self.rfile.read(n))['documents']\n"
          "        path = self.path.split('?')[0]\n"
          "        if path.endswith('/sentiment'):\n"
-         "            s = 'positive' if 'love' in doc['text'] else 'negative'\n"
-         "            body = {'documents': [{'id': '0', 'sentiment': s}], 'errors': []}\n"
+         "            out = [{'id': d['id'], 'sentiment':\n"
+         "                    'positive' if 'love' in d['text'] else 'negative'}\n"
+         "                   for d in docs]\n"
          "        else:\n"
-         "            body = {'documents': [{'id': '0',\n"
-         "                    'detectedLanguage': {'iso6391Name': 'en'}}], 'errors': []}\n"
-         "        raw = json.dumps(body).encode()\n"
+         "            out = [{'id': d['id'],\n"
+         "                    'detectedLanguage': {'iso6391Name': 'en'}}\n"
+         "                   for d in docs]\n"
+         "        raw = json.dumps({'documents': out, 'errors': []}).encode()\n"
          "        self.send_response(200)\n"
          "        self.send_header('Content-Length', str(len(raw)))\n"
          "        self.end_headers()\n"
@@ -896,8 +900,11 @@ NOTEBOOKS = {
          "scored = TextSentiment(url=url, output_col='sentiment',\n"
          "                       subscription_key='demo-key'\n"
          "                       ).set_col('text', 'text').transform(df)\n"
-         "sentiments = [s['sentiment'] for s in scored['sentiment']]\n"
-         "print(sentiments)\n"
+         "# outputs are TYPED records (schemas.SentimentDocument): attribute\n"
+         "# access and dict-style both work, and the column carries schema\n"
+         "# metadata for downstream consumers\n"
+         "sentiments = [s.sentiment for s in scored['sentiment']]\n"
+         "print(sentiments, scored.column_metadata('sentiment')['response_schema'])\n"
          "assert sentiments == ['positive', 'negative']\n"
          "srv.shutdown()"),
     ],
