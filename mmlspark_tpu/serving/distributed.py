@@ -152,7 +152,16 @@ class ServingGateway:
     ``workers``: static list of :class:`ServiceInfo`/dict/:class:`Backend`;
     and/or ``registry_url``: a :class:`DriverRegistry` endpoint polled
     every ``refresh_s`` so late-registering or restarted workers join the
-    pool without a gateway restart."""
+    pool without a gateway restart.
+
+    Delivery semantics: failures BEFORE the request body is delivered
+    (connect refused/reset, write error) always re-dispatch to another
+    worker — the worker cannot have started executing. A timeout AFTER the
+    body was sent means the worker may be mid-execution (first-hit compile,
+    heavy batch); by default that request fails with 504 instead of being
+    executed a second time elsewhere (at-most-once for non-idempotent
+    POSTs). Set ``retry_after_send=True`` for idempotent handlers to get
+    at-least-once re-dispatch on post-send timeouts too."""
 
     # hop-by-hop headers that must not be forwarded verbatim
     _SKIP_HEADERS = {"connection", "content-length", "host", "keep-alive"}
@@ -170,6 +179,7 @@ class ServingGateway:
         cooldown_s: float = 5.0,
         max_attempts: Optional[int] = None,
         evict_after: Optional[int] = None,
+        retry_after_send: bool = False,
     ):
         self.service_name = service_name
         self._ingress = WorkerServer(
@@ -190,6 +200,7 @@ class ServingGateway:
         self._timeout = request_timeout_s
         self._num_dispatchers = num_dispatchers
         self._max_attempts = max_attempts
+        self._retry_after_send = retry_after_send
         self._threads: list = []
         self._stop = threading.Event()
         self.forwarded = 0
@@ -301,15 +312,34 @@ class ServingGateway:
             b = self._pool.next(exclude=tried)
             if b is None:
                 break
+            sent = False
             try:
                 conn = http.client.HTTPConnection(
                     b.host, b.port, timeout=self._timeout
                 )
+                # request() returning means the body was fully flushed; an
+                # exception DURING it leaves an incomplete body the worker
+                # will never execute (Content-Length mismatch) — safe to
+                # re-dispatch
                 conn.request(req.method, b.path, body=req.body, headers=headers)
+                sent = True
                 resp = conn.getresponse()
                 body = resp.read()
                 conn.close()
-            except (OSError, http.client.HTTPException):
+            except (OSError, http.client.HTTPException) as e:
+                timed_out_after_send = sent and isinstance(e, TimeoutError)
+                if timed_out_after_send and not self._retry_after_send:
+                    # the worker may be mid-execution (slow, not dead):
+                    # re-dispatching would double-process a non-idempotent
+                    # POST, and cooling down a healthy-but-slow worker
+                    # would starve the pool — fail this request instead
+                    self.failed += 1
+                    self._ingress.reply_to(
+                        req.id,
+                        b'{"error": "worker timed out after request was sent"}',
+                        504, {"Content-Type": "application/json"},
+                    )
+                    return
                 # the cross-worker replay: this worker is down or died
                 # mid-request (refused connect OR a half-written response
                 # — IncompleteRead/BadStatusLine are HTTPException, not

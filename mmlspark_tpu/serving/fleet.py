@@ -1,0 +1,213 @@
+"""Serving-fleet entrypoint: registry, worker, and gateway roles as one CLI.
+
+The deployment story for the serving layer (the reference ships docker +
+helm recipes under tools/docker and tools/helm that bring up a Spark
+master/worker/zeppelin fleet; here the unit is registry + model workers +
+gateway). Each role is one process:
+
+    python -m mmlspark_tpu.serving.fleet registry --port 9090
+    python -m mmlspark_tpu.serving.fleet worker \
+        --registry http://registry:9090/ --model zoo:ResNet8_Digits
+    python -m mmlspark_tpu.serving.fleet gateway \
+        --registry http://registry:9090/ --port 8080
+
+Workers register with the driver registry on start and heartbeat by
+re-registering; the gateway discovers them by polling the registry
+(serving/distributed.py), so workers can join/leave/restart without
+touching the gateway — the reference's DistributedHTTPSource re-discovery
+semantics. ``tools/deploy/`` packages these roles as docker-compose and
+k8s manifests with a smoke script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+def make_model_handler(model_spec: str) -> Callable:
+    """Model spec -> batch handler for :class:`ServingQuery`.
+
+    - ``echo``           — replies with the parsed request body (smoke tests)
+    - ``zoo:<name>``     — ImageFeaturizer on the named zoo backbone; body
+      ``{"image": [[...]]}`` (H, W, C) uint8 -> ``{"features": [...]}``
+    - ``module:pkg.fn``  — import ``pkg.fn``; it must return a handler
+    """
+    if model_spec == "echo":
+
+        def handler(reqs: list) -> dict:
+            out = {}
+            for r in reqs:
+                try:
+                    body = json.loads(r.body) if r.body else {}
+                    out[r.id] = (200, json.dumps({"echo": body}).encode(), {})
+                except ValueError as e:
+                    out[r.id] = (400, json.dumps({"error": str(e)}).encode(), {})
+            return out
+
+        return handler
+    if model_spec.startswith("module:"):
+        import importlib
+
+        mod_name, _, fn_name = model_spec[len("module:"):].rpartition(".")
+        return getattr(importlib.import_module(mod_name), fn_name)()
+    if model_spec.startswith("zoo:"):
+        from mmlspark_tpu.models import ImageFeaturizer
+
+        feat = ImageFeaturizer(
+            input_col="image", output_col="features",
+            model_name=model_spec[len("zoo:"):],
+        )
+        inner = feat._build()
+
+        def handler(reqs: list) -> dict:
+            out = {}
+            imgs, ids = [], []
+            for r in reqs:
+                try:
+                    imgs.append(
+                        np.asarray(json.loads(r.body)["image"], np.uint8)
+                    )
+                    ids.append(r.id)
+                except (ValueError, KeyError) as e:
+                    out[r.id] = (400, json.dumps({"error": str(e)}).encode(), {})
+            if imgs:
+                feats = inner.apply_batch(np.stack(imgs))
+                for rid, f in zip(ids, feats):
+                    out[rid] = (
+                        200,
+                        json.dumps({"features": np.asarray(f).tolist()}).encode(),
+                        {},
+                    )
+            return out
+
+        return handler
+    raise ValueError(f"unknown model spec {model_spec!r}")
+
+
+def run_registry(host: str = "0.0.0.0", port: int = 9090) -> Any:
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    reg = DriverRegistry(host=host, port=port)
+    print(f"registry: {reg.url}", flush=True)
+    return reg
+
+
+def run_worker(
+    registry_url: str,
+    model: str = "echo",
+    host: str = "0.0.0.0",
+    port: int = 0,
+    service_name: str = "serving",
+    heartbeat_s: float = 5.0,
+    advertise_host: Optional[str] = None,
+) -> tuple:
+    """Start a worker, register it, and re-register on a heartbeat thread
+    (a restarted registry re-learns live workers within one beat)."""
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.registry import DriverRegistry
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer(host=host, port=port, name=service_name)
+    info = srv.start()
+    if advertise_host:
+        # the registry roster must carry an address OTHER containers can
+        # reach, not the 0.0.0.0 bind address
+        import dataclasses
+
+        info = dataclasses.replace(info, host=advertise_host)
+    q = ServingQuery(srv, make_model_handler(model)).start()
+    stop = threading.Event()
+
+    def beat() -> None:
+        while not stop.is_set():
+            try:
+                DriverRegistry.register(registry_url, info)
+            except Exception as e:  # noqa: BLE001 — registry may be restarting
+                print(f"worker: register failed: {e}", file=sys.stderr, flush=True)
+            stop.wait(heartbeat_s)
+
+    threading.Thread(target=beat, name="worker-heartbeat", daemon=True).start()
+    print(f"worker: {info.host}:{info.port} model={model}", flush=True)
+    return srv, q, stop
+
+
+def run_gateway(
+    registry_url: str,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    service_name: str = "serving",
+) -> Any:
+    from mmlspark_tpu.serving.distributed import ServingGateway
+
+    gw = ServingGateway(
+        registry_url=registry_url, service_name=service_name,
+        host=host, port=port,
+    )
+    ginfo = gw.start()
+    print(f"gateway: http://{ginfo.host}:{ginfo.port}/", flush=True)
+    return gw
+
+
+def _serve_forever(stoppables: list) -> None:
+    ev = threading.Event()
+
+    def on_sig(signum: int, frame: Any) -> None:
+        ev.set()
+
+    signal.signal(signal.SIGTERM, on_sig)
+    signal.signal(signal.SIGINT, on_sig)
+    ev.wait()
+    for s in stoppables:
+        try:
+            s.stop() if hasattr(s, "stop") else s.set()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def main(argv: Optional[list] = None) -> None:
+    ap = argparse.ArgumentParser(prog="mmlspark_tpu.serving.fleet")
+    sub = ap.add_subparsers(dest="role", required=True)
+    r = sub.add_parser("registry")
+    r.add_argument("--host", default="0.0.0.0")
+    r.add_argument("--port", type=int, default=9090)
+    w = sub.add_parser("worker")
+    w.add_argument("--registry", required=True)
+    w.add_argument("--model", default="echo")
+    w.add_argument("--host", default="0.0.0.0")
+    w.add_argument("--port", type=int, default=0)
+    w.add_argument("--service-name", default="serving")
+    w.add_argument("--heartbeat-s", type=float, default=5.0)
+    w.add_argument(
+        "--advertise-host", default=None,
+        help="hostname other containers reach this worker by (compose/k8s)",
+    )
+    g = sub.add_parser("gateway")
+    g.add_argument("--registry", required=True)
+    g.add_argument("--host", default="0.0.0.0")
+    g.add_argument("--port", type=int, default=8080)
+    g.add_argument("--service-name", default="serving")
+    args = ap.parse_args(argv)
+    if args.role == "registry":
+        reg = run_registry(args.host, args.port)
+        _serve_forever([reg])
+    elif args.role == "worker":
+        srv, q, stop = run_worker(
+            args.registry, args.model, args.host, args.port,
+            args.service_name, args.heartbeat_s, args.advertise_host,
+        )
+        _serve_forever([stop, q, srv])
+    else:
+        gw = run_gateway(args.registry, args.host, args.port, args.service_name)
+        _serve_forever([gw])
+
+
+if __name__ == "__main__":
+    main()

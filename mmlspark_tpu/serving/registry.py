@@ -98,6 +98,9 @@ class DriverRegistry:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._thread.join(5.0)
+        # shutdown() only stops the serve loop; the listening socket must
+        # be closed too or the port stays bound (restart-on-same-port)
+        self._httpd.server_close()
 
     @staticmethod
     def register(registry_url: str, info: ServiceInfo) -> bool:

@@ -533,3 +533,72 @@ def test_registry_roster_is_bounded():
         assert {e["port"] for e in roster} == set(range(40015, 40020))
     finally:
         reg.stop()
+
+
+def test_fleet_roles_bring_up_and_smoke():
+    """The deployment recipe's code path (tools/deploy): fleet.py roles
+    bring up registry + 2 workers + gateway; the smoke client round-trips
+    through the gateway and both workers serve."""
+    from mmlspark_tpu.serving import fleet
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0)
+    workers = [
+        fleet.run_worker(reg.url, model="echo", host="127.0.0.1",
+                         heartbeat_s=0.5)
+        for _ in range(2)
+    ]
+    gw = fleet.run_gateway(reg.url, host="127.0.0.1", port=0)
+    try:
+        deadline = time.monotonic() + 5.0
+        while gw.pool.size() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert gw.pool.size() == 2
+        for i in range(20):
+            status, data = _post(
+                int(gw.url.rsplit(":", 1)[1].rstrip("/")), "/", {"x": i}
+            )
+            assert status == 200
+            assert json.loads(data)["echo"]["x"] == i
+    finally:
+        gw.stop()
+        for srv, q, stop in workers:
+            stop.set()
+            q.stop()
+            srv.stop()
+        reg.stop()
+
+
+def test_fleet_worker_heartbeat_survives_registry_restart():
+    """A restarted registry re-learns live workers from heartbeats — the
+    operational property the deployment doc promises."""
+    from mmlspark_tpu.serving import fleet
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0)
+    port = int(reg.url.rsplit(":", 1)[1].rstrip("/"))
+    srv, q, stop = fleet.run_worker(
+        reg.url, model="echo", host="127.0.0.1", heartbeat_s=0.2
+    )
+    try:
+        time.sleep(0.4)
+        assert reg.services("serving")
+        reg.stop()
+        reg2 = None
+        for _ in range(50):  # the freed port may linger in TIME_WAIT
+            try:
+                reg2 = DriverRegistry(host="127.0.0.1", port=port)
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert reg2 is not None, "could not rebind registry port"
+        try:
+            deadline = time.monotonic() + 5.0
+            while not reg2.services("serving") and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert reg2.services("serving"), "heartbeat did not re-register"
+        finally:
+            reg2.stop()
+    finally:
+        stop.set()
+        q.stop()
+        srv.stop()

@@ -1,0 +1,38 @@
+"""Fleet smoke test: POST through the gateway, check replies + p50.
+
+    python tools/deploy/smoke.py http://localhost:8080/ [n_requests]
+"""
+
+import http.client
+import json
+import sys
+import time
+import urllib.parse
+
+
+def main() -> int:
+    url = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8080/"
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    u = urllib.parse.urlparse(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port or 80, timeout=10)
+    lat = []
+    ok = 0
+    for i in range(n):
+        body = json.dumps({"x": i})
+        t0 = time.perf_counter()
+        conn.request("POST", u.path or "/", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        lat.append((time.perf_counter() - t0) * 1e3)
+        if resp.status == 200 and json.loads(data).get("echo", {}).get("x") == i:
+            ok += 1
+    conn.close()
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    print(f"smoke: {ok}/{n} ok, p50 {p50:.2f} ms")
+    return 0 if ok == n else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
