@@ -122,7 +122,17 @@ class XLAModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
     _MAX_IN_FLIGHT = 4
 
     def apply_batch(self, x: np.ndarray) -> np.ndarray:
-        """Evaluate one host batch (used by transform and by serving)."""
+        """Evaluate one host batch (used by transform and by serving).
+
+        Double-buffered: the main thread ONLY stages + dispatches (upload of
+        batch k+1 streams while batch k computes), and result fetches run on
+        a dedicated thread — over a remote-device link a blocking fetch
+        costs ~70-100 ms that would otherwise serialize with the next
+        dispatch (CNTKModel.scala:515-520 batches for the same
+        keep-the-accelerator-busy reason). The in-flight window bounds live
+        HBM and applies backpressure."""
+        import concurrent.futures as _futures
+
         mesh = get_mesh()
         vs = self._device_variables(mesh)
         bs = self._effective_batch(mesh)
@@ -130,14 +140,18 @@ class XLAModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
         x = np.asarray(x, dtype=dt) if dt else np.asarray(x)
         padded, n = pad_batch(x, bs)
         fn = self._compiled(padded[:bs].shape, mesh)
-        outs = []
-        in_flight: list = []
-        for i in range(0, padded.shape[0], bs):
-            chunk = shard_batch(padded[i: i + bs], mesh)
-            in_flight.append(fn(vs, chunk))  # async dispatch, no host sync
-            if len(in_flight) >= self._MAX_IN_FLIGHT:
-                outs.append(np.asarray(in_flight.pop(0)))
-        outs.extend(np.asarray(r) for r in in_flight)
+        outs: list = []
+        pending: list = []
+        # one fetcher thread keeps results ordered; np.asarray releases the
+        # GIL while it waits on the transfer, so dispatch continues
+        with _futures.ThreadPoolExecutor(max_workers=1) as fetcher:
+            for i in range(0, padded.shape[0], bs):
+                chunk = shard_batch(padded[i: i + bs], mesh)
+                y = fn(vs, chunk)  # async dispatch, no host sync
+                pending.append(fetcher.submit(np.asarray, y))
+                if len(pending) >= self._MAX_IN_FLIGHT:
+                    outs.append(pending.pop(0).result())
+            outs.extend(f.result() for f in pending)
         return np.concatenate(outs, axis=0)[:n]
 
     # -- stage interface ----------------------------------------------------

@@ -118,19 +118,30 @@ class _VowpalWabbitBase(
             "-b": ("bits", int), "--bit_precision": ("bits", int),
         }
         while i < len(args):
-            a = args[i]
+            # both VW syntaxes: "--flag value" and "--flag=value"
+            a, eq, inline = args[i].partition("=")
             if a == "--adaptive":
                 out["adaptive"] = True
                 i += 1
             elif a == "--no_adaptive":
                 out["adaptive"] = False
                 i += 1
+            elif a in flag_map and eq:
+                if not inline:
+                    raise ValueError(f"pass_through_args: {a} requires a value")
+                key, conv = flag_map[a]
+                out[key] = conv(inline)
+                i += 1
             elif a in flag_map and i + 1 < len(args):
                 key, conv = flag_map[a]
                 out[key] = conv(args[i + 1])
                 i += 2
+            elif a in flag_map:
+                # a recognized flag with no value is a semantic error, not
+                # noise — silently ignoring it would train with defaults
+                raise ValueError(f"pass_through_args: {a} requires a value")
             else:
-                log.warning("pass_through_args: ignoring unrecognized %r", a)
+                log.warning("pass_through_args: ignoring unrecognized %r", args[i])
                 i += 1
         if out["loss"] not in LOSSES:
             raise ValueError(
