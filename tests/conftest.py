@@ -30,6 +30,16 @@ if _ROOT not in sys.path:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# persistent compile cache shared by xdist workers AND across runs: most of
+# the suite's wall-clock is XLA compiles of the same jitted programs
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(_ROOT, ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # pragma: no cover - older jax
+    pass
 try:
     from jax._src import xla_bridge as _xb
 
