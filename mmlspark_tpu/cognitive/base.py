@@ -260,12 +260,18 @@ class CognitiveServiceBase(Transformer, _HasServiceParams, HasOutputCol):
                         results[(i, w)] = resp
             outs = np.empty(n, dtype=object)
             errs = np.empty(n, dtype=object)
+            row_out_ctx = getattr(self, "_row_output_ctx", None)
             for i, reqs in enumerate(row_reqs):
                 if reqs and "__input_error__" in reqs[0]:
                     errs[i] = {"status_code": 0, "reason": reqs[0]["__input_error__"]}
                     continue
                 resps = [results.get((i, w)) for w in range(len(reqs))]
-                outs[i], errs[i] = self._row_output(resps)
+                # _row_output_ctx also sees the REQUESTS (per-window
+                # metadata like stream offsets rides on the request dicts)
+                outs[i], errs[i] = (
+                    row_out_ctx(resps, reqs) if row_out_ctx
+                    else self._row_output(resps)
+                )
             q = dict(p)
             q[out_col] = outs
             q[err_col] = errs

@@ -127,3 +127,103 @@ class TestSpeechToTextSDK:
         errs = out["text_error"][0]
         assert errs and errs[0]["window"] == 0
         assert out["text"][0] == [None]  # placeholder keeps alignment
+
+
+class TestVADSegmentation:
+    """Phrase-boundary segmentation (the SDK's continuous-recognition
+    behavior, SpeechToTextSDK.scala:204-249): energy dips end segments,
+    offsets are exact stream positions in 100-ns ticks."""
+
+    def _tone_silence_tone(self, rate=16000):
+        import numpy as np
+
+        t1 = np.sin(2 * np.pi * 440 * np.arange(rate) / rate)  # 1s tone
+        gap = np.zeros(rate // 2)                              # 0.5s silence
+        t2 = np.sin(2 * np.pi * 220 * np.arange(rate) / rate)  # 1s tone
+        pcm = (np.concatenate([t1, gap, t2]) * 20000).astype(np.int16)
+        from mmlspark_tpu.cognitive.audio import WavFormat, wrap_wav
+
+        fmt = WavFormat(channels=1, sample_rate=rate, bits_per_sample=16)
+        return wrap_wav(pcm.tobytes(), fmt), rate
+
+    def test_splits_at_silence_with_exact_offsets(self):
+        from mmlspark_tpu.cognitive.audio import WavStream
+
+        wav, rate = self._tone_silence_tone()
+        segs = WavStream(wav).segments(max_seconds=15.0, min_silence_s=0.3)
+        assert len(segs) == 2, [s[1:] for s in segs]
+        (b0, off0, dur0), (b1, off1, dur1) = segs
+        assert off0 == 0
+        # the cut lands inside the 0.5 s gap: between 1.0 s and 1.5 s
+        assert 1.0e7 < off1 < 1.5e7, off1
+        assert off1 == dur0  # contiguous segments tile the stream
+        # each chunk is itself a parseable WAV at the right duration
+        assert abs(WavStream(b0).duration_seconds - off1 / 1e7) < 0.03
+        assert abs(WavStream(b1).duration_seconds - (2.5 - off1 / 1e7)) < 0.03
+
+    def test_max_seconds_caps_segments(self):
+        from mmlspark_tpu.cognitive.audio import WavStream
+
+        wav, rate = self._tone_silence_tone()
+        segs = WavStream(wav).segments(max_seconds=0.6, min_silence_s=0.3)
+        for _, off, dur in segs:
+            assert dur <= 0.62e7
+        # offsets strictly increase and tile without gaps
+        pos = 0
+        for _, off, dur in segs:
+            assert off == pos
+            pos += dur
+
+    def test_pull_stream_contract(self):
+        from mmlspark_tpu.cognitive.audio import WavStream
+
+        wav, rate = self._tone_silence_tone()
+        s = WavStream(wav)
+        chunks = list(s.pull(3200))
+        assert all(len(c) == 3200 for c in chunks[:-1])
+        assert b"".join(chunks) == s.pcm
+
+
+class _OffsetHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        out = json.dumps({
+            "RecognitionStatus": "Success", "DisplayText": "hi",
+            "Offset": 1000, "Duration": 5000,
+        }).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_sdk_offsets_rebased_to_stream_time():
+    """The service's window-relative Offset is rebased to the stream start
+    (SpeechToTextSDK.scala emits session-relative offsets the same way),
+    and records are typed SpeechResponse objects."""
+    from mmlspark_tpu.cognitive.schemas import SpeechResponse
+
+    srv = HTTPServer(("127.0.0.1", 0), _OffsetHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        blob = np.empty(1, dtype=object)
+        blob[0] = make_wav(2.5)
+        df = DataFrame.from_dict({"audio": blob})
+        stage = SpeechToTextSDK(
+            url=f"http://127.0.0.1:{srv.server_port}",
+            output_col="text", window_seconds=1.0,
+            use_advanced_handler=False, concurrency=1,
+        ).set_col("audio_data", "audio")
+        segs = stage.transform(df)["text"][0]
+        assert len(segs) == 3
+        assert all(isinstance(s, SpeechResponse) for s in segs)
+        # window-relative Offset=1000 rebased by each segment's start tick
+        assert segs[0].Offset == 1000
+        assert segs[1].Offset == 1_0000000 + 1000   # 1 s in
+        assert segs[2].Offset == 2_0000000 + 1000   # 2 s in
+    finally:
+        srv.shutdown()
