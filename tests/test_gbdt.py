@@ -1029,3 +1029,56 @@ class TestDeviceLambdaRank:
                           num_leaves=7, min_data_in_leaf=5, seed=0)
         b = train(x[perm], rel[perm], cfg, group_ids=gid[perm])
         assert len(b.trees) == 3
+
+
+class TestPartitionedInteractions:
+    """The TPU-default partitioned grower under the training loop's other
+    machinery: GOSS reweighting, bagging masks, and quantile leaf renewal
+    all consume its outputs (weights in stats, row_leaf for renewal)."""
+
+    def _xy(self, n=3000, seed=9):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        y = (x[:, 0] + x[:, 1] * x[:, 2] > 0).astype(np.float64)
+        return x, y
+
+    def test_goss_partitioned_matches_masked(self, monkeypatch):
+        from mmlspark_tpu.models.gbdt.objectives import sigmoid
+
+        x, y = self._xy()
+        cfg = TrainConfig(objective="binary", num_iterations=6, num_leaves=15,
+                          min_data_in_leaf=5, seed=0, boosting_type="goss")
+        monkeypatch.setenv("MMLSPARK_TPU_GBDT_PARTITION", "1")
+        b_part = train(x, y, cfg, shard=False)
+        monkeypatch.setenv("MMLSPARK_TPU_GBDT_PARTITION", "0")
+        b_mask = train(x, y, cfg, shard=False)
+        pa = sigmoid(b_part.predict_raw(x))
+        pb = sigmoid(b_mask.predict_raw(x))
+        assert np.mean(np.abs(pa - pb)) < 1e-3
+
+    def test_bagging_partitioned_matches_masked(self, monkeypatch):
+        x, y = self._xy(seed=10)
+        yr = x[:, 0] * 2.0 + np.random.default_rng(0).normal(size=len(x)) * 0.1
+        cfg = TrainConfig(objective="regression", num_iterations=6,
+                          num_leaves=15, min_data_in_leaf=5, seed=0,
+                          bagging_fraction=0.7, bagging_freq=1)
+        monkeypatch.setenv("MMLSPARK_TPU_GBDT_PARTITION", "1")
+        b_part = train(x, yr, cfg, shard=False)
+        monkeypatch.setenv("MMLSPARK_TPU_GBDT_PARTITION", "0")
+        b_mask = train(x, yr, cfg, shard=False)
+        pa, pb = b_part.predict_raw(x), b_mask.predict_raw(x)
+        assert np.mean(np.abs(pa - pb)) < 1e-3 * max(1.0, np.abs(pb).mean())
+
+    def test_quantile_renewal_partitioned(self, monkeypatch):
+        """Leaf renewal consumes the partitioned grower's row_leaf — the
+        pinball-loss gate must hold with partitioning forced on."""
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(4000, 6)).astype(np.float32)
+        y = x[:, 0] * 3.0 + rng.normal(size=4000) * (1.0 + np.abs(x[:, 1]))
+        monkeypatch.setenv("MMLSPARK_TPU_GBDT_PARTITION", "1")
+        cfg = TrainConfig(objective="quantile", alpha=0.8, num_iterations=40,
+                          num_leaves=15, min_data_in_leaf=10, seed=0)
+        b = train(x, y, cfg, shard=False)
+        pred = b.predict_raw(x)
+        cov = float((y <= pred).mean())
+        assert 0.74 < cov < 0.86, cov  # coverage near the 0.8 target
