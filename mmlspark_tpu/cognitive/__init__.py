@@ -16,17 +16,19 @@ Each transformer here speaks the same wire format against any base URL
 
 from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
 from mmlspark_tpu.cognitive.text import (
+    NER,
     EntityDetector,
     KeyPhraseExtractor,
     LanguageDetector,
     TextSentiment,
 )
 from mmlspark_tpu.cognitive.vision import (
+    OCR,
     AnalyzeImage,
     DescribeImage,
     GenerateThumbnails,
-    OCR,
     RecognizeDomainSpecificContent,
+    RecognizeText,
     TagImage,
 )
 from mmlspark_tpu.cognitive.face import (
@@ -47,8 +49,10 @@ __all__ = [
     "LanguageDetector",
     "EntityDetector",
     "KeyPhraseExtractor",
+    "NER",
     "AnalyzeImage",
     "OCR",
+    "RecognizeText",
     "RecognizeDomainSpecificContent",
     "GenerateThumbnails",
     "TagImage",

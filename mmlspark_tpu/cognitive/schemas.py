@@ -302,6 +302,32 @@ class OCRResponse(Record):
 
 
 @schema
+class RTWord(Record):
+    boundingBox: List[int] = field(default_factory=list)
+    text: Optional[str] = None
+
+
+@schema
+class RTLine(Record):
+    boundingBox: List[int] = field(default_factory=list)
+    text: Optional[str] = None
+    words: List[RTWord] = field(default_factory=list)
+
+
+@schema
+class RecognitionResult(Record):
+    lines: List[RTLine] = field(default_factory=list)
+
+
+@schema
+class RecognizeTextResponse(Record):
+    """RTResponse (ComputerVisionSchemas.scala RecognizeText)."""
+
+    status: Optional[str] = None
+    recognitionResult: Optional[RecognitionResult] = None
+
+
+@schema
 class TagImagesResponse(Record):
     tags: List[ImageTag] = field(default_factory=list)
     requestId: Optional[str] = None

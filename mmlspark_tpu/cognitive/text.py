@@ -123,6 +123,12 @@ class EntityDetector(_TextAnalyticsBase):
     _response_schema = S.EntitiesDocument
 
 
+class NER(EntityDetector):
+    """Named-entity recognition (NERV2/NER in TextAnalytics.scala:217-227;
+    the v3 wire format unifies it with EntityDetector's endpoint — this is
+    the same stage under the reference's other registry name)."""
+
+
 class KeyPhraseExtractor(_TextAnalyticsBase):
     """Key-phrase extraction (KeyPhraseExtractor; /keyPhrases)."""
 

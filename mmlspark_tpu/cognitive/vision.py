@@ -12,6 +12,7 @@ from typing import Any, Optional
 
 from mmlspark_tpu.cognitive import schemas as S
 from mmlspark_tpu.cognitive.base import CognitiveServiceBase, ServiceParam
+from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.io.http_schema import HTTPRequestData
 
 
@@ -82,6 +83,100 @@ class OCR(_VisionBase):
             f"language={vals.get('language') or 'unk'}"
             f"&detectOrientation={str(bool(vals.get('detect_orientation'))).lower()}"
         )
+
+
+class RecognizeText(_VisionBase):
+    """Async printed/handwritten text recognition
+    (RecognizeText, ComputerVision.scala:215-262; /vision/v2.0/recognizeText).
+
+    The service's wire contract is ASYNC: the POST answers 202 with an
+    ``Operation-Location`` header, and the result is GET-polled from that
+    URL until ``status`` leaves running/notStarted (the reference's
+    ``maxPollingRetries``/``pollingDelay`` handler loop). Polls reuse the
+    original request's resolved auth headers and the stage's configured
+    retry handler, and run inside ``_row_output_ctx`` so the base's thread
+    pool still fans rows out concurrently."""
+
+    _path = "/vision/v2.0/recognizeText"
+    _response_schema = S.RecognizeTextResponse
+    mode = ServiceParam(
+        "'Printed' or 'Handwritten'", default={"value": "Printed"}
+    )
+    # plain ints, as in the reference (IntParam maxPollingRetries /
+    # pollingDelay) — they configure the stage, not a per-row value
+    max_polling_retries = Param("poll attempts", default=1000, type_=int)
+    polling_delay_ms = Param("delay between polls (ms)", default=300, type_=int)
+
+    def _query(self, vals: dict) -> str:
+        return f"mode={vals.get('mode') or 'Printed'}"
+
+    def _row_output_ctx(self, resps: list, reqs: list) -> tuple:
+        import time as _time
+
+        from mmlspark_tpu.io.clients import AdvancedHandler, BasicHandler
+        from mmlspark_tpu.io.http_schema import HTTPRequestData, response_to_json
+
+        resp = resps[0] if resps else None
+        if resp is None:
+            return None, None
+        if resp["status_code"] not in (200, 202):
+            return None, {
+                "status_code": resp["status_code"],
+                "reason": resp["reason"],
+                "entity": resp["entity"],
+            }
+        op_url = next(
+            (v for k, v in (resp.get("headers") or {}).items()
+             if k.lower() == "operation-location"),
+            None,
+        )
+        if not op_url:
+            return None, {
+                "status_code": resp["status_code"],
+                "reason": "202 without Operation-Location header",
+            }
+        # the ORIGINAL request's resolved headers carry this row's auth
+        # (column-bound subscription keys included); drop the content type
+        headers = {
+            k: v for k, v in (reqs[0].get("headers") or {}).items()
+            if k.lower() != "content-type"
+        }
+        # same retry semantics as the initial POST (429/5xx backoff)
+        handler = (
+            AdvancedHandler(
+                backoffs_ms=self.get("backoffs_ms"),
+                timeout=self.get("timeout"),
+            )
+            if self.get("use_advanced_handler")
+            else BasicHandler(timeout=self.get("timeout"))
+        )
+        delay = int(self.get("polling_delay_ms"))
+        last = None
+        for _ in range(max(int(self.get("max_polling_retries")), 1)):
+            pr = handler(HTTPRequestData(op_url, "GET", headers))
+            if pr["status_code"] // 100 != 2:
+                return None, {
+                    "status_code": pr["status_code"],
+                    "reason": pr["reason"], "entity": pr["entity"],
+                }
+            try:
+                last = response_to_json(pr) or {}
+            except (ValueError, KeyError, TypeError) as e:
+                return None, {
+                    "status_code": pr["status_code"],
+                    "reason": f"poll parse error: {e}",
+                }
+            if str(last.get("status", "")).lower() not in (
+                "running", "notstarted", "not started", ""
+            ):
+                break
+            _time.sleep(delay / 1000.0)
+        if last is None or str(last.get("status", "")).lower() != "succeeded":
+            return None, {
+                "status_code": 200,
+                "reason": f"recognition did not succeed: {last and last.get('status')}",
+            }
+        return self._project_response(last), None
 
 
 class RecognizeDomainSpecificContent(_VisionBase):

@@ -43,8 +43,25 @@ class _Mock(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    poll_counts: dict = {}
+
     def do_GET(self):
-        if "/images/search" in self.path:
+        if "/operations/" in self.path:
+            # async recognizeText operation: 'running' once, then succeeded
+            op = self.path.rsplit("/", 1)[1]
+            n = type(self).poll_counts.get(op, 0) + 1
+            type(self).poll_counts[op] = n
+            if n < 2:
+                self._send(200, {"status": "Running"})
+            else:
+                self._send(200, {
+                    "status": "Succeeded",
+                    "recognitionResult": {"lines": [
+                        {"text": "HELLO TPU", "words": [
+                            {"text": "HELLO"}, {"text": "TPU"}]}
+                    ]},
+                })
+        elif "/images/search" in self.path:
             from urllib.parse import parse_qs, urlparse
 
             q = parse_qs(urlparse(self.path).query)["q"][0]
@@ -90,6 +107,16 @@ class _Mock(BaseHTTPRequestHandler):
         elif path.endswith("/ocr"):
             self._send(200, {"language": "en", "regions": [
                 {"lines": [{"words": [{"text": "HELLO"}]}]}]})
+        elif path.endswith("/recognizeText"):
+            # async contract: 202 + Operation-Location header, empty body
+            op = f"op{len(type(self).log)}"
+            self.send_response(202)
+            self.send_header(
+                "Operation-Location",
+                f"http://{self.headers.get('Host')}/vision/v2.0/textOperations/operations/{op}",
+            )
+            self.send_header("Content-Length", "0")
+            self.end_headers()
         elif path.endswith("/generateThumbnail"):
             self._send(200, b"\x89PNGthumbnail", ctype="application/octet-stream")
         elif path.endswith("/detect"):
@@ -363,3 +390,42 @@ def test_typed_response_schema_and_metadata(svc):
     md = out.column_metadata("sent")
     assert md["response_schema"] == "SentimentDocument"
     assert {"name": "sentiment", "type": "str"} in md["response_fields"]
+
+
+def test_recognize_text_async_polling(svc):
+    """RecognizeText's wire contract is async (ComputerVision.scala:215-262):
+    202 + Operation-Location, then GET-polling until the operation leaves
+    'Running'. The mock requires >=2 polls before succeeding."""
+    from mmlspark_tpu.cognitive import RecognizeText
+    from mmlspark_tpu.cognitive.schemas import RecognizeTextResponse
+
+    df = DataFrame.from_dict(
+        {"img": np.array(["http://x/a.png", "http://x/b.png"], dtype=object)}
+    )
+    _Mock.poll_counts.clear()
+    out = (
+        RecognizeText(url=svc, subscription_key="k", output_col="rt",
+                      polling_delay_ms=10)
+        .set_col("image_url", "img")
+        .transform(df)
+    )
+    recs = list(out["rt"])
+    assert all(isinstance(r, RecognizeTextResponse) for r in recs)
+    assert recs[0].status == "Succeeded"
+    texts = [" ".join(ln.text for ln in r.recognitionResult.lines) for r in recs]
+    assert texts == ["HELLO TPU", "HELLO TPU"]
+    assert all(n >= 2 for n in _Mock.poll_counts.values())  # really polled
+
+
+def test_ner_matches_entity_detector(svc):
+    from mmlspark_tpu.cognitive import NER
+
+    df = _texts()
+    out = (
+        NER(url=svc, subscription_key="k", output_col="ents")
+        .set_col("text", "text")
+        .transform(df)
+    )
+    ents = list(out["ents"])
+    assert ents[0].entities[0].text == "TPU"
+    assert ents[0].entities[0].category == "Product"

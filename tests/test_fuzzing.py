@@ -273,7 +273,9 @@ def make_test_objects() -> list:
         C.TextSentiment(url=dead, output_col="o", **no_retry).set_col("text", "text"),
         C.LanguageDetector(url=dead, output_col="o", **no_retry).set_col("text", "text"),
         C.EntityDetector(url=dead, output_col="o", **no_retry).set_col("text", "text"),
+        C.NER(url=dead, output_col="o", **no_retry).set_col("text", "text"),
         C.KeyPhraseExtractor(url=dead, output_col="o", **no_retry).set_col("text", "text"),
+        C.RecognizeText(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
         C.AnalyzeImage(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
         C.OCR(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
         C.RecognizeDomainSpecificContent(url=dead, output_col="o", **no_retry).set_col("image_url", "url"),
