@@ -40,7 +40,7 @@ from mmlspark_tpu.cognitive.face import (
 )
 from mmlspark_tpu.cognitive.anomaly import DetectAnomalies, DetectLastAnomaly
 from mmlspark_tpu.cognitive.speech import SpeechToText, SpeechToTextSDK
-from mmlspark_tpu.cognitive.search import AzureSearchWriter, BingImageSearch
+from mmlspark_tpu.cognitive.search import SearchIndex, AzureSearchWriter, BingImageSearch
 
 __all__ = [
     "CognitiveServiceBase",
@@ -68,4 +68,5 @@ __all__ = [
     "SpeechToTextSDK",
     "BingImageSearch",
     "AzureSearchWriter",
+    "SearchIndex",
 ]

@@ -95,6 +95,13 @@ class CognitiveServiceBase(Transformer, _HasServiceParams, HasOutputCol):
         r = self._build_request(vals)
         return [] if r is None else [r]
 
+    def _wrap_handler(self, handler_fn: Any) -> Any:
+        """Hook: wrap the per-request handler (runs INSIDE the thread
+        pool). Multi-step wire contracts (async operations that poll a
+        follow-up URL) compose here so their waiting overlaps across
+        rows; default identity."""
+        return handler_fn
+
     def _project_response(self, obj: Any) -> Any:
         """Parsed JSON -> output value; default: the typed record when a
         response schema is declared, else the raw dict."""
@@ -172,6 +179,7 @@ class CognitiveServiceBase(Transformer, _HasServiceParams, HasOutputCol):
             if self.get("use_advanced_handler")
             else BasicHandler(timeout=self.get("timeout"))
         )
+        handler_fn = self._wrap_handler(handler_fn)
         concurrency = self.get("concurrency")
         param_names = list(self.params())
 

@@ -126,3 +126,120 @@ class AzureSearchWriter:
                 )
             resps.append(resp)
         return resps
+
+
+# -- index management (AzureSearchAPI.scala:16-150) ---------------------------
+
+EDM_TYPES = (
+    "Edm.String", "Collection(Edm.String)", "Edm.Boolean", "Edm.Int32",
+    "Edm.Int64", "Edm.Double", "Edm.DateTimeOffset", "Edm.GeographyPoint",
+    "Edm.ComplexType",
+)
+
+
+class SearchIndex:
+    """Index lifecycle for the search sink (SearchIndex object in
+    AzureSearchAPI.scala: ``getExisting`` lists index names,
+    ``createIfNoneExists`` validates the index JSON field by field and
+    creates the index only when absent). ``url`` is the service endpoint
+    (the reference builds it from a service name; local mocks pass a full
+    URL)."""
+
+    DEFAULT_API_VERSION = "2019-05-06"
+
+    @staticmethod
+    def validate_index(index: dict) -> dict:
+        """Field-by-field validation (validIndexJson/validIndexField):
+        non-empty names, known EDM types, exactly one Edm.String key
+        field, and the searchable/sortable/facetable type constraints."""
+        if not index.get("name"):
+            raise ValueError("index needs a non-empty 'name'")
+        fields = index.get("fields") or []
+        if not fields:
+            raise ValueError("index needs at least one field")
+        keys = 0
+        for f in fields:
+            name = f.get("name")
+            if not name:
+                raise ValueError("every field needs a non-empty 'name'")
+            t = f.get("type")
+            if t not in EDM_TYPES:
+                raise ValueError(
+                    f"field {name!r}: unknown EDM type {t!r} "
+                    f"(expected one of {EDM_TYPES})"
+                )
+            if f.get("searchable") and t not in (
+                "Edm.String", "Collection(Edm.String)"
+            ):
+                raise ValueError(
+                    f"field {name!r}: only Edm.String and "
+                    "Collection(Edm.String) fields can be searchable"
+                )
+            if f.get("sortable") and t == "Collection(Edm.String)":
+                raise ValueError(
+                    f"field {name!r}: Collection(Edm.String) fields "
+                    "cannot be sortable"
+                )
+            if f.get("facetable") and t == "Edm.GeographyPoint":
+                raise ValueError(
+                    f"field {name!r}: Edm.GeographyPoint fields "
+                    "cannot be facetable"
+                )
+            if f.get("key"):
+                keys += 1
+                if t != "Edm.String":
+                    raise ValueError(
+                        f"field {name!r}: the key field must be Edm.String"
+                    )
+        if keys != 1:
+            raise ValueError(f"index needs exactly one key field, got {keys}")
+        return index
+
+    @classmethod
+    def get_existing(
+        cls, url: str, key: Optional[str] = None,
+        api_version: str = DEFAULT_API_VERSION, timeout: float = 30.0,
+    ) -> list:
+        headers = {"api-key": key} if key else {}
+        # same 429/5xx retry policy as the create POST below
+        resp = AdvancedHandler(timeout=timeout)(
+            HTTPRequestData(
+                url.rstrip("/")
+                + f"/indexes?api-version={api_version}&$select=name",
+                "GET", headers,
+            )
+        )
+        if resp["status_code"] // 100 != 2:
+            raise RuntimeError(
+                f"SearchIndex.get_existing: {resp['status_code']} {resp['reason']}"
+            )
+        body = json.loads(resp["entity"] or b"{}")
+        return [i.get("name") for i in body.get("value") or []]
+
+    @classmethod
+    def create_if_none_exists(
+        cls, url: str, index: Any, key: Optional[str] = None,
+        api_version: str = DEFAULT_API_VERSION, timeout: float = 30.0,
+    ) -> bool:
+        """Create the (validated) index when absent; returns True when a
+        create happened (createIfNoneExists asserts the 201 the same way)."""
+        if isinstance(index, str):
+            index = json.loads(index)
+        cls.validate_index(index)
+        if index["name"] in cls.get_existing(url, key, api_version, timeout):
+            return False
+        headers = {"Content-Type": "application/json"}
+        if key:
+            headers["api-key"] = key
+        resp = AdvancedHandler(timeout=timeout)(
+            HTTPRequestData(
+                url.rstrip("/") + f"/indexes?api-version={api_version}",
+                "POST", headers, json.dumps(index),
+            )
+        )
+        if resp["status_code"] != 201:
+            raise RuntimeError(
+                f"SearchIndex.create_if_none_exists: "
+                f"{resp['status_code']} {resp['reason']}"
+            )
+        return True

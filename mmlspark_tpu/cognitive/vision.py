@@ -92,10 +92,11 @@ class RecognizeText(_VisionBase):
     The service's wire contract is ASYNC: the POST answers 202 with an
     ``Operation-Location`` header, and the result is GET-polled from that
     URL until ``status`` leaves running/notStarted (the reference's
-    ``maxPollingRetries``/``pollingDelay`` handler loop). Polls reuse the
-    original request's resolved auth headers and the stage's configured
-    retry handler, and run inside ``_row_output_ctx`` so the base's thread
-    pool still fans rows out concurrently."""
+    ``maxPollingRetries``/``pollingDelay`` handler loop). The whole
+    POST-then-poll sequence runs inside the per-request handler (the
+    ``_wrap_handler`` hook), so rows poll CONCURRENTLY on the base's
+    thread pool and reuse the stage's configured retry handler and the
+    request's own resolved auth headers."""
 
     _path = "/vision/v2.0/recognizeText"
     _response_schema = S.RecognizeTextResponse
@@ -110,73 +111,64 @@ class RecognizeText(_VisionBase):
     def _query(self, vals: dict) -> str:
         return f"mode={vals.get('mode') or 'Printed'}"
 
-    def _row_output_ctx(self, resps: list, reqs: list) -> tuple:
+    def _wrap_handler(self, handler_fn: Any) -> Any:
         import time as _time
 
-        from mmlspark_tpu.io.clients import AdvancedHandler, BasicHandler
-        from mmlspark_tpu.io.http_schema import HTTPRequestData, response_to_json
+        from mmlspark_tpu.io.http_schema import (
+            HTTPRequestData,
+            HTTPResponseData,
+            response_to_json,
+        )
 
-        resp = resps[0] if resps else None
-        if resp is None:
-            return None, None
-        if resp["status_code"] not in (200, 202):
-            return None, {
-                "status_code": resp["status_code"],
-                "reason": resp["reason"],
-                "entity": resp["entity"],
-            }
-        op_url = next(
-            (v for k, v in (resp.get("headers") or {}).items()
-             if k.lower() == "operation-location"),
-            None,
-        )
-        if not op_url:
-            return None, {
-                "status_code": resp["status_code"],
-                "reason": "202 without Operation-Location header",
-            }
-        # the ORIGINAL request's resolved headers carry this row's auth
-        # (column-bound subscription keys included); drop the content type
-        headers = {
-            k: v for k, v in (reqs[0].get("headers") or {}).items()
-            if k.lower() != "content-type"
-        }
-        # same retry semantics as the initial POST (429/5xx backoff)
-        handler = (
-            AdvancedHandler(
-                backoffs_ms=self.get("backoffs_ms"),
-                timeout=self.get("timeout"),
+        retries = max(int(self.get("max_polling_retries")), 1)
+        delay_s = int(self.get("polling_delay_ms")) / 1000.0
+
+        def wrapped(req: dict) -> dict:
+            resp = handler_fn(req)
+            if resp is None or resp["status_code"] not in (200, 202):
+                return resp
+            op_url = next(
+                (v for k, v in (resp.get("headers") or {}).items()
+                 if k.lower() == "operation-location"),
+                None,
             )
-            if self.get("use_advanced_handler")
-            else BasicHandler(timeout=self.get("timeout"))
-        )
-        delay = int(self.get("polling_delay_ms"))
-        last = None
-        for _ in range(max(int(self.get("max_polling_retries")), 1)):
-            pr = handler(HTTPRequestData(op_url, "GET", headers))
-            if pr["status_code"] // 100 != 2:
-                return None, {
-                    "status_code": pr["status_code"],
-                    "reason": pr["reason"], "entity": pr["entity"],
-                }
-            try:
-                last = response_to_json(pr) or {}
-            except (ValueError, KeyError, TypeError) as e:
-                return None, {
-                    "status_code": pr["status_code"],
-                    "reason": f"poll parse error: {e}",
-                }
-            if str(last.get("status", "")).lower() not in (
-                "running", "notstarted", "not started", ""
-            ):
-                break
-            _time.sleep(delay / 1000.0)
-        if last is None or str(last.get("status", "")).lower() != "succeeded":
-            return None, {
-                "status_code": 200,
-                "reason": f"recognition did not succeed: {last and last.get('status')}",
+            if not op_url:
+                return HTTPResponseData(
+                    0,
+                    reason=(
+                        f"{resp['status_code']} without "
+                        "Operation-Location header"
+                    ),
+                )
+            # the ORIGINAL request's resolved headers carry this row's
+            # auth (column-bound subscription keys included)
+            headers = {
+                k: v for k, v in (req.get("headers") or {}).items()
+                if k.lower() != "content-type"
             }
-        return self._project_response(last), None
+            last_status = ""
+            for _ in range(retries):
+                pr = handler_fn(HTTPRequestData(op_url, "GET", headers))
+                if pr["status_code"] // 100 != 2:
+                    return pr
+                try:
+                    body = response_to_json(pr) or {}
+                except (ValueError, KeyError, TypeError) as e:
+                    return HTTPResponseData(0, reason=f"poll parse error: {e}")
+                last_status = str(body.get("status", "")).lower()
+                if last_status not in ("running", "notstarted", "not started", ""):
+                    if last_status != "succeeded":
+                        return HTTPResponseData(
+                            0,
+                            reason=f"recognition did not succeed: {body.get('status')}",
+                        )
+                    return pr  # the final response body IS the result
+                _time.sleep(delay_s)
+            return HTTPResponseData(
+                0, reason=f"polling exhausted (last status: {last_status!r})"
+            )
+
+        return wrapped
 
 
 class RecognizeDomainSpecificContent(_VisionBase):

@@ -44,9 +44,13 @@ class _Mock(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     poll_counts: dict = {}
+    created_indexes: list = []
 
     def do_GET(self):
-        if "/operations/" in self.path:
+        if "/indexes" in self.path:
+            self._send(200, {"value": [
+                {"name": n} for n in type(self).created_indexes]})
+        elif "/operations/" in self.path:
             # async recognizeText operation: 'running' once, then succeeded
             op = self.path.rsplit("/", 1)[1]
             n = type(self).poll_counts.get(op, 0) + 1
@@ -151,6 +155,10 @@ class _Mock(BaseHTTPRequestHandler):
             self._send(200, {"isIdentical": same, "confidence": 1.0 if same else 0.1})
         elif path.endswith("/v1") or "recognition" in path:
             self._send(200, {"RecognitionStatus": "Success", "DisplayText": "hello world"})
+        elif path.endswith("/indexes") or "/indexes?" in self.path:
+            body = json.loads(raw)
+            type(self).created_indexes.append(body["name"])
+            self._send(201, {"name": body["name"]})
         elif path.endswith("/docs/index"):
             docs = json.loads(raw)["value"]
             self._send(200, {"value": [
@@ -429,3 +437,51 @@ def test_ner_matches_entity_detector(svc):
     ents = list(out["ents"])
     assert ents[0].entities[0].text == "TPU"
     assert ents[0].entities[0].category == "Product"
+
+
+def test_search_index_lifecycle(svc):
+    """SearchIndex.createIfNoneExists semantics (AzureSearchAPI.scala:
+    42-105): field validation, create-when-absent, idempotent second call."""
+    from mmlspark_tpu.cognitive import SearchIndex
+
+    _Mock.created_indexes.clear()
+    idx = {
+        "name": "docs-1",
+        "fields": [
+            {"name": "id", "type": "Edm.String", "key": True},
+            {"name": "body", "type": "Edm.String", "searchable": True},
+            {"name": "rank", "type": "Edm.Int32"},
+        ],
+    }
+    assert SearchIndex.create_if_none_exists(svc, idx, key="k") is True
+    assert SearchIndex.get_existing(svc, key="k") == ["docs-1"]
+    # second call: already exists, no second create
+    assert SearchIndex.create_if_none_exists(svc, idx, key="k") is False
+    assert _Mock.created_indexes == ["docs-1"]
+
+
+def test_search_index_validation_rules():
+    """The reference's validIndexField constraints, verbatim."""
+    from mmlspark_tpu.cognitive import SearchIndex
+
+    base = {"name": "i", "fields": [
+        {"name": "id", "type": "Edm.String", "key": True}]}
+    SearchIndex.validate_index(dict(base))
+    with pytest.raises(ValueError, match="exactly one key"):
+        SearchIndex.validate_index(
+            {"name": "i", "fields": [{"name": "a", "type": "Edm.String"}]})
+    with pytest.raises(ValueError, match="unknown EDM type"):
+        SearchIndex.validate_index({"name": "i", "fields": [
+            {"name": "id", "type": "Edm.String", "key": True},
+            {"name": "x", "type": "Edm.Float"}]})
+    with pytest.raises(ValueError, match="searchable"):
+        SearchIndex.validate_index({"name": "i", "fields": [
+            {"name": "id", "type": "Edm.String", "key": True},
+            {"name": "n", "type": "Edm.Int32", "searchable": True}]})
+    with pytest.raises(ValueError, match="sortable"):
+        SearchIndex.validate_index({"name": "i", "fields": [
+            {"name": "id", "type": "Edm.String", "key": True},
+            {"name": "tags", "type": "Collection(Edm.String)", "sortable": True}]})
+    with pytest.raises(ValueError, match="key field must be Edm.String"):
+        SearchIndex.validate_index({"name": "i", "fields": [
+            {"name": "id", "type": "Edm.Int32", "key": True}]})
