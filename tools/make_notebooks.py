@@ -907,6 +907,74 @@ NOTEBOOKS = {
          "print(sentiments, scored.column_metadata('sentiment')['response_schema'])\n"
          "assert sentiments == ['positive', 'negative']\n"
          "srv.shutdown()"),
+        ("markdown",
+         "## Async services and the search sink\n\n"
+         "`RecognizeText` speaks the service's ASYNC wire contract (202 +\n"
+         "`Operation-Location`, then polling) with the polling riding the\n"
+         "transformer's request thread pool; `SearchIndex` validates and\n"
+         "creates indexes before `AzureSearchWriter` uploads documents."),
+        ("code",
+         "class AsyncMock(Mock):\n"
+         "    polls = {}\n"
+         "    indexes = []\n"
+         "    def do_POST(self):\n"
+         "        n = int(self.headers.get('Content-Length') or 0)\n"
+         "        raw = self.rfile.read(n)\n"
+         "        if '/recognizeText' in self.path:\n"
+         "            self.send_response(202)\n"
+         "            self.send_header('Operation-Location',\n"
+         "                f'http://{self.headers.get(\"Host\")}/operations/op1')\n"
+         "            self.send_header('Content-Length', '0')\n"
+         "            self.end_headers()\n"
+         "            return\n"
+         "        if '/indexes' in self.path and '/docs' not in self.path:\n"
+         "            type(self).indexes.append(json.loads(raw)['name'])\n"
+         "            body = json.dumps({'ok': True}).encode()\n"
+         "            self.send_response(201)\n"
+         "        else:\n"
+         "            docs = json.loads(raw)['value']\n"
+         "            body = json.dumps({'value': [\n"
+         "                {'key': str(i), 'status': True}\n"
+         "                for i in range(len(docs))]}).encode()\n"
+         "            self.send_response(200)\n"
+         "        self.send_header('Content-Length', str(len(body)))\n"
+         "        self.end_headers()\n"
+         "        self.wfile.write(body)\n"
+         "    def do_GET(self):\n"
+         "        if '/operations/' in self.path:\n"
+         "            n = type(self).polls.get('op1', 0) + 1\n"
+         "            type(self).polls['op1'] = n\n"
+         "            body = json.dumps({'status': 'Running'} if n < 2 else\n"
+         "                {'status': 'Succeeded', 'recognitionResult':\n"
+         "                 {'lines': [{'text': 'printed text'}]}}).encode()\n"
+         "        else:\n"
+         "            body = json.dumps({'value': [\n"
+         "                {'name': x} for x in type(self).indexes]}).encode()\n"
+         "        self.send_response(200)\n"
+         "        self.send_header('Content-Length', str(len(body)))\n"
+         "        self.end_headers()\n"
+         "        self.wfile.write(body)\n\n"
+         "asrv = ThreadingHTTPServer(('127.0.0.1', 0), AsyncMock)\n"
+         "threading.Thread(target=asrv.serve_forever, daemon=True).start()\n"
+         "aurl = f'http://127.0.0.1:{asrv.server_port}'"),
+        ("code",
+         "from mmlspark_tpu.cognitive import (AzureSearchWriter, RecognizeText,\n"
+         "                                    SearchIndex)\n\n"
+         "imgs = DataFrame.from_dict({'img': np.array(\n"
+         "    ['http://x/a.png'], dtype=object)})\n"
+         "rt = RecognizeText(url=aurl, output_col='rt', polling_delay_ms=20\n"
+         "                   ).set_col('image_url', 'img').transform(imgs)\n"
+         "rec = rt['rt'][0]\n"
+         "print(rec.status, '->', rec.recognitionResult.lines[0].text)\n"
+         "assert rec.recognitionResult.lines[0].text == 'printed text'\n\n"
+         "SearchIndex.create_if_none_exists(aurl, {'name': 'notes', 'fields': [\n"
+         "    {'name': 'id', 'type': 'Edm.String', 'key': True},\n"
+         "    {'name': 'body', 'type': 'Edm.String', 'searchable': True}]})\n"
+         "AzureSearchWriter.write(DataFrame.from_dict({\n"
+         "    'id': np.array(['1'], dtype=object),\n"
+         "    'body': np.array(['printed text'], dtype=object)}), aurl, 'notes')\n"
+         "print('indexed into', SearchIndex.get_existing(aurl))\n"
+         "asrv.shutdown()"),
     ],
     # zoo import flow: externally trained torchvision weights
     "DeepLearning - Importing Torch Checkpoints.ipynb": [
