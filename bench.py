@@ -57,7 +57,7 @@ SEGMENT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_SEGMENT_TIMEOUT", "200"))
 # + the 63-bin variant; the ResNet trace): give their watchdogs more rope.
 # A raised MMLSPARK_BENCH_SEGMENT_TIMEOUT still wins (max() at use); the
 # phase deadline caps everything regardless.
-SEGMENT_TIMEOUTS = {"sklearn": 300, "featurizer": 280}
+SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280}
 
 # Cheap + CPU-startable first, headline throughput last, so a late hang
 # can only cost the segments not yet reached.
@@ -216,6 +216,25 @@ def _seg_gbdt(on_accel: bool, n_dev: int) -> dict:
             train(x, y, cfg)
             best = min(best, time.perf_counter() - t0)
         out[key] = round(reps / best, 2)
+    if on_accel:
+        # attribution: the same lossguide run with the data-partitioned
+        # grower forced OFF (the pre-round-5 masked full-pass path), so the
+        # partition win is visible inside one bench line
+        import os as _os
+
+        _os.environ["MMLSPARK_TPU_GBDT_PARTITION"] = "0"
+        try:
+            cfg = TrainConfig(objective="binary", num_iterations=reps,
+                              num_leaves=63, min_data_in_leaf=20, seed=0)
+            _retry(lambda: train(x, y, cfg), "gbdt masked compile")
+            best = np.inf
+            for _ in range(2):
+                t0 = time.perf_counter()
+                train(x, y, cfg)
+                best = min(best, time.perf_counter() - t0)
+            out["gbdt_masked_trees_per_sec"] = round(reps / best, 2)
+        finally:
+            _os.environ.pop("MMLSPARK_TPU_GBDT_PARTITION", None)
     return out
 
 
