@@ -14,7 +14,9 @@ clock (round 4 lost EVERY metric to one 1200 s hang). So this harness is
 **incremental and un-killable**:
 
 - the child process emits one JSON line PER SEGMENT as it completes
-  (cheap, CPU-startable segments first; the headline featurizer last);
+  (the TPU attempt orders segments by evidence value — the
+  GBDT-vs-sklearn head-to-head first, serving's relay-floor RPC number
+  last; the CPU fallback runs cheap-first — see TPU_ORDER/CPU_ORDER);
 - the parent harvests lines with per-segment watchdog timeouts, kills a
   hung child, and re-runs only the MISSING segments (one TPU retry, then
   a clean-CPU fallback child) — completed metrics are never lost;
@@ -59,9 +61,17 @@ SEGMENT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_SEGMENT_TIMEOUT", "200"))
 # phase deadline caps everything regardless.
 SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280}
 
-# Cheap + CPU-startable first, headline throughput last, so a late hang
-# can only cost the segments not yet reached.
+# Canonical segment set. Two orders, learned the hard way:
+# - On the TPU attempt, spend the chip's uncertain lifetime on the
+#   metrics that NEED the chip, most valuable first: the GBDT-vs-sklearn
+#   head-to-head (the round's gate), the kernel microbench, the headline
+#   featurizer. serving goes last — its chip-specific number is the
+#   relay's RPC floor, while its real claims (local + gateway p50) come
+#   out of the CPU child identically.
+# - On the CPU fallback, cheap-first so a late death costs least.
 SEGMENTS = ["serving", "hist", "vw", "gbdt", "sklearn", "featurizer"]
+TPU_ORDER = ["sklearn", "gbdt", "hist", "featurizer", "vw", "serving"]
+CPU_ORDER = SEGMENTS
 
 
 def _retry(fn, what: str, tries: int = 3, base_sleep: float = 10.0):
@@ -727,21 +737,25 @@ class _Assembly:
 
 
 def _harvest(child: _Child, asm: _Assembly, remaining: list,
-             deadline: float, on_cpu: bool) -> None:
+             deadline: float, on_cpu: bool, order: list) -> bool:
     """Drain records from a child until done/EOF/hang/deadline; removes
-    completed segments from ``remaining`` in place."""
+    completed segments from ``remaining`` in place. Returns True if the
+    child engaged the backend (emitted its init line) AND had to be
+    killed while still running — the case that strands the chip claim
+    (a killed client never runs the PJRT release handshake; a child that
+    exited on its own released the claim at interpreter teardown)."""
     saw_line = False
     failed_here: set = set()
     while remaining:
         budget = deadline - time.monotonic()
         if budget <= 0:
             break
-        # the child runs segments in SEGMENTS order; a FAILED segment
-        # stays in `remaining` but the child has moved past it, so the
-        # next record is the first remaining segment not failed this
-        # attempt — that segment's own watchdog applies
+        # the child runs segments in ``order``; a FAILED segment stays in
+        # `remaining` but the child has moved past it, so the next record
+        # is the first remaining segment not failed this attempt — that
+        # segment's own watchdog applies
         nxt = next(
-            (s for s in SEGMENTS if s in remaining and s not in failed_here),
+            (s for s in order if s in remaining and s not in failed_here),
             None,
         )
         seg_timeout = max(SEGMENT_TIMEOUT_S, SEGMENT_TIMEOUTS.get(nxt, 0))
@@ -758,7 +772,9 @@ def _harvest(child: _Child, asm: _Assembly, remaining: list,
             failed_here.add(rec["segment"])
         if seg == "done":
             break
+    was_running = child.proc.poll() is None
     child.kill()
+    return saw_line and was_running
 
 
 def main() -> None:
@@ -781,7 +797,7 @@ def main() -> None:
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
 
-    remaining = list(SEGMENTS)
+    remaining = [s for s in TPU_ORDER]
     tpu_deadline = start + TOTAL_TPU_BUDGET_S
     attempt = 0
     while (remaining and time.monotonic() < tpu_deadline - 30
@@ -792,7 +808,8 @@ def main() -> None:
         child = _Child(remaining, env)
         live_child[:] = [child]
         before = set(remaining)
-        _harvest(child, asm, remaining, tpu_deadline, on_cpu=False)
+        engaged = _harvest(child, asm, remaining, tpu_deadline,
+                           on_cpu=False, order=TPU_ORDER)
         live_child[:] = []
         if not remaining:
             break
@@ -805,7 +822,21 @@ def main() -> None:
         )
         if "backend is cpu" in err:
             break  # deterministic plugin absence — go straight to fallback
+        if engaged:
+            # the child held the chip claim and was KILLED mid-flight (a
+            # killed client never runs the PJRT release handshake); the
+            # relay frees the stranded claim only after minutes, so a
+            # second attempt would hang at init and burn the whole budget
+            # (observed: 6.5 min init hang right after a kill). Salvage
+            # the rest on CPU instead. A child that exited by itself
+            # released the claim cleanly — those keep their retry.
+            sys.stderr.write(
+                "bench: chip claim was engaged and the child was killed; "
+                "skipping TPU retry (claim-release latency)\n"
+            )
+            break
     if remaining:
+        remaining = [s for s in CPU_ORDER if s in remaining]
         sys.stderr.write(
             f"bench: CPU fallback for segments: {remaining}\n"
         )
@@ -816,7 +847,8 @@ def main() -> None:
         child = _Child(remaining, env)
         live_child[:] = [child]
         _harvest(child, asm, remaining,
-                 time.monotonic() + CPU_BUDGET_S, on_cpu=True)
+                 time.monotonic() + CPU_BUDGET_S, on_cpu=True,
+                 order=CPU_ORDER)
         live_child[:] = []
         if remaining:
             sys.stderr.write(
