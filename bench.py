@@ -551,6 +551,10 @@ def run_child() -> None:
         sys.stdout.write(json.dumps({"segment": seg, "data": data}) + "\n")
         sys.stdout.flush()
 
+    # pre-init marker: from here on the child may be holding (or queued
+    # for) the chip claim, so a kill is no longer known-safe — the parent
+    # treats any emitted line + kill as claim-stranding (no TPU retry)
+    emit("starting", {})
     devices = _retry(jax.devices, "backend init", tries=2, base_sleep=15.0)
     platform = devices[0].platform
     n_dev = len(devices)
@@ -740,10 +744,12 @@ def _harvest(child: _Child, asm: _Assembly, remaining: list,
              deadline: float, on_cpu: bool, order: list) -> bool:
     """Drain records from a child until done/EOF/hang/deadline; removes
     completed segments from ``remaining`` in place. Returns True if the
-    child engaged the backend (emitted its init line) AND had to be
-    killed while still running — the case that strands the chip claim
-    (a killed client never runs the PJRT release handshake; a child that
-    exited on its own released the claim at interpreter teardown)."""
+    child may have engaged the backend (emitted any line — the child
+    prints "starting" right before backend init, so even a kill during a
+    hung init counts) AND had to be killed while still running — the
+    case that strands the chip claim (a killed client never runs the
+    PJRT release handshake; a child that exited on its own, including
+    after "done", released the claim at interpreter teardown)."""
     saw_line = False
     failed_here: set = set()
     while remaining:
@@ -771,6 +777,13 @@ def _harvest(child: _Child, asm: _Assembly, remaining: list,
         elif seg == "" and rec.get("segment") in remaining:
             failed_here.add(rec["segment"])
         if seg == "done":
+            # give the child its natural exit: killing it mid-teardown
+            # would skip the very PJRT release handshake the engaged
+            # guard protects, and a clean "done" exit must keep its retry
+            try:
+                child.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
             break
     was_running = child.proc.poll() is None
     child.kill()

@@ -92,7 +92,7 @@ def test_child_streams_segment_lines():
     assert p.returncode == 0, p.stderr[-1500:]
     recs = [json.loads(ln) for ln in p.stdout.splitlines() if ln.startswith("{")]
     segs = [r["segment"] for r in recs]
-    assert segs == ["init", "serving", "done"]
-    serving = recs[1]["data"]
+    assert segs == ["starting", "init", "serving", "done"]
+    serving = recs[2]["data"]
     assert "serving_p50_ms" in serving
     assert "serving_gateway_p50_ms" in serving  # the gateway-overhead budget

@@ -15,6 +15,8 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
 WORKER = textwrap.dedent(
     """
     import os, sys
@@ -53,6 +55,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.xdist_group("multiproc")
 def test_two_process_rendezvous_and_reduction(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
@@ -78,7 +81,7 @@ def test_two_process_rendezvous_and_reduction(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=150)
+            out, err = p.communicate(timeout=420)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:  # a hung rendezvous must not orphan workers
@@ -225,6 +228,7 @@ GBDT_WORKER = textwrap.dedent(
 )
 
 
+@pytest.mark.xdist_group("multiproc")
 def test_two_process_gbdt_training(tmp_path):
     """Distributed GBDT across a real process boundary: both processes grow
     IDENTICAL trees from their own data halves (SPMD histogram allreduce
@@ -254,7 +258,7 @@ def test_two_process_gbdt_training(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=300)
+            out, err = p.communicate(timeout=600)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
@@ -336,6 +340,7 @@ VW_WORKER = textwrap.dedent(
 )
 
 
+@pytest.mark.xdist_group("multiproc")
 def test_two_process_vw_training(tmp_path):
     """Online learning across a real process boundary: the per-pass weight
     pmean crosses processes, and the model trained on split halves scores
@@ -364,7 +369,7 @@ def test_two_process_vw_training(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=300)
+            out, err = p.communicate(timeout=600)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:

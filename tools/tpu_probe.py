@@ -1,0 +1,87 @@
+"""Bounded TPU availability probe.
+
+Checks whether the axon-tunneled chip will initialize within a budget.
+Exits CLEANLY (interpreter teardown -> PJRT client release handshake)
+whenever init succeeds or fails fast. When init hangs inside the native
+PJRT/gRPC call, NOTHING can unwind it — a Python-level SIGALRM handler
+only runs between bytecodes, so the in-process alarm never fires while
+the C call blocks. For that case a daemon thread hard-exits the process
+at budget + 10 s so no external SIGKILL is needed; the claim (if one was
+queued) is stranded either way — that outcome is inherent to a hung
+init, not a probe defect. Callers should rely on the probe's own exit
+and never kill it externally.
+
+    python tools/tpu_probe.py [budget_seconds=120]
+
+Prints one JSON line {"ok": bool, "init_s": float | null, "error": str}.
+Exit codes: 0 = chip usable, 1 = init failed fast, 2 = init hung.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+
+def main() -> int:
+    budget = int(sys.argv[1]) if len(sys.argv) > 1 else 120
+
+    def _hard_exit() -> None:
+        # last resort for an init hung in native code: report, then exit
+        # without teardown (teardown would block on the same hung client)
+        print(json.dumps({
+            "ok": False, "init_s": None,
+            "error": f"backend init still blocked at {budget + 10}s; "
+                     "hard exit (claim may be stranded upstream)",
+        }), flush=True)
+        os._exit(2)
+
+    watchdog = threading.Timer(budget + 10, _hard_exit)
+    watchdog.daemon = True
+    watchdog.start()
+
+    class _Timeout(Exception):
+        pass
+
+    def _raise(signum, frame):
+        raise _Timeout(f"no backend init within {budget}s")
+
+    # the alarm catches the slow-but-interpretable case (init returns to
+    # Python between retries); the watchdog thread catches the hard hang
+    signal.signal(signal.SIGALRM, _raise)
+    signal.alarm(budget)
+    t0 = time.time()
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        devs = jax.devices()
+        # one tiny dispatch proves the claim is usable, not just granted
+        float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
+        signal.alarm(0)
+        watchdog.cancel()
+        print(json.dumps({
+            "ok": True,
+            "init_s": round(time.time() - t0, 1),
+            "devices": [str(d) for d in devs],
+        }))
+        return 0
+    except _Timeout as e:
+        signal.alarm(0)
+        watchdog.cancel()
+        print(json.dumps({"ok": False, "init_s": None, "error": str(e)}))
+        return 1
+    except Exception as e:  # noqa: BLE001 — report, never crash
+        signal.alarm(0)
+        watchdog.cancel()
+        print(json.dumps({"ok": False, "init_s": None,
+                          "error": str(e)[:300]}))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
