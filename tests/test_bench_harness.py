@@ -96,3 +96,79 @@ def test_child_streams_segment_lines():
     serving = recs[2]["data"]
     assert "serving_p50_ms" in serving
     assert "serving_gateway_p50_ms" in serving  # the gateway-overhead budget
+
+class _FakeProc:
+    def __init__(self, running: bool):
+        self._running = running
+
+    def poll(self):
+        return None if self._running else 0
+
+    def wait(self, timeout=None):
+        if self._running:
+            raise subprocess.TimeoutExpired("fake", timeout)
+        return 0
+
+
+class _FakeChild:
+    """Replays scripted records; None = watchdog timeout/EOF. ``running``
+    is the proc state _harvest sees when deciding the engaged guard."""
+
+    def __init__(self, records, running_at_end: bool):
+        self._records = list(records)
+        self.proc = _FakeProc(running_at_end)
+        self.killed = False
+
+    def next_record(self, timeout_s):
+        if self._records:
+            return self._records.pop(0)
+        return None
+
+    def kill(self):
+        self.killed = True
+        self.proc._running = False
+
+
+def test_harvest_killed_midflight_reports_engaged(tmp_path, monkeypatch):
+    """A child that emitted lines and was killed while running strands the
+    chip claim -> _harvest returns True and main() skips the TPU retry."""
+    import time as _time
+
+    b = _load_bench()
+    monkeypatch.setattr(b, "PARTIAL_PATH", str(tmp_path / "p.json"))
+    asm = b._Assembly()
+    child = _FakeChild(
+        [{"segment": "starting", "data": {}},
+         {"segment": "init", "data": {"platform": "tpu", "n_dev": 1}}],
+        running_at_end=True,  # hung mid-segment, parent kills it
+    )
+    remaining = list(b.TPU_ORDER)
+    engaged = b._harvest(child, asm, remaining,
+                         _time.monotonic() + 60, False, b.TPU_ORDER)
+    assert engaged is True
+    assert child.killed
+    assert remaining == list(b.TPU_ORDER)  # nothing completed
+
+
+def test_harvest_clean_exit_keeps_retry(tmp_path, monkeypatch):
+    """A child that ran to 'done' (with one failed segment) and exited on
+    its own released the claim -> returns False, the TPU retry stays."""
+    import time as _time
+
+    b = _load_bench()
+    monkeypatch.setattr(b, "PARTIAL_PATH", str(tmp_path / "p.json"))
+    asm = b._Assembly()
+    recs = [{"segment": "starting", "data": {}},
+            {"segment": "init", "data": {"platform": "tpu", "n_dev": 1}}]
+    for seg in b.TPU_ORDER:
+        if seg == "gbdt":  # one transient failure: stays in remaining
+            recs.append({"segment": seg, "data": {"gbdt_error": "flap"}})
+        else:
+            recs.append({"segment": seg, "data": {f"{seg}_x": 1.0}})
+    recs.append({"segment": "done", "data": {}})
+    child = _FakeChild(recs, running_at_end=False)  # exits cleanly
+    remaining = list(b.TPU_ORDER)
+    engaged = b._harvest(child, asm, remaining,
+                         _time.monotonic() + 60, False, b.TPU_ORDER)
+    assert engaged is False
+    assert remaining == ["gbdt"]  # only the failed segment is left
