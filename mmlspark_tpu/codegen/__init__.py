@@ -271,6 +271,32 @@ def generate_r_package(out_dir: str, manifest: Optional[dict] = None) -> list:
         "  else core$DataFrame$from_dict(columns, num_partitions = as.integer(num_partitions))",
         "}",
         "",
+        "#' Transform a DataFrame with a (fitted) stage",
+        "#' (src/main/R/ml_utils.R sdf_transform analogue)",
+        "#' @export",
+        "mt_transform <- function(stage, df, ...) {",
+        "  stage$transform(df, ...)",
+        "}",
+        "",
+        "#' Fit an estimator on a DataFrame, returning the fitted model",
+        "#' (src/main/R/ml_utils.R sdf_fit analogue)",
+        "#' @export",
+        "mt_fit <- function(estimator, df, ...) {",
+        "  estimator$fit(df, ...)",
+        "}",
+        "",
+        "#' Model zoo downloader (src/main/R/model_downloader.R",
+        "#' smd_model_downloader analogue). Without server_url: the local",
+        "#' repo client ($list_models(), $download_by_name(name)); with",
+        "#' server_url: a RemoteRepository syncing into that local repo.",
+        "#' @export",
+        "mt_model_downloader <- function(local_path, server_url = NULL) {",
+        '  d <- reticulate::import("mmlspark_tpu.downloader")',
+        "  local <- d$ModelDownloader(local_path)",
+        "  if (is.null(server_url)) local",
+        "  else d$RemoteRepository(server_url, local)",
+        "}",
+        "",
     ]
     with open(os.path.join(out_dir, "R", "core.R"), "w") as f:
         f.write("\n".join(core))
