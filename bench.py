@@ -744,12 +744,12 @@ def _harvest(child: _Child, asm: _Assembly, remaining: list,
              deadline: float, on_cpu: bool, order: list) -> bool:
     """Drain records from a child until done/EOF/hang/deadline; removes
     completed segments from ``remaining`` in place. Returns True if the
-    child may have engaged the backend (emitted any line — the child
-    prints "starting" right before backend init, so even a kill during a
-    hung init counts) AND had to be killed while still running — the
-    case that strands the chip claim (a killed client never runs the
-    PJRT release handshake; a child that exited on its own, including
-    after "done", released the claim at interpreter teardown)."""
+    child had to be killed while still running — the case that can
+    strand the chip claim (a killed client never runs the PJRT release
+    handshake, and even a pre-init kill may orphan a queued claim); a
+    child that exited on its own, including after "done" or a fail-fast
+    error, released its claim at interpreter teardown and keeps the
+    retry."""
     saw_line = False
     failed_here: set = set()
     while remaining:
@@ -787,7 +787,7 @@ def _harvest(child: _Child, asm: _Assembly, remaining: list,
             break
     was_running = child.proc.poll() is None
     child.kill()
-    return saw_line and was_running
+    return was_running
 
 
 def main() -> None:

@@ -130,8 +130,10 @@ class _FakeChild:
 
 
 def test_harvest_killed_midflight_reports_engaged(tmp_path, monkeypatch):
-    """A child that emitted lines and was killed while running strands the
-    chip claim -> _harvest returns True and main() skips the TPU retry."""
+    """A child killed while running strands the chip claim -> _harvest
+    returns True and main() skips the TPU retry — whether or not it got
+    as far as emitting lines (a pre-init kill can orphan a queued
+    claim)."""
     import time as _time
 
     b = _load_bench()
@@ -148,6 +150,10 @@ def test_harvest_killed_midflight_reports_engaged(tmp_path, monkeypatch):
     assert engaged is True
     assert child.killed
     assert remaining == list(b.TPU_ORDER)  # nothing completed
+    # the pre-line variant: hung before any output, killed -> still engaged
+    silent = _FakeChild([], running_at_end=True)
+    assert b._harvest(silent, asm, list(b.TPU_ORDER),
+                      _time.monotonic() + 60, False, b.TPU_ORDER) is True
 
 
 def test_harvest_clean_exit_keeps_retry(tmp_path, monkeypatch):
