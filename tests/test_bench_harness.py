@@ -178,3 +178,13 @@ def test_harvest_clean_exit_keeps_retry(tmp_path, monkeypatch):
                          _time.monotonic() + 60, False, b.TPU_ORDER)
     assert engaged is False
     assert remaining == ["gbdt"]  # only the failed segment is left
+
+
+def test_segment_orders_cover_all_segments():
+    """TPU_ORDER and CPU_ORDER must each be a permutation of SEGMENTS —
+    a segment missing from either order would silently never run on
+    that attempt."""
+    b = _load_bench()
+    assert sorted(b.TPU_ORDER) == sorted(b.SEGMENTS)
+    assert sorted(b.CPU_ORDER) == sorted(b.SEGMENTS)
+    assert set(b.SEGMENTS) == set(b.SEGMENT_FNS)
