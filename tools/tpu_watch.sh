@@ -1,0 +1,25 @@
+#!/bin/bash
+# Probe the chip every ~10 min; on success capture the full bench +
+# validation as builder evidence, then exit 0. Exit 1 after ~2h of
+# failed probes. All chip users exit cleanly (probe self-bounds; the
+# bench parent traps SIGTERM) — nothing here SIGKILLs a chip holder.
+cd /root/repo
+for i in $(seq 1 12); do
+  echo "[watch] probe $i $(date +%T)"
+  python tools/tpu_probe.py 240 > /tmp/probe_last.json 2>&1
+  if grep -q '"ok": true' /tmp/probe_last.json; then
+    echo "[watch] CHIP UP $(date +%T)"; cat /tmp/probe_last.json
+    rm -f bench_partial.json
+    timeout 2400 python bench.py > /tmp/bench_tpu_r05.json 2>/tmp/bench_tpu_r05.err
+    echo "[watch] bench rc=$? $(date +%T)"
+    tail -c 400 /tmp/bench_tpu_r05.json
+    PYTHONPATH=/root/repo:/root/.axon_site timeout 580 python tools/tpu_validation.py \
+      > /tmp/tpu_validation_r05b.json 2>&1
+    echo "[watch] validation rc=$? $(date +%T)"
+    exit 0
+  fi
+  tail -1 /tmp/probe_last.json
+  sleep 600
+done
+echo "[watch] no chip after $i probes"
+exit 1
