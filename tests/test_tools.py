@@ -1,0 +1,31 @@
+"""Ops-tool contracts (tools/): the probe must emit one parseable JSON
+line and exit 0 on a healthy backend — the watch loop and the round
+driver both branch on that line."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tpu_probe_healthy_backend():
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("PYTHONPATH", "PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
+                     "XLA_FLAGS")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpu_probe.py"), "60"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr[-1000:]
+    line = [l for l in p.stdout.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["ok"] is True
+    assert rec["init_s"] is not None
+    assert rec["devices"]
