@@ -47,6 +47,32 @@ _DF = int(os.environ.get("MMLSPARK_TPU_HIST_DF", "8"))
 _NC = int(os.environ.get("MMLSPARK_TPU_HIST_NC", "512"))
 
 
+def _tpu_compiler_params():
+    """Mosaic scoped-VMEM ceiling for the histogram kernels.
+
+    The default 16 MB limit is too tight for the multi-plane kernel's
+    resident set (one-hot block + packed accumulator: ~16.1 MB at
+    DF=32, B=256, 32 slots — observed as a compile-time scoped-vmem OOM
+    at d=64 on v5e). The chip has 128 MB of VMEM; raise the ceiling so
+    legal block choices aren't rejected 128 KB over the default bound.
+    """
+    if jax.default_backend() != "tpu":
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+
+    # jax renamed TPUCompilerParams -> CompilerParams (0.6); accept both
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cls is None:
+        return None
+    return cls(vmem_limit_bytes=_hist_vmem_mb() << 20)
+
+
+def _hist_vmem_mb() -> int:
+    return int(os.environ.get("MMLSPARK_TPU_HIST_VMEM_MB", "96"))
+
+
 def _pallas_enabled() -> bool:
     """Is the Pallas lowering wanted at all (any device layout)?"""
     env = os.environ.get("MMLSPARK_TPU_PALLAS")
@@ -218,6 +244,7 @@ def _plane_histogram_pallas(
             out_specs=pl.BlockSpec((df * bh, bl * 6), lambda f, r: (f, 0)),
             out_shape=jax.ShapeDtypeStruct((d_pad * bh, bl * 6), jnp.float32),
             interpret=jax.default_backend() == "cpu",
+            compiler_params=_tpu_compiler_params(),
         )(bins.T.astype(jnp.int32), stats.astype(jnp.float32))
         un = packed.reshape(d_pad, bh, bl, 6)
         out = (un[..., :3] + un[..., 3:]).reshape(d_pad * b, 3)
@@ -233,6 +260,7 @@ def _plane_histogram_pallas(
         out_specs=pl.BlockSpec((df * b, 3), lambda f, r: (f, 0)),
         out_shape=jax.ShapeDtypeStruct((d_pad * b, 3), jnp.float32),
         interpret=jax.default_backend() == "cpu",
+        compiler_params=_tpu_compiler_params(),
     )(bins.T.astype(jnp.int32), stats.astype(jnp.float32))
     return out[: d * b]
 
@@ -274,17 +302,31 @@ def _multi_kernel(
     )
 
 
-def _multi_df(num_slots: int, num_bins: int, d: int = 1 << 30) -> int:
-    """Feature block for the multi-plane kernel: as large as the packed
-    (DF*B, S*6) f32 output block allows within a ~8 MB VMEM budget
-    (bigger blocks amortize the slot-mask rhs; measured +11% at S=32),
-    but never wider than the feature count needs (padding a d=4 input to
-    a 32-wide block would 4x the one-hot work on sentinel rows)."""
-    budget = 8 << 20
+def _multi_resident_bytes(df: int, num_slots: int, num_bins: int) -> int:
+    """Estimated VMEM-resident set of one multi-kernel grid step: the
+    bf16 one-hot block (DF*B*NC) plus the packed f32 accumulator and
+    its dot_general result (2 x DF*B*S*6) — these dominate; row-chunk
+    inputs and the slot-mask rhs are < 1 MB."""
+    return df * num_bins * (_NC * 2 + num_slots * 6 * 4 * 2)
+
+
+def _multi_df(num_slots: int, num_bins: int, d: int = 1 << 30) -> int | None:
+    """Feature block for the multi-plane kernel: as large as the
+    kernel's VMEM-resident set allows (bigger blocks amortize the
+    slot-mask rhs; measured +11% at S=32), but never wider than the
+    feature count needs (padding a d=4 input to a 32-wide block would
+    4x the one-hot work on sentinel rows).
+
+    The budget is 2/3 of the Mosaic ceiling :func:`_tpu_compiler_params`
+    sets (same env knob), leaving headroom for double-buffered input DMA
+    and Mosaic's own scratch. Returns ``None`` when not even the
+    smallest block fits — the caller must use the scatter lowering
+    (e.g. thousands of slots at 256 bins)."""
+    budget = _hist_vmem_mb() * 2 // 3 << 20
     d_need = max(8, ((d + 7) // 8) * 8)
     best = None
-    for df in sorted({32, 16, _DF}, reverse=True):
-        if df * num_bins * num_slots * 6 * 4 > budget:
+    for df in sorted({32, 16, 8, _DF}, reverse=True):
+        if _multi_resident_bytes(df, num_slots, num_bins) > budget:
             continue
         # compare resulting PADDED widths: a wider block that pads to the
         # same width does the same one-hot work in fewer grid steps (fewer
@@ -292,12 +334,12 @@ def _multi_df(num_slots: int, num_bins: int, d: int = 1 << 30) -> int:
         pad_w = ((d_need + df - 1) // df) * df
         if best is None or pad_w < best[0] or (pad_w == best[0] and df > best[1]):
             best = (pad_w, df)
-    return best[1] if best else 8
+    return best[1] if best else None
 
 
 def _multi_plane_pallas(
     bins: jnp.ndarray, stats: jnp.ndarray, slot: jnp.ndarray, num_slots: int,
-    num_bins: int = NUM_BINS,
+    num_bins: int = NUM_BINS, df: int | None = None,
 ) -> jnp.ndarray:
     import functools as _ft
 
@@ -305,7 +347,8 @@ def _multi_plane_pallas(
 
     n, d = bins.shape
     b = num_bins
-    _df_m = _multi_df(num_slots, b, d)
+    _df_m = df if df is not None else _multi_df(num_slots, b, d)
+    assert _df_m is not None, "no feature block fits VMEM; use scatter"
     d_pad = ((d + _df_m - 1) // _df_m) * _df_m
     n_pad = ((n + _NC - 1) // _NC) * _NC
     sentinel = b
@@ -327,6 +370,7 @@ def _multi_plane_pallas(
         out_specs=pl.BlockSpec((_df_m * b, num_slots * 6), lambda f, r: (f, 0)),
         out_shape=jax.ShapeDtypeStruct((d_pad * b, num_slots * 6), jnp.float32),
         interpret=jax.default_backend() == "cpu",
+        compiler_params=_tpu_compiler_params(),
     )(
         bins.T.astype(jnp.int32),
         stats.astype(jnp.float32),
@@ -375,14 +419,21 @@ def multi_plane_histogram(
     the depthwise grower's workhorse: one row pass per LEVEL instead of
     one per leaf, with the bin one-hot (the VPU-bound part) amortized
     across all the level's leaves. ``mesh``/``shard_axis`` as in
-    :func:`plane_histogram` (per-shard kernel + psum of the cube)."""
-    if _rows_sharded(mesh, shard_axis) and _pallas_enabled():
+    :func:`plane_histogram` (per-shard kernel + psum of the cube).
+
+    When the slot count is so large that no feature block fits the
+    kernel's VMEM budget (thousands of planes at 256 bins — see
+    :func:`_multi_df`), the scatter lowering is used regardless of
+    backend: slower, but it compiles instead of tripping Mosaic's
+    scoped-VMEM ceiling."""
+    df_fit = _multi_df(num_slots, num_bins, bins.shape[1])
+    if df_fit is not None and _rows_sharded(mesh, shard_axis) and _pallas_enabled():
         from jax.sharding import PartitionSpec as P
 
         def local(b, s, sl):
             cube = _multi_plane_pallas(
                 b.astype(jnp.int32), s, sl.astype(jnp.int32), num_slots,
-                num_bins,
+                num_bins, df=df_fit,
             )
             return jax.lax.psum(cube, shard_axis)
 
@@ -393,11 +444,13 @@ def multi_plane_histogram(
             out_specs=P(),
             check_vma=False,
         )(bins, stats, slot)
-    if use_pallas():
+    if df_fit is not None and use_pallas():
         return _multi_plane_pallas(
             bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots,
-            num_bins,
+            num_bins, df=df_fit,
         )
+    # scatter path; under a sharded trace GSPMD partitions the scatter
+    # and inserts the allreduce automatically
     return _multi_plane_scatter(
         bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots,
         num_bins,

@@ -206,3 +206,52 @@ def test_shard_map_plane_psum_in_hlo(devices8, monkeypatch):
         )
     )
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-3)
+
+
+def test_multi_df_vmem_accounting(monkeypatch):
+    """The multi-plane feature-block pick must count the kernel's full
+    VMEM-resident set (bf16 one-hot block + packed f32 accumulator pair),
+    not just the output block — the output-only budget chose DF=32 at
+    d=64/S=32 whose real resident set (~16.1 MB) tripped Mosaic's default
+    16 MB scoped-vmem ceiling on v5e (observed compile failure, BENCH r5).
+
+    Expected values below are hand-computed, NOT re-derived through the
+    implementation's formula: at NC=512, B=256 the per-DF resident set is
+    DF*256*(512*2 + S*48) bytes = DF * (256 KiB + S * 12 KiB)."""
+    if (H._NC, H._DF) != (512, 8):
+        pytest.skip("hand-computed table assumes default NC/DF tiles")
+    # default ceiling 96 MB -> budget 64 MB:
+    #   S=32:  DF=32 -> 32*(0.25+0.375)MiB*32 = 20 MiB  -> fits, picked
+    assert H._multi_df(32, 256, 64) == 32
+    #   S=256: DF=32 -> 32*(0.25+3)MiB*... = 104 MiB > 64 -> DF=16 (52 MiB)
+    assert H._multi_df(256, 256, 64) == 16
+    #   S=1024: even DF=8 is 8*(0.25+12) = 98 MiB > 64 -> no block fits
+    assert H._multi_df(1024, 256, 64) is None
+    # the knob and the budget move together: restoring the Mosaic default
+    # ceiling (16 MB -> 10 MiB budget) must reject the DF=32/S=32 pick
+    # that compile-failed on chip (resident 20 MiB); DF=16 (10 MiB) fits
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_VMEM_MB", "16")
+    assert H._multi_df(32, 256, 64) == 16
+
+
+def test_multi_plane_huge_slots_uses_scatter():
+    """When no feature block fits VMEM the public op must still work
+    (scatter lowering), not assert or compile-fail."""
+    rng = np.random.default_rng(5)
+    n, d, s = 300, 4, 1024
+    bins = jnp.asarray(rng.integers(0, 256, (n, d)), jnp.int32)
+    stats_np = rng.normal(size=(n, 3)).astype(np.float32)
+    stats_np[:, 2] = 1.0  # count column
+    stats = jnp.asarray(stats_np)
+    slot = jnp.asarray(rng.integers(0, s, (n,)), jnp.int32)
+    out = H.multi_plane_histogram(bins, stats, slot, s)
+    assert out.shape == (s, d * 256, 3)
+    np.testing.assert_allclose(
+        np.asarray(out.sum(axis=(0, 1))[2]), n * d, rtol=1e-6
+    )
+
+
+def test_tpu_compiler_params_off_device():
+    """On CPU the kernels run in interpret mode: no TPU compiler params
+    (passing Mosaic options to the interpreter would be meaningless)."""
+    assert H._tpu_compiler_params() is None
