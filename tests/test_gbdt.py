@@ -824,6 +824,53 @@ class TestDepthwise:
         b = train(x, y, cfg)
         assert b.trees[0].active.sum() <= 9
 
+    def test_sibling_subtraction_equivalence(self, monkeypatch):
+        """Sibling subtraction (default) must grow the same trees as the
+        direct full-frontier build: derived left planes are parent -
+        right, exact up to f32 rounding, so split records agree on data
+        without razor-edge gain ties. Guards the derivation's indexing
+        (pair -> parent plane) end-to-end through a multi-tree train."""
+        x, y = self._xy(n=2500, d=6, seed=3)
+        outs = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv("MMLSPARK_TPU_GBDT_SIBLING", flag)
+            cfg = TrainConfig(objective="binary", num_iterations=8,
+                              num_leaves=31, min_data_in_leaf=10, seed=2,
+                              growth_policy="depthwise")
+            outs[flag] = train(x, y, cfg)
+        t_on, t_off = outs["1"].trees, outs["0"].trees
+        self._assert_tree_parity(t_on, t_off, outs, x)
+
+    def test_sibling_subtraction_odd_frontier(self, monkeypatch):
+        """max_depth deeper than log2(num_leaves) makes a level's frontier
+        capacity S_next = num_leaves (odd, e.g. 31): the interleaved pair
+        cube is padded to S planes and splits run under leaf-budget
+        pressure — the clip-guarded parent_local/inv writes must stay
+        in bounds and not overwrite live pairs."""
+        x, y = self._xy(n=2500, d=6, seed=4)
+        outs = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv("MMLSPARK_TPU_GBDT_SIBLING", flag)
+            cfg = TrainConfig(objective="binary", num_iterations=6,
+                              num_leaves=31, min_data_in_leaf=5, seed=2,
+                              growth_policy="depthwise", max_depth=8)
+            outs[flag] = train(x, y, cfg)
+        self._assert_tree_parity(outs["1"].trees, outs["0"].trees, outs, x)
+
+    def _assert_tree_parity(self, t_on, t_off, outs, x):
+        assert len(t_on) == len(t_off)
+        same = sum(
+            int(np.array_equal(a.feature, b.feature)
+                and np.array_equal(a.threshold, b.threshold))
+            for a, b in zip(t_on, t_off)
+        )
+        # identical structure on nearly every tree (a rare f32 tie may
+        # flip one split late in the boosting chain)
+        assert same >= len(t_on) - 1, f"{same}/{len(t_on)} trees identical"
+        pr_on = outs["1"].predict_raw(x)
+        pr_off = outs["0"].predict_raw(x)
+        np.testing.assert_allclose(pr_on, pr_off, rtol=1e-3, atol=1e-3)
+
     def test_categorical_depthwise(self):
         rng = np.random.default_rng(2)
         n = 2000
