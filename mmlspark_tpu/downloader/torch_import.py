@@ -130,6 +130,108 @@ def import_torch_resnet(state_dict: dict, variant: str = "ResNet50") -> dict:
     return {"params": params, "batch_stats": stats}
 
 
+def import_torch_vit(
+    state_dict: dict, num_heads: Optional[int] = None,
+    variant: str = "ViTB16",
+) -> dict:
+    """torchvision ViT ``state_dict`` (``vit_b_16`` layout) -> flax
+    variables ``{"params": ...}`` for ``VITS[variant]``. Strict like the
+    ResNet importer: every weight consumed, every expected key present,
+    and the checkpoint's geometry (patch size, hidden dim, depth,
+    mlp width) validated against the variant — a mismatched checkpoint
+    fails HERE, not at serve time deep inside flax apply.
+
+    Layout notes: torch packs q/k/v as ``in_proj_weight`` (3C, C) with
+    heads contiguous inside each of q/k/v — exactly the (C, 3, H, D)
+    DenseGeneral kernel after a transpose+reshape; linears transpose;
+    LayerNorm weight/bias -> scale/bias.
+    """
+    from mmlspark_tpu.models.vit import VITS
+
+    if variant not in VITS:
+        raise ValueError(f"unsupported variant {variant!r}; known: {list(VITS)}")
+    ref = VITS[variant]()
+    if num_heads is None:
+        num_heads = ref.num_heads
+    sd = dict(state_dict)
+    params: dict = {}
+
+    params["conv_proj"] = {
+        "kernel": _conv(sd, "conv_proj.weight"),
+        "bias": _np(_take(sd, "conv_proj.bias")),
+    }
+    kh, kw_ = params["conv_proj"]["kernel"].shape[:2]
+    if (kh, kw_) != (ref.patch_size, ref.patch_size):
+        raise ValueError(
+            f"checkpoint patch size {kh}x{kw_} != {variant}'s "
+            f"{ref.patch_size}"
+        )
+    params["cls_token"] = _np(_take(sd, "class_token"))
+    params["pos_embedding"] = _np(_take(sd, "encoder.pos_embedding"))
+    c = params["pos_embedding"].shape[-1]
+    if c != ref.hidden_dim:
+        raise ValueError(
+            f"checkpoint hidden dim {c} != {variant}'s {ref.hidden_dim}"
+        )
+    if c % num_heads:
+        raise ValueError(f"hidden dim {c} not divisible by heads {num_heads}")
+    d = c // num_heads
+
+    def _ln(prefix: str) -> dict:
+        return {
+            "scale": _np(_take(sd, f"{prefix}.weight")),
+            "bias": _np(_take(sd, f"{prefix}.bias")),
+        }
+
+    def _linear(prefix: str) -> dict:
+        return {
+            "kernel": _np(_take(sd, f"{prefix}.weight")).T,
+            "bias": _np(_take(sd, f"{prefix}.bias")),
+        }
+
+    i = 0
+    while f"encoder.layers.encoder_layer_{i}.ln_1.weight" in sd:
+        t = f"encoder.layers.encoder_layer_{i}"
+        w_in = _np(_take(sd, f"{t}.self_attention.in_proj_weight"))
+        b_in = _np(_take(sd, f"{t}.self_attention.in_proj_bias"))
+        params[f"block_{i}"] = {
+            "ln_1": _ln(f"{t}.ln_1"),
+            "qkv": {
+                "kernel": w_in.T.reshape(c, 3, num_heads, d),
+                "bias": b_in.reshape(3, num_heads, d),
+            },
+            "out": _linear(f"{t}.self_attention.out_proj"),
+            "ln_2": _ln(f"{t}.ln_2"),
+            "mlp_1": _linear(f"{t}.mlp.0"),
+            "mlp_2": _linear(f"{t}.mlp.3"),
+        }
+        i += 1
+    if i == 0:
+        raise ValueError(
+            "state_dict has no encoder.layers.encoder_layer_0 — not a "
+            "torchvision ViT checkpoint"
+        )
+    if i != ref.depth:
+        raise ValueError(
+            f"checkpoint has {i} encoder layers != {variant}'s {ref.depth}"
+        )
+    if params["block_0"]["mlp_1"]["kernel"].shape[1] != ref.mlp_dim:
+        raise ValueError(
+            f"checkpoint mlp width "
+            f"{params['block_0']['mlp_1']['kernel'].shape[1]} != "
+            f"{variant}'s {ref.mlp_dim}"
+        )
+    params["ln"] = _ln("encoder.ln")
+    params["head"] = _linear("heads.head")
+    leftovers = list(sd)
+    if leftovers:
+        raise ValueError(
+            f"unconsumed keys in state_dict (architecture mismatch with "
+            f"{variant}): {leftovers[:8]}{'...' if len(leftovers) > 8 else ''}"
+        )
+    return {"params": params}
+
+
 def install_torch_checkpoint(
     src: Any,
     name: str,
@@ -155,16 +257,40 @@ def install_torch_checkpoint(
     else:
         state_dict = src
     variant = variant or name.split("_", 1)[0]
-    variables = import_torch_resnet(state_dict, variant=variant)
+    is_vit = variant.startswith("ViT")
+    if is_vit:
+        from mmlspark_tpu.models.vit import VITS, ViT
+
+        variables = import_torch_vit(state_dict, variant=variant)
+        # pos-embedding length is input-size-dependent: serving at a
+        # different size than the checkpoint was trained for would only
+        # fail at transform time, so pin it here
+        n_ck = variables["params"]["pos_embedding"].shape[1]
+        ps = VITS[variant]().patch_size
+        n_want = (image_size // ps) ** 2 + 1
+        if n_ck != n_want:
+            raise ValueError(
+                f"checkpoint pos_embedding has {n_ck} tokens but "
+                f"image_size={image_size} needs {n_want} — pass the "
+                f"image_size the checkpoint was trained at"
+            )
+        layer_names = list(ViT.LAYER_NAMES)
+    else:
+        variables = import_torch_resnet(state_dict, variant=variant)
+        layer_names = None  # schema default (ResNet stage names)
     if num_classes is None:
         num_classes = int(variables["params"]["head"]["bias"].shape[0])
     dl = downloader or ModelDownloader()
+    extra = {} if layer_names is None else {"layer_names": layer_names}
     schema = ModelSchema(
         name=name,
         variant=variant,
         num_classes=num_classes,
         image_size=image_size,
-        torch_padding=True,
+        # ViT has no strided-conv SAME/symmetric divergence (patch conv is
+        # VALID at stride = kernel); torch_padding only concerns ResNets
+        torch_padding=not is_vit,
+        **extra,
     )
     dl.register(schema, variables)
     log.info("installed torch checkpoint %r as zoo model %r", variant, name)

@@ -67,6 +67,11 @@ class ModelSchema:
         return json.dumps(asdict(self), indent=1)
 
 
+# ViT output-layer order; must match models/vit.py ViT.LAYER_NAMES (a
+# top-level import would be circular through the models package init —
+# tests/test_vit.py::test_layer_names_match_zoo_schema pins the equality)
+_VIT_LAYERS = ("logits", "pool", "encoder", "patches")
+
 BUILTIN_MODELS = {
     "ResNet18": ModelSchema(name="ResNet18", variant="ResNet18"),
     "ResNet34": ModelSchema(name="ResNet34", variant="ResNet34"),
@@ -78,6 +83,18 @@ BUILTIN_MODELS = {
         num_classes=10,
         image_size=32,
         small_inputs=True,
+    ),
+    "ViTB16": ModelSchema(
+        name="ViTB16",
+        variant="ViTB16",
+        layer_names=list(_VIT_LAYERS),
+    ),
+    "ViTTiny": ModelSchema(
+        name="ViTTiny",
+        variant="ViTTiny",
+        num_classes=10,
+        image_size=32,
+        layer_names=list(_VIT_LAYERS),
     ),
 }
 
@@ -165,7 +182,7 @@ class ModelDownloader:
             schema.sha256 = hashlib.sha256(blob).hexdigest()
             self.install_blob(schema, blob)
         else:
-            from mmlspark_tpu.models.resnet import init_resnet
+            from mmlspark_tpu.models.resnet import RESNETS, init_resnet
 
             log.warning(
                 "model %r has no trained checkpoint in this egress-free "
@@ -175,17 +192,27 @@ class ModelDownloader:
                 "checkpoints)",
                 name,
             )
-            width = {} if schema.num_filters is None else {
-                "num_filters": schema.num_filters
-            }
-            _, variables = init_resnet(
-                schema.variant,
-                num_classes=schema.num_classes,
-                image_size=schema.image_size,
-                small_inputs=schema.small_inputs,
-                seed=schema.seed,
-                **width,
-            )
+            if schema.variant in RESNETS:
+                width = {} if schema.num_filters is None else {
+                    "num_filters": schema.num_filters
+                }
+                _, variables = init_resnet(
+                    schema.variant,
+                    num_classes=schema.num_classes,
+                    image_size=schema.image_size,
+                    small_inputs=schema.small_inputs,
+                    seed=schema.seed,
+                    **width,
+                )
+            else:
+                from mmlspark_tpu.models.vit import init_vit
+
+                _, variables = init_vit(
+                    schema.variant,
+                    num_classes=schema.num_classes,
+                    image_size=schema.image_size,
+                    seed=schema.seed,
+                )
             self.register(schema, variables)
         return schema
 
@@ -212,13 +239,19 @@ class ModelDownloader:
             if getattr(a, "dtype", None) == _np.float16 else a,
             variables,
         )
-        width = {} if schema.num_filters is None else {
-            "num_filters": schema.num_filters
-        }
-        module = RESNETS[schema.variant](
-            num_classes=schema.num_classes, small_inputs=schema.small_inputs,
-            torch_padding=schema.torch_padding, **width,
-        )
+        if schema.variant in RESNETS:
+            width = {} if schema.num_filters is None else {
+                "num_filters": schema.num_filters
+            }
+            module = RESNETS[schema.variant](
+                num_classes=schema.num_classes,
+                small_inputs=schema.small_inputs,
+                torch_padding=schema.torch_padding, **width,
+            )
+        else:
+            from mmlspark_tpu.models.vit import VITS
+
+            module = VITS[schema.variant](num_classes=schema.num_classes)
         return module, variables, schema
 
     def _fetch(self, schema: ModelSchema, wpath: str) -> None:

@@ -1,5 +1,6 @@
 from mmlspark_tpu.models.xla_model import XLAModel
 from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
 from mmlspark_tpu.models import resnet
+from mmlspark_tpu.models import vit
 
-__all__ = ["XLAModel", "ImageFeaturizer", "resnet"]
+__all__ = ["XLAModel", "ImageFeaturizer", "resnet", "vit"]

@@ -1,0 +1,196 @@
+"""Vision Transformer family — the attention-based zoo backbone.
+
+The reference's model zoo serves CNTK conv-net graphs only
+(downloader/ModelDownloader.scala, Schema.scala:54-66); this adds the
+transformer generation of image backbones to the same
+ImageFeaturizer/zoo machinery (named layer outputs, ``cutOutputLayers``
+truncation, torchvision checkpoint import), built TPU-first:
+
+- patch embedding is a strided conv (one big MXU matmul per image);
+- encoder blocks are pre-LN MHSA + MLP in bf16, fused by XLA;
+- the attention can run **sequence-parallel over a mesh axis** via
+  :func:`mmlspark_tpu.ops.ring_attention.ring_attention`: the token dim
+  is padded to a multiple of the axis size and the pad tail masked with
+  the ring's ``kv_mask``, so ViT's N = (H/P)*(W/P) + 1 tokens (197 for
+  224/16 — never divisible) shard cleanly. Single-device meshes use
+  dense attention automatically.
+
+Naming/structure mirrors torchvision's ``vit_b_16`` closely enough that
+``torch_import.import_torch_vit`` maps its checkpoints 1:1 (erf GELU,
+pre-LN, class-token pooling).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class ViTEncoderBlock(nn.Module):
+    """One pre-LN transformer block: x + MHSA(LN(x)); x + MLP(LN(x))."""
+
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    attn: Optional[Callable] = None  # (B,N,H,D)x3 -> (B,N,H,D)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from mmlspark_tpu.ops.ring_attention import dense_attention
+
+        b, n, c = x.shape
+        h = self.num_heads
+        d = c // h
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_1")(x)
+        qkv = nn.DenseGeneral((3, h, d), dtype=self.dtype, name="qkv")(y)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, N, H, D)
+        attend = self.attn if self.attn is not None else dense_attention
+        o = attend(q, k, v).reshape(b, n, c)
+        o = nn.Dense(c, dtype=self.dtype, name="out")(o)
+        x = x + o
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_1")(y)
+        y = nn.gelu(y, approximate=False)  # erf GELU: torchvision parity
+        y = nn.Dense(c, dtype=self.dtype, name="mlp_2")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """ViT with named outputs for ``cutOutputLayers`` truncation.
+
+    Layer-name order (outermost first) matches the zoo convention:
+    ["logits", "pool", "encoder", "patches"] — ``pool`` is the
+    class-token embedding after the final LayerNorm (the standard
+    featurization vector), ``encoder`` the full token sequence.
+    """
+
+    patch_size: int = 16
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    # sequence parallelism: shard the token dim over mesh[seq_axis] with
+    # ring attention (pad + kv_mask when N doesn't divide the axis size)
+    seq_mesh: Any = None
+    seq_axis: str = "data"
+
+    LAYER_NAMES = ("logits", "pool", "encoder", "patches")
+
+    def _attend(self) -> Optional[Callable]:
+        mesh = self.seq_mesh
+        if mesh is None or dict(mesh.shape).get(self.seq_axis, 1) <= 1:
+            return None  # dense attention
+
+        from mmlspark_tpu.ops.ring_attention import ring_attention
+
+        n_sh = dict(mesh.shape)[self.seq_axis]
+
+        def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray):
+            b, n, h, d = q.shape
+            n_pad = ((n + n_sh - 1) // n_sh) * n_sh
+            if n_pad == n:
+                return ring_attention(
+                    q, k, v, mesh=mesh, axis=self.seq_axis
+                )
+            pad = ((0, 0), (0, n_pad - n), (0, 0), (0, 0))
+            mask = jnp.broadcast_to(
+                jnp.arange(n_pad)[None, :] < n, (b, n_pad)
+            )
+            o = ring_attention(
+                jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                mesh=mesh, axis=self.seq_axis, kv_mask=mask,
+            )
+            return o[:, :n]
+
+        return attend
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> dict:
+        outputs: dict = {}
+        ps = self.patch_size
+        x = x.astype(self.dtype)
+        p = nn.Conv(
+            self.hidden_dim, (ps, ps), strides=(ps, ps),
+            dtype=self.dtype, name="conv_proj", padding="VALID",
+        )(x)                                       # (B, H/ps, W/ps, C)
+        b, gh, gw, c = p.shape
+        seq = p.reshape(b, gh * gw, c)
+        outputs["patches"] = seq.astype(jnp.float32)
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, c), jnp.float32
+        )
+        seq = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, c)), seq], axis=1
+        )
+        n = seq.shape[1]
+        pos = self.param(
+            "pos_embedding", nn.initializers.normal(0.02), (1, n, c),
+            jnp.float32,
+        )
+        seq = seq + pos.astype(self.dtype)
+        attend = self._attend()
+        for i in range(self.depth):
+            seq = ViTEncoderBlock(
+                num_heads=self.num_heads, mlp_dim=self.mlp_dim,
+                dtype=self.dtype, attn=attend, name=f"block_{i}",
+            )(seq)
+        seq = nn.LayerNorm(dtype=self.dtype, name="ln")(seq)
+        outputs["encoder"] = seq.astype(jnp.float32)
+        pooled = seq[:, 0].astype(jnp.float32)     # class token
+        outputs["pool"] = pooled
+        logits = nn.Dense(
+            self.num_classes, dtype=self.dtype, name="head"
+        )(pooled)
+        outputs["logits"] = logits.astype(jnp.float32)
+        return outputs
+
+
+def vit_b16(**kw: Any) -> ViT:
+    return ViT(**kw)
+
+
+def vit_tiny(**kw: Any) -> ViT:
+    """Test-scale ViT (the ResNet8-of-ViTs): fast to init and trace."""
+    kw.setdefault("patch_size", 4)
+    kw.setdefault("hidden_dim", 32)
+    kw.setdefault("depth", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("mlp_dim", 64)
+    return ViT(**kw)
+
+
+VITS: dict = {"ViTB16": vit_b16, "ViTTiny": vit_tiny}
+
+
+def init_vit(name: str, image_size: int = 224, num_classes: int = 1000,
+             seed: int = 0, **kw: Any):
+    """(module, variables) at the given input size (pos-emb length is
+    size-dependent, like the reference schema's input shape).
+
+    Init always runs on the host CPU backend, same rationale as
+    ``init_resnet``: weight materialization must not be hostage to a
+    dead/remote accelerator backend."""
+    import jax
+
+    model = VITS[name](num_classes=num_classes, **kw)
+    dummy = np.zeros((1, image_size, image_size, 3), np.float32)
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        cpu = None
+    if cpu is not None:
+        with jax.default_device(cpu):
+            variables = jax.jit(
+                lambda: model.init(
+                    jax.random.PRNGKey(seed), dummy, train=False
+                )
+            )()
+        variables = jax.tree_util.tree_map(np.asarray, variables)
+    else:
+        variables = model.init(jax.random.PRNGKey(seed), dummy, train=False)
+    return model, variables

@@ -39,6 +39,7 @@ def _block_attend(
     k_off: jnp.ndarray,      # scalar: global offset of this k block
     scale: float,
     causal: bool,
+    kv_mask: Optional[jnp.ndarray] = None,  # (B, Tk) bool; False = pad key
 ) -> tuple:
     """Fold one K/V block into the online-softmax accumulators."""
     s = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale  # (B, Tq, H, Tk)
@@ -47,6 +48,10 @@ def _block_attend(
         ki = k_off + jnp.arange(k.shape[1])
         mask = qi[:, None] >= ki[None, :]            # (Tq, Tk)
         s = jnp.where(mask[None, :, None, :], s, -jnp.inf)
+    if kv_mask is not None:
+        # padding keys receive no attention; the accumulator math below
+        # already tolerates fully-masked blocks (running max stays -inf)
+        s = jnp.where(kv_mask[:, None, None, :], s, -jnp.inf)
     blk_m = s.max(axis=-1)                           # (B, Tq, H)
     new_m = jnp.maximum(m, blk_m)
     # fully-masked blocks: new_m stays -inf; exp(-inf - -inf) guards below
@@ -67,18 +72,24 @@ def ring_attention(
     axis: str = SEQ_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
+    kv_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Exact attention with the SEQUENCE dim sharded over ``mesh[axis]``.
 
     ``q``/``k``/``v``: (batch, seq, heads, head_dim), seq sharded over the
     axis (shard_map reshards if needed). Returns the attention output in
     the same layout/sharding. ``causal=True`` applies the autoregressive
-    mask with GLOBAL positions (each shard knows its ring offset)."""
+    mask with GLOBAL positions (each shard knows its ring offset).
+    ``kv_mask``: optional (batch, seq) bool — False keys receive no
+    attention. This is how padded sequences shard cleanly: pad to a
+    multiple of the axis size, mask the tail (the pad mask rides the
+    same ring rotation as its K/V block)."""
     mesh = mesh or get_mesh()
     n_shards = dict(mesh.shape).get(axis, 1)
     sc = scale if scale is not None else q.shape[-1] ** -0.5
+    has_mask = kv_mask is not None
 
-    def local(ql: jnp.ndarray, kl: jnp.ndarray, vl: jnp.ndarray) -> jnp.ndarray:
+    def local(ql, kl, vl, mk) -> jnp.ndarray:
         B, Tq, H, D = ql.shape
         my = jax.lax.axis_index(axis)
         o = jnp.zeros_like(ql)
@@ -87,26 +98,30 @@ def ring_attention(
         q_off = my * Tq
 
         def step(i: int, carry: tuple) -> tuple:
-            o, m, l, kc, vc = carry
+            o, m, l, kc, vc, mc = carry
             # the block currently held arrived from shard (my + i) % n
             src = (my + i) % n_shards
             o, m, l = _block_attend(
                 ql, kc, vc, o, m, l, q_off, src * kc.shape[1], sc, causal,
+                mc,
             )
-            # rotate K/V one hop around the ring for the next step
+            # rotate K/V (and the pad mask) one hop around the ring
             perm = [(j, (j - 1) % n_shards) for j in range(n_shards)]
             kc = jax.lax.ppermute(kc, axis, perm)
             vc = jax.lax.ppermute(vc, axis, perm)
-            return o, m, l, kc, vc
+            if mc is not None:
+                mc = jax.lax.ppermute(mc, axis, perm)
+            return o, m, l, kc, vc, mc
 
         # n-1 rotated steps; the LAST block attends outside the loop so the
         # ring never pays a final hop whose result would be discarded
-        o, m, l, kc, vc = jax.lax.fori_loop(
-            0, n_shards - 1, step, (o, m, l, kl, vl)
+        o, m, l, kc, vc, mc = jax.lax.fori_loop(
+            0, n_shards - 1, step, (o, m, l, kl, vl, mk)
         )
         last_src = (my + n_shards - 1) % n_shards
         o, m, l = _block_attend(
             ql, kc, vc, o, m, l, q_off, last_src * kc.shape[1], sc, causal,
+            mc,
         )
         # rows with no visible keys (can't happen with causal diag) -> 0
         return o / jnp.maximum(l, 1e-30)[..., None]
@@ -118,13 +133,23 @@ def ring_attention(
         m = jnp.full((B, T, H), -jnp.inf, q.dtype)
         l = jnp.zeros((B, T, H), q.dtype)
         o, m, l = _block_attend(
-            q, k, v, o, m, l, jnp.int32(0), jnp.int32(0), sc, causal
+            q, k, v, o, m, l, jnp.int32(0), jnp.int32(0), sc, causal,
+            kv_mask,
         )
         return o / jnp.maximum(l, 1e-30)[..., None]
 
     spec = P(None, axis, None, None)
+    mspec = P(None, axis)
+    if has_mask:
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, mspec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v, kv_mask)
     return jax.shard_map(
-        local,
+        lambda a, b, c: local(a, b, c, None),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -135,6 +160,7 @@ def ring_attention(
 def dense_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     causal: bool = False, scale: Optional[float] = None,
+    kv_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Reference single-device attention (the golden for ring tests)."""
     sc = scale if scale is not None else q.shape[-1] ** -0.5
@@ -143,5 +169,7 @@ def dense_attention(
         T, S = s.shape[1], s.shape[3]
         mask = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
         s = jnp.where(mask[None, :, None, :], s, -jnp.inf)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bqhk,bkhd->bqhd", p, v)

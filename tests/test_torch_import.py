@@ -203,3 +203,155 @@ def test_install_and_featurize_through_the_zoo(tmp_path):
     with torch.no_grad():
         ref = tm(torch.from_numpy(np.asarray(pix).transpose(0, 3, 1, 2)))
     np.testing.assert_allclose(out, ref["pool"].numpy(), rtol=2e-2, atol=2e-2)
+
+
+# -- ViT ---------------------------------------------------------------------
+
+
+class _TorchViTBlock(tnn.Module):
+    """torchvision EncoderBlock: pre-LN MHSA + pre-LN MLP, erf GELU.
+    State-dict names match torchvision vit_b_16 exactly (ln_1,
+    self_attention.in_proj_*, ln_2, mlp.0/mlp.3)."""
+
+    def __init__(self, hidden, heads, mlp_dim):
+        super().__init__()
+        # torchvision ViT uses eps=1e-6 LayerNorms (matches flax default)
+        self.ln_1 = tnn.LayerNorm(hidden, eps=1e-6)
+        self.self_attention = tnn.MultiheadAttention(
+            hidden, heads, batch_first=True
+        )
+        self.ln_2 = tnn.LayerNorm(hidden, eps=1e-6)
+        self.mlp = tnn.Sequential(
+            tnn.Linear(hidden, mlp_dim), tnn.GELU(), tnn.Dropout(0.0),
+            tnn.Linear(mlp_dim, hidden), tnn.Dropout(0.0),
+        )
+
+    def forward(self, x):
+        y = self.ln_1(x)
+        y, _ = self.self_attention(y, y, y, need_weights=False)
+        x = x + y
+        return x + self.mlp(self.ln_2(x))
+
+
+class _TorchViT(tnn.Module):
+    """Minimal torchvision-vit_b_16-layout ViT as import ground truth."""
+
+    def __init__(self, image_size=32, patch=4, hidden=32, depth=2,
+                 heads=2, mlp_dim=64, num_classes=10):
+        super().__init__()
+        self.conv_proj = tnn.Conv2d(3, hidden, patch, stride=patch)
+        n = (image_size // patch) ** 2 + 1
+        self.class_token = tnn.Parameter(torch.zeros(1, 1, hidden))
+        self.encoder = tnn.Module()
+        self.encoder.pos_embedding = tnn.Parameter(
+            torch.randn(1, n, hidden) * 0.02
+        )
+        self.encoder.layers = tnn.Module()
+        for i in range(depth):
+            setattr(
+                self.encoder.layers, f"encoder_layer_{i}",
+                _TorchViTBlock(hidden, heads, mlp_dim),
+            )
+        self.depth = depth
+        self.encoder.ln = tnn.LayerNorm(hidden, eps=1e-6)
+        self.heads = tnn.Module()
+        self.heads.head = tnn.Linear(hidden, num_classes)
+
+    def forward(self, x):
+        p = self.conv_proj(x)                      # (B, C, gh, gw)
+        b, c, gh, gw = p.shape
+        seq = p.flatten(2).transpose(1, 2)         # (B, N, C)
+        cls = self.class_token.expand(b, -1, -1)
+        seq = torch.cat([cls, seq], dim=1) + self.encoder.pos_embedding
+        for i in range(self.depth):
+            seq = getattr(self.encoder.layers, f"encoder_layer_{i}")(seq)
+        seq = self.encoder.ln(seq)
+        pool = seq[:, 0]
+        return {"pool": pool, "logits": self.heads.head(pool)}
+
+
+def test_torch_vit_import_feature_parity():
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.downloader.torch_import import import_torch_vit
+    from mmlspark_tpu.models.vit import vit_tiny
+
+    torch.manual_seed(3)
+    tm = _TorchViT()
+    # non-trivial class token (zeros would hide a cls/pos mapping swap)
+    with torch.no_grad():
+        tm.class_token.copy_(torch.randn(1, 1, 32) * 0.1)
+    tm.eval()
+
+    x = np.random.default_rng(4).normal(size=(2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+
+    variables = import_torch_vit(tm.state_dict(), variant="ViTTiny")
+    fm = vit_tiny(num_classes=10, dtype=jnp.float32)
+    out = fm.apply(variables, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(
+        np.asarray(out["pool"]), ref["pool"].numpy(), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["logits"]), ref["logits"].numpy(), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_torch_vit_import_strictness():
+    from mmlspark_tpu.downloader.torch_import import import_torch_vit
+
+    tm = _TorchViT()
+    sd = tm.state_dict()
+    sd["encoder.layers.encoder_layer_0.extra.weight"] = torch.zeros(1)
+    with pytest.raises(ValueError, match="unconsumed"):
+        import_torch_vit(sd, variant="ViTTiny")
+    with pytest.raises(ValueError, match="not a"):
+        import_torch_vit({"conv_proj.weight": torch.zeros(32, 3, 4, 4),
+                          "conv_proj.bias": torch.zeros(32),
+                          "class_token": torch.zeros(1, 1, 32),
+                          "encoder.pos_embedding": torch.zeros(1, 65, 32)},
+                         variant="ViTTiny")
+    # geometry validation: a tiny checkpoint must not install as ViTB16
+    with pytest.raises(ValueError, match="patch size|hidden dim"):
+        import_torch_vit(tm.state_dict(), variant="ViTB16")
+
+
+def test_install_torch_vit_rejects_wrong_image_size(tmp_path):
+    from mmlspark_tpu.downloader import install_torch_checkpoint
+    from mmlspark_tpu.downloader.zoo import ModelDownloader
+
+    tm = _TorchViT()  # trained at 32 -> 65 tokens
+    with pytest.raises(ValueError, match="pos_embedding"):
+        install_torch_checkpoint(
+            tm.state_dict(), name="ViTTiny_Bad", variant="ViTTiny",
+            image_size=64, downloader=ModelDownloader(str(tmp_path)),
+        )
+
+
+def test_install_torch_vit_through_the_zoo(tmp_path):
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.downloader import install_torch_checkpoint
+    from mmlspark_tpu.downloader.zoo import ModelDownloader
+    from mmlspark_tpu.models import ImageFeaturizer
+
+    tm = _TorchViT()
+    tm.eval()
+    dl = ModelDownloader(str(tmp_path))
+    schema = install_torch_checkpoint(
+        tm.state_dict(), name="ViTTiny_Import", variant="ViTTiny",
+        image_size=32, downloader=dl,
+    )
+    assert schema.num_classes == 10
+    assert schema.layer_names[:2] == ["logits", "pool"]
+    imgs = np.random.default_rng(5).integers(
+        0, 255, size=(4, 32, 32, 3), dtype=np.uint8
+    )
+    df = DataFrame.from_dict({"image": imgs})
+    feat = ImageFeaturizer(
+        input_col="image", output_col="features",
+        model_name="ViTTiny_Import", cut_output_layers=1,
+        batch_size=4, repo_dir=str(tmp_path),
+    )
+    out = feat.transform(df)["features"]
+    assert out.shape == (4, 32) and np.all(np.isfinite(out))
