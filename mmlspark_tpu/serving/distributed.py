@@ -108,6 +108,11 @@ class BackendPool:
         with self._lock:
             return len(self._backends)
 
+    def members(self) -> list:
+        """Snapshot of the live backends (for cache pruning)."""
+        with self._lock:
+            return list(self._backends)
+
     def next(self, exclude: Optional[set] = None) -> Optional[Backend]:
         """The next live backend, skipping cooled-down and ``exclude``d
         ones; falls back to a cooled-down backend rather than none (it may
@@ -203,6 +208,10 @@ class ServingGateway:
         self._retry_after_send = retry_after_send
         self._threads: list = []
         self._stop = threading.Event()
+        # per-dispatcher-thread persistent connections: the worker server
+        # speaks HTTP/1.1 keep-alive, so reusing the TCP connection drops
+        # the per-request handshake from the gateway overhead
+        self._conns = threading.local()
         self.forwarded = 0
         self.retried = 0
         self.failed = 0
@@ -301,6 +310,61 @@ class ServingGateway:
         for r in self._ingress.get_next_batch(max_n=1_000_000, timeout_s=0.0):
             self._ingress.reply_to(r.id, b"gateway stopping", 503)
 
+    @staticmethod
+    def _conn_alive(conn) -> bool:
+        """Is an idle pooled connection still usable? A dead worker's FIN
+        (or any unread stray bytes) makes the socket readable — reusing
+        it would turn 'worker stopped between requests' from a safe
+        pre-send connect-refused into a send-then-hang 504. poll(), not
+        select(): the gateway ingress holds an fd per client, so pooled
+        fds routinely exceed select's FD_SETSIZE under load."""
+        import select
+
+        sock = getattr(conn, "sock", None)
+        if sock is None:
+            return False
+        try:
+            p = select.poll()
+            p.register(sock, select.POLLIN)
+            return not p.poll(0)
+        except (OSError, ValueError):
+            return False
+
+    def _conn_for(self, b) -> tuple:
+        """(conn, cached): this dispatcher thread's persistent connection
+        to backend ``b``, or a fresh one."""
+        cache = getattr(self._conns, "by_backend", None)
+        if cache is None:
+            cache = self._conns.by_backend = {}
+        # prune connections to backends no longer in the pool (registry
+        # churn: workers restarting on new ports would otherwise leak a
+        # CLOSE_WAIT fd per dispatcher thread per departed backend)
+        if len(cache) > self._pool.size():
+            live = {(m.host, m.port) for m in self._pool.members()}
+            for key in [k for k in cache if k not in live]:
+                try:
+                    cache.pop(key).close()
+                except OSError:
+                    pass
+        key = (b.host, b.port)
+        conn = cache.get(key)
+        if conn is not None:
+            if self._conn_alive(conn):
+                return conn, True
+            self._drop_conn(b)
+        conn = http.client.HTTPConnection(b.host, b.port, timeout=self._timeout)
+        cache[key] = conn
+        return conn, False
+
+    def _drop_conn(self, b) -> None:
+        cache = getattr(self._conns, "by_backend", None)
+        conn = cache.pop((b.host, b.port), None) if cache else None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     def _forward(self, req) -> None:
         attempts = self._max_attempts or max(2, self._pool.size() + 1)
         tried: set = set()
@@ -314,19 +378,34 @@ class ServingGateway:
                 break
             sent = False
             try:
-                conn = http.client.HTTPConnection(
-                    b.host, b.port, timeout=self._timeout
-                )
+                conn, cached = self._conn_for(b)
                 # request() returning means the body was fully flushed; an
                 # exception DURING it leaves an incomplete body the worker
                 # will never execute (Content-Length mismatch) — safe to
                 # re-dispatch
-                conn.request(req.method, b.path, body=req.body, headers=headers)
+                try:
+                    conn.request(
+                        req.method, b.path, body=req.body, headers=headers
+                    )
+                except (OSError, http.client.HTTPException):
+                    if not cached:
+                        raise
+                    # a kept-alive connection the worker has since closed
+                    # is a connection-staleness failure, not a worker
+                    # failure: retry ONCE on a fresh connection before
+                    # blaming the backend
+                    self._drop_conn(b)
+                    conn, _ = self._conn_for(b)
+                    conn.request(
+                        req.method, b.path, body=req.body, headers=headers
+                    )
                 sent = True
                 resp = conn.getresponse()
                 body = resp.read()
-                conn.close()
+                if resp.will_close:
+                    self._drop_conn(b)
             except (OSError, http.client.HTTPException) as e:
+                self._drop_conn(b)
                 timed_out_after_send = sent and isinstance(e, TimeoutError)
                 if timed_out_after_send and not self._retry_after_send:
                     # the worker may be mid-execution (slow, not dead):

@@ -16,6 +16,7 @@ stays O(µs) and the end-to-end budget is spent on the XLA call.
 from __future__ import annotations
 
 import asyncio
+import socket as socket_mod
 import threading
 import time
 import uuid
@@ -92,6 +93,11 @@ class WorkerServer:
         self._history: dict[int, list[CachedRequest]] = {}
         # request id -> (writer, keep_alive) — pending replies (routingTable)
         self._routing: dict[str, tuple] = {}
+        # open client connections, so stop() can close them: a stopped
+        # worker whose sockets linger half-open looks "slow" (send
+        # succeeds, reply never comes) to keep-alive peers like the
+        # gateway, instead of cleanly dead
+        self._writers: set = set()
         self.requests_seen = 0
 
     # -- lifecycle -----------------------------------------------------------
@@ -148,6 +154,23 @@ class WorkerServer:
         def _shutdown() -> None:
             if self._aserver is not None:
                 self._aserver.close()
+            # close open client connections BEFORE stopping the loop:
+            # cancelled handler tasks never get to run their cleanup once
+            # the loop stops, and a lingering ESTABLISHED socket makes
+            # this worker look slow (send-then-silence) rather than dead
+            # to keep-alive clients. transport.abort() alone isn't enough
+            # — its close callbacks need loop iterations that never come —
+            # so shut the raw socket down synchronously (FIN goes out now;
+            # the fd stays valid for the transport's own teardown)
+            for w in list(self._writers):
+                try:
+                    sock = w.transport.get_extra_info("socket")
+                    w.transport.abort()
+                    if sock is not None:
+                        sock.shutdown(socket_mod.SHUT_RDWR)
+                except Exception:
+                    pass
+            self._writers.clear()
             for task in asyncio.all_tasks(loop):
                 task.cancel()
             loop.stop()
@@ -163,6 +186,7 @@ class WorkerServer:
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        self._writers.add(writer)
         try:
             while True:
                 line = await reader.readline()
@@ -230,6 +254,7 @@ class WorkerServer:
         except (asyncio.IncompleteReadError, ConnectionError):
             return
         finally:
+            self._writers.discard(writer)
             try:
                 writer.close()
             except Exception:
