@@ -605,3 +605,41 @@ def test_fleet_worker_heartbeat_survives_registry_restart():
         stop.set()
         q.stop()
         srv.stop()
+
+
+def test_gateway_conn_cache_prunes_departed_backends():
+    """Registry churn must not leak pooled connections: when a backend
+    leaves the pool, the next dispatch closes and forgets its cached
+    keep-alive connection (per dispatcher thread)."""
+    from mmlspark_tpu.serving import ServingGateway
+
+    from mmlspark_tpu.serving.distributed import BackendPool
+
+    s1, q1, i1 = _worker_with_handler("p1")
+    s2, q2, i2 = _worker_with_handler("p2")
+    gw = ServingGateway(workers=[i1, i2], request_timeout_s=2.0)
+    try:
+        b1, b2 = gw.pool.members()
+        # registry-style pool: no static members, so refresh() can drop
+        # a departed backend (static pools never shrink by design)
+        gw._pool = BackendPool()
+        gw.pool.refresh([b1, b2])
+        # populate this thread's cache with live connections to both
+        c1, cached1 = gw._conn_for(b1)
+        c2, _ = gw._conn_for(b2)
+        assert not cached1
+        c1.request("POST", b1.path, body=b'{"x": 1}')
+        assert c1.getresponse().read()
+        assert set(gw._conns.by_backend) == {
+            (b1.host, b1.port), (b2.host, b2.port)
+        }
+        # b2 leaves the roster; next dispatch to b1 prunes b2's conn
+        gw.pool.refresh([b1])
+        c1b, cached = gw._conn_for(b1)
+        assert cached and c1b is c1  # live entry survives, still pooled
+        assert set(gw._conns.by_backend) == {(b1.host, b1.port)}
+        assert c2.sock is None  # pruned connection was closed
+    finally:
+        for s, q in ((s1, q1), (s2, q2)):
+            q.stop()
+            s.stop()
