@@ -682,6 +682,18 @@ def grow_tree_depthwise(
     sibling = os.environ.get("MMLSPARK_TPU_GBDT_SIBLING", "1") not in (
         "0", "false", ""
     )
+    # vectorized level application pays on TPU (the sequential chain of
+    # tiny dependent ops per split dominates wall clock there) but costs
+    # ~30% on CPU (no dispatch-latency problem; full-width scatters per
+    # level instead). Default by backend, env-overridable.
+    env_vec = os.environ.get("MMLSPARK_TPU_GBDT_VECTOR_SPLIT")
+    if env_vec is not None:
+        vector = env_vec not in ("0", "false", "")
+    else:
+        try:
+            vector = jax.default_backend() == "tpu"
+        except Exception:
+            vector = False
     return _grow_tree_depthwise(
         bins, grad, hess, row_weight,
         num_leaves=L, lambda_l2=lambda_l2, min_gain=min_gain,
@@ -690,7 +702,7 @@ def grow_tree_depthwise(
         categorical_mask=categorical_mask, has_categorical=has_categorical,
         lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
         num_bins=num_bins, mesh=mesh, shard_axis=shard_axis,
-        sibling_subtract=sibling,
+        sibling_subtract=sibling, vector_split=vector,
     )
 
 
@@ -699,6 +711,7 @@ def grow_tree_depthwise(
     static_argnames=(
         "num_leaves", "n_levels", "min_data_in_leaf", "has_categorical",
         "num_bins", "mesh", "shard_axis", "sibling_subtract",
+        "vector_split",
     ),
 )
 def _grow_tree_depthwise(
@@ -721,6 +734,7 @@ def _grow_tree_depthwise(
     mesh: Any = None,
     shard_axis: Optional[str] = None,
     sibling_subtract: bool = True,
+    vector_split: bool = True,
 ) -> GrownTree:
     from mmlspark_tpu.ops.histogram import multi_plane_histogram
 
@@ -793,6 +807,103 @@ def _grow_tree_depthwise(
         # budget: when fewer than S splits remain, best-gain nodes win
         order = jnp.argsort(-gains)
         S_next = min(2 * S, L)
+
+        if vector_split:
+            # ONE vectorized application of the whole level's splits.
+            # The sequential fori_loop below is semantically a chain of
+            # ~30 tiny dependent XLA ops per split — at 63 splits x 50
+            # trees that dependency chain, not the histogram FLOPs,
+            # dominated on-chip wall clock. Every split in a level
+            # touches a DIFFERENT leaf, so the only cross-split coupling
+            # is the budget/record ordering — reproduced exactly by a
+            # cumsum over the gain-sorted valid mask (argsort is stable,
+            # and the budget cuts a suffix: once k + rank hits L-1 every
+            # later valid fails too, so surviving ranks are unchanged).
+            slot_s = inv[order]
+            gain_s = gains[order]
+            ok = (
+                (slot_s >= 0) & jnp.isfinite(gain_s) & (gain_s > min_gain)
+            )
+            rank = jnp.cumsum(ok.astype(jnp.int32)) - ok.astype(jnp.int32)
+            ok = ok & (k + rank < L - 1)
+            ks = k + rank                    # record index per sorted pos
+            new_id = ks + 1
+            bf_s, bb_s, cm_s = feats[order], bbs[order], catms[order]
+            if has_categorical:
+                is_cat_s = cat_f[bf_s]
+            else:
+                is_cat_s = jnp.zeros_like(ok)
+            # record scatters; invalid positions write out-of-range (drop)
+            idx = jnp.where(ok, ks, L - 1)   # rec arrays are (L-1,)
+            rec_leaf = rec_leaf.at[idx].set(slot_s, mode="drop")
+            rec_feature = rec_feature.at[idx].set(bf_s, mode="drop")
+            rec_bin = rec_bin.at[idx].set(bb_s, mode="drop")
+            rec_active = rec_active.at[idx].set(True, mode="drop")
+            rec_gain = rec_gain.at[idx].set(gain_s, mode="drop")
+            rec_is_cat = rec_is_cat.at[idx].set(is_cat_s, mode="drop")
+            rec_catmask = rec_catmask.at[idx].set(
+                jnp.where(is_cat_s[:, None], cm_s, False), mode="drop"
+            )
+            # next frontier: pair p (= rank) at locals (2p, 2p+1)
+            lut = (
+                jnp.full((L,), L, jnp.int32)
+                .at[jnp.where(ok, slot_s, L)].set(2 * rank, mode="drop")
+                .at[jnp.where(ok, new_id, L)].set(2 * rank + 1, mode="drop")
+            )
+            inv = (
+                jnp.full((S_next,), -1, jnp.int32)
+                .at[jnp.where(ok, 2 * rank, S_next)].set(slot_s, mode="drop")
+                .at[jnp.where(ok, 2 * rank + 1, S_next)].set(
+                    new_id, mode="drop"
+                )
+            )
+            pl_n = S_next // 2
+            parent_local = (
+                jnp.full((pl_n,), -1, jnp.int32)
+                .at[jnp.where(ok, rank, pl_n)].set(order, mode="drop")
+            )
+            # row routing: per ORIGINAL local j, this level's chosen split.
+            # The lookup arrays are (S+1,) with slot S as the ALL-FALSE
+            # pad: rows whose leaf left the frontier carry local == L,
+            # which the clamped gather maps to S — so invalid sorted
+            # positions must dump OUT of range (S+1, dropped), never
+            # into slot S itself (that pollution rerouted frozen-leaf
+            # rows by garbage split params)
+            sj = jnp.where(ok, order, S + 1)  # scatter index by local
+            split_ok_l = jnp.zeros((S + 1,), bool).at[sj].set(
+                True, mode="drop"
+            )
+            split_bf_l = jnp.zeros((S + 1,), jnp.int32).at[sj].set(
+                bf_s, mode="drop"
+            )
+            split_bb_l = jnp.zeros((S + 1,), jnp.int32).at[sj].set(
+                bb_s, mode="drop"
+            )
+            split_new_l = jnp.zeros((S + 1,), jnp.int32).at[sj].set(
+                new_id, mode="drop"
+            )
+            j_r = local                       # (n,) in [0, S]
+            okr = split_ok_l[j_r]
+            bf_r = split_bf_l[j_r]
+            row_bins = jnp.take_along_axis(bins, bf_r[:, None], axis=1)[:, 0]
+            if has_categorical:
+                split_iscat_l = jnp.zeros((S + 1,), bool).at[sj].set(
+                    is_cat_s, mode="drop"
+                )
+                split_cm_l = jnp.zeros((S + 1, B), bool).at[sj].set(
+                    cm_s, mode="drop"
+                )
+                goes_right = okr & jnp.where(
+                    split_iscat_l[j_r],
+                    ~split_cm_l[j_r, row_bins],
+                    row_bins > split_bb_l[j_r],
+                )
+            else:
+                goes_right = okr & (row_bins > split_bb_l[j_r])
+            row_slot = jnp.where(goes_right, split_new_l[j_r], row_slot)
+            k = k + ok.sum(dtype=jnp.int32)
+            continue
+
         lut_next0 = jnp.full((L,), L, jnp.int32)
         inv_next0 = jnp.full((S_next,), -1, jnp.int32)
         parent_local0 = jnp.full((S_next // 2,), -1, jnp.int32)

@@ -72,6 +72,16 @@ def main() -> None:
     out["sibling_speedup"] = round(
         out["depthwise_no_sibling_s"] / out["depthwise_s"], 2
     )
+    # vector-split A/B, both sides pinned explicitly (the backend default
+    # would silently compare sequential vs sequential off-TPU)
+    os.environ["MMLSPARK_TPU_GBDT_VECTOR_SPLIT"] = "1"
+    out["depthwise_vec_split_s"] = round(best2(cfgd), 2)
+    os.environ["MMLSPARK_TPU_GBDT_VECTOR_SPLIT"] = "0"
+    out["depthwise_seq_split_s"] = round(best2(cfgd), 2)
+    os.environ.pop("MMLSPARK_TPU_GBDT_VECTOR_SPLIT", None)
+    out["vector_split_speedup"] = round(
+        out["depthwise_seq_split_s"] / out["depthwise_vec_split_s"], 2
+    )
     # masked/partitioned ratio needs only the TPU timings — compute it
     # before (and regardless of) the sklearn head-to-head below
     out["partitioned_over_masked"] = round(
