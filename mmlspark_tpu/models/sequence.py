@@ -1,0 +1,146 @@
+"""Recurrent sequence models — the BiLSTM family of the zoo.
+
+The reference's deep-learning catalog includes recurrent graphs served
+through the batched eval stage (the BiLSTM entity-extraction sample runs
+a pretrained CNTK BiLSTM via CNTKModel; notebooks/samples/"DeepLearning -
+BiLSTM Medical Entity Extraction.ipynb", cntk/CNTKModel.scala:490-530).
+Here the recurrence is a ``flax.linen.RNN`` over an LSTM cell — a
+``lax.scan`` under jit, so the whole tagger is one fixed-shape XLA
+program: embedding and output projection hit the MXU, the scan carries
+the (B, hidden) state without Python-level loops, and ``XLAModel``
+serves it batched like any other backbone.
+
+Sequence batches are padded + masked (``seq_lengths``): the forward scan
+simply runs over the pad tail (its outputs are masked out), and the
+backward direction uses ``flax``'s ``reverse + keep_order`` which
+respects ``seq_lengths`` so padding never leaks into real positions.
+
+``XLAModel``'s apply contract is (variables, one batch array) — to keep
+the mask on the SERVING path too, pack each row's length as a trailing
+column (:func:`pack_lengths`) and serve
+:meth:`BiLSTMTagger.packed_apply_fn`, which unpacks it inside the
+jitted program. Serving unpacked tokens without lengths runs the
+backward scan over whatever sits in the pad tail.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class BiLSTMTagger(nn.Module):
+    """Per-token tagger: embed -> BiLSTM -> per-position logits.
+
+    Named outputs follow the zoo convention for ``cut_output_layers``:
+    ["logits", "hidden", "embedded"].
+    """
+
+    vocab_size: int
+    num_tags: int
+    embed_dim: int = 64
+    hidden_dim: int = 64
+    dtype: Any = jnp.float32
+
+    LAYER_NAMES = ("logits", "hidden", "embedded")
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jnp.ndarray,                    # (B, T) int32 token ids
+        seq_lengths: Optional[jnp.ndarray] = None,  # (B,) int32
+    ) -> dict:
+        outputs: dict = {}
+        x = nn.Embed(
+            self.vocab_size, self.embed_dim, dtype=self.dtype,
+            name="embed",
+        )(tokens)
+        outputs["embedded"] = x
+        fwd = nn.RNN(
+            nn.OptimizedLSTMCell(self.hidden_dim), name="lstm_fwd"
+        )(x, seq_lengths=seq_lengths)
+        bwd = nn.RNN(
+            nn.OptimizedLSTMCell(self.hidden_dim), reverse=True,
+            keep_order=True, name="lstm_bwd",
+        )(x, seq_lengths=seq_lengths)
+        h = jnp.concatenate([fwd, bwd], axis=-1)   # (B, T, 2H)
+        outputs["hidden"] = h
+        logits = nn.Dense(self.num_tags, dtype=self.dtype, name="head")(h)
+        outputs["logits"] = logits
+        if seq_lengths is not None:
+            # padded positions predict tag 0 deterministically so batch
+            # content can't leak through the pad tail
+            t = tokens.shape[1]
+            valid = jnp.arange(t)[None, :] < seq_lengths[:, None]
+            neg = jnp.full_like(logits, -1e9).at[..., 0].set(0.0)
+            outputs["logits"] = jnp.where(valid[..., None], logits, neg)
+        return outputs
+
+    def packed_apply_fn(self, node: str = "logits"):
+        """Jittable ``(variables, packed) -> output`` for ``XLAModel``:
+        ``packed`` is (B, T+1) int with each row's true length in the
+        LAST column (:func:`pack_lengths`), so the seq_lengths mask
+        rides the single-input serving contract."""
+
+        def fn(variables: Any, packed: jnp.ndarray) -> jnp.ndarray:
+            return self.apply(variables, packed[:, :-1], packed[:, -1])[node]
+
+        return fn
+
+
+def pack_lengths(tokens: np.ndarray, seq_lengths: np.ndarray) -> np.ndarray:
+    """(B, T) tokens + (B,) lengths -> (B, T+1) with the length as the
+    trailing column — the serving-side carrier for the pad mask."""
+    tokens = np.asarray(tokens)
+    return np.concatenate(
+        [tokens, np.asarray(seq_lengths, tokens.dtype)[:, None]], axis=1
+    )
+
+
+def train_tagger(
+    tokens: np.ndarray,
+    tags: np.ndarray,
+    vocab_size: int,
+    num_tags: int,
+    seq_lengths: Optional[np.ndarray] = None,
+    num_steps: int = 200,
+    learning_rate: float = 3e-3,
+    seed: int = 0,
+    **kw: Any,
+):
+    """Fit a :class:`BiLSTMTagger` with Adam on token-level cross-entropy
+    (masked by ``seq_lengths``). Returns (module, variables). One jitted
+    update step; the loop stays in Python for simplicity — tagger
+    training is a convenience for samples/tests, not a perf path."""
+    import jax
+    import optax
+
+    model = BiLSTMTagger(vocab_size=vocab_size, num_tags=num_tags, **kw)
+    tok = jnp.asarray(tokens, jnp.int32)
+    tg = jnp.asarray(tags, jnp.int32)
+    sl = None if seq_lengths is None else jnp.asarray(seq_lengths, jnp.int32)
+    variables = model.init(jax.random.PRNGKey(seed), tok[:1],
+                           None if sl is None else sl[:1])
+    opt = optax.adam(learning_rate)
+    opt_state = opt.init(variables)
+
+    def loss_fn(vs):
+        logits = model.apply(vs, tok, sl)["logits"]
+        ll = optax.softmax_cross_entropy_with_integer_labels(logits, tg)
+        if sl is not None:
+            mask = jnp.arange(tok.shape[1])[None, :] < sl[:, None]
+            return (ll * mask).sum() / jnp.maximum(mask.sum(), 1)
+        return ll.mean()
+
+    @jax.jit
+    def step(vs, os_):
+        loss, grads = jax.value_and_grad(loss_fn)(vs)
+        updates, os_ = opt.update(grads, os_)
+        return optax.apply_updates(vs, updates), os_, loss
+
+    for _ in range(num_steps):
+        variables, opt_state, loss = step(variables, opt_state)
+    return model, variables
