@@ -14,10 +14,13 @@ from mmlspark_tpu.core.pipeline import (
     Transformer,
     load_stage,
 )
+from mmlspark_tpu.core.faults import FaultPlan, Preempted
 from mmlspark_tpu.core.schema import ColumnInfo, Schema
 from mmlspark_tpu.core.utils import StopWatch
 
 __all__ = [
+    "FaultPlan",
+    "Preempted",
     "DataFrame",
     "Row",
     "Param",

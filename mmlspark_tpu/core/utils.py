@@ -110,18 +110,42 @@ def retry_with_backoff(
     fn: Callable[[], T],
     backoffs_ms: Sequence[int] = (100, 500, 1000),
     retryable: Callable[[Exception], bool] = lambda e: True,
+    jitter: bool = True,
+    deadline_s: Optional[float] = None,
+    rng: Optional[Any] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
 ) -> T:
     """FaultToleranceUtils.retryWithTimeout / RESTHelpers.retry analogue
-    (ModelDownloader.scala:37-47, RESTHelpers.scala:35-47)."""
+    (ModelDownloader.scala:37-47, RESTHelpers.scala:35-47).
+
+    ``jitter`` (default on): each wait is uniform in [0, backoff] — full
+    jitter, so a fleet of workers retrying the same dead dependency
+    desynchronizes instead of hammering it in lockstep every 100/500/
+    1000 ms. ``deadline_s``: overall budget — no sleep extends past it and
+    no attempt starts after it, so a retried call cannot overshoot its
+    caller's own timeout; on expiry the last error is raised. ``rng``/
+    ``sleep``/``clock`` are injectable for deterministic tests."""
+    import random as _random
+
+    draw = (rng or _random).uniform
+    start = clock()
     last: Optional[Exception] = None
-    for i, wait_ms in enumerate([0, *backoffs_ms]):
+    for wait_ms in [0, *backoffs_ms]:
         if wait_ms:
-            time.sleep(wait_ms / 1000.0)
+            delay = draw(0.0, wait_ms / 1000.0) if jitter else wait_ms / 1000.0
+            if deadline_s is not None and (
+                delay >= deadline_s - (clock() - start)
+            ):
+                break  # the next attempt would start at/after the deadline
+            sleep(delay)
         try:
             return fn()
         except Exception as e:  # noqa: BLE001 - retry boundary
             if not retryable(e):
                 raise
             last = e
+            if deadline_s is not None and clock() - start >= deadline_s:
+                break
     assert last is not None
     raise last

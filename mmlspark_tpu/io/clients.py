@@ -15,6 +15,7 @@ import urllib.error
 import urllib.request
 from typing import Callable, Optional, Sequence
 
+from mmlspark_tpu.core import faults
 from mmlspark_tpu.io.http_schema import HTTPResponseData
 
 Handler = Callable[[dict], dict]
@@ -23,7 +24,12 @@ Handler = Callable[[dict], dict]
 def send_request(request: dict, timeout: float = 60.0) -> dict:
     """Send one request dict, return a response dict. Network errors become
     status_code=0 responses (the reference surfaces nulls/errors as rows,
-    never exceptions mid-partition)."""
+    never exceptions mid-partition).
+
+    Fault point ``io.send_request``: an injected network error follows the
+    same become-a-status-0-row path as a real one; an int payload becomes
+    a synthetic response with that HTTP status (5xx storms); a rule delay
+    simulates a hung connection."""
     req = urllib.request.Request(
         request["url"],
         data=request.get("entity"),
@@ -31,6 +37,12 @@ def send_request(request: dict, timeout: float = 60.0) -> dict:
         method=request.get("method", "GET"),
     )
     try:
+        injected = faults.inject("io.send_request", context=request)
+        # bool excluded: a delay-only rule returns payload True, which
+        # must fall through to the REAL request (hung-connection sim),
+        # not become a synthetic status_code=True response
+        if isinstance(injected, int) and not isinstance(injected, bool):
+            return HTTPResponseData(injected, b"", "injected fault")
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return HTTPResponseData(
                 resp.status, resp.read(), getattr(resp, "reason", ""), dict(resp.headers)

@@ -1,5 +1,10 @@
 from mmlspark_tpu.models.gbdt.binning import BinMapper
 from mmlspark_tpu.models.gbdt.booster import Booster, Tree
+from mmlspark_tpu.models.gbdt.checkpoint import (
+    TrainCheckpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
 from mmlspark_tpu.models.gbdt.delegate import LightGBMDelegate
 from mmlspark_tpu.models.gbdt.train import TrainConfig, train
 from mmlspark_tpu.models.gbdt.estimators import (
@@ -18,6 +23,9 @@ __all__ = [
     "LightGBMDelegate",
     "TrainConfig",
     "train",
+    "TrainCheckpoint",
+    "save_checkpoint",
+    "load_checkpoint",
     "LightGBMClassifier",
     "LightGBMClassificationModel",
     "LightGBMRegressor",

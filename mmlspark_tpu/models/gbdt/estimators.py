@@ -114,6 +114,20 @@ class _LightGBMParams(
     )
     fair_c = Param("fair-loss scale c", default=1.0, type_=float)
     num_batches = Param("fold training into k sequential batches", default=0, type_=int)
+    checkpoint_dir = Param(
+        "directory for round-level preemption-safe checkpoints ('' = off); "
+        "see docs/robustness.md", default="", type_=str,
+    )
+    checkpoint_every = Param(
+        "boosting rounds between checkpoints (each save re-serializes the "
+        "full booster — small values trade training throughput for a "
+        "tighter recovery window)", default=10, type_=int
+    )
+    resume_from = Param(
+        "checkpoint directory to resume training from ('' = fresh run); "
+        "point it at checkpoint_dir for crash-loop-safe auto-resume",
+        default="", type_=str,
+    )
     delegate = ComplexParam(
         "LightGBMDelegate: lifecycle callbacks + dynamic learning rate"
     )
@@ -187,6 +201,19 @@ class _LightGBMParams(
         nb = self.get("num_batches")
         booster = self._init_booster()
         delegate = self.get("delegate")
+        if not (nb and nb > 1):
+            kw.setdefault("checkpoint_dir", self.get("checkpoint_dir") or None)
+            kw.setdefault("checkpoint_every", self.get("checkpoint_every"))
+            kw.setdefault("resume_from", self.get("resume_from") or None)
+        elif self.get("checkpoint_dir") or self.get("resume_from"):
+            # refuse rather than silently train unprotected: numBatches
+            # folds k train() calls whose round indices would collide in
+            # one checkpoint directory
+            raise ValueError(
+                "checkpoint_dir/resume_from are incompatible with "
+                "num_batches > 1 (per-segment round indices would collide "
+                "in one checkpoint directory)"
+            )
         if nb and nb > 1:
             n = len(data["y"])
             bounds = np.linspace(0, n, nb + 1).astype(int)

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mmlspark_tpu.core import faults
 from mmlspark_tpu.models.gbdt import objectives
 from mmlspark_tpu.parallel.mesh import DATA_AXIS as _DATA_AXIS
 from mmlspark_tpu.models.gbdt.binning import BinMapper
@@ -779,6 +780,9 @@ def train(
     init_booster: Optional[Booster] = None,
     base_score: Any = 0.0,
     shard: bool = True,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 10,
+    resume_from: Optional[str] = None,
 ) -> Booster:
     """Fit a booster on dense (n, d) features or a CSR triple.
 
@@ -789,7 +793,14 @@ def train(
 
     ``base_score``: boost_from_average baseline (scalar, or (k,) for
     multiclass) — added to the initial scores AND stored on the booster so
-    prediction replays it."""
+    prediction replays it.
+
+    Preemption safety (models/gbdt/checkpoint.py): ``checkpoint_dir``
+    serializes trees + device score/bag state + host RNG every
+    ``checkpoint_every`` rounds; ``resume_from`` continues from the last
+    complete checkpoint and reproduces the uninterrupted run bit-for-bit
+    (same config fingerprint enforced). Passing the same directory for
+    both gives crash-loop-safe auto-resume. Single-process only."""
     if cfg.boosting_type not in BOOSTING_TYPES:
         raise ValueError(f"boosting_type must be one of {BOOSTING_TYPES}")
     canon = objectives.canonical_objective(cfg.objective)
@@ -1106,6 +1117,77 @@ def train(
     delegate = cfg.delegate
     lr_cur = float(cfg.learning_rate)
 
+    # -- preemption-safe checkpoint/resume -----------------------------------
+    # round-level state capture: trees so far, device scores/bag (exact f32
+    # through the host round-trip), the host rng stream, early-stop counters.
+    # Resume restores all of it, so the continued run replays the identical
+    # iteration-by-iteration computation (chaos suite asserts bit-identity).
+    start_round = 0
+    resume_bag: Optional[np.ndarray] = None
+    _ckpt_fp = None
+    checkpoint_every = max(1, int(checkpoint_every))
+    if checkpoint_dir or resume_from:
+        if multihost:
+            raise ValueError(
+                "GBDT checkpoint/resume is single-process only (multihost "
+                "runs re-rendezvous via jax.distributed instead)"
+            )
+        from mmlspark_tpu.models.gbdt.checkpoint import (
+            TrainCheckpoint,
+            config_fingerprint,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        _ckpt_fp = config_fingerprint(cfg, n, d, k)
+    if resume_from:
+        _rck = load_checkpoint(resume_from)
+        if _rck is not None:
+            if _rck.fingerprint != _ckpt_fp:
+                raise ValueError(
+                    f"checkpoint at {resume_from!r} was written by a "
+                    "different training configuration or dataset shape — "
+                    "refusing to resume (fingerprint mismatch)"
+                )
+            start_round = _rck.round
+            scores = padded(
+                np.asarray(_rck.scores, np.float32).reshape(scores0.shape)
+            )
+            resume_bag = _rck.bag
+            if resume_bag is not None:
+                # the dispatch-per-iteration loop's bagging carry; the
+                # fast path re-pads resume_bag into its own scan carry
+                bag = padded(np.asarray(resume_bag, np.float32))
+            rng.bit_generator.state = _rck.rng_state
+            best_val = _rck.best_val
+            best_iter = _rck.best_iter
+            rounds_no_improve = _rck.rounds_no_improve
+            lr_cur = _rck.lr
+            booster.trees = list(_rck.booster.trees)
+            booster.best_iteration = _rck.booster.best_iteration
+            log.info("resuming GBDT training from round %d", start_round)
+
+    def _save_ckpt(next_round: int, bag_state: Any) -> None:
+        """Persist state as of entering ``next_round`` (reads the CURRENT
+        loop locals — call only at a completed round boundary)."""
+        save_checkpoint(
+            checkpoint_dir,
+            TrainCheckpoint(
+                round=next_round,
+                booster=booster,
+                scores=np.asarray(scores)[:n],
+                bag=(
+                    np.asarray(bag_state)[:n] if bag_state is not None else None
+                ),
+                rng_state=rng.bit_generator.state,
+                fingerprint=_ckpt_fp,
+                best_val=best_val,
+                best_iter=best_iter,
+                rounds_no_improve=rounds_no_improve,
+                lr=lr_cur,
+            ),
+        )
+
     # -- scan-fused fast path ------------------------------------------------
     # Everything whose loop needs no host work between iterations trains as
     # chunked lax.scan programs: ONE dispatch (and one packed record fetch)
@@ -1165,7 +1247,14 @@ def train(
             cfg.num_iterations if early_stopping_round == 0
             else min(cfg.num_iterations, max(16, early_stopping_round))
         )
+        if checkpoint_dir:
+            # chunk boundaries ARE the checkpoint (and fault-injection)
+            # boundaries; align them so every checkpoint lands exactly
+            # every checkpoint_every rounds
+            C_full = max(1, min(C_full, checkpoint_every))
         bag_dev = jnp.ones_like(w_dev)
+        if resume_bag is not None:
+            bag_dev = padded(np.asarray(resume_bag, np.float32))
         y_eval = valid_w = rf_base_dev = None
         rank_idx_dev = rank_valid_dev = None
         rank_idx_eval_dev = rank_valid_eval_dev = None
@@ -1190,9 +1279,12 @@ def train(
             g_pre_f = h_pre_f = None
         # lambdarank: y_dev is the relevance vector the device kernel reads
         y_enc_f = None if grad_pre_f else (y_onehot_dev if k > 1 else y_dev)
-        it0 = 0
+        it0 = start_round
         stopped = False
         while it0 < cfg.num_iterations and not stopped:
+            # preemption fires BETWEEN rounds: state through round it0-1 is
+            # checkpointed, rounds >= it0 have not run
+            faults.inject("gbdt.round", step=it0)
             C = min(C_full, cfg.num_iterations - it0)
             if cfg.feature_fraction < 1.0:
                 fms = np.empty((C, d), np.float32)
@@ -1264,10 +1356,13 @@ def train(
                 )
             )
             it0 += C
+            if checkpoint_dir and not stopped:
+                _save_ckpt(it0, bag_dev if use_bag else None)
 
     # dispatch-per-iteration path (dart / lambdarank / multihost /
     # delegates / host-only eval metrics)
-    for it in range(0 if fast else cfg.num_iterations):
+    for it in (range(0) if fast else range(start_round, cfg.num_iterations)):
+        faults.inject("gbdt.round", step=it)
         if delegate is not None:
             delegate.before_train_iteration(it)
             # dynamic learning rate (getLearningRate delegate semantics);
@@ -1456,6 +1551,12 @@ def train(
             delegate.after_train_iteration(
                 it, eval_result, stop_now or it == cfg.num_iterations - 1
             )
+        if checkpoint_dir and not stop_now and (it + 1) % checkpoint_every == 0:
+            # materialize deferred trees now — the checkpointed booster
+            # must contain every completed round (dart's are already eager)
+            booster.trees.extend(_trees_from_device_batched(pending_trees, mapper))
+            pending_trees = []
+            _save_ckpt(it + 1, bag)
         if stop_now:
             break
 

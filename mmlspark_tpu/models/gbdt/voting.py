@@ -41,6 +41,7 @@ from jax.sharding import PartitionSpec as P
 
 from mmlspark_tpu.models.gbdt.treegrow import GrownTree, split_gain_term, threshold_l1
 from mmlspark_tpu.ops.histogram import NUM_BINS, plane_histogram
+from mmlspark_tpu.parallel.compat import shard_map
 from mmlspark_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -347,7 +348,7 @@ def _voting_program(
 
     row = P(axis)
     rep = P()
-    mapped = jax.shard_map(
+    mapped = shard_map(
         program,
         mesh=mesh,
         in_specs=(row, row, row, row, rep, rep, rep, rep, rep, rep, rep),

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mmlspark_tpu.parallel.compat import shard_map
+
 NUM_BINS = 256
 
 # block sizes: DF features x NC rows per grid step; the one-hot block is
@@ -437,7 +439,7 @@ def multi_plane_histogram(
             )
             return jax.lax.psum(cube, shard_axis)
 
-        return jax.shard_map(
+        return shard_map(
             local,
             mesh=mesh,
             in_specs=(P(shard_axis, None), P(shard_axis, None), P(shard_axis)),
@@ -485,7 +487,7 @@ def _plane_histogram_shard_map(
         h = _plane_histogram_pallas(b.astype(jnp.int32), s, num_bins)
         return jax.lax.psum(h, shard_axis)
 
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(P(shard_axis, None), P(shard_axis, None)),

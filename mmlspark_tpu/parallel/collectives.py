@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from mmlspark_tpu.parallel.compat import axis_size, shard_map
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, get_mesh
 
 
@@ -45,7 +46,7 @@ def reduce_scatter(x: Any, axis: str = DATA_AXIS) -> Any:
 def ring_permute(x: Any, axis: str = DATA_AXIS, shift: int = 1) -> Any:
     """Neighbor exchange on the ring (building block for ring attention /
     pipelined allreduce)."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name=axis, perm=perm)
 
@@ -60,6 +61,14 @@ def shard_apply(
     in_specs: Any = P(DATA_AXIS),
     out_specs: Any = P(DATA_AXIS),
 ) -> Callable:
-    """``shard_map`` convenience wrapper bound to the default mesh."""
+    """``shard_map`` convenience wrapper bound to the default mesh.
+
+    Replication checking is off (as at every other shard_map site here):
+    the pmean-in-scan-carry pattern (vw/learner.py) legitimately moves
+    arrays between replicated and varying, which the old-jax ``check_rep``
+    tracker cannot type."""
     mesh = mesh or get_mesh()
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )

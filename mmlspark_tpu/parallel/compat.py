@@ -1,0 +1,59 @@
+"""Version-portable ``shard_map``.
+
+The framework targets the modern top-level ``jax.shard_map`` (whose
+replication-check kwarg is ``check_vma``); older jax releases (< 0.5,
+including the baked-in toolchain here) only ship
+``jax.experimental.shard_map.shard_map`` with the equivalent kwarg named
+``check_rep``. Every sharded-program lowering (collectives.shard_apply,
+the histogram plane psum, ring attention, the PV-Tree voting grower —
+and through them distributed VW) was failing on old jax for this reason
+alone; route all of them through this shim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+
+def axis_size(axis_name: str) -> int:
+    """``jax.lax.axis_size`` (new jax) with the classic ``psum(1, axis)``
+    fallback — a unit-literal psum constant-folds to the static size."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast(x: Any, axis_name: str, to: Optional[str] = None) -> Any:
+    """``jax.lax.pcast`` (new jax varying-axis typing) — an identity on
+    old jax, whose ``check_rep`` tracker does not type casts; pair with
+    ``check_vma=False``/``check_rep=False`` shard_maps."""
+    if hasattr(jax.lax, "pcast"):
+        if to is not None:
+            return jax.lax.pcast(x, axis_name, to=to)
+        return jax.lax.pcast(x, axis_name)
+    return x
+
+
+def shard_map(
+    f: Callable,
+    mesh: Optional[Any] = None,
+    in_specs: Any = None,
+    out_specs: Any = None,
+    check_vma: Optional[bool] = None,
+    **kw: Any,
+) -> Callable:
+    if hasattr(jax, "shard_map"):
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )

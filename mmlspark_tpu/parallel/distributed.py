@@ -12,11 +12,43 @@ barrier execution mode (LightGBMBase.scala:122-131).
 from __future__ import annotations
 
 import os
-from typing import Optional
+import threading
+from typing import Callable, Optional, Sequence
 
 import jax
 
+from mmlspark_tpu.core import faults
+
 _initialized = False
+
+
+class BarrierTimeoutError(TimeoutError):
+    """A gang sync point that did not complete in time — carries enough
+    diagnostics to name the culprit instead of hanging forever."""
+
+    def __init__(
+        self,
+        name: str,
+        timeout_s: float,
+        missing: Sequence[str] = (),
+        process_index: int = 0,
+        process_count: int = 1,
+    ):
+        self.name = name
+        self.timeout_s = timeout_s
+        self.missing = list(missing)
+        msg = (
+            f"barrier {name!r} timed out after {timeout_s:g}s on process "
+            f"{process_index}/{process_count}"
+        )
+        if self.missing:
+            msg += f"; missing hosts: {', '.join(self.missing)}"
+        else:
+            msg += (
+                "; no roster provided — pass expected=/alive= to barrier() "
+                "to identify the missing host"
+            )
+        super().__init__(msg)
 
 
 def initialize(
@@ -55,9 +87,7 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
-def barrier(name: str = "mmlspark_tpu_barrier") -> None:
-    """Host-level sync point. On multi-host this rides a tiny psum over the
-    global mesh; single-host it is a no-op."""
+def _barrier_collective() -> None:
     if jax.process_count() == 1:
         return
     import jax.numpy as jnp
@@ -68,3 +98,63 @@ def barrier(name: str = "mmlspark_tpu_barrier") -> None:
             jnp.ones((jax.local_device_count(),))
         )
     )
+
+
+def barrier(
+    name: str = "mmlspark_tpu_barrier",
+    timeout_s: Optional[float] = None,
+    expected: Optional[Sequence[str]] = None,
+    alive: Optional[Callable[[], Sequence[str]]] = None,
+) -> None:
+    """Host-level sync point. On multi-host this rides a tiny psum over the
+    global mesh; single-host it is a no-op.
+
+    ``timeout_s``: instead of blocking forever on a slow/dead host (the
+    failure the reference's Spark barrier stage would eventually kill),
+    raise :class:`BarrierTimeoutError` after this many seconds. The
+    abandoned collective keeps waiting on a daemon thread — XLA offers no
+    cancellation — but the caller gets control back with a diagnosis.
+
+    ``expected``/``alive``: optional roster for the diagnosis — the full
+    gang's host names and a callable returning the currently-live ones
+    (e.g. a TTL'd DriverRegistry roster, serving/registry.py); the error
+    then names exactly which hosts never arrived.
+
+    Fault point ``parallel.barrier``: an injected delay simulates the slow
+    host; an injected error simulates local rendezvous failure."""
+
+    def _wait() -> None:
+        faults.inject("parallel.barrier", context={"name": name})
+        _barrier_collective()
+
+    if timeout_s is None:
+        _wait()
+        return
+    done = threading.Event()
+    errs: list = []
+
+    def _run() -> None:
+        try:
+            _wait()
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            errs.append(e)
+        finally:
+            done.set()
+
+    threading.Thread(
+        target=_run, name=f"barrier-{name}", daemon=True
+    ).start()
+    if not done.wait(timeout_s):
+        missing: list = []
+        if expected is not None and alive is not None:
+            try:
+                missing = sorted(set(expected) - set(alive()))
+            except Exception:  # noqa: BLE001 — roster is best-effort
+                missing = []
+        raise BarrierTimeoutError(
+            name, timeout_s, missing,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+    if errs:
+        raise errs[0]

@@ -30,6 +30,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from mmlspark_tpu.core import faults
 from mmlspark_tpu.serving.server import ServiceInfo, WorkerServer
 
 log = logging.getLogger("mmlspark_tpu.serving")
@@ -208,6 +209,7 @@ class ServingGateway:
         self._retry_after_send = retry_after_send
         self._threads: list = []
         self._stop = threading.Event()
+        self._draining = False
         # per-dispatcher-thread persistent connections: the worker server
         # speaks HTTP/1.1 keep-alive, so reusing the TCP connection drops
         # the per-request handshake from the gateway overhead
@@ -261,6 +263,27 @@ class ServingGateway:
             t.join(5.0)
         self._ingress.stop()
 
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown for fleet rolls: flip ``/health`` to 503 (so a
+        load balancer stops routing here), keep dispatching until every
+        ACCEPTED request has been answered, then :meth:`stop`. Returns True
+        when fully drained, False when ``timeout_s`` expired with requests
+        still in flight (they get 503'd by stop()'s queue drain)."""
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        drained = False
+        while time.monotonic() < deadline:
+            if self._ingress.pending() == 0 and self._ingress.inflight() == 0:
+                drained = True
+                break
+            time.sleep(0.02)
+        self.stop()
+        return drained
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     @property
     def url(self) -> str:
         return f"http://{self._ingress.host}:{self._ingress.port}/"
@@ -295,6 +318,31 @@ class ServingGateway:
 
     # -- dispatch -------------------------------------------------------------
 
+    def _reply_health(self, req) -> None:
+        """``/health``: answered by the gateway itself, never forwarded.
+        200 only when routable (live backends, not draining) — the shape a
+        load balancer / k8s readiness probe consumes during a fleet roll."""
+        n = self._pool.size()
+        status = (
+            "draining" if self._draining
+            else "ok" if n > 0
+            else "no_backends"
+        )
+        body = json.dumps(
+            {
+                "status": status,
+                "backends": n,
+                "pending": self._ingress.pending(),
+                "forwarded": self.forwarded,
+                "retried": self.retried,
+                "failed": self.failed,
+            }
+        ).encode()
+        self._ingress.reply_to(
+            req.id, body, 200 if status == "ok" else 503,
+            {"Content-Type": "application/json"},
+        )
+
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
             reqs = self._ingress.get_next_batch(max_n=16, timeout_s=0.2)
@@ -303,7 +351,17 @@ class ServingGateway:
                     # a popped request must still get an answer
                     self._ingress.reply_to(r.id, b"gateway stopping", 503)
                     continue
+                if r.path.split("?", 1)[0] in ("/health", "/healthz"):
+                    self._reply_health(r)
+                    continue
                 self._forward(r)
+            if reqs:
+                # prune the ingress replay history behind the answered
+                # requests: the gateway's recovery story is cross-worker
+                # re-dispatch, not epoch replay, and without this commit
+                # every request ever accepted (incl. each LB /health
+                # probe) stays in _history forever — an unbounded leak
+                self._ingress.auto_commit()
         # drain: answer whatever is still queued so clients aren't hung
         # (stop() joins dispatchers BEFORE closing the ingress, so these
         # replies still reach their sockets)
@@ -378,6 +436,13 @@ class ServingGateway:
                 break
             sent = False
             try:
+                # fault point gateway.forward: an injected OSError here is
+                # indistinguishable from a worker that died before the
+                # request was delivered — exercises the re-dispatch path
+                faults.inject(
+                    "gateway.forward",
+                    context={"backend": (b.host, b.port), "attempt": attempt},
+                )
                 conn, cached = self._conn_for(b)
                 # request() returning means the body was fully flushed; an
                 # exception DURING it leaves an incomplete body the worker
@@ -400,6 +465,13 @@ class ServingGateway:
                         req.method, b.path, body=req.body, headers=headers
                     )
                 sent = True
+                # fault point gateway.response: an injected TimeoutError
+                # here is a worker hanging mid-execution after the body was
+                # delivered — exercises the at-most-once 504 path
+                faults.inject(
+                    "gateway.response",
+                    context={"backend": (b.host, b.port), "attempt": attempt},
+                )
                 resp = conn.getresponse()
                 body = resp.read()
                 if resp.will_close:

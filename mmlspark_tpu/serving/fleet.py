@@ -92,12 +92,52 @@ def make_model_handler(model_spec: str) -> Callable:
     raise ValueError(f"unknown model spec {model_spec!r}")
 
 
-def run_registry(host: str = "0.0.0.0", port: int = 9090) -> Any:
+def run_registry(
+    host: str = "0.0.0.0", port: int = 9090, ttl_s: Optional[float] = None
+) -> Any:
     from mmlspark_tpu.serving.registry import DriverRegistry
 
-    reg = DriverRegistry(host=host, port=port)
+    reg = DriverRegistry(host=host, port=port, ttl_s=ttl_s)
     print(f"registry: {reg.url}", flush=True)
     return reg
+
+
+class _WorkerStopper:
+    """Shutdown handle for a fleet worker: stops the heartbeat AND
+    deregisters from the registry, so a clean SIGTERM removes the roster
+    entry immediately instead of leaving it stale until TTL expiry or
+    gateway-failure eviction. Keeps the Event surface (``set``/``is_set``/
+    ``wait``) callers and tests already use."""
+
+    def __init__(self, ev: threading.Event, registry_url: str, info: Any):
+        self._ev = ev
+        self._registry_url = registry_url
+        self._info = info
+        self._beat: Optional[threading.Thread] = None
+
+    def set(self) -> None:
+        from mmlspark_tpu.serving.registry import DriverRegistry
+
+        if self._ev.is_set():
+            return
+        self._ev.set()
+        if self._beat is not None:
+            # no heartbeat may land AFTER the goodbye, or the entry would
+            # resurrect until the next expiry — so outwait even a register
+            # POST stuck at its full 10 s send_request timeout
+            self._beat.join(12.0)
+        try:
+            DriverRegistry.deregister(self._registry_url, self._info)
+        except Exception as e:  # noqa: BLE001 — registry may already be gone
+            print(f"worker: deregister failed: {e}", file=sys.stderr, flush=True)
+
+    stop = set
+
+    def is_set(self) -> bool:
+        return self._ev.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._ev.wait(timeout)
 
 
 def run_worker(
@@ -110,7 +150,8 @@ def run_worker(
     advertise_host: Optional[str] = None,
 ) -> tuple:
     """Start a worker, register it, and re-register on a heartbeat thread
-    (a restarted registry re-learns live workers within one beat)."""
+    (a restarted registry re-learns live workers within one beat). The
+    returned stopper deregisters on shutdown (clean-SIGTERM path)."""
     from mmlspark_tpu.serving.query import ServingQuery
     from mmlspark_tpu.serving.registry import DriverRegistry
     from mmlspark_tpu.serving.server import WorkerServer
@@ -125,18 +166,23 @@ def run_worker(
         info = dataclasses.replace(info, host=advertise_host)
     q = ServingQuery(srv, make_model_handler(model)).start()
     stop = threading.Event()
+    stopper = _WorkerStopper(stop, registry_url, info)
 
     def beat() -> None:
         while not stop.is_set():
             try:
-                DriverRegistry.register(registry_url, info)
+                # checked INSIDE the try so a shutdown signaled between the
+                # loop test and the POST still skips the re-register
+                if not stop.is_set():
+                    DriverRegistry.register(registry_url, info)
             except Exception as e:  # noqa: BLE001 — registry may be restarting
                 print(f"worker: register failed: {e}", file=sys.stderr, flush=True)
             stop.wait(heartbeat_s)
 
-    threading.Thread(target=beat, name="worker-heartbeat", daemon=True).start()
+    stopper._beat = threading.Thread(target=beat, name="worker-heartbeat", daemon=True)
+    stopper._beat.start()
     print(f"worker: {info.host}:{info.port} model={model}", flush=True)
-    return srv, q, stop
+    return srv, q, stopper
 
 
 def run_gateway(
@@ -156,7 +202,7 @@ def run_gateway(
     return gw
 
 
-def _serve_forever(stoppables: list) -> None:
+def _serve_forever(stoppables: list, drain_s: float = 0.0) -> None:
     ev = threading.Event()
 
     def on_sig(signum: int, frame: Any) -> None:
@@ -167,17 +213,33 @@ def _serve_forever(stoppables: list) -> None:
     ev.wait()
     for s in stoppables:
         try:
-            s.stop() if hasattr(s, "stop") else s.set()
+            if drain_s > 0 and hasattr(s, "drain"):
+                # gateway roll: 503 /health, finish accepted requests, stop
+                s.drain(timeout_s=drain_s)
+            elif hasattr(s, "stop"):
+                s.stop()
+            else:
+                s.set()
         except Exception:  # noqa: BLE001
             pass
 
 
 def main(argv: Optional[list] = None) -> None:
     ap = argparse.ArgumentParser(prog="mmlspark_tpu.serving.fleet")
+    ap.add_argument(
+        "--fault-plan", default=None,
+        help="JSON fault plan (inline or a file path) armed for this "
+        "process — chaos-smokes a docker-compose fleet (core/faults.py)",
+    )
     sub = ap.add_subparsers(dest="role", required=True)
     r = sub.add_parser("registry")
     r.add_argument("--host", default="0.0.0.0")
     r.add_argument("--port", type=int, default=9090)
+    r.add_argument(
+        "--ttl-s", type=float, default=None,
+        help="drop roster entries not re-registered within this many "
+        "seconds (a few worker heartbeat periods)",
+    )
     w = sub.add_parser("worker")
     w.add_argument("--registry", required=True)
     w.add_argument("--model", default="echo")
@@ -194,9 +256,19 @@ def main(argv: Optional[list] = None) -> None:
     g.add_argument("--host", default="0.0.0.0")
     g.add_argument("--port", type=int, default=8080)
     g.add_argument("--service-name", default="serving")
+    g.add_argument(
+        "--drain-s", type=float, default=10.0,
+        help="on SIGTERM: finish accepted requests for up to this long "
+        "(0 = stop immediately)",
+    )
     args = ap.parse_args(argv)
+    if args.fault_plan:
+        from mmlspark_tpu.core.faults import FaultPlan
+
+        FaultPlan.from_spec(args.fault_plan).install()
+        print(f"fleet: fault plan armed ({args.fault_plan})", flush=True)
     if args.role == "registry":
-        reg = run_registry(args.host, args.port)
+        reg = run_registry(args.host, args.port, args.ttl_s)
         _serve_forever([reg])
     elif args.role == "worker":
         srv, q, stop = run_worker(
@@ -206,7 +278,7 @@ def main(argv: Optional[list] = None) -> None:
         _serve_forever([stop, q, srv])
     else:
         gw = run_gateway(args.registry, args.host, args.port, args.service_name)
-        _serve_forever([gw])
+        _serve_forever([gw], drain_s=args.drain_s)
 
 
 if __name__ == "__main__":

@@ -23,16 +23,40 @@ class DriverRegistry:
     def __init__(
         self, host: str = "127.0.0.1", port: int = 0,
         max_entries_per_service: int = 256,
+        ttl_s: Optional[float] = None,
     ):
         """``max_entries_per_service`` bounds each roster: crash-looping
         workers on ephemeral ports register a NEW (host, port) every
         restart, and without a cap the dead entries accumulate without
-        bound (oldest registrations are dropped first)."""
+        bound (oldest registrations are dropped first).
+
+        ``ttl_s``: heartbeat expiry — an entry whose last (re)registration
+        is older than this is dropped at the next read. Workers heartbeat
+        by re-registering (serving/fleet.py), so a silently-dead host
+        vanishes from the roster within one TTL instead of lingering until
+        gateway failures evict it; set it to a few heartbeat periods."""
         self.host = host
         self.max_entries_per_service = max_entries_per_service
+        self.ttl_s = ttl_s
         self._services: dict[str, list] = {}
         self._lock = threading.Lock()
         registry = self
+
+        def expire_locked() -> None:
+            if registry.ttl_s is None:
+                return
+            floor = time.time() - registry.ttl_s
+            for svc in list(registry._services):
+                kept = [
+                    e for e in registry._services[svc]
+                    if e.get("ts", 0.0) >= floor
+                ]
+                if kept:
+                    registry._services[svc] = kept
+                else:
+                    del registry._services[svc]
+
+        self._expire_locked = expire_locked
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -69,8 +93,39 @@ class DriverRegistry:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_DELETE(self):
+                """Explicit deregistration: a cleanly-stopping worker
+                removes its roster entry instead of waiting out the TTL."""
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    info = json.loads(self.rfile.read(n))
+                    name = info["name"]
+                    key = (info.get("host"), info.get("port"))
+                except (ValueError, KeyError, TypeError):
+                    self.send_response(400)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                with registry._lock:
+                    entries = registry._services.get(name, [])
+                    before = len(entries)
+                    entries[:] = [
+                        e for e in entries
+                        if (e.get("host"), e.get("port")) != key
+                    ]
+                    removed = before - len(entries)
+                    if not entries:
+                        registry._services.pop(name, None)
+                body = json.dumps({"deregistered": removed > 0}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 with registry._lock:
+                    registry._expire_locked()
                     body = json.dumps(registry._services).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -91,9 +146,16 @@ class DriverRegistry:
 
     def services(self, name: Optional[str] = None) -> list:
         with self._lock:
+            self._expire_locked()
             if name is not None:
                 return list(self._services.get(name, ()))
             return [s for infos in self._services.values() for s in infos]
+
+    def live_hosts(self, name: str) -> list:
+        """Host names currently on the (TTL-filtered) roster — the shape
+        ``parallel.distributed.barrier(alive=...)`` consumes to name the
+        missing host in its timeout diagnostics."""
+        return sorted({e.get("host") for e in self.services(name)})
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -111,6 +173,21 @@ class DriverRegistry:
                 json.dumps({
                     "name": info.name, "host": info.host,
                     "port": info.port, "path": info.path,
+                }),
+            ),
+            timeout=10.0,
+        )
+        return resp["status_code"] == 200
+
+    @staticmethod
+    def deregister(registry_url: str, info: ServiceInfo) -> bool:
+        """Worker-side: remove this worker's roster entry (clean SIGTERM
+        path — the TTL handles workers that die without saying goodbye)."""
+        resp = send_request(
+            HTTPRequestData(
+                registry_url, "DELETE", {"Content-Type": "application/json"},
+                json.dumps({
+                    "name": info.name, "host": info.host, "port": info.port,
                 }),
             ),
             timeout=10.0,

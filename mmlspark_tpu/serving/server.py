@@ -383,3 +383,9 @@ class WorkerServer:
     def pending(self) -> int:
         with self._lock:
             return len(self._queue)
+
+    def inflight(self) -> int:
+        """Accepted requests not yet replied to (queued OR handed to a
+        dispatcher) — the set a graceful drain must see through to zero."""
+        with self._lock:
+            return len(self._routing)

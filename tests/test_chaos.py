@@ -1,0 +1,690 @@
+"""Chaos suite: drive every fault-injection point end-to-end on CPU.
+
+Each test arms a deterministic :class:`FaultPlan` (core/faults.py) and
+asserts the matching recovery machinery actually recovers:
+
+- ``io.send_request``  — injected network errors become status-0 rows;
+  injected 5xx retried through by AdvancedHandler;
+- ``gateway.forward``  — workers dying mid-flight; the gateway
+  re-dispatches and completes 100% of accepted requests;
+- ``gateway.response`` — post-send hangs; at-most-once 504 vs opt-in
+  re-dispatch;
+- ``parallel.barrier`` — a slow host; the timeout diagnostic names the
+  missing host off a TTL'd registry roster;
+- ``gbdt.round``       — preemption between boosting rounds; training
+  resumed from the round checkpoint is bit-identical to uninterrupted.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.faults import FaultPlan, Preempted, active_plan
+
+pytestmark = pytest.mark.chaos
+
+
+# -- the plan/schedule machinery itself --------------------------------------
+
+
+def test_fault_plan_schedules_are_deterministic():
+    def fires(seed):
+        plan = FaultPlan(seed=seed).on("p", probability=0.3, payload=1)
+        with plan.armed():
+            for i in range(50):
+                plan.check("p", step=i)
+        return plan.fires()
+
+    a, b = fires(7), fires(7)
+    assert a == b and 0 < len(a) < 50  # same seed -> same schedule
+    assert fires(8) != a               # different seed -> different schedule
+
+
+def test_fault_plan_at_every_and_max_fires():
+    plan = FaultPlan().on("a", at=(2, 5), payload="x")
+    plan.on("b", after=1, every=3, payload="y", max_fires=2)
+    with plan.armed():
+        got_a = [plan.check("a", step=i) for i in range(7)]
+        got_b = [plan.check("b", step=i) for i in range(12)]
+    assert [i for i, v in enumerate(got_a) if v] == [2, 5]
+    assert [i for i, v in enumerate(got_b) if v] == [1, 4]  # capped at 2
+
+
+def test_fault_plan_json_spec_roundtrip():
+    plan = FaultPlan.from_spec(
+        '{"seed": 3, "rules": [{"point": "io.send_request", '
+        '"error": "ConnectionError", "at": [0]}, '
+        '{"point": "io.send_request", "payload": 503, "at": [1]}]}'
+    )
+    assert plan.seed == 3 and plan.points() == ["io.send_request"]
+    with plan.armed():
+        with pytest.raises(ConnectionError):
+            plan.check("io.send_request", step=0)
+        assert plan.check("io.send_request", step=1) == 503
+    assert active_plan() is None  # armed() uninstalls
+    # a typo'd error name must fail at plan load, not as a mystery
+    # FaultError from inside the injected call site
+    with pytest.raises(ValueError, match="unknown fault error name"):
+        FaultPlan.from_spec(
+            '{"rules": [{"point": "p", "error": "ConectionError"}]}'
+        )
+
+
+# -- io.send_request ---------------------------------------------------------
+
+
+def test_send_request_injected_faults_follow_error_contract():
+    from mmlspark_tpu.io.clients import send_request
+
+    plan = FaultPlan().on(
+        "io.send_request", error=ConnectionError, at=(0,)
+    ).on("io.send_request", payload=503, at=(1,))
+    with plan.armed():
+        # injected network error -> status-0 row, never an exception
+        r0 = send_request({"url": "http://127.0.0.1:1/"})
+        assert r0["status_code"] == 0 and "injected" in r0["reason"]
+        # injected int payload -> synthetic HTTP status
+        r1 = send_request({"url": "http://127.0.0.1:1/"})
+        assert r1["status_code"] == 503
+    # a delay-only rule (payload True, a bool) must fall through to the
+    # REAL request after sleeping — not become a status_code=True row
+    plan2 = FaultPlan().on("io.send_request", delay_s=0.05, at=(0,))
+    with plan2.armed():
+        t0 = time.monotonic()
+        r2 = send_request({"url": "http://127.0.0.1:1/"}, timeout=2.0)
+        assert time.monotonic() - t0 >= 0.05
+        assert r2["status_code"] == 0  # the real connect was attempted
+
+
+def test_advanced_handler_retries_through_injected_5xx():
+    from mmlspark_tpu.io.clients import AdvancedHandler
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer()
+    info = srv.start()
+    q = ServingQuery(srv, _echo_handler).start()
+    plan = FaultPlan().on("io.send_request", payload=503, at=(0, 1))
+    try:
+        with plan.armed():
+            resp = AdvancedHandler(backoffs_ms=(5, 5, 5))(
+                HTTPRequestData(
+                    f"http://127.0.0.1:{info.port}/", "POST",
+                    {"Content-Type": "application/json"}, '{"v": 1}',
+                )
+            )
+        assert resp["status_code"] == 200
+        assert json.loads(resp["entity"]) == {"echo": {"v": 1}}
+        assert len(plan.fires()) == 2  # two synthetic 503s were retried
+    finally:
+        q.stop()
+        srv.stop()
+
+
+# -- serving gateway ---------------------------------------------------------
+
+
+def _echo_handler(reqs):
+    out = {}
+    for r in reqs:
+        body = json.loads(r.body) if r.body else {}
+        out[r.id] = (200, json.dumps({"echo": body}).encode(), {})
+    return out
+
+
+def _worker(handler=_echo_handler):
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer()
+    info = srv.start()
+    q = ServingQuery(srv, handler).start()
+    return srv, q, info
+
+
+def _post(port, path, obj, method="POST"):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        body = json.dumps(obj) if obj is not None else None
+        c.request(method, path, body=body,
+                  headers={"Content-Type": "application/json"})
+        r = c.getresponse()
+        return r.status, r.read()
+    finally:
+        c.close()
+
+
+def test_gateway_worker_death_mid_flight_zero_lost():
+    """Every 4th forward attempt dies like a worker crash; the gateway
+    re-dispatches and 100% of accepted requests complete correctly."""
+    from mmlspark_tpu.serving.distributed import ServingGateway
+
+    s1, q1, i1 = _worker()
+    s2, q2, i2 = _worker()
+    gw = ServingGateway(workers=[i1, i2], request_timeout_s=5.0)
+    ginfo = gw.start()
+    plan = FaultPlan().on(
+        "gateway.forward", error=ConnectionResetError, every=4
+    )
+    try:
+        with plan.armed():
+            for i in range(40):
+                status, data = _post(ginfo.port, "/", {"i": i})
+                assert status == 200, f"request {i} lost (status {status})"
+                assert json.loads(data)["echo"]["i"] == i
+        assert gw.retried >= 10 and gw.failed == 0
+        assert len(plan.fires()) == gw.retried
+    finally:
+        gw.stop()
+        for s, q in ((s1, q1), (s2, q2)):
+            q.stop()
+            s.stop()
+
+
+def test_gateway_post_send_hang_is_at_most_once_504():
+    from mmlspark_tpu.serving.distributed import ServingGateway
+
+    s1, q1, i1 = _worker()
+    gw = ServingGateway(workers=[i1], request_timeout_s=5.0)
+    ginfo = gw.start()
+    plan = FaultPlan().on("gateway.response", error=TimeoutError, at=(0,))
+    try:
+        with plan.armed():
+            status, data = _post(ginfo.port, "/", {"i": 0})
+            assert status == 504 and b"timed out" in data
+            status, data = _post(ginfo.port, "/", {"i": 1})
+            assert status == 200  # the hang was not held against the pool
+        assert gw.failed == 1
+    finally:
+        gw.stop()
+        q1.stop()
+        s1.stop()
+
+
+def test_gateway_post_send_hang_redispatches_when_idempotent():
+    from mmlspark_tpu.serving.distributed import ServingGateway
+
+    s1, q1, i1 = _worker()
+    s2, q2, i2 = _worker()
+    gw = ServingGateway(
+        workers=[i1, i2], request_timeout_s=5.0, retry_after_send=True
+    )
+    ginfo = gw.start()
+    plan = FaultPlan().on("gateway.response", error=TimeoutError, at=(0,))
+    try:
+        with plan.armed():
+            status, data = _post(ginfo.port, "/", {"i": 0})
+        assert status == 200 and json.loads(data)["echo"]["i"] == 0
+        assert gw.retried == 1 and gw.failed == 0
+    finally:
+        gw.stop()
+        for s, q in ((s1, q1), (s2, q2)):
+            q.stop()
+            s.stop()
+
+
+@pytest.mark.xdist_group("latency")
+def test_gateway_health_endpoint_and_graceful_drain():
+    from mmlspark_tpu.serving.distributed import ServingGateway
+
+    def slow_echo(reqs):
+        time.sleep(0.4)
+        return _echo_handler(reqs)
+
+    s1, q1, i1 = _worker(slow_echo)
+    gw = ServingGateway(workers=[i1], request_timeout_s=10.0)
+    ginfo = gw.start()
+    status, data = _post(ginfo.port, "/health", None, method="GET")
+    health = json.loads(data)
+    assert status == 200 and health["status"] == "ok"
+    assert health["backends"] == 1
+
+    results = []
+
+    def client():
+        results.append(_post(ginfo.port, "/", {"i": 1}))
+
+    t = threading.Thread(target=client)
+    t.start()
+    time.sleep(0.1)  # request accepted and dispatched to the slow worker
+
+    drain_health = []
+
+    def probe():
+        time.sleep(0.05)  # after drain() has flipped the flag
+        drain_health.append(_post(ginfo.port, "/health", None, method="GET"))
+
+    p = threading.Thread(target=probe)
+    p.start()
+    try:
+        assert gw.drain(timeout_s=10.0)  # waits out the in-flight request
+        t.join(5.0)
+        p.join(5.0)
+        # the accepted request was NOT dropped by the roll
+        assert results and results[0][0] == 200
+        assert json.loads(results[0][1])["echo"]["i"] == 1
+        # while draining, /health told the balancer to route elsewhere
+        assert drain_health and drain_health[0][0] == 503
+        assert json.loads(drain_health[0][1])["status"] == "draining"
+    finally:
+        q1.stop()
+        s1.stop()
+
+
+# -- registry TTL + clean deregistration -------------------------------------
+
+
+@pytest.mark.xdist_group("latency")
+def test_registry_ttl_expires_silently_dead_workers():
+    from mmlspark_tpu.serving.registry import DriverRegistry
+    from mmlspark_tpu.serving.server import ServiceInfo
+
+    reg = DriverRegistry(host="127.0.0.1", port=0, ttl_s=0.25)
+    try:
+        info = ServiceInfo("svc", "host-a", 1234)
+        assert DriverRegistry.register(reg.url, info)
+        assert [e["host"] for e in reg.services("svc")] == ["host-a"]
+        time.sleep(0.4)  # no heartbeat: the entry must expire, not linger
+        assert reg.services("svc") == []
+        assert DriverRegistry.register(reg.url, info)  # heartbeat revives
+        assert reg.services("svc")
+    finally:
+        reg.stop()
+
+
+def test_fleet_worker_deregisters_on_clean_shutdown():
+    from mmlspark_tpu.serving import fleet
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0)
+    srv, q, stop = fleet.run_worker(
+        reg.url, model="echo", host="127.0.0.1", heartbeat_s=30.0
+    )
+    try:
+        deadline = time.monotonic() + 5.0
+        while not reg.services("serving") and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert reg.services("serving")
+        stop.stop()  # clean SIGTERM path: roster entry removed NOW
+        assert reg.services("serving") == []
+    finally:
+        q.stop()
+        srv.stop()
+        reg.stop()
+
+
+# -- barrier timeout diagnostics ---------------------------------------------
+
+
+@pytest.mark.xdist_group("latency")
+def test_barrier_timeout_names_missing_host():
+    from mmlspark_tpu.parallel.distributed import BarrierTimeoutError, barrier
+    from mmlspark_tpu.serving.registry import DriverRegistry
+    from mmlspark_tpu.serving.server import ServiceInfo
+
+    reg = DriverRegistry(host="127.0.0.1", port=0, ttl_s=0.5)
+    try:
+        DriverRegistry.register(reg.url, ServiceInfo("hosts", "host-a", 1))
+        DriverRegistry.register(reg.url, ServiceInfo("hosts", "host-b", 2))
+        time.sleep(0.7)  # both heartbeats lapse...
+        DriverRegistry.register(reg.url, ServiceInfo("hosts", "host-a", 1))
+        # ...and only host-a comes back: host-b is the dead one
+        plan = FaultPlan().on("parallel.barrier", delay_s=2.0)
+        with plan.armed():
+            with pytest.raises(BarrierTimeoutError) as ei:
+                barrier(
+                    "epoch-sync",
+                    timeout_s=0.2,
+                    expected=["host-a", "host-b"],
+                    alive=lambda: reg.live_hosts("hosts"),
+                )
+        assert ei.value.missing == ["host-b"]
+        assert "host-b" in str(ei.value) and "epoch-sync" in str(ei.value)
+    finally:
+        reg.stop()
+
+
+def test_barrier_without_timeout_and_error_relay():
+    from mmlspark_tpu.parallel.distributed import barrier
+
+    barrier("fast-path")  # single-process no-op must stay a no-op
+    plan = FaultPlan().on("parallel.barrier", error=RuntimeError, at=(0,))
+    with plan.armed():
+        with pytest.raises(RuntimeError):
+            barrier("relay", timeout_s=5.0)  # worker-thread error surfaces
+
+
+# -- GBDT preemption + checkpoint/resume -------------------------------------
+
+
+def _toy_binary(n=400, d=8, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] + 0.1 * r.normal(size=n) > 0).astype(
+        np.float64
+    )
+    return x, y
+
+
+def _preempt_resume_roundtrip(tmp_path, cfg, preempt_round, valid_mask=None):
+    """Train uninterrupted; train again preempted at ``preempt_round`` and
+    resume from the checkpoint; return both model strings."""
+    from mmlspark_tpu.models.gbdt.train import train
+
+    x, y = _toy_binary()
+    kw = dict(valid_mask=valid_mask, checkpoint_every=1)
+    ref = train(x, y, cfg, checkpoint_dir=str(tmp_path / "ref"), **kw)
+    ck = str(tmp_path / "ck")
+    plan = FaultPlan().on("gbdt.round", at=(preempt_round,), error=Preempted)
+    with plan.armed():
+        with pytest.raises(Preempted):
+            train(x, y, cfg, checkpoint_dir=ck, **kw)
+    assert plan.fires() == [("gbdt.round", preempt_round)]
+    resumed = train(x, y, cfg, checkpoint_dir=ck, resume_from=ck, **kw)
+    return ref.to_model_string(), resumed.to_model_string()
+
+
+def test_gbdt_preempt_resume_bit_identical(tmp_path):
+    """The headline guarantee: preempt at round k, resume, get the SAME
+    model bit-for-bit (scan-fused fast path)."""
+    from mmlspark_tpu.models.gbdt.train import TrainConfig
+
+    cfg = TrainConfig(
+        objective="binary", num_iterations=8, num_leaves=7, seed=5
+    )
+    ref, resumed = _preempt_resume_roundtrip(tmp_path, cfg, preempt_round=5)
+    assert resumed == ref
+
+
+def test_gbdt_preempt_resume_bit_identical_with_sampling(tmp_path):
+    """Resume mid-bagging-period with feature subsampling: the checkpoint
+    must carry the bagging mask AND the host RNG stream exactly."""
+    from mmlspark_tpu.models.gbdt.train import TrainConfig
+
+    cfg = TrainConfig(
+        objective="binary", num_iterations=8, num_leaves=7, seed=11,
+        bagging_fraction=0.7, bagging_freq=2, feature_fraction=0.6,
+    )
+    # round 5 is mid-period (5 % 2 != 0): the restored mask, not a fresh
+    # draw, must drive rounds 5..7
+    ref, resumed = _preempt_resume_roundtrip(tmp_path, cfg, preempt_round=5)
+    assert resumed == ref
+
+
+def test_gbdt_preempt_resume_bit_identical_goss_with_eval(tmp_path):
+    from mmlspark_tpu.models.gbdt.train import TrainConfig
+
+    cfg = TrainConfig(
+        objective="binary", num_iterations=8, num_leaves=7, seed=3,
+        boosting_type="goss", feature_fraction=0.6,
+    )
+    valid = np.zeros(400, bool)
+    valid[350:] = True  # eval path: best_val/best_iter counters checkpoint too
+    ref, resumed = _preempt_resume_roundtrip(
+        tmp_path, cfg, preempt_round=5, valid_mask=valid
+    )
+    assert resumed == ref
+
+
+def test_gbdt_preempt_resume_bit_identical_dart_slow_path(tmp_path):
+    """dart runs the dispatch-per-iteration path and mutates PAST trees
+    with host-rng dropouts — the harshest resume case."""
+    from mmlspark_tpu.models.gbdt.train import TrainConfig
+
+    cfg = TrainConfig(
+        objective="binary", num_iterations=8, num_leaves=7, seed=9,
+        boosting_type="dart", drop_rate=0.5, skip_drop=0.0,
+    )
+    ref, resumed = _preempt_resume_roundtrip(tmp_path, cfg, preempt_round=5)
+    assert resumed == ref
+
+
+def test_gbdt_resume_rejects_config_mismatch(tmp_path):
+    from mmlspark_tpu.models.gbdt.train import TrainConfig, train
+
+    x, y = _toy_binary()
+    ck = str(tmp_path / "ck")
+    cfg = TrainConfig(objective="binary", num_iterations=4, num_leaves=7)
+    train(x, y, cfg, checkpoint_dir=ck, checkpoint_every=2)
+    other = TrainConfig(objective="binary", num_iterations=4, num_leaves=15)
+    with pytest.raises(ValueError, match="fingerprint"):
+        train(x, y, other, resume_from=ck)
+
+
+def test_checkpoint_torn_save_is_invisible(tmp_path):
+    """LATEST flips only after a round dir is complete: garbage from a
+    preemption mid-save must never be loaded."""
+    import os
+
+    from mmlspark_tpu.models.gbdt.booster import Booster
+    from mmlspark_tpu.models.gbdt.checkpoint import (
+        TrainCheckpoint,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    d = str(tmp_path)
+    rng = np.random.default_rng(0)
+    ck = TrainCheckpoint(
+        round=2, booster=Booster(), scores=np.zeros(4, np.float32),
+        bag=None, rng_state=rng.bit_generator.state, fingerprint="fp",
+    )
+    save_checkpoint(d, ck)
+    # a torn save: round dir partially written, LATEST not yet flipped
+    torn = os.path.join(d, "round-0000003")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "state.json"), "w") as f:
+        f.write("{ totally not json")
+    loaded = load_checkpoint(d)
+    assert loaded is not None and loaded.round == 2
+    # completing round 4 prunes history beyond keep_last
+    save_checkpoint(d, TrainCheckpoint(
+        round=4, booster=Booster(), scores=np.zeros(4, np.float32),
+        bag=None, rng_state=rng.bit_generator.state, fingerprint="fp",
+    ), keep_last=2)
+    assert load_checkpoint(d).round == 4
+    rounds = sorted(e for e in os.listdir(d) if e.startswith("round-"))
+    assert len(rounds) == 2
+
+
+def test_checkpoint_prune_never_eats_the_live_checkpoint(tmp_path):
+    """A fresh run writing LOW round numbers into a dir still holding a
+    previous run's HIGHER rounds must not prune its own just-committed
+    checkpoint (pruning is by recency, not round number)."""
+    import os
+    import time as _time
+
+    from mmlspark_tpu.models.gbdt.booster import Booster
+    from mmlspark_tpu.models.gbdt.checkpoint import (
+        TrainCheckpoint,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    d = str(tmp_path)
+    rng = np.random.default_rng(0)
+
+    def ck(rnd):
+        return TrainCheckpoint(
+            round=rnd, booster=Booster(), scores=np.zeros(4, np.float32),
+            bag=None, rng_state=rng.bit_generator.state, fingerprint="fp",
+        )
+
+    save_checkpoint(d, ck(20))
+    _time.sleep(0.02)  # mtime ordering must be unambiguous
+    save_checkpoint(d, ck(30))
+    _time.sleep(0.02)
+    save_checkpoint(d, ck(10), keep_last=2)  # the new, shorter run
+    loaded = load_checkpoint(d)
+    assert loaded is not None and loaded.round == 10
+    assert os.path.isdir(os.path.join(d, "round-0000010"))
+
+
+def test_gateway_ingress_history_stays_bounded():
+    """LB /health probes and data traffic must not accumulate in the
+    gateway ingress replay history forever (the gateway re-dispatches
+    across workers; it never replays epochs)."""
+    from mmlspark_tpu.serving.distributed import ServingGateway
+
+    s1, q1, i1 = _worker()
+    gw = ServingGateway(workers=[i1], request_timeout_s=5.0)
+    ginfo = gw.start()
+    try:
+        for i in range(30):
+            assert _post(ginfo.port, "/", {"i": i})[0] == 200
+            assert _post(ginfo.port, "/health", None, method="GET")[0] == 200
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with gw._ingress._lock:
+                n_hist = sum(len(v) for v in gw._ingress._history.values())
+            if n_hist == 0:
+                break
+            time.sleep(0.05)  # the post-batch auto_commit may still be due
+        assert n_hist == 0, f"{n_hist} requests leaked into ingress history"
+    finally:
+        gw.stop()
+        q1.stop()
+        s1.stop()
+
+
+def test_estimator_checkpoint_rejects_num_batches(tmp_path):
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    r = np.random.default_rng(1)
+    df = DataFrame.from_dict(
+        {
+            "features": r.normal(size=(60, 4)).astype(np.float32),
+            "label": (r.random(60) > 0.5).astype(np.float64),
+        },
+        num_partitions=1,
+    )
+    est = LightGBMClassifier(
+        num_iterations=2, num_batches=2, checkpoint_dir=str(tmp_path / "ck")
+    )
+    with pytest.raises(ValueError, match="num_batches"):
+        est.fit(df)
+
+
+def test_estimator_checkpoint_resume_params(tmp_path):
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    r = np.random.default_rng(4)
+    x = r.normal(size=(200, 5)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float64)
+    df = DataFrame.from_dict({"features": x, "label": y}, num_partitions=2)
+    common = dict(num_iterations=6, num_leaves=7, seed=3, checkpoint_every=1)
+    ref = LightGBMClassifier(
+        checkpoint_dir=str(tmp_path / "ref"), **common
+    ).fit(df)
+    ck = str(tmp_path / "ck")
+    plan = FaultPlan().on("gbdt.round", at=(4,), error=Preempted)
+    with plan.armed():
+        with pytest.raises(Preempted):
+            LightGBMClassifier(checkpoint_dir=ck, **common).fit(df)
+    resumed = LightGBMClassifier(
+        checkpoint_dir=ck, resume_from=ck, **common
+    ).fit(df)
+    assert (
+        resumed.booster.to_model_string() == ref.booster.to_model_string()
+    )
+
+
+# -- retry_with_backoff: jitter + deadline -----------------------------------
+
+
+def test_retry_full_jitter_desynchronizes_and_deadline_caps():
+    from mmlspark_tpu.core.utils import retry_with_backoff
+
+    sleeps = []
+    t = [0.0]
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        t[0] += s
+
+    calls = []
+
+    def fail():
+        calls.append(1)
+        raise ValueError("down")
+
+    with pytest.raises(ValueError):
+        retry_with_backoff(
+            fail, backoffs_ms=(100, 500, 1000), rng=random.Random(1),
+            sleep=fake_sleep, clock=lambda: t[0],
+        )
+    assert len(calls) == 4
+    # full jitter: every wait inside [0, backoff], NOT the fixed schedule
+    assert all(0.0 <= s <= b / 1000.0 for s, b in zip(sleeps, (100, 500, 1000)))
+    assert sleeps != [0.1, 0.5, 1.0]
+
+    # deadline: no sleep extends past it, no attempt starts after it
+    sleeps.clear()
+    calls.clear()
+    t[0] = 0.0
+    with pytest.raises(ValueError):
+        retry_with_backoff(
+            fail, backoffs_ms=(1000, 1000, 1000), jitter=False,
+            deadline_s=1.5, sleep=fake_sleep, clock=lambda: t[0],
+        )
+    assert len(calls) == 2 and sleeps == [1.0]  # second wait would overshoot
+
+    # jitter=False keeps the legacy fixed schedule
+    sleeps.clear()
+
+    def flaky():
+        if not sleeps:
+            raise ValueError("once")
+        return 42
+
+    assert retry_with_backoff(
+        flaky, backoffs_ms=(100,), jitter=False, sleep=fake_sleep,
+        clock=lambda: t[0],
+    ) == 42
+    assert sleeps == [0.1]
+
+
+# -- chaos smoke through the deployed-fleet client ---------------------------
+
+
+def test_smoke_script_fault_plan_chaos_smokes_the_fleet(capsys):
+    from mmlspark_tpu.serving import fleet
+    from tools.deploy import smoke
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0)
+    srv, q, stop = fleet.run_worker(
+        reg.url, model="echo", host="127.0.0.1", heartbeat_s=0.5
+    )
+    gw = fleet.run_gateway(reg.url, host="127.0.0.1", port=0)
+    try:
+        deadline = time.monotonic() + 5.0
+        while gw.pool.size() < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert gw.pool.size() == 1
+        plan = json.dumps({
+            "seed": 0,
+            "rules": [{"point": "io.send_request", "payload": 503,
+                       "every": 4}],
+        })
+        rc = smoke.main([gw.url, "--n", "12", "--fault-plan", plan])
+        out = capsys.readouterr().out
+        assert rc == 0, out           # 100% completion under injected 5xx
+        assert "faults injected" in out
+    finally:
+        from mmlspark_tpu.core import faults
+
+        faults.clear()  # smoke.main installs the plan process-globally
+        gw.stop()
+        stop.stop()
+        q.stop()
+        srv.stop()
+        reg.stop()

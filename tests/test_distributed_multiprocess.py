@@ -15,7 +15,16 @@ import subprocess
 import sys
 import textwrap
 
+import jax
 import pytest
+
+# jax < 0.5's CPU backend hard-errors on any cross-process computation
+# ("Multiprocess computations aren't implemented on the CPU backend"), so
+# on that toolchain these tests can never pass — skip, don't fail.
+pytestmark = pytest.mark.skipif(
+    tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5),
+    reason="jax<0.5 CPU backend cannot run multi-process computations",
+)
 
 WORKER = textwrap.dedent(
     """

@@ -124,8 +124,15 @@ class TestRealDatasetGoldens:
 # gbdt rows are covered by the TestRealDatasetGoldens class tests above
 # (same params/splits/golden keys plus the sklearn parity check), so the
 # matrix only adds the other three modes; iris runs all four
+# digits is ~20 s per mode serially (~60 s of the tier-1 budget for rows
+# whose failure modes the breast_cancer/wine/iris rows already catch); its
+# three non-gbdt modes run in the full tier only, and digits gbdt stays
+# tier-1 via TestRealDatasetGoldens.test_digits_binary_auc
 MATRIX = [
-    (ds, mode)
+    pytest.param(
+        ds, mode,
+        marks=[pytest.mark.slow] if ds == "digits_binary" else [],
+    )
     for ds in ("breast_cancer", "digits_binary", "wine")
     for mode in ("goss", "dart", "rf")
 ] + [("iris", mode) for mode in ("gbdt", "goss", "dart", "rf")]

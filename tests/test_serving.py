@@ -643,3 +643,69 @@ def test_gateway_conn_cache_prunes_departed_backends():
         for s, q in ((s1, q1), (s2, q2)):
             q.stop()
             s.stop()
+
+
+# -- BackendPool eviction/revival edge cases ---------------------------------
+
+
+def _pool_backend(port):
+    from mmlspark_tpu.serving.distributed import Backend
+
+    return Backend(host="10.0.0.1", port=port)
+
+
+def test_pool_evicted_backend_same_stamp_stays_dead():
+    """A dead worker's roster entry keeps its registration timestamp; a
+    refresh carrying the SAME stamp must not resurrect an evicted backend
+    — only an actual re-registration (newer stamp) revives it."""
+    from mmlspark_tpu.serving.distributed import BackendPool
+
+    b = _pool_backend(9001)
+    pool = BackendPool(cooldown_s=0.0, evict_after=3)
+    pool.refresh([b], stamps={b: 100.0})
+    for _ in range(3):
+        pool.report_failure(b)
+    assert pool.size() == 0
+    pool.refresh([b], stamps={b: 100.0})  # stale roster echo: same stamp
+    assert pool.size() == 0 and pool.next() is None
+    pool.refresh([b], stamps={b: 101.0})  # real re-registration: new stamp
+    assert pool.size() == 1 and pool.next() == b
+
+
+def test_pool_static_backend_never_evicted():
+    """Static backends (constructor list) only cool down: with no registry
+    to revive them, eviction would lose a briefly-down worker forever —
+    both at evict_after=0 (eviction off) and above any threshold."""
+    from mmlspark_tpu.serving.distributed import BackendPool
+
+    for evict_after in (0, 3):
+        b = _pool_backend(9002)
+        pool = BackendPool([b], cooldown_s=10.0, evict_after=evict_after)
+        for _ in range(10):  # far past any eviction threshold
+            pool.report_failure(b)
+        assert pool.size() == 1
+        # cooled down, but still reachable via the fallback (it may have
+        # recovered — better one retry than a refused request)
+        assert pool.next() == b
+        pool.refresh([], stamps={})  # roster refresh cannot drop it either
+        assert pool.size() == 1
+
+
+def test_pool_cooldown_fallback_when_all_backends_cooling():
+    """With every backend cooling down, next() must still hand out one of
+    them (round-robin would otherwise refuse all traffic during a blip),
+    and exclusions are honored before the fallback."""
+    from mmlspark_tpu.serving.distributed import BackendPool
+
+    b1, b2 = _pool_backend(9003), _pool_backend(9004)
+    pool = BackendPool([b1, b2], cooldown_s=60.0, evict_after=0)
+    pool.report_failure(b1)
+    pool.report_failure(b2)
+    got = pool.next()
+    assert got in (b1, b2)
+    other = b2 if got == b1 else b1
+    assert pool.next(exclude={got}) == other
+    assert pool.next(exclude={b1, b2}) is None
+    # recovery clears the cooldown entirely
+    pool.report_ok(b1)
+    assert pool.next(exclude={b2}) == b1

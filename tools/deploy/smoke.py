@@ -1,8 +1,22 @@
 """Fleet smoke test: POST through the gateway, check replies + p50.
 
-    python tools/deploy/smoke.py http://localhost:8080/ [n_requests]
+    python tools/deploy/smoke.py http://localhost:8080/ --n 50
+
+Chaos smoke (``--fault-plan``): arm a deterministic fault plan
+(mmlspark_tpu/core/faults.py) in THIS client and route every request
+through the framework's retrying AdvancedHandler instead of a bare
+socket. Injected wire faults (point ``io.send_request``: connection
+errors, synthetic 5xx, latency) then hit the real retry/backoff path
+against the real fleet, and the gate stays the same — 100% of requests
+must complete. Example plan::
+
+    {"seed": 0, "rules": [
+      {"point": "io.send_request", "error": "ConnectionError",
+       "probability": 0.2},
+      {"point": "io.send_request", "payload": 503, "probability": 0.1}]}
 """
 
+import argparse
 import http.client
 import json
 import sys
@@ -10,10 +24,7 @@ import time
 import urllib.parse
 
 
-def main() -> int:
-    url = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8080/"
-    n = int(sys.argv[2]) if len(sys.argv) > 2 else 50
-    u = urllib.parse.urlparse(url)
+def _smoke_raw(u, n: int) -> tuple:
     conn = http.client.HTTPConnection(u.hostname, u.port or 80, timeout=10)
     lat = []
     ok = 0
@@ -28,6 +39,55 @@ def main() -> int:
         if resp.status == 200 and json.loads(data).get("echo", {}).get("x") == i:
             ok += 1
     conn.close()
+    return ok, lat
+
+
+def _smoke_chaos(url: str, n: int, fault_plan: str) -> tuple:
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    from mmlspark_tpu.core.faults import FaultPlan
+    from mmlspark_tpu.io.clients import AdvancedHandler
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+    plan = FaultPlan.from_spec(fault_plan).install()
+    handler = AdvancedHandler(backoffs_ms=(50, 200, 500, 1000), timeout=10.0)
+    lat = []
+    ok = 0
+    for i in range(n):
+        t0 = time.perf_counter()
+        resp = handler(HTTPRequestData(
+            url, "POST", {"Content-Type": "application/json"},
+            json.dumps({"x": i}),
+        ))
+        lat.append((time.perf_counter() - t0) * 1e3)
+        if (
+            resp["status_code"] == 200
+            and json.loads(resp["entity"]).get("echo", {}).get("x") == i
+        ):
+            ok += 1
+    print(f"smoke: {len(plan.fires())} faults injected")
+    return ok, lat
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="smoke.py", description=__doc__)
+    ap.add_argument("url", nargs="?", default="http://127.0.0.1:8080/")
+    ap.add_argument("n_requests", nargs="?", type=int, default=None,
+                    help="positional alias for --n (back-compat)")
+    ap.add_argument("--n", type=int, default=50)
+    ap.add_argument(
+        "--fault-plan", default=None,
+        help="JSON fault plan (inline or file path): chaos-smoke through "
+        "the retrying client instead of a bare socket",
+    )
+    args = ap.parse_args(argv)
+    n = args.n_requests if args.n_requests is not None else args.n
+    if args.fault_plan:
+        ok, lat = _smoke_chaos(args.url, n, args.fault_plan)
+    else:
+        ok, lat = _smoke_raw(urllib.parse.urlparse(args.url), n)
     lat.sort()
     p50 = lat[len(lat) // 2]
     print(f"smoke: {ok}/{n} ok, p50 {p50:.2f} ms")
