@@ -44,6 +44,17 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
 
+from mmlspark_tpu import obs
+
+# chaos observability: every fire is counted, so a live fleet under an
+# armed plan shows its injected faults on /metrics and chaos tests can
+# assert schedule counts == observed counts (tests/test_obs.py)
+_M_INJECTED = obs.counter(
+    "mmlspark_faults_injected_total",
+    "Faults fired by the armed FaultPlan, by injection point",
+    labels=("point",),
+)
+
 
 class FaultError(Exception):
     """Base class for errors whose only cause is an armed FaultPlan."""
@@ -193,7 +204,10 @@ class FaultPlan:
                     self.log.append((point, s))
                     fire = rule
                     break
-        return fire.raise_or_payload() if fire is not None else None
+        if fire is None:
+            return None
+        _M_INJECTED.labels(point=point).inc()
+        return fire.raise_or_payload()
 
     # -- arming ---------------------------------------------------------------
 

@@ -6,17 +6,22 @@ the Timer pipeline stage; the TPU equivalent adds device-level tracing).
 - :func:`annotate` marks host spans so stage boundaries show up inside the
   device trace (the log-per-stage analogue of stages/Timer.scala:57-92).
 - :class:`ProfiledRun` collects per-stage wall times for a pipeline the
-  way VW's TrainingStats DataFrame reports per-partition timings.
+  way VW's TrainingStats DataFrame reports per-partition timings. Stage
+  timings ride the obs span API (``mmlspark_tpu.obs``), so each stage
+  lands in the process metrics registry as
+  ``mmlspark_trace_span_seconds{span="pipeline.<Stage>"}`` AND nests into
+  any active ``jax.profiler`` capture — the same numbers show up on
+  ``/metrics`` and in Perfetto.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
 from typing import Any, Iterator, Optional
 
 import jax
 
+from mmlspark_tpu import obs
 from mmlspark_tpu.core.dataframe import DataFrame
 
 
@@ -32,6 +37,23 @@ def annotate(name: str) -> Any:
     return jax.profiler.TraceAnnotation(name)
 
 
+def _pipeline_stages(pipeline_model: Any) -> list:
+    """The stage list of a PipelineModel, or [model] for a single
+    transformer. Must not raise on plain transformers: anything without a
+    ``params()`` classmethod / ``get`` accessor (a bare function wrapper,
+    a duck-typed stage) profiles as one stage."""
+    try:
+        params = type(pipeline_model).params()
+    except Exception:  # noqa: BLE001 — params() is a Params-API contract
+        return [pipeline_model]
+    if "stages" not in params:
+        return [pipeline_model]
+    try:
+        return list(pipeline_model.get("stages"))
+    except Exception:  # noqa: BLE001 — declared but unreadable
+        return [pipeline_model]
+
+
 class ProfiledRun:
     """Time each stage of a pipeline transform; emit a stats DataFrame.
 
@@ -43,19 +65,17 @@ class ProfiledRun:
     def __init__(self) -> None:
         self.records: list = []
 
-    def transform(self, pipeline_model: Any, df: DataFrame) -> DataFrame:
-        stages = (
-            pipeline_model.get("stages")
-            if "stages" in type(pipeline_model).params()
-            else [pipeline_model]
-        )
+    def transform(
+        self, pipeline_model: Any, df: DataFrame,
+        trace_id: Optional[str] = None,
+    ) -> DataFrame:
         cur = df
-        for stage in stages:
-            name = type(stage).__name__
-            t0 = time.perf_counter_ns()
-            with annotate(name):
-                cur = stage.transform(cur)
-            self.records.append((name, time.perf_counter_ns() - t0))
+        with obs.span("pipeline.transform", trace_id=trace_id):
+            for stage in _pipeline_stages(pipeline_model):
+                name = type(stage).__name__
+                with obs.span(f"pipeline.{name}") as sp:
+                    cur = stage.transform(cur)
+                self.records.append((name, sp.duration_ns))
         return cur
 
     def stats(self) -> DataFrame:

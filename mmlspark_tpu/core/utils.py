@@ -12,7 +12,22 @@ import time
 import zipfile
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence, TypeVar
 
+from mmlspark_tpu import obs
+
 T = TypeVar("T")
+
+_M_RETRY_ATTEMPTS = obs.counter(
+    "mmlspark_core_retry_attempts_total",
+    "retry_with_backoff attempts (first try included)",
+)
+_M_RETRY_DEADLINE = obs.counter(
+    "mmlspark_core_retry_deadline_hits_total",
+    "retry_with_backoff budgets exhausted (deadline_s reached)",
+)
+_M_RETRY_BACKOFF = obs.counter(
+    "mmlspark_core_retry_backoff_seconds_total",
+    "Cumulative retry_with_backoff sleep",
+)
 
 
 class StopWatch:
@@ -137,15 +152,20 @@ def retry_with_backoff(
             if deadline_s is not None and (
                 delay >= deadline_s - (clock() - start)
             ):
-                break  # the next attempt would start at/after the deadline
+                # the next attempt would start at/after the deadline
+                _M_RETRY_DEADLINE.inc()
+                break
+            _M_RETRY_BACKOFF.inc(delay)
             sleep(delay)
         try:
+            _M_RETRY_ATTEMPTS.inc()
             return fn()
         except Exception as e:  # noqa: BLE001 - retry boundary
             if not retryable(e):
                 raise
             last = e
             if deadline_s is not None and clock() - start >= deadline_s:
+                _M_RETRY_DEADLINE.inc()
                 break
     assert last is not None
     raise last

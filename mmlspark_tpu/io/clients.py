@@ -15,10 +15,31 @@ import urllib.error
 import urllib.request
 from typing import Callable, Optional, Sequence
 
+from mmlspark_tpu import obs
 from mmlspark_tpu.core import faults
 from mmlspark_tpu.io.http_schema import HTTPResponseData
 
 Handler = Callable[[dict], dict]
+
+_M_REQS = obs.counter(
+    "mmlspark_io_requests_total", "Outbound HTTP requests sent",
+)
+_M_REQ_ERRS = obs.counter(
+    "mmlspark_io_request_errors_total",
+    "Outbound requests that became status-0 rows, by error kind",
+    labels=("kind",),
+)
+_M_REQ_SECONDS = obs.histogram(
+    "mmlspark_io_request_seconds", "Outbound HTTP request wall time",
+)
+_M_RETRIES = obs.counter(
+    "mmlspark_io_retries_total",
+    "AdvancedHandler re-sends after a retryable status",
+)
+_M_BACKOFF = obs.counter(
+    "mmlspark_io_backoff_seconds_total",
+    "Cumulative AdvancedHandler backoff sleep",
+)
 
 
 def send_request(request: dict, timeout: float = 60.0) -> dict:
@@ -36,6 +57,8 @@ def send_request(request: dict, timeout: float = 60.0) -> dict:
         headers=request.get("headers") or {},
         method=request.get("method", "GET"),
     )
+    _M_REQS.inc()
+    t0 = time.perf_counter()
     try:
         injected = faults.inject("io.send_request", context=request)
         # bool excluded: a delay-only rule returns payload True, which
@@ -50,7 +73,10 @@ def send_request(request: dict, timeout: float = 60.0) -> dict:
     except urllib.error.HTTPError as e:  # non-2xx still has a response body
         return HTTPResponseData(e.code, e.read(), str(e.reason), dict(e.headers or {}))
     except (urllib.error.URLError, socket.timeout, ConnectionError, OSError) as e:
+        _M_REQ_ERRS.labels(kind=type(e).__name__).inc()
         return HTTPResponseData(0, b"", f"{type(e).__name__}: {e}")
+    finally:
+        _M_REQ_SECONDS.observe(time.perf_counter() - t0)
 
 
 def BasicHandler(timeout: float = 60.0) -> Handler:
@@ -84,7 +110,9 @@ def AdvancedHandler(
                     delay = backoff / 1000.0
             except ValueError:
                 delay = backoff / 1000.0
+            _M_BACKOFF.inc(delay)
             sleep(delay)
+            _M_RETRIES.inc()
             resp = send_request(request, timeout=timeout)
         return resp
 

@@ -28,15 +28,29 @@ import hashlib
 import json
 import os
 import shutil
+import time
 from dataclasses import dataclass
 from typing import Any, Optional
 
 import numpy as np
 
+from mmlspark_tpu import obs
 from mmlspark_tpu.models.gbdt.booster import Booster
 
 _FORMAT = "mmlspark_tpu_gbdt_ckpt_v1"
 _LATEST = "LATEST"
+
+_M_CKPTS = obs.counter(
+    "mmlspark_gbdt_checkpoints_total", "GBDT checkpoints committed",
+)
+_M_CKPT_SAVE = obs.histogram(
+    "mmlspark_gbdt_checkpoint_save_seconds",
+    "Wall time to serialize + atomically commit one checkpoint",
+)
+_M_CKPT_RESTORE = obs.histogram(
+    "mmlspark_gbdt_checkpoint_restore_seconds",
+    "Wall time to load the LATEST checkpoint at resume",
+)
 
 
 def config_fingerprint(cfg: Any, n: int, d: int, k: int) -> str:
@@ -74,6 +88,7 @@ def save_checkpoint(
     ckpt_dir: str, ckpt: TrainCheckpoint, keep_last: int = 2
 ) -> str:
     """Write one checkpoint; returns the round directory path."""
+    t0 = time.perf_counter()
     os.makedirs(ckpt_dir, exist_ok=True)
     name = f"round-{ckpt.round:07d}"
     tmp = os.path.join(ckpt_dir, f".tmp-{name}-{os.getpid()}")
@@ -122,6 +137,8 @@ def save_checkpoint(
                 shutil.rmtree(
                     os.path.join(ckpt_dir, stale), ignore_errors=True
                 )
+    _M_CKPTS.inc()
+    _M_CKPT_SAVE.observe(time.perf_counter() - t0)
     return final
 
 
@@ -132,6 +149,7 @@ def load_checkpoint(ckpt_dir: str) -> Optional[TrainCheckpoint]:
     latest_path = os.path.join(ckpt_dir, _LATEST)
     if not os.path.exists(latest_path):
         return None
+    t0 = time.perf_counter()
     with open(latest_path) as f:
         name = f.read().strip()
     rdir = os.path.join(ckpt_dir, name)
@@ -146,6 +164,7 @@ def load_checkpoint(ckpt_dir: str) -> Optional[TrainCheckpoint]:
     with np.load(os.path.join(rdir, "arrays.npz")) as z:
         scores = z["scores"]
         bag = z["bag"] if "bag" in z.files else None
+    _M_CKPT_RESTORE.observe(time.perf_counter() - t0)
     return TrainCheckpoint(
         round=int(state["round"]),
         booster=booster,

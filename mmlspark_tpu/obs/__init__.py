@@ -1,0 +1,95 @@
+"""Runtime telemetry: metrics registry, span tracing, Prometheus exposition.
+
+The reference ships per-stage StopWatch timers and VW TrainingStats
+DataFrames; production visibility there came from Spark's own metrics
+system. This package is the TPU rebuild's equivalent substrate — a
+dependency-free (stdlib-only; jax is touched lazily and optionally)
+telemetry layer every subsystem reports into:
+
+- :class:`MetricsRegistry` — process-wide counters, gauges and
+  fixed-bucket histograms with labels; thread-safe; snapshot +
+  Prometheus text exposition v0.0.4 (:func:`render`); scrape-side
+  :func:`parse_text` for the fleet aggregator.
+- :func:`span` / :func:`record_span` — host-side tracing with trace-id
+  propagation (the gateway stamps :data:`TRACE_HEADER` into forwarded
+  requests; workers continue the trace). Spans export both to the
+  registry (``mmlspark_trace_span_seconds`` latency histograms) and to
+  ``jax.profiler.TraceAnnotation`` so host spans nest into device traces.
+
+Metric names follow ``mmlspark_<subsystem>_<name>_<unit>`` — enforced by
+``tools/lint_metric_names.py``. Catalogue: docs/observability.md.
+
+Hot-path contract: every instrument op on a disabled registry
+(:func:`set_enabled`\\ (False)) returns after one attribute read — the
+serving path's full per-request instrumentation costs < 1 µs
+(asserted in tests/test_obs.py).
+"""
+
+from mmlspark_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    counter,
+    gauge,
+    histogram,
+    parse_text,
+    render,
+    sum_samples,
+)
+from mmlspark_tpu.obs.tracing import (
+    Span,
+    TRACE_HEADER,
+    clear_recent_spans,
+    current_trace_id,
+    new_trace_id,
+    recent_spans,
+    record_span,
+    span,
+)
+
+
+def set_enabled(on: bool) -> None:
+    """Enable/disable the process-wide default registry (and with it span
+    recording). Disabled instruments are ~free (< 1 µs for a whole
+    request's worth of calls)."""
+    REGISTRY.enabled = bool(on)
+
+
+def enabled() -> bool:
+    return REGISTRY.enabled
+
+
+def reset() -> None:
+    """Zero every metric in the default registry IN PLACE (children stay
+    bound — call sites pre-resolve label children for hot-path speed) and
+    drop recorded spans. Test isolation helper."""
+    REGISTRY.reset()
+    clear_recent_spans()
+
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "Span",
+    "TRACE_HEADER",
+    "clear_recent_spans",
+    "counter",
+    "current_trace_id",
+    "enabled",
+    "gauge",
+    "histogram",
+    "new_trace_id",
+    "parse_text",
+    "recent_spans",
+    "record_span",
+    "render",
+    "reset",
+    "set_enabled",
+    "span",
+    "sum_samples",
+]

@@ -1,0 +1,257 @@
+"""Span tracing with trace-id propagation and device-trace nesting.
+
+A :class:`Span` is a named host-side interval tied to a trace id. The
+gateway mints a trace id per ingress request and stamps it into the
+forwarded request's :data:`TRACE_HEADER`; the worker reads the header and
+records its own spans under the same id — one logical request is one
+trace across processes, with zero infrastructure (ids ride the existing
+HTTP hop).
+
+Spans land in two places:
+
+- the default metrics registry, as the ``mmlspark_trace_span_seconds``
+  histogram labeled by span name — so every span family gets a latency
+  distribution for free on ``/metrics``;
+- ``jax.profiler.TraceAnnotation`` (lazily imported, optional) — inside a
+  ``jax.profiler.trace`` capture the host span nests into the device
+  timeline, which is how "queue wait vs. TPU dispatch" becomes visible in
+  one Perfetto view.
+
+A bounded ring of recently finished spans (:func:`recent_spans`) supports
+tests and ad-hoc debugging; it is NOT an export pipeline.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from mmlspark_tpu.obs.registry import REGISTRY, histogram
+
+# the one header the gateway stamps and workers read (lowercased: the
+# WorkerServer parser lowercases header names on ingress)
+TRACE_HEADER = "x-mmlspark-trace-id"
+
+_SPAN_SECONDS = histogram(
+    "mmlspark_trace_span_seconds",
+    "Duration of host-side trace spans, by span name",
+    labels=("span",),
+)
+
+_RECENT_CAP = 512
+_recent: deque = deque(maxlen=_RECENT_CAP)
+_recent_lock = threading.Lock()
+_tls = threading.local()
+
+# span-name -> pre-resolved histogram child: labels() validates label
+# sets per call, far too slow for per-request span recording
+_span_children: dict = {}
+
+
+def _span_child(name: str) -> Any:
+    ch = _span_children.get(name)
+    if ch is None:
+        ch = _span_children[name] = _SPAN_SECONDS.labels(span=name)
+    return ch
+
+# jax.profiler.TraceAnnotation, resolved lazily once: None = not yet
+# tried, False = unavailable (obs stays importable without jax)
+_TA: Any = None
+
+
+def _trace_annotation() -> Any:
+    global _TA
+    if _TA is None:
+        try:
+            from jax.profiler import TraceAnnotation
+
+            _TA = TraceAnnotation
+        except Exception:  # noqa: BLE001 — jax absent or too old
+            _TA = False
+    return _TA
+
+
+# id generation: uniqueness, not cryptography. uuid4 reads the OS entropy
+# pool per call (~14 µs in sandboxed containers) — far too slow for a
+# per-request hot path. pid + process-start nanos make ids unique across
+# processes; the C-level counter makes them unique (and thread-safe)
+# within one.
+_ID_BASE = f"{os.getpid():08x}{time.time_ns() & 0xFFFFFFFFFFFF:012x}"
+_ID_SEQ = itertools.count()
+
+
+def new_trace_id() -> str:
+    return f"{_ID_BASE}{next(_ID_SEQ) & 0xFFFFFFFFFFFF:012x}"
+
+
+def _new_span_id() -> str:
+    return f"{next(_ID_SEQ) & 0xFFFFFFFFFFFFFFFF:016x}"
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def current_trace_id() -> Optional[str]:
+    """The innermost open span's trace id on this thread, if any."""
+    s = _stack()
+    return s[-1].trace_id if s else None
+
+
+class Span:
+    """One named interval in a trace. Slotted plain class, not a
+    dataclass: spans are created per request on the serving hot path and
+    dataclass construction costs ~3x (measured ~1.6 µs vs ~0.5 µs in
+    this container)."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start_ns", "end_ns",
+        "attrs",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        span_id: str = "",
+        parent_id: Optional[str] = None,
+        start_ns: int = 0,
+        end_ns: int = 0,
+        attrs: Optional[dict] = None,
+    ):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id or _new_span_id()
+        self.parent_id = parent_id
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+        self.attrs = attrs
+
+    @property
+    def duration_ns(self) -> int:
+        return self.end_ns - self.start_ns
+
+    @property
+    def duration_s(self) -> float:
+        return self.duration_ns / 1e9
+
+    def __repr__(self) -> str:
+        return (
+            f"Span({self.name!r}, trace={self.trace_id}, "
+            f"{self.duration_ns} ns)"
+        )
+
+
+def _record(sp: Span) -> None:
+    if not REGISTRY._enabled:
+        return
+    _span_child(sp.name).observe(sp.duration_s)
+    with _recent_lock:
+        _recent.append(sp)
+
+
+class _SpanContext:
+    """Class-based context manager (not ``@contextmanager``: the
+    generator protocol costs ~2 µs per use, and spans wrap every
+    dispatched serving batch)."""
+
+    __slots__ = ("_name", "_trace_id", "_attrs", "_sp", "_ann")
+
+    def __init__(self, name: str, trace_id: Optional[str],
+                 attrs: Optional[dict]):
+        self._name = name
+        self._trace_id = trace_id
+        self._attrs = attrs
+
+    def __enter__(self) -> Span:
+        stack = _stack()
+        parent = stack[-1] if stack else None
+        sp = Span(
+            name=self._name,
+            trace_id=self._trace_id
+            or (parent.trace_id if parent else new_trace_id()),
+            parent_id=parent.span_id if parent else None,
+            attrs=self._attrs,
+        )
+        ta_cls = _trace_annotation()
+        self._ann = ta_cls(self._name) if ta_cls else None
+        stack.append(sp)
+        self._sp = sp
+        sp.start_ns = time.perf_counter_ns()
+        if self._ann is not None:
+            self._ann.__enter__()
+        return sp
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        sp = self._sp
+        sp.end_ns = time.perf_counter_ns()
+        _stack().pop()
+        _record(sp)
+        return False
+
+
+def span(
+    name: str,
+    trace_id: Optional[str] = None,
+    attrs: Optional[dict] = None,
+) -> _SpanContext:
+    """Open a span: ``with span("gateway.forward") as sp: ...``.
+
+    Trace id resolution: explicit argument > enclosing span on this
+    thread > freshly minted. The span enters a
+    ``jax.profiler.TraceAnnotation`` of the same name (a no-op outside an
+    active profiler capture), so host stages show up nested in device
+    traces. The span is recorded on BOTH clean and exceptional exit."""
+    return _SpanContext(name, trace_id, attrs)
+
+
+def record_span(
+    name: str,
+    start_ns: int,
+    end_ns: int,
+    trace_id: Optional[str] = None,
+    attrs: Optional[dict] = None,
+) -> Optional[Span]:
+    """Retroactively record a span from already-measured timestamps — the
+    hot-serving-path form (no context manager overhead; the timestamps
+    are perf_counter_ns values the caller already had, e.g. a request's
+    ``arrival_ns``). Returns the span, or None when the registry is
+    disabled."""
+    if not REGISTRY._enabled:
+        return None
+    sp = Span(
+        name=name,
+        trace_id=trace_id or new_trace_id(),
+        start_ns=start_ns,
+        end_ns=end_ns,
+        attrs=attrs,
+    )
+    _record(sp)
+    return sp
+
+
+def recent_spans(
+    name: Optional[str] = None, trace_id: Optional[str] = None
+) -> list:
+    """Most-recent finished spans (bounded ring), optionally filtered."""
+    with _recent_lock:
+        spans = list(_recent)
+    return [
+        s for s in spans
+        if (name is None or s.name == name)
+        and (trace_id is None or s.trace_id == trace_id)
+    ]
+
+
+def clear_recent_spans() -> None:
+    with _recent_lock:
+        _recent.clear()

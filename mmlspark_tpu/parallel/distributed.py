@@ -13,13 +13,24 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable, Optional, Sequence
 
 import jax
 
+from mmlspark_tpu import obs
 from mmlspark_tpu.core import faults
 
 _initialized = False
+
+_M_BARRIER_WAIT = obs.histogram(
+    "mmlspark_parallel_barrier_wait_seconds",
+    "Time spent inside gang barriers, by barrier name", labels=("name",),
+)
+_M_BARRIER_TIMEOUTS = obs.counter(
+    "mmlspark_parallel_barrier_timeouts_total",
+    "Barriers abandoned by timeout", labels=("name",),
+)
 
 
 class BarrierTimeoutError(TimeoutError):
@@ -127,8 +138,15 @@ def barrier(
         faults.inject("parallel.barrier", context={"name": name})
         _barrier_collective()
 
+    t0 = time.perf_counter()
+
+    def _observe() -> None:
+        _M_BARRIER_WAIT.labels(name=name).observe(time.perf_counter() - t0)
+
     if timeout_s is None:
-        _wait()
+        with obs.span("parallel.barrier"):
+            _wait()
+        _observe()
         return
     done = threading.Event()
     errs: list = []
@@ -145,6 +163,8 @@ def barrier(
         target=_run, name=f"barrier-{name}", daemon=True
     ).start()
     if not done.wait(timeout_s):
+        _M_BARRIER_TIMEOUTS.labels(name=name).inc()
+        _observe()  # the timeout IS the observed wait — the tail must show
         missing: list = []
         if expected is not None and alive is not None:
             try:
@@ -156,5 +176,6 @@ def barrier(
             process_index=jax.process_index(),
             process_count=jax.process_count(),
         )
+    _observe()
     if errs:
         raise errs[0]

@@ -22,6 +22,7 @@ WorkerServer/ServingQuery pair unchanged and adds:
 
 from __future__ import annotations
 
+import contextlib
 import http.client
 import json
 import logging
@@ -30,10 +31,43 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from mmlspark_tpu import obs
 from mmlspark_tpu.core import faults
 from mmlspark_tpu.serving.server import ServiceInfo, WorkerServer
 
 log = logging.getLogger("mmlspark_tpu.serving")
+
+_M_GW_FORWARDED = obs.counter(
+    "mmlspark_gateway_requests_total",
+    "Requests successfully forwarded and answered",
+)
+_M_GW_RETRIES = obs.counter(
+    "mmlspark_gateway_retries_total",
+    "Cross-worker re-dispatch attempts after a backend failure",
+)
+_M_GW_FAILED = obs.counter(
+    "mmlspark_gateway_failures_total",
+    "Requests the gateway answered with an error", labels=("reason",),
+)
+_M_GW_LATENCY = obs.histogram(
+    "mmlspark_gateway_request_latency_seconds",
+    "Gateway ingress arrival to reply (includes queue wait + retries)",
+)
+_M_GW_BACKENDS = obs.gauge(
+    "mmlspark_gateway_backends_count", "Live backends in the pool",
+)
+_M_BE_REQS = obs.counter(
+    "mmlspark_gateway_backend_requests_total",
+    "Successful forwards per backend", labels=("backend",),
+)
+_M_BE_ERRS = obs.counter(
+    "mmlspark_gateway_backend_errors_total",
+    "Reported failures per backend", labels=("backend",),
+)
+_M_BE_EVICT = obs.counter(
+    "mmlspark_gateway_backend_evictions_total",
+    "DEAD-mark evictions per backend", labels=("backend",),
+)
 
 
 @dataclass(frozen=True)
@@ -83,6 +117,21 @@ class BackendPool:
         self._rr = 0
         self.cooldown_s = cooldown_s
         self.evict_after = evict_after
+        # per-backend pre-resolved label children: labels() does set
+        # comparisons per call — too slow for the per-request report_ok
+        self._m_by_backend: dict = {}
+        _M_GW_BACKENDS.set(len(self._backends))
+
+    def _metrics_for(self, b: Backend) -> tuple:
+        m = self._m_by_backend.get(b)
+        if m is None:
+            addr = f"{b.host}:{b.port}"
+            m = self._m_by_backend[b] = (
+                _M_BE_REQS.labels(backend=addr),
+                _M_BE_ERRS.labels(backend=addr),
+                _M_BE_EVICT.labels(backend=addr),
+            )
+        return m
 
     def refresh(self, backends: list, stamps: Optional[dict] = None) -> None:
         with self._lock:
@@ -104,6 +153,16 @@ class BackendPool:
             self._cooldown = {
                 b: t for b, t in self._cooldown.items() if b in self._backends
             }
+            # series lifecycle: a fleet of ephemeral-port workers mints a
+            # new backend label per restart — drop the metric children of
+            # backends that left the roster, or scrape output and gateway
+            # memory grow forever (counter resets are rate()-safe)
+            for b in [x for x in self._m_by_backend if x not in live]:
+                del self._m_by_backend[b]
+                addr = f"{b.host}:{b.port}"
+                for fam in (_M_BE_REQS, _M_BE_ERRS, _M_BE_EVICT):
+                    fam.remove(backend=addr)
+            _M_GW_BACKENDS.set(len(self._backends))
 
     def size(self) -> int:
         with self._lock:
@@ -135,6 +194,7 @@ class BackendPool:
             return fallback
 
     def report_failure(self, b: Backend) -> None:
+        self._metrics_for(b)[1].inc()
         with self._lock:
             self._cooldown[b] = time.monotonic() + self.cooldown_s
             self._fails[b] = self._fails.get(b, 0) + 1
@@ -145,8 +205,11 @@ class BackendPool:
             ):
                 self._dead[b] = self._stamps.get(b, 0.0)
                 self._backends = [x for x in self._backends if x != b]
+                self._metrics_for(b)[2].inc()
+                _M_GW_BACKENDS.set(len(self._backends))
 
     def report_ok(self, b: Backend) -> None:
+        self._metrics_for(b)[0].inc()
         with self._lock:
             self._cooldown.pop(b, None)
             self._fails.pop(b, None)
@@ -423,6 +486,19 @@ class ServingGateway:
             except OSError:
                 pass
 
+    def _reply(self, req, body: bytes, code: int,
+               headers: Optional[dict] = None) -> None:
+        """Answer the client and close out the request's gateway metrics
+        (ingress arrival -> reply, including queue wait and retries)."""
+        self._ingress.reply_to(req.id, body, code, headers)
+        if _M_GW_LATENCY._on:
+            done_ns = time.perf_counter_ns()
+            _M_GW_LATENCY.observe((done_ns - req.arrival_ns) / 1e9)
+            obs.record_span(
+                "gateway.request", req.arrival_ns, done_ns,
+                trace_id=req.headers.get(obs.TRACE_HEADER),
+            )
+
     def _forward(self, req) -> None:
         attempts = self._max_attempts or max(2, self._pool.size() + 1)
         tried: set = set()
@@ -430,6 +506,12 @@ class ServingGateway:
             k: v for k, v in req.headers.items()
             if k.lower() not in self._SKIP_HEADERS
         }
+        # trace propagation: continue the client's trace id if it sent
+        # one, else mint one here — the worker reads this header and its
+        # spans join the same trace (docs/observability.md)
+        trace_id = req.headers.get(obs.TRACE_HEADER) or obs.new_trace_id()
+        headers[obs.TRACE_HEADER] = trace_id
+        req.headers[obs.TRACE_HEADER] = trace_id
         for attempt in range(attempts):
             b = self._pool.next(exclude=tried)
             if b is None:
@@ -443,37 +525,43 @@ class ServingGateway:
                     "gateway.forward",
                     context={"backend": (b.host, b.port), "attempt": attempt},
                 )
-                conn, cached = self._conn_for(b)
-                # request() returning means the body was fully flushed; an
-                # exception DURING it leaves an incomplete body the worker
-                # will never execute (Content-Length mismatch) — safe to
-                # re-dispatch
-                try:
-                    conn.request(
-                        req.method, b.path, body=req.body, headers=headers
-                    )
-                except (OSError, http.client.HTTPException):
-                    if not cached:
-                        raise
-                    # a kept-alive connection the worker has since closed
-                    # is a connection-staleness failure, not a worker
-                    # failure: retry ONCE on a fresh connection before
-                    # blaming the backend
-                    self._drop_conn(b)
-                    conn, _ = self._conn_for(b)
-                    conn.request(
-                        req.method, b.path, body=req.body, headers=headers
-                    )
-                sent = True
-                # fault point gateway.response: an injected TimeoutError
-                # here is a worker hanging mid-execution after the body was
-                # delivered — exercises the at-most-once 504 path
-                faults.inject(
-                    "gateway.response",
-                    context={"backend": (b.host, b.port), "attempt": attempt},
+                fwd_ctx = (
+                    obs.span("gateway.forward", trace_id=trace_id)
+                    if _M_GW_LATENCY._on
+                    else contextlib.nullcontext()
                 )
-                resp = conn.getresponse()
-                body = resp.read()
+                with fwd_ctx:
+                    conn, cached = self._conn_for(b)
+                    # request() returning means the body was fully flushed;
+                    # an exception DURING it leaves an incomplete body the
+                    # worker will never execute (Content-Length mismatch) —
+                    # safe to re-dispatch
+                    try:
+                        conn.request(
+                            req.method, b.path, body=req.body, headers=headers
+                        )
+                    except (OSError, http.client.HTTPException):
+                        if not cached:
+                            raise
+                        # a kept-alive connection the worker has since
+                        # closed is a connection-staleness failure, not a
+                        # worker failure: retry ONCE on a fresh connection
+                        # before blaming the backend
+                        self._drop_conn(b)
+                        conn, _ = self._conn_for(b)
+                        conn.request(
+                            req.method, b.path, body=req.body, headers=headers
+                        )
+                    sent = True
+                    # fault point gateway.response: an injected TimeoutError
+                    # here is a worker hanging mid-execution after the body
+                    # was delivered — exercises the at-most-once 504 path
+                    faults.inject(
+                        "gateway.response",
+                        context={"backend": (b.host, b.port), "attempt": attempt},
+                    )
+                    resp = conn.getresponse()
+                    body = resp.read()
                 if resp.will_close:
                     self._drop_conn(b)
             except (OSError, http.client.HTTPException) as e:
@@ -485,8 +573,9 @@ class ServingGateway:
                     # POST, and cooling down a healthy-but-slow worker
                     # would starve the pool — fail this request instead
                     self.failed += 1
-                    self._ingress.reply_to(
-                        req.id,
+                    _M_GW_FAILED.labels(reason="post_send_timeout").inc()
+                    self._reply(
+                        req,
                         b'{"error": "worker timed out after request was sent"}',
                         504, {"Content-Type": "application/json"},
                     )
@@ -498,17 +587,20 @@ class ServingGateway:
                 tried.add(b)
                 self._pool.report_failure(b)
                 self.retried += 1
+                _M_GW_RETRIES.inc()
                 continue
             self._pool.report_ok(b)
             self.forwarded += 1
+            _M_GW_FORWARDED.inc()
             out_headers = {}
             ct = resp.getheader("Content-Type")
             if ct:
                 out_headers["Content-Type"] = ct
-            self._ingress.reply_to(req.id, body, resp.status, out_headers)
+            self._reply(req, body, resp.status, out_headers)
             return
         self.failed += 1
-        self._ingress.reply_to(
-            req.id, b'{"error": "no live serving workers"}', 503,
+        _M_GW_FAILED.labels(reason="no_backends").inc()
+        self._reply(
+            req, b'{"error": "no live serving workers"}', 503,
             {"Content-Type": "application/json"},
         )

@@ -185,6 +185,171 @@ def run_worker(
     return srv, q, stopper
 
 
+def scrape_metrics(url: str, timeout: float = 5.0) -> Optional[dict]:
+    """GET a /metrics endpoint -> parsed samples, or None when
+    unreachable / non-200 (a dead worker must not kill the whole fleet
+    summary). Shared by ``fleet top`` and the deploy smoke gate."""
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.io.clients import send_request
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    resp = send_request(HTTPRequestData(url, "GET"), timeout=timeout)
+    if resp["status_code"] != 200:
+        return None
+    body = resp["entity"]
+    if isinstance(body, bytes):
+        body = body.decode("utf-8", "replace")
+    return obs.parse_text(body)
+
+
+def worker_urls_from_registry(
+    registry_url: str, service_name: str = "serving", timeout: float = 5.0
+) -> list:
+    """Roster -> worker base URLs (preferring forwarded endpoints).
+    Raises on an unreachable registry — callers decide how to degrade."""
+    from mmlspark_tpu.io.clients import send_request
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+    resp = send_request(
+        HTTPRequestData(registry_url.rstrip("/") + "/", "GET"),
+        timeout=timeout,
+    )
+    if resp["status_code"] != 200:
+        raise ConnectionError(
+            f"registry {registry_url} answered {resp['status_code']}"
+        )
+    roster = json.loads(resp["entity"])
+    return [
+        f"http://{i.get('forwarded_host') or i['host']}"
+        f":{i.get('forwarded_port') or i['port']}"
+        for i in roster.get(service_name, [])
+    ]
+
+
+def _hist_stats(parsed: dict, name: str, match: Optional[dict] = None) -> tuple:
+    """(p50_estimate, mean) in the histogram's native unit from exposition
+    samples: p50 is the smallest bucket bound whose cumulative count
+    reaches half the total (the standard scrape-side estimate)."""
+    from mmlspark_tpu import obs
+
+    count = obs.sum_samples(parsed, f"{name}_count", match)
+    total = obs.sum_samples(parsed, f"{name}_sum", match)
+    if count <= 0:
+        return (0.0, 0.0)
+    mean = total / count
+    want = set((match or {}).items())
+    by_le: dict = {}
+    for (n, labels), v in parsed.items():
+        if n != f"{name}_bucket":
+            continue
+        ld = dict(labels)
+        le = ld.pop("le", None)
+        if le is None or not want <= set(ld.items()):
+            continue
+        bound = float("inf") if le == "+Inf" else float(le)
+        by_le[bound] = by_le.get(bound, 0.0) + v
+    p50 = 0.0
+    for bound in sorted(by_le):
+        if by_le[bound] >= count / 2:
+            p50 = bound
+            break
+    return (p50, mean)
+
+
+def run_top(
+    registry_url: Optional[str] = None,
+    gateway_url: Optional[str] = None,
+    worker_urls: Optional[list] = None,
+    service_name: str = "serving",
+) -> str:
+    """One-screen fleet summary from /metrics scrapes (``fleet top``).
+
+    Worker endpoints come from ``worker_urls`` and/or the registry roster;
+    the gateway row needs ``gateway_url``. Everything rides the same
+    Prometheus text any external scraper would consume — this is the
+    zero-infrastructure view of it."""
+    from mmlspark_tpu import obs
+
+    endpoints: list = [(u.rstrip("/"), None) for u in (worker_urls or ())]
+    notes: list = []
+    if registry_url:
+        try:
+            for ep in worker_urls_from_registry(registry_url, service_name):
+                if ep not in [e for e, _ in endpoints]:
+                    endpoints.append((ep, None))
+        except Exception as e:  # noqa: BLE001 — summary must degrade, not die
+            # still report the explicitly-passed workers and the gateway:
+            # the registry being the one dead component is exactly when
+            # the operator needs the rest of the picture
+            notes.append(f"fleet top: registry scrape failed: {e}")
+    lines = notes + [
+        f"fleet top — service {service_name!r}, {len(endpoints)} worker(s)"
+    ]
+    hdr = (
+        f"{'WORKER':<26} {'ACCEPT':>8} {'QDEPTH':>7} {'ERR':>5} "
+        f"{'QWAIT_P50_MS':>13} {'LAT_P50_MS':>11} {'BATCH_AVG':>10}"
+    )
+    lines.append(hdr)
+    tot_accept = 0.0
+    for ep, _ in endpoints:
+        parsed = scrape_metrics(ep)
+        addr = ep.split("//", 1)[-1]
+        if parsed is None:
+            lines.append(f"{addr:<26} {'DOWN':>8}")
+            continue
+        m = {"server": service_name}
+        accept = obs.sum_samples(parsed, "mmlspark_serving_requests_total", m)
+        qdepth = obs.sum_samples(
+            parsed, "mmlspark_serving_queue_depth_requests", m
+        )
+        errs = obs.sum_samples(
+            parsed, "mmlspark_serving_handler_errors_total", m
+        )
+        qwait_p50, _ = _hist_stats(
+            parsed, "mmlspark_serving_queue_wait_seconds", m
+        )
+        lat_p50, _ = _hist_stats(
+            parsed, "mmlspark_serving_request_latency_seconds", m
+        )
+        _, batch_avg = _hist_stats(
+            parsed, "mmlspark_serving_batch_size_requests", m
+        )
+        tot_accept += accept
+        lines.append(
+            f"{addr:<26} {accept:>8.0f} {qdepth:>7.0f} {errs:>5.0f} "
+            f"{qwait_p50 * 1e3:>13.2f} {lat_p50 * 1e3:>11.2f} "
+            f"{batch_avg:>10.1f}"
+        )
+    if gateway_url:
+        parsed = scrape_metrics(gateway_url)
+        addr = gateway_url.rstrip("/").split("//", 1)[-1]
+        if parsed is None:
+            lines.append(f"gateway {addr}: DOWN")
+        else:
+            gm = {"server": f"{service_name}-gateway"}
+            accepted = obs.sum_samples(
+                parsed, "mmlspark_serving_requests_total", gm
+            )
+            fwd = obs.sum_samples(parsed, "mmlspark_gateway_requests_total")
+            retried = obs.sum_samples(parsed, "mmlspark_gateway_retries_total")
+            failed = obs.sum_samples(parsed, "mmlspark_gateway_failures_total")
+            backends = obs.sum_samples(
+                parsed, "mmlspark_gateway_backends_count"
+            )
+            lat_p50, _ = _hist_stats(
+                parsed, "mmlspark_gateway_request_latency_seconds"
+            )
+            lines.append(
+                f"gateway {addr}: accepted {accepted:.0f}, forwarded "
+                f"{fwd:.0f}, retried {retried:.0f}, failed {failed:.0f}, "
+                f"backends {backends:.0f}, p50 {lat_p50 * 1e3:.2f} ms"
+            )
+    lines.append(f"total accepted across workers: {tot_accept:.0f}")
+    return "\n".join(lines)
+
+
 def run_gateway(
     registry_url: str,
     host: str = "0.0.0.0",
@@ -261,13 +426,40 @@ def main(argv: Optional[list] = None) -> None:
         help="on SIGTERM: finish accepted requests for up to this long "
         "(0 = stop immediately)",
     )
+    t = sub.add_parser(
+        "top", help="scrape /metrics across the fleet, print a summary"
+    )
+    t.add_argument("--registry", default=None)
+    t.add_argument("--gateway", default=None)
+    t.add_argument("--service-name", default="serving")
+    t.add_argument(
+        "--worker", action="append", default=[],
+        help="explicit worker base URL (repeatable; adds to the roster)",
+    )
+    t.add_argument(
+        "--watch", type=float, default=0.0,
+        help="refresh every N seconds (0 = print once and exit)",
+    )
     args = ap.parse_args(argv)
     if args.fault_plan:
         from mmlspark_tpu.core.faults import FaultPlan
 
         FaultPlan.from_spec(args.fault_plan).install()
         print(f"fleet: fault plan armed ({args.fault_plan})", flush=True)
-    if args.role == "registry":
+    if args.role == "top":
+        while True:
+            print(
+                run_top(
+                    registry_url=args.registry, gateway_url=args.gateway,
+                    worker_urls=args.worker or None,
+                    service_name=args.service_name,
+                ),
+                flush=True,
+            )
+            if args.watch <= 0:
+                break
+            time.sleep(args.watch)
+    elif args.role == "registry":
         reg = run_registry(args.host, args.port, args.ttl_s)
         _serve_forever([reg])
     elif args.role == "worker":

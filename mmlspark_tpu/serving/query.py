@@ -15,6 +15,7 @@ bucket instead of once per request count.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -22,12 +23,23 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from mmlspark_tpu import obs
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.serving.server import CachedRequest, WorkerServer
 from mmlspark_tpu.serving.udfs import make_reply, request_to_json
 
 # handler: list[CachedRequest] -> dict[id, (code, body_bytes, headers)]
 Handler = Callable[[list], dict]
+
+_M_LATENCY = obs.histogram(
+    "mmlspark_serving_request_latency_seconds",
+    "End-to-end request latency (ingress arrival to reply)",
+    labels=("server",),
+)
+_M_HANDLER_ERRS = obs.counter(
+    "mmlspark_serving_handler_errors_total",
+    "Handler exceptions turned into 500 batches", labels=("server",),
+)
 
 
 class ServingQuery:
@@ -55,6 +67,8 @@ class ServingQuery:
         self._lat_count = 0
         self.batches = 0
         self.errors = 0
+        self._m_latency = _M_LATENCY.labels(server=server.name)
+        self._m_handler_errs = _M_HANDLER_ERRS.labels(server=server.name)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -112,9 +126,22 @@ class ServingQuery:
 
     def _process(self, reqs: list) -> None:
         try:
-            replies = self.handler(reqs)
+            # the dispatch span wraps the model call, so inside a
+            # jax.profiler capture the XLA dispatch nests under it; the
+            # trace id continues from the gateway's stamped header
+            ctx = (
+                obs.span(
+                    "serving.dispatch",
+                    trace_id=reqs[0].headers.get(obs.TRACE_HEADER),
+                )
+                if self._m_latency._on
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                replies = self.handler(reqs)
         except Exception as e:  # handler crash -> 500s, keep serving
             self.errors += 1
+            self._m_handler_errs.inc()
             msg = f"handler error: {type(e).__name__}: {e}".encode()
             replies = {r.id: (500, msg, {}) for r in reqs}
         done_ns = time.perf_counter_ns()
@@ -123,6 +150,12 @@ class ServingQuery:
                 r.id, (500, b"no reply produced", {})
             )
             self.server.reply_to(r.id, body, code, headers)
+            if self._m_latency._on:
+                self._m_latency.observe((done_ns - r.arrival_ns) / 1e9)
+                obs.record_span(
+                    "serving.request", r.arrival_ns, done_ns,
+                    trace_id=r.headers.get(obs.TRACE_HEADER),
+                )
             if len(self._latencies_ns) < self._lat_cap:
                 self._latencies_ns.append(done_ns - r.arrival_ns)
             else:

@@ -14,9 +14,27 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from mmlspark_tpu import obs
 from mmlspark_tpu.io.clients import send_request
 from mmlspark_tpu.io.http_schema import HTTPRequestData
 from mmlspark_tpu.serving.server import ServiceInfo
+
+_M_REGISTRATIONS = obs.counter(
+    "mmlspark_registry_registrations_total",
+    "Worker (re)registrations accepted", labels=("service",),
+)
+_M_DEREGISTRATIONS = obs.counter(
+    "mmlspark_registry_deregistrations_total",
+    "Explicit roster removals (clean worker shutdown)", labels=("service",),
+)
+_M_EXPIRATIONS = obs.counter(
+    "mmlspark_registry_expirations_total",
+    "Roster entries dropped by TTL expiry", labels=("service",),
+)
+_M_ENTRIES = obs.gauge(
+    "mmlspark_registry_entries_count",
+    "Live roster entries per service", labels=("service",),
+)
 
 
 class DriverRegistry:
@@ -51,6 +69,10 @@ class DriverRegistry:
                     e for e in registry._services[svc]
                     if e.get("ts", 0.0) >= floor
                 ]
+                dropped = len(registry._services[svc]) - len(kept)
+                if dropped:
+                    _M_EXPIRATIONS.labels(service=svc).inc(dropped)
+                    _M_ENTRIES.labels(service=svc).set(len(kept))
                 if kept:
                     registry._services[svc] = kept
                 else:
@@ -87,6 +109,8 @@ class DriverRegistry:
                     if len(entries) > registry.max_entries_per_service:
                         entries.sort(key=lambda e: e.get("ts", 0.0))
                         del entries[: len(entries) - registry.max_entries_per_service]
+                    _M_REGISTRATIONS.labels(service=name).inc()
+                    _M_ENTRIES.labels(service=name).set(len(entries))
                 body = b'{"registered": true}'
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))
@@ -114,6 +138,9 @@ class DriverRegistry:
                         if (e.get("host"), e.get("port")) != key
                     ]
                     removed = before - len(entries)
+                    if removed:
+                        _M_DEREGISTRATIONS.labels(service=name).inc(removed)
+                        _M_ENTRIES.labels(service=name).set(len(entries))
                     if not entries:
                         registry._services.pop(name, None)
                 body = json.dumps({"deregistered": removed > 0}).encode()
@@ -124,6 +151,19 @@ class DriverRegistry:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path.split("?", 1)[0] == "/metrics":
+                    with registry._lock:
+                        registry._expire_locked()  # scrape sees fresh TTLs
+                    body = obs.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 with registry._lock:
                     registry._expire_locked()
                     body = json.dumps(registry._services).encode()

@@ -24,8 +24,42 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs.registry import SIZE_BUCKETS
+
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found", 500: "Internal Server Error",
              503: "Service Unavailable"}
+
+# ingress telemetry (docs/observability.md). Families are module-level;
+# each server pre-binds its label children in __init__ so the per-request
+# hot path is one enabled-check + one locked add per instrument.
+_M_ACCEPTED = obs.counter(
+    "mmlspark_serving_requests_total",
+    "Requests accepted into the ingress queue", labels=("server",),
+)
+_M_REJECTED = obs.counter(
+    "mmlspark_serving_rejected_total",
+    "Requests rejected at ingress (never queued)",
+    labels=("server", "reason"),
+)
+_M_QDEPTH = obs.gauge(
+    "mmlspark_serving_queue_depth_requests",
+    "Requests currently queued awaiting dispatch", labels=("server",),
+)
+_M_QWAIT = obs.histogram(
+    "mmlspark_serving_queue_wait_seconds",
+    "Ingress-to-dispatch wait (arrival_ns to queue pop)", labels=("server",),
+)
+_M_BATCH = obs.histogram(
+    "mmlspark_serving_batch_size_requests",
+    "Requests per dispatched batch", labels=("server",),
+    buckets=SIZE_BUCKETS,
+)
+_M_REPLAYED = obs.counter(
+    "mmlspark_serving_replayed_total",
+    "Requests re-enqueued by epoch replay recovery", labels=("server",),
+)
+_METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 @dataclass
@@ -99,6 +133,14 @@ class WorkerServer:
         # gateway, instead of cleanly dead
         self._writers: set = set()
         self.requests_seen = 0
+        self._m_accepted = _M_ACCEPTED.labels(server=name)
+        self._m_rej_full = _M_REJECTED.labels(server=name, reason="queue_full")
+        self._m_rej_404 = _M_REJECTED.labels(server=name, reason="not_found")
+        self._m_rej_400 = _M_REJECTED.labels(server=name, reason="bad_request")
+        self._m_qdepth = _M_QDEPTH.labels(server=name)
+        self._m_qwait = _M_QWAIT.labels(server=name)
+        self._m_batch = _M_BATCH.labels(server=name)
+        self._m_replayed = _M_REPLAYED.labels(server=name)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -206,21 +248,36 @@ class WorkerServer:
                 try:
                     n = int(headers.get("content-length") or 0)
                 except ValueError:
+                    self._m_rej_400.inc()
                     self._write_response(writer, 400, b"bad Content-Length", False)
                     return
                 if n < 0:
+                    self._m_rej_400.inc()
                     self._write_response(writer, 400, b"bad Content-Length", False)
                     return
                 body = await reader.readexactly(n) if n else b""
                 keep = headers.get("connection", "keep-alive").lower() != "close"
                 prefix = self.api_path.rstrip("/")
                 path_only = path.split("?", 1)[0]
+                if path_only == "/metrics" and method == "GET":
+                    # scrape endpoint: answered inline on the ingress
+                    # thread (no model work), never queued or counted as
+                    # an accepted request — scraping must not perturb the
+                    # request metrics it reports
+                    self._write_response(
+                        writer, 200, obs.render().encode(), keep,
+                        {"Content-Type": _METRICS_CONTENT_TYPE},
+                    )
+                    if not keep:
+                        return
+                    continue
                 on_path = (
                     not prefix
                     or path_only == prefix
                     or path_only.startswith(prefix + "/")
                 )
                 if not on_path:
+                    self._m_rej_404.inc()
                     self._write_response(writer, 404, b"not found", keep)
                     if not keep:
                         return
@@ -237,6 +294,7 @@ class WorkerServer:
                 replied = asyncio.Event()
                 with self._not_empty:
                     if len(self._queue) >= self._max_queue:
+                        self._m_rej_full.inc()
                         self._write_response(writer, 503, b"queue full", keep)
                         if not keep:
                             return
@@ -245,6 +303,9 @@ class WorkerServer:
                     self._queue.append(req)
                     self._history.setdefault(req.epoch, []).append(req)
                     self.requests_seen += 1
+                    if self._m_accepted._on:
+                        self._m_accepted.inc()
+                        self._m_qdepth.set(len(self._queue))
                     self._not_empty.notify()
                 # wait for the reply before reading the next request on this
                 # connection (no HTTP/1.1 pipelining needed)
@@ -300,6 +361,15 @@ class WorkerServer:
             out = []
             while self._queue and len(out) < max_n:
                 out.append(self._queue.popleft())
+            if out and self._m_qwait._on:
+                # ingress->dispatch latency: arrival_ns was previously
+                # recorded but never reported anywhere — the queue-wait
+                # histogram is where it lands (docs/observability.md)
+                now_ns = time.perf_counter_ns()
+                for r in out:
+                    self._m_qwait.observe((now_ns - r.arrival_ns) / 1e9)
+                self._m_batch.observe(len(out))
+                self._m_qdepth.set(len(self._queue))
             return out
 
     # -- replies (any thread) --------------------------------------------------
@@ -377,6 +447,9 @@ class WorkerServer:
             queued = {r.id for r in reqs}
             self._queue = deque(r for r in self._queue if r.id not in queued)
             self._queue.extendleft(reversed(reqs))
+            if reqs:
+                self._m_replayed.inc(len(reqs))
+                self._m_qdepth.set(len(self._queue))
             self._not_empty.notify()
             return len(reqs)
 

@@ -1,0 +1,605 @@
+"""Telemetry subsystem tests: registry semantics, exposition goldens,
+/metrics endpoints, trace propagation gateway->worker, chaos-counter
+integration, and the disabled-registry hot-path overhead gate."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs.registry import SIZE_BUCKETS, MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    """Zero the process registry around each test: families persist (call
+    sites hold pre-bound children) but values start from 0, so absolute
+    assertions hold regardless of what ran before."""
+    obs.reset()
+    yield
+    obs.set_enabled(True)
+    obs.reset()
+
+
+# -- registry semantics -------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_labels_and_identity(self):
+        reg = MetricsRegistry()
+        c = reg.counter("mmlspark_io_test_total", "t", labels=("kind",))
+        assert reg.counter("mmlspark_io_test_total", "t", labels=("kind",)) is c
+        c.labels(kind="a").inc()
+        c.labels(kind="a").inc(2)
+        c.labels(kind="b").inc()
+        snap = reg.snapshot()["mmlspark_io_test_total"]
+        assert dict(
+            (s[0]["kind"], s[1]) for s in snap["samples"]
+        ) == {"a": 3.0, "b": 1.0}
+
+    def test_type_conflict_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("mmlspark_io_x_total")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("mmlspark_io_x_total")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.counter("mmlspark_io_x_total", labels=("k",))
+
+    def test_unknown_label_rejected(self):
+        reg = MetricsRegistry()
+        c = reg.counter("mmlspark_io_y_total", labels=("kind",))
+        with pytest.raises(ValueError, match="takes labels"):
+            c.labels(wrong="x")
+
+    def test_gauge_set_inc_dec(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("mmlspark_serving_depth_count")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert g.value == 6.0
+
+    def test_histogram_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            "mmlspark_serving_t_seconds", buckets=(0.01, 0.1, 1.0)
+        )
+        for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+            h.observe(v)
+        snap = reg.snapshot()["mmlspark_serving_t_seconds"]["samples"][0][1]
+        assert snap["buckets"] == [(0.01, 1), (0.1, 3), (1.0, 4)]
+        assert snap["count"] == 5
+        assert snap["sum"] == pytest.approx(5.605)
+
+    def test_concurrent_increments_lose_nothing(self):
+        reg = MetricsRegistry()
+        c = reg.counter("mmlspark_core_race_total")
+        h = reg.histogram("mmlspark_core_race_seconds", buckets=(1.0,))
+
+        def work():
+            for _ in range(2000):
+                c.inc()
+                h.observe(0.5)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 16000
+        snap = reg.snapshot()["mmlspark_core_race_seconds"]["samples"][0][1]
+        assert snap["count"] == 16000
+
+    def test_disabled_registry_records_nothing(self):
+        reg = MetricsRegistry()
+        c = reg.counter("mmlspark_core_off_total")
+        reg.enabled = False
+        c.inc()
+        assert c.value == 0.0
+
+
+# -- exposition ---------------------------------------------------------------
+
+
+class TestExposition:
+    def test_prometheus_golden(self):
+        reg = MetricsRegistry()
+        c = reg.counter(
+            "mmlspark_io_g_total", "Outbound requests", labels=("kind",)
+        )
+        c.labels(kind="a").inc(3)
+        g = reg.gauge("mmlspark_serving_g_count", "Depth")
+        g.set(2)
+        h = reg.histogram(
+            "mmlspark_serving_g_seconds", "Latency", buckets=(0.1, 1.0)
+        )
+        h.observe(0.05)
+        h.observe(0.5)
+        assert reg.render() == (
+            "# HELP mmlspark_io_g_total Outbound requests\n"
+            "# TYPE mmlspark_io_g_total counter\n"
+            'mmlspark_io_g_total{kind="a"} 3\n'
+            "# HELP mmlspark_serving_g_count Depth\n"
+            "# TYPE mmlspark_serving_g_count gauge\n"
+            "mmlspark_serving_g_count 2\n"
+            "# HELP mmlspark_serving_g_seconds Latency\n"
+            "# TYPE mmlspark_serving_g_seconds histogram\n"
+            'mmlspark_serving_g_seconds_bucket{le="0.1"} 1\n'
+            'mmlspark_serving_g_seconds_bucket{le="1"} 2\n'
+            'mmlspark_serving_g_seconds_bucket{le="+Inf"} 2\n'
+            "mmlspark_serving_g_seconds_sum 0.55\n"
+            "mmlspark_serving_g_seconds_count 2\n"
+        )
+
+    def test_label_escaping_round_trip(self):
+        reg = MetricsRegistry()
+        c = reg.counter("mmlspark_io_esc_total", labels=("k",))
+        c.labels(k='we"ird\\val\nue').inc()
+        parsed = obs.parse_text(reg.render())
+        assert parsed[
+            ("mmlspark_io_esc_total", (("k", 'we"ird\\val\nue'),))
+        ] == 1.0
+
+    def test_parse_and_sum(self):
+        reg = MetricsRegistry()
+        c = reg.counter("mmlspark_io_p_total", labels=("kind",))
+        c.labels(kind="a").inc(2)
+        c.labels(kind="b").inc(3)
+        parsed = obs.parse_text(reg.render())
+        assert obs.sum_samples(parsed, "mmlspark_io_p_total") == 5.0
+        assert obs.sum_samples(
+            parsed, "mmlspark_io_p_total", {"kind": "b"}
+        ) == 3.0
+
+
+# -- tracing ------------------------------------------------------------------
+
+
+class TestTracing:
+    def test_span_nesting_shares_trace(self):
+        with obs.span("outer") as outer:
+            assert obs.current_trace_id() == outer.trace_id
+            with obs.span("inner") as inner:
+                pass
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+        assert outer.duration_ns >= inner.duration_ns >= 0
+
+    def test_spans_land_in_registry(self):
+        with obs.span("obs.test.span"):
+            pass
+        parsed = obs.parse_text(obs.render())
+        assert obs.sum_samples(
+            parsed, "mmlspark_trace_span_seconds_count",
+            {"span": "obs.test.span"},
+        ) == 1.0
+
+    def test_trace_ids_unique(self):
+        ids = {obs.new_trace_id() for _ in range(1000)}
+        assert len(ids) == 1000
+
+
+# -- serving endpoints + propagation -----------------------------------------
+
+
+def _post(port, path, obj, headers=None, conn=None):
+    c = conn or http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    c.request("POST", path, body=json.dumps(obj), headers=hdrs)
+    r = c.getresponse()
+    data = r.read()
+    if conn is None:
+        c.close()
+    return r.status, data
+
+
+def _get(port, path):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    c.request("GET", path)
+    r = c.getresponse()
+    data = r.read()
+    c.close()
+    return r.status, dict(r.getheaders()), data
+
+
+def _echo_handler(reqs):
+    from mmlspark_tpu.serving import make_reply, request_to_json
+
+    return {
+        r.id: make_reply({"echo": request_to_json(r)}) for r in reqs
+    }
+
+
+class TestServingMetrics:
+    def test_worker_metrics_endpoint(self):
+        from mmlspark_tpu.serving import ServingQuery, WorkerServer
+
+        srv = WorkerServer(name="obsworker")
+        info = srv.start()
+        q = ServingQuery(srv, _echo_handler).start()
+        try:
+            for i in range(5):
+                status, _ = _post(info.port, "/", {"i": i})
+                assert status == 200
+            status, headers, body = _get(info.port, "/metrics")
+            assert status == 200
+            assert headers["Content-Type"].startswith("text/plain")
+            parsed = obs.parse_text(body.decode())
+            m = {"server": "obsworker"}
+            assert obs.sum_samples(
+                parsed, "mmlspark_serving_requests_total", m
+            ) == 5.0
+            # the write-only arrival_ns bug: queue wait must be REPORTED
+            assert obs.sum_samples(
+                parsed, "mmlspark_serving_queue_wait_seconds_count", m
+            ) == 5.0
+            assert obs.sum_samples(
+                parsed, "mmlspark_serving_request_latency_seconds_count", m
+            ) == 5.0
+            assert obs.sum_samples(
+                parsed, "mmlspark_serving_batch_size_requests_count", m
+            ) >= 1.0
+            # /metrics itself is never counted as an accepted request
+            status, _, body = _get(info.port, "/metrics")
+            parsed = obs.parse_text(body.decode())
+            assert obs.sum_samples(
+                parsed, "mmlspark_serving_requests_total", m
+            ) == 5.0
+        finally:
+            q.stop()
+            srv.stop()
+
+    def test_metrics_include_cross_subsystem_families(self):
+        """The acceptance-criteria families all appear on one scrape:
+        request latency, queue depth, GBDT round timings, barrier waits,
+        retry and fault-injection counters."""
+        import mmlspark_tpu.core.utils  # noqa: F401 — registers retry metrics
+        import mmlspark_tpu.io.clients  # noqa: F401
+        import mmlspark_tpu.models.gbdt.train  # noqa: F401
+        from mmlspark_tpu.core.faults import FaultPlan
+        from mmlspark_tpu.parallel.distributed import barrier
+        from mmlspark_tpu.serving import WorkerServer
+
+        barrier("obs-test")  # single-host no-op, still observed
+        with FaultPlan(seed=0).on("obs.test", payload=True).armed():
+            from mmlspark_tpu.core import faults
+
+            faults.inject("obs.test")
+        srv = WorkerServer(name="obsfam")
+        info = srv.start()
+        try:
+            _, _, body = _get(info.port, "/metrics")
+        finally:
+            srv.stop()
+        text = body.decode()
+        for family in (
+            "mmlspark_serving_request_latency_seconds",
+            "mmlspark_serving_queue_depth_requests",
+            "mmlspark_serving_queue_wait_seconds",
+            "mmlspark_gbdt_round_seconds",
+            "mmlspark_gbdt_rounds_total",
+            "mmlspark_core_retry_attempts_total",
+            "mmlspark_io_retries_total",
+        ):
+            assert f"# TYPE {family} " in text, family
+        parsed = obs.parse_text(text)
+        assert obs.sum_samples(
+            parsed, "mmlspark_parallel_barrier_wait_seconds_count",
+            {"name": "obs-test"},
+        ) == 1.0
+        assert obs.sum_samples(
+            parsed, "mmlspark_faults_injected_total", {"point": "obs.test"}
+        ) == 1.0
+
+    def test_gateway_trace_propagation_and_counters(self):
+        from mmlspark_tpu.serving import (
+            ServingGateway, ServingQuery, WorkerServer,
+        )
+
+        seen_headers: list = []
+
+        def handler(reqs):
+            seen_headers.extend(r.headers for r in reqs)
+            return _echo_handler(reqs)
+
+        srv = WorkerServer(name="serving")
+        winfo = srv.start()
+        q = ServingQuery(srv, handler).start()
+        gw = ServingGateway(workers=[winfo])
+        ginfo = gw.start()
+        try:
+            n = 8
+            for i in range(n):
+                status, data = _post(ginfo.port, "/", {"i": i})
+                assert status == 200
+                assert json.loads(data)["echo"] == {"i": i}
+            # gateway minted a trace id and the worker saw it
+            assert len(seen_headers) == n
+            minted = [h.get(obs.TRACE_HEADER) for h in seen_headers]
+            assert all(minted), "worker did not receive the trace header"
+            assert len(set(minted)) == n  # one trace per request
+            # a client-supplied trace id propagates verbatim
+            status, _ = _post(
+                ginfo.port, "/", {"i": 99},
+                headers={obs.TRACE_HEADER: "cafebabe" * 4},
+            )
+            assert status == 200
+            assert seen_headers[-1][obs.TRACE_HEADER] == "cafebabe" * 4
+            # spans on BOTH sides of the hop carry the client's trace id
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                worker_spans = obs.recent_spans(
+                    "serving.request", trace_id="cafebabe" * 4
+                )
+                gw_spans = obs.recent_spans(
+                    "gateway.request", trace_id="cafebabe" * 4
+                )
+                if worker_spans and gw_spans:
+                    break
+                time.sleep(0.01)
+            assert worker_spans and gw_spans
+            # scrape through HTTP: accepted == forwarded == client sends
+            _, _, body = _get(ginfo.port, "/metrics")
+            parsed = obs.parse_text(body.decode())
+            assert obs.sum_samples(
+                parsed, "mmlspark_gateway_requests_total"
+            ) == n + 1
+            assert obs.sum_samples(
+                parsed, "mmlspark_serving_requests_total",
+                {"server": "serving"},
+            ) == n + 1
+            assert obs.sum_samples(
+                parsed, "mmlspark_gateway_backend_requests_total",
+                {"backend": f"{winfo.host}:{winfo.port}"},
+            ) == n + 1
+            assert obs.sum_samples(
+                parsed, "mmlspark_gateway_backends_count"
+            ) == 1.0
+        finally:
+            gw.stop()
+            q.stop()
+            srv.stop()
+
+    def test_registry_metrics_endpoint(self):
+        from mmlspark_tpu.serving import DriverRegistry, ServiceInfo
+
+        reg = DriverRegistry(ttl_s=30.0)
+        try:
+            DriverRegistry.register(
+                reg.url, ServiceInfo("svc", "127.0.0.1", 1234)
+            )
+            _, _, body = _get(reg.port, "/metrics")
+            parsed = obs.parse_text(body.decode())
+            assert obs.sum_samples(
+                parsed, "mmlspark_registry_registrations_total",
+                {"service": "svc"},
+            ) == 1.0
+            assert obs.sum_samples(
+                parsed, "mmlspark_registry_entries_count", {"service": "svc"}
+            ) == 1.0
+            DriverRegistry.deregister(
+                reg.url, ServiceInfo("svc", "127.0.0.1", 1234)
+            )
+            _, _, body = _get(reg.port, "/metrics")
+            parsed = obs.parse_text(body.decode())
+            assert obs.sum_samples(
+                parsed, "mmlspark_registry_deregistrations_total",
+                {"service": "svc"},
+            ) == 1.0
+            assert obs.sum_samples(
+                parsed, "mmlspark_registry_entries_count", {"service": "svc"}
+            ) == 0.0
+        finally:
+            reg.stop()
+
+    def test_fleet_top_summary(self):
+        from mmlspark_tpu.serving import (
+            ServingGateway, ServingQuery, WorkerServer,
+        )
+        from mmlspark_tpu.serving.fleet import run_top
+
+        srv = WorkerServer(name="serving")
+        winfo = srv.start()
+        q = ServingQuery(srv, _echo_handler).start()
+        gw = ServingGateway(workers=[winfo])
+        ginfo = gw.start()
+        try:
+            for i in range(4):
+                status, _ = _post(ginfo.port, "/", {"i": i})
+                assert status == 200
+            out = run_top(
+                worker_urls=[f"http://127.0.0.1:{winfo.port}"],
+                gateway_url=f"http://127.0.0.1:{ginfo.port}",
+            )
+        finally:
+            gw.stop()
+            q.stop()
+            srv.stop()
+        assert "fleet top" in out
+        assert f"127.0.0.1:{winfo.port}" in out
+        assert "forwarded 4" in out
+        # worker row reports the 4 accepted requests
+        row = [l for l in out.splitlines() if str(winfo.port) in l][0]
+        assert row.split()[1] == "4"
+
+
+# -- chaos integration --------------------------------------------------------
+
+
+class TestChaosCounters:
+    def test_injected_counter_matches_plan_schedule(self):
+        from mmlspark_tpu.core import faults
+        from mmlspark_tpu.core.faults import FaultPlan
+
+        plan = FaultPlan(seed=7).on(
+            "chaos.obs", payload=True, at=(0, 2, 5)
+        )
+        with plan.armed():
+            for _ in range(8):
+                faults.inject("chaos.obs")
+        assert len(plan.fires("chaos.obs")) == 3
+        parsed = obs.parse_text(obs.render())
+        assert obs.sum_samples(
+            parsed, "mmlspark_faults_injected_total", {"point": "chaos.obs"}
+        ) == 3.0
+
+    def test_injected_wire_faults_match_observed_retries(self):
+        """The io.send_request chaos loop: every injected network error
+        becomes exactly one client retry, so injected == retried."""
+        from mmlspark_tpu.core.faults import FaultPlan
+        from mmlspark_tpu.io.clients import AdvancedHandler
+        from mmlspark_tpu.io.http_schema import HTTPRequestData
+        from mmlspark_tpu.serving import ServingQuery, WorkerServer
+
+        srv = WorkerServer(name="chaosw")
+        info = srv.start()
+        q = ServingQuery(srv, _echo_handler).start()
+        plan = FaultPlan(seed=1).on(
+            "io.send_request", error=ConnectionError, at=(1, 4)
+        )
+        handler = AdvancedHandler(backoffs_ms=(1, 1, 1), timeout=5.0)
+        try:
+            with plan.armed():
+                for i in range(4):
+                    resp = handler(HTTPRequestData(
+                        f"http://127.0.0.1:{info.port}/", "POST",
+                        {"Content-Type": "application/json"},
+                        json.dumps({"i": i}),
+                    ))
+                    assert resp["status_code"] == 200
+        finally:
+            q.stop()
+            srv.stop()
+        n_injected = len(plan.fires("io.send_request"))
+        assert n_injected == 2
+        parsed = obs.parse_text(obs.render())
+        assert obs.sum_samples(
+            parsed, "mmlspark_faults_injected_total",
+            {"point": "io.send_request"},
+        ) == n_injected
+        assert obs.sum_samples(
+            parsed, "mmlspark_io_retries_total"
+        ) == n_injected
+        assert obs.sum_samples(
+            parsed, "mmlspark_io_request_errors_total",
+            {"kind": "ConnectionError"},
+        ) == n_injected
+
+
+# -- profiling port -----------------------------------------------------------
+
+
+class TestProfiledRun:
+    def test_pipeline_stage_spans_land_in_registry(self):
+        import numpy as np
+
+        from mmlspark_tpu import DataFrame, Pipeline
+        from mmlspark_tpu.core.profiling import ProfiledRun
+        from mmlspark_tpu.stages import DropColumns, RenameColumn
+
+        df = DataFrame.from_dict({"a": np.arange(5), "b": np.arange(5)})
+        pm = Pipeline([
+            RenameColumn(input_col="a", output_col="x"),
+            DropColumns(cols=["b"]),
+        ]).fit(df)
+        prof = ProfiledRun()
+        out = prof.transform(pm, df)
+        assert out.columns == ["x"]
+        stats = prof.stats()
+        assert stats["stage"].tolist() == ["RenameColumn", "DropColumns"]
+        assert (stats["seconds"] >= 0).all()
+        parsed = obs.parse_text(obs.render())
+        for stage in ("RenameColumn", "DropColumns"):
+            assert obs.sum_samples(
+                parsed, "mmlspark_trace_span_seconds_count",
+                {"span": f"pipeline.{stage}"},
+            ) == 1.0
+
+    def test_plain_transformer_does_not_raise(self):
+        import numpy as np
+
+        from mmlspark_tpu import DataFrame
+        from mmlspark_tpu.core.profiling import ProfiledRun
+
+        class Plain:  # no params()/get() — a duck-typed stage
+            def transform(self, df):
+                return df
+
+        df = DataFrame.from_dict({"a": np.arange(3)})
+        prof = ProfiledRun()
+        out = prof.transform(Plain(), df)
+        assert out.columns == ["a"]
+        assert prof.stats()["stage"].tolist() == ["Plain"]
+
+
+# -- hot-path overhead gate ---------------------------------------------------
+
+
+class TestOverhead:
+    def test_disabled_registry_under_1us_per_request(self):
+        """The serving hot path gates each instrument bundle behind ONE
+        pre-bound ``child._on`` attribute check (enqueue, queue pop,
+        reply — the exact sequence server.py/query.py run per request).
+        With the registry disabled, the whole per-request sequence must
+        cost < 1 µs."""
+        import gc as _gc
+
+        c = obs.counter("mmlspark_serving_bench_total", labels=("server",))
+        g = obs.gauge(
+            "mmlspark_serving_bench_count", labels=("server",)
+        )
+        h1 = obs.histogram(
+            "mmlspark_serving_bench_seconds", labels=("server",)
+        )
+        h2 = obs.histogram(
+            "mmlspark_serving_bench_requests", labels=("server",),
+            buckets=SIZE_BUCKETS,
+        )
+        cc = c.labels(server="w")
+        gauge_c = g.labels(server="w")
+        hc1 = h1.labels(server="w")
+        hc2 = h2.labels(server="w")
+
+        def per_request():
+            # enqueue (server._handle_conn)
+            if cc._on:
+                cc.inc()
+                gauge_c.set(1)
+            # pop (server.get_next_batch)
+            if hc1._on:
+                hc1.observe(0.001)
+                hc2.observe(1)
+                gauge_c.set(0)
+            # reply (query._process)
+            if hc1._on:
+                hc1.observe(0.002)
+                obs.record_span("serving.request", 0, 1000)
+
+        obs.set_enabled(False)
+        _gc.disable()
+        try:
+            per_request()  # warm attribute caches / specialization
+            # min over many short trials: the claim is the sequence's
+            # COST, and the minimum is the contention-free sample — a
+            # loaded CI box must not fail a gate about instruction count
+            n = 10_000
+            best = float("inf")
+            for _ in range(20):
+                t0 = time.perf_counter_ns()
+                for _ in range(n):
+                    per_request()
+                best = min(best, (time.perf_counter_ns() - t0) / n)
+        finally:
+            _gc.enable()
+            obs.set_enabled(True)
+        assert best < 1000, f"disabled hot-path sequence: {best:.0f} ns"
+        assert cc.value == 0.0  # disabled means recorded nothing
+        # and flipping back on actually records again
+        cc.inc()
+        assert cc.value == 1.0

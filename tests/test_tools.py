@@ -29,3 +29,32 @@ def test_tpu_probe_healthy_backend():
     assert rec["ok"] is True
     assert rec["init_s"] is not None
     assert rec["devices"]
+
+
+def test_metric_names_follow_convention():
+    """mmlspark_<subsystem>_<name>_<unit> over the whole tree — drift in
+    a metric name breaks dashboards/alerts silently, so it fails here."""
+    from tools.lint_metric_names import MIN_EXPECTED, lint
+
+    violations, seen = lint()
+    assert not violations, violations
+    assert seen >= MIN_EXPECTED, (
+        f"only {seen} registrations found — the linter's scan regex no "
+        "longer matches the registration idiom"
+    )
+
+
+def test_metric_name_linter_catches_violations(tmp_path):
+    from tools.lint_metric_names import lint
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'c = obs.counter("mmlspark_serving_oops")\n'          # no unit
+        'g = obs.gauge("mmlspark_nonexistent_thing_total")\n'  # bad subsystem
+        'h = obs.histogram("mmlspark_gbdt_round_seconds")\n'   # ok
+    )
+    violations, seen = lint([str(bad)])
+    assert seen == 3
+    assert sorted(v[1] for v in violations) == [
+        "mmlspark_nonexistent_thing_total", "mmlspark_serving_oops",
+    ]

@@ -2,6 +2,16 @@
 
     python tools/deploy/smoke.py http://localhost:8080/ --n 50
 
+Metrics verification (on by default; ``--no-verify-metrics`` to skip):
+the gateway and workers are scraped via ``GET /metrics`` before and
+after the request phase, and the delta of
+``mmlspark_gateway_requests_total`` (requests forwarded AND answered)
+must equal the client-observed successes — a live-fleet gate for silent
+drops that the chaos suite's in-process assertions can't see. With
+``--registry`` the per-worker accepted counters are summed and checked
+too. Under ``--fault-plan`` the client retries, so the gate relaxes to
+``forwarded >= successes``.
+
 Chaos smoke (``--fault-plan``): arm a deterministic fault plan
 (mmlspark_tpu/core/faults.py) in THIS client and route every request
 through the framework's retrying AdvancedHandler instead of a bare
@@ -19,9 +29,17 @@ must complete. Example plan::
 import argparse
 import http.client
 import json
+import os
 import sys
 import time
 import urllib.parse
+
+
+def _ensure_repo_path() -> None:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if root not in sys.path:
+        sys.path.insert(0, root)
 
 
 def _smoke_raw(u, n: int) -> tuple:
@@ -42,11 +60,83 @@ def _smoke_raw(u, n: int) -> tuple:
     return ok, lat
 
 
-def _smoke_chaos(url: str, n: int, fault_plan: str) -> tuple:
-    import os
+def _fleet_counters(gateway_url: str, registry_url, service: str) -> dict:
+    """The accepted/forwarded counters the drop-gate compares.
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))))
+    ``gateway_forwarded`` is None when the target exposes no gateway
+    metrics (pre-telemetry build, or smoking a worker directly) — the
+    gate then skips rather than failing a healthy fleet."""
+    _ensure_repo_path()
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.serving.fleet import (
+        scrape_metrics, worker_urls_from_registry,
+    )
+
+    gw = scrape_metrics(gateway_url)
+    # "is the target actually a gateway?" — the gateway family registers
+    # at import time in EVERY serving process (package __init__ pulls in
+    # distributed.py), so family presence proves nothing; a constructed
+    # ServingGateway is detected by its ingress server label
+    # ("<service>-gateway", pre-bound at construction)
+    has_gw = gw is not None and any(
+        name == "mmlspark_serving_requests_total"
+        and any(k == "server" and v.endswith("-gateway") for k, v in labels)
+        for name, labels in gw
+    )
+    out = {
+        "gateway_forwarded": (
+            obs.sum_samples(gw, "mmlspark_gateway_requests_total")
+            if has_gw else None
+        ),
+        "workers_accepted": None,
+    }
+    if registry_url:
+        try:
+            urls = worker_urls_from_registry(registry_url, service)
+        except Exception as e:  # noqa: BLE001 — gate degrades, smoke goes on
+            print(f"smoke: registry scrape failed ({e}); "
+                  "skipping worker-counter gate")
+            urls = None
+        if urls is not None:
+            total = 0.0
+            for wurl in urls:
+                total += obs.sum_samples(
+                    scrape_metrics(wurl) or {},
+                    "mmlspark_serving_requests_total", {"server": service},
+                )
+            out["workers_accepted"] = total
+    return out
+
+
+def _verify_metrics(before: dict, after: dict, ok: int,
+                    chaos: bool) -> bool:
+    """Gate: forwarded-request delta must account for every client-observed
+    success (equality without faults; >= under client-side fault
+    injection, where retries resend the same logical request)."""
+    good = True
+    if after.get("gateway_forwarded") is None or (
+        before.get("gateway_forwarded") is None
+    ):
+        print("smoke: target exposes no gateway metrics; "
+              "skipping forwarded-counter gate")
+    else:
+        fwd = after["gateway_forwarded"] - before["gateway_forwarded"]
+        good = fwd >= ok if chaos else fwd == ok
+        print(f"smoke: gateway forwarded delta {fwd:.0f} vs {ok} client "
+              f"successes — {'ok' if good else 'MISMATCH'}")
+    if after.get("workers_accepted") is not None and (
+        before.get("workers_accepted") is not None
+    ):
+        wacc = after["workers_accepted"] - before["workers_accepted"]
+        w_good = wacc >= ok if chaos else wacc == ok
+        print(f"smoke: workers accepted delta {wacc:.0f} vs {ok} client "
+              f"successes — {'ok' if w_good else 'MISMATCH'}")
+        good = good and w_good
+    return good
+
+
+def _smoke_chaos(url: str, n: int, fault_plan: str) -> tuple:
+    _ensure_repo_path()
     from mmlspark_tpu.core.faults import FaultPlan
     from mmlspark_tpu.io.clients import AdvancedHandler
     from mmlspark_tpu.io.http_schema import HTTPRequestData
@@ -82,8 +172,23 @@ def main(argv=None) -> int:
         help="JSON fault plan (inline or file path): chaos-smoke through "
         "the retrying client instead of a bare socket",
     )
+    ap.add_argument(
+        "--registry", default=None,
+        help="driver-registry URL: also scrape every rostered worker's "
+        "/metrics and gate on their accepted-request counters",
+    )
+    ap.add_argument("--service-name", default="serving")
+    ap.add_argument(
+        "--no-verify-metrics", action="store_true",
+        help="skip the /metrics accepted-vs-observed drop gate",
+    )
     args = ap.parse_args(argv)
     n = args.n_requests if args.n_requests is not None else args.n
+    verify = not args.no_verify_metrics
+    before = (
+        _fleet_counters(args.url, args.registry, args.service_name)
+        if verify else None
+    )
     if args.fault_plan:
         ok, lat = _smoke_chaos(args.url, n, args.fault_plan)
     else:
@@ -91,7 +196,13 @@ def main(argv=None) -> int:
     lat.sort()
     p50 = lat[len(lat) // 2]
     print(f"smoke: {ok}/{n} ok, p50 {p50:.2f} ms")
-    return 0 if ok == n else 1
+    metrics_ok = True
+    if verify:
+        after = _fleet_counters(args.url, args.registry, args.service_name)
+        metrics_ok = _verify_metrics(
+            before, after, ok, chaos=bool(args.fault_plan)
+        )
+    return 0 if (ok == n and metrics_ok) else 1
 
 
 if __name__ == "__main__":
