@@ -71,8 +71,10 @@ SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280}
 #   relay's RPC floor, while its real claims (local + gateway p50) come
 #   out of the CPU child identically.
 # - On the CPU fallback, cheap-first so a late death costs least.
-SEGMENTS = ["serving", "hist", "vw", "gbdt", "sklearn", "featurizer"]
-TPU_ORDER = ["sklearn", "gbdt", "hist", "featurizer", "vw", "serving"]
+SEGMENTS = ["serving", "modelstore", "hist", "vw", "gbdt", "sklearn",
+            "featurizer"]
+TPU_ORDER = ["sklearn", "gbdt", "hist", "featurizer", "vw", "serving",
+             "modelstore"]
 CPU_ORDER = SEGMENTS
 
 
@@ -527,8 +529,137 @@ def _seg_serving(on_accel: bool, n_dev: int) -> dict:
     return out
 
 
+def _seg_modelstore(on_accel: bool, n_dev: int) -> dict:
+    """Multi-model serving + hot-swap: sustained loopback POSTs through a
+    ModelStore worker while v2 loads and the serving alias flips.
+    ``serving_swap_p99_ms`` is the p99 of the requests straddling the
+    flip — the number that proves zero-downtime hot-swap costs nothing
+    the client can see — plus resident-version accounting after the old
+    version drains out."""
+    import http.client
+
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.serving.modelstore import (
+        LoadedModel,
+        ModelDispatcher,
+        ModelStore,
+    )
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    dim = 64
+
+    def make_loaded(seed: int) -> LoadedModel:
+        w_host = np.random.default_rng(seed).normal(
+            size=(dim, dim)
+        ).astype(np.float32)
+        w = jnp.asarray(w_host)
+
+        @jax.jit
+        def model(x):
+            return jnp.tanh(x @ w).sum(axis=-1)
+
+        def handler(reqs):
+            x = np.stack([
+                np.asarray(json.loads(r.body)["x"], np.float32) for r in reqs
+            ])
+            pad = -len(x) % 8  # fixed-shape batch: pad to the 8-row bucket
+            if pad:
+                x = np.pad(x, ((0, pad), (0, 0)))
+            y = np.asarray(model(x))[: len(reqs)]
+            return {
+                r.id: (200, json.dumps({"y": float(v)}).encode(), {})
+                for r, v in zip(reqs, y)
+            }
+
+        def warmup():
+            model(jnp.zeros((8, dim), jnp.float32)).block_until_ready()
+
+        return LoadedModel(handler=handler, nbytes=int(w.nbytes), warmup=warmup)
+
+    store = ModelStore()
+    _retry(lambda: store.load("m", make_loaded(1)), "modelstore v1 load")
+    srv = WorkerServer()
+    info = srv.start()
+    disp = ModelDispatcher(srv, store, default_model="m").start()
+    out: dict = {}
+    try:
+        import threading
+
+        payload = json.dumps({"x": [0.1] * dim})
+        conn = http.client.HTTPConnection("127.0.0.1", info.port, timeout=10)
+        n_req, swap_at, warmup_n = 600, 300, 50
+        lat = []
+        swap_done_idx = [None]
+
+        def do_swap() -> None:
+            # load+warm v2, then flip — CONCURRENT with the request loop,
+            # so requests genuinely straddle the flip (a swap that held
+            # the store lock against dispatch would show up in the
+            # straddling window's p99)
+            v2 = store.load("m", make_loaded(2), wait=True)
+            t_sw = time.perf_counter()
+            store.swap("m", v2)
+            out["modelstore_swap_ctl_ms"] = round(
+                (time.perf_counter() - t_sw) * 1e3, 3
+            )
+
+        swapper = None
+        for i in range(n_req):
+            if i == swap_at:
+                swapper = threading.Thread(target=do_swap)
+                swapper.start()
+            t0 = time.perf_counter()
+            conn.request(
+                "POST", "/", body=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            lat.append((time.perf_counter() - t0) * 1e3)
+            if (
+                swap_done_idx[0] is None and swapper is not None
+                and not swapper.is_alive()
+            ):
+                swap_done_idx[0] = i  # first request after the flip landed
+        conn.close()
+        if swapper is not None:
+            swapper.join(60.0)
+        arr = np.sort(np.asarray(lat[warmup_n:]))
+        # the straddling window: requests issued while the load+swap ran,
+        # plus a tail after the flip (bounded by the run's end)
+        end = min(n_req, (swap_done_idx[0] or n_req - 25) + 25)
+        window = np.sort(np.asarray(lat[swap_at:end]))
+        out["serving_swap_p99_ms"] = round(
+            float(window[int(len(window) * 0.99)]), 3
+        )
+        out["serving_multimodel_p50_ms"] = round(
+            float(arr[len(arr) // 2]), 3
+        )
+        out["serving_multimodel_p99_ms"] = round(
+            float(arr[int(len(arr) * 0.99)]), 3
+        )
+        # post-swap accounting: v1 drained + evicted, only v2 resident
+        deadline = time.monotonic() + 5.0
+        while store.resident_bytes() > dim * dim * 4 and (
+            time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        out["modelstore_resident_models"] = sum(
+            1 for v in store.models()["m"]["versions"]
+            if v["state"] in ("ready", "warming")
+        )
+        out["modelstore_resident_bytes"] = store.resident_bytes()
+    finally:
+        disp.stop()
+        srv.stop()
+    return out
+
+
 SEGMENT_FNS = {
     "serving": _seg_serving,
+    "modelstore": _seg_modelstore,
     "hist": _seg_hist,
     "vw": _seg_vw,
     "gbdt": _seg_gbdt,

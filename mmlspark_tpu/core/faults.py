@@ -26,6 +26,14 @@ point                     fires inside
 ``gbdt.round``            models/gbdt/train.py round boundary — a
                           :class:`Preempted` here simulates host preemption
                           between boosting rounds (checkpoint/resume path)
+``modelstore.load``       serving/modelstore/store.py before the loader runs
+                          — latency is a slow deserialize (background loads
+                          must keep serving through it), an error a corrupt
+                          model artifact
+``modelstore.swap``       serving/modelstore/store.py before the alias flip —
+                          latency stalls only the control op while traffic
+                          keeps serving the old version (the zero-downtime
+                          hot-swap property the chaos suite asserts)
 ========================  ====================================================
 
 Schedules are **seeded and step-indexed**: a rule fires by absolute step

@@ -17,9 +17,14 @@ id on the same machine, and crashed partitions replay their queue history
 - :class:`DriverRegistry` — the driver-side registration service workers
   report their ``ServiceInfo`` to (DriverServiceUtils analogue).
 - :class:`ServingGateway` / :class:`BackendPool` — the distributed mode:
-  N workers behind ONE endpoint with registry discovery, round-robin
-  dispatch and cross-worker re-dispatch when a worker dies mid-request
-  (DistributedHTTPSource analogue).
+  N workers behind ONE endpoint with registry discovery, model-aware
+  round-robin dispatch and cross-worker re-dispatch when a worker dies
+  mid-request (DistributedHTTPSource analogue).
+- :class:`ModelStore` / :class:`ModelDispatcher` (``modelstore/``) — the
+  model-lifecycle layer: named+versioned models resident in device
+  memory under a byte budget, background load+warmup, zero-downtime
+  hot-swap, per-model queues with deadline-aware admission control, and
+  a ``/models`` control plane (docs/modelstore.md).
 - ``make_reply`` / ``request_to_row`` — ServingUDFs analogues.
 """
 
@@ -27,6 +32,11 @@ from mmlspark_tpu.serving.server import CachedRequest, ServiceInfo, WorkerServer
 from mmlspark_tpu.serving.query import ServingQuery, serve_transformer
 from mmlspark_tpu.serving.registry import DriverRegistry
 from mmlspark_tpu.serving.distributed import Backend, BackendPool, ServingGateway
+from mmlspark_tpu.serving.modelstore import (
+    LoadedModel,
+    ModelDispatcher,
+    ModelStore,
+)
 from mmlspark_tpu.serving.udfs import make_reply, request_to_json, request_to_text
 
 __all__ = [
@@ -39,6 +49,9 @@ __all__ = [
     "Backend",
     "BackendPool",
     "ServingGateway",
+    "LoadedModel",
+    "ModelDispatcher",
+    "ModelStore",
     "make_reply",
     "request_to_json",
     "request_to_text",

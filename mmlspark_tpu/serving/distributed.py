@@ -105,7 +105,7 @@ class BackendPool:
 
     def __init__(
         self, backends: Optional[list] = None, cooldown_s: float = 5.0,
-        evict_after: int = 3,
+        evict_after: int = 3, models: Optional[dict] = None,
     ):
         self._lock = threading.Lock()
         self._static: list = list(backends or ())
@@ -114,6 +114,13 @@ class BackendPool:
         self._fails: dict = {}
         self._dead: dict = {}    # backend -> roster stamp at eviction
         self._stamps: dict = {}  # backend -> latest roster stamp
+        # backend -> frozenset of advertised model names (ModelStore
+        # workers); a backend with no entry serves any model as far as
+        # routing knows. Constructor-provided entries belong to static
+        # backends, which never appear in a registry roster — refresh()
+        # must keep them rather than replace the map wholesale
+        self._static_models: dict = dict(models or {})
+        self._models: dict = dict(self._static_models)
         self._rr = 0
         self.cooldown_s = cooldown_s
         self.evict_after = evict_after
@@ -133,9 +140,12 @@ class BackendPool:
             )
         return m
 
-    def refresh(self, backends: list, stamps: Optional[dict] = None) -> None:
+    def refresh(self, backends: list, stamps: Optional[dict] = None,
+                models: Optional[dict] = None) -> None:
         with self._lock:
             self._stamps = dict(stamps or {})
+            if models is not None:
+                self._models = {**self._static_models, **models}
             live = []
             for b in self._static + [
                 b for b in backends if b not in self._static
@@ -162,6 +172,8 @@ class BackendPool:
                 addr = f"{b.host}:{b.port}"
                 for fam in (_M_BE_REQS, _M_BE_ERRS, _M_BE_EVICT):
                     fam.remove(backend=addr)
+            for b in [x for x in self._models if x not in live]:
+                del self._models[b]
             _M_GW_BACKENDS.set(len(self._backends))
 
     def size(self) -> int:
@@ -173,25 +185,40 @@ class BackendPool:
         with self._lock:
             return list(self._backends)
 
-    def next(self, exclude: Optional[set] = None) -> Optional[Backend]:
+    def next(self, exclude: Optional[set] = None,
+             model: Optional[str] = None) -> Optional[Backend]:
         """The next live backend, skipping cooled-down and ``exclude``d
         ones; falls back to a cooled-down backend rather than none (it may
-        have recovered — better one retry than a refused request)."""
-        now = time.monotonic()
-        exclude = exclude or set()
+        have recovered — better one retry than a refused request).
+
+        ``model``: prefer backends advertising that model name; when no
+        advertiser is available the pick falls back to the whole pool
+        (backends that advertise nothing are assumed to serve anything —
+        pre-ModelStore workers)."""
         with self._lock:
-            n = len(self._backends)
-            fallback = None
-            for i in range(n):
-                b = self._backends[(self._rr + i) % n]
-                if b in exclude:
+            b = self._next_locked(exclude or set(), model)
+            if b is None and model is not None:
+                b = self._next_locked(exclude or set(), None)
+            return b
+
+    def _next_locked(self, exclude: set, model: Optional[str]):
+        now = time.monotonic()
+        n = len(self._backends)
+        fallback = None
+        for i in range(n):
+            b = self._backends[(self._rr + i) % n]
+            if b in exclude:
+                continue
+            if model is not None:
+                advertised = self._models.get(b)
+                if advertised is not None and model not in advertised:
                     continue
-                if self._cooldown.get(b, 0.0) > now:
-                    fallback = fallback or b
-                    continue
-                self._rr = (self._rr + i + 1) % n
-                return b
-            return fallback
+            if self._cooldown.get(b, 0.0) > now:
+                fallback = fallback or b
+                continue
+            self._rr = (self._rr + i + 1) % n
+            return b
+        return fallback
 
     def report_failure(self, b: Backend) -> None:
         self._metrics_for(b)[1].inc()
@@ -259,10 +286,16 @@ class ServingGateway:
             # revival path (re-registration). A static pool would lose a
             # briefly-down worker FOREVER, so it relies on cooldown alone.
             evict_after = 3 if registry_url else 0
+        static_models = {
+            self._as_backend(w): frozenset(w.models)
+            for w in (workers or ())
+            if isinstance(w, ServiceInfo) and w.models
+        }
         self._pool = BackendPool(
             [self._as_backend(w) for w in (workers or ())],
             cooldown_s=cooldown_s,
             evict_after=evict_after,
+            models=static_models,
         )
         self._registry_url = registry_url
         self._refresh_s = refresh_s
@@ -372,6 +405,11 @@ class ServingGateway:
                 stamps={
                     Backend.from_info(i): float(i.get("ts") or 0.0)
                     for i in infos
+                },
+                models={
+                    Backend.from_info(i): frozenset(i["models"])
+                    for i in infos
+                    if i.get("models")
                 },
             )
 
@@ -499,9 +537,26 @@ class ServingGateway:
                 trace_id=req.headers.get(obs.TRACE_HEADER),
             )
 
+    @staticmethod
+    def _model_of(req) -> Optional[str]:
+        """The model a request targets (``x-mmlspark-model`` header or a
+        ``/models/<name>`` path) — the routing key for model-aware backend
+        selection. None = unrouted (any backend)."""
+        model = req.headers.get("x-mmlspark-model")
+        if model:
+            return model
+        path = req.path.split("?", 1)[0]
+        if path.startswith("/models/"):
+            parts = [p for p in path[len("/models/"):].split("/") if p]
+            if parts:
+                return parts[0]
+        return None
+
     def _forward(self, req) -> None:
         attempts = self._max_attempts or max(2, self._pool.size() + 1)
         tried: set = set()
+        model = self._model_of(req)
+        not_ready = None  # last worker-local model-loading 503, if any
         headers = {
             k: v for k, v in req.headers.items()
             if k.lower() not in self._SKIP_HEADERS
@@ -513,9 +568,18 @@ class ServingGateway:
         headers[obs.TRACE_HEADER] = trace_id
         req.headers[obs.TRACE_HEADER] = trace_id
         for attempt in range(attempts):
-            b = self._pool.next(exclude=tried)
+            b = self._pool.next(exclude=tried, model=model)
             if b is None:
                 break
+            # preserve the request's own path (the /models/<name> data and
+            # control routes must survive the hop); a worker registered
+            # under a base path gets it prefixed
+            target = (
+                req.path if b.path in ("", "/")
+                else b.path.rstrip("/") + (
+                    req.path if req.path.startswith("/") else "/" + req.path
+                )
+            )
             sent = False
             try:
                 # fault point gateway.forward: an injected OSError here is
@@ -538,7 +602,7 @@ class ServingGateway:
                     # safe to re-dispatch
                     try:
                         conn.request(
-                            req.method, b.path, body=req.body, headers=headers
+                            req.method, target, body=req.body, headers=headers
                         )
                     except (OSError, http.client.HTTPException):
                         if not cached:
@@ -550,7 +614,7 @@ class ServingGateway:
                         self._drop_conn(b)
                         conn, _ = self._conn_for(b)
                         conn.request(
-                            req.method, b.path, body=req.body, headers=headers
+                            req.method, target, body=req.body, headers=headers
                         )
                     sent = True
                     # fault point gateway.response: an injected TimeoutError
@@ -590,6 +654,26 @@ class ServingGateway:
                 _M_GW_RETRIES.inc()
                 continue
             self._pool.report_ok(b)
+            if (
+                resp.status in (503, 404)
+                and resp.getheader("x-mmlspark-model-state")
+                and attempt + 1 < attempts
+            ):
+                # worker-local unavailability, not a dead worker: THIS
+                # replica is still loading/warming the model (mid-swap or
+                # cold start) or doesn't know it at all (heartbeat lag) —
+                # another replica may already serve it, so re-dispatch
+                # without cooling the healthy backend down. When every
+                # candidate declines, relay a loading 503 over an
+                # unknown 404 (the model provably exists somewhere)
+                if not_ready is None or resp.status == 503:
+                    not_ready = (
+                        resp.status, body, resp.getheader("Content-Type"),
+                    )
+                tried.add(b)
+                self.retried += 1
+                _M_GW_RETRIES.inc()
+                continue
             self.forwarded += 1
             _M_GW_FORWARDED.inc()
             out_headers = {}
@@ -597,6 +681,17 @@ class ServingGateway:
             if ct:
                 out_headers["Content-Type"] = ct
             self._reply(req, body, resp.status, out_headers)
+            return
+        if not_ready is not None:
+            # every candidate said "model still loading here": relay the
+            # worker's own 503 (clients with a retrying handler back off)
+            status, body, ct = not_ready
+            self.failed += 1
+            _M_GW_FAILED.labels(reason="model_not_ready").inc()
+            self._reply(
+                req, body, status,
+                {"Content-Type": ct} if ct else None,
+            )
             return
         self.failed += 1
         _M_GW_FAILED.labels(reason="no_backends").inc()

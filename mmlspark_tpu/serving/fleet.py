@@ -29,8 +29,6 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
-import numpy as np
-
 
 def make_model_handler(model_spec: str) -> Callable:
     """Model spec -> batch handler for :class:`ServingQuery`.
@@ -38,58 +36,16 @@ def make_model_handler(model_spec: str) -> Callable:
     - ``echo``           — replies with the parsed request body (smoke tests)
     - ``zoo:<name>``     — ImageFeaturizer on the named zoo backbone; body
       ``{"image": [[...]]}`` (H, W, C) uint8 -> ``{"features": [...]}``
-    - ``module:pkg.fn``  — import ``pkg.fn``; it must return a handler
-    """
-    if model_spec == "echo":
+    - ``module:pkg.fn``  — import ``pkg.fn``; it may return a handler or a
+      :class:`~mmlspark_tpu.serving.modelstore.LoadedModel`
 
-        def handler(reqs: list) -> dict:
-            out = {}
-            for r in reqs:
-                try:
-                    body = json.loads(r.body) if r.body else {}
-                    out[r.id] = (200, json.dumps({"echo": body}).encode(), {})
-                except ValueError as e:
-                    out[r.id] = (400, json.dumps({"error": str(e)}).encode(), {})
-            return out
+    The spec grammar lives in serving/modelstore/loaders.py (the fleet
+    workers' ModelStore path, which adds byte accounting, warmup and
+    eviction hooks); this is the bare-handler view of the same resolver
+    for embedding a single model in a :class:`ServingQuery`."""
+    from mmlspark_tpu.serving.modelstore import build_loaded_model
 
-        return handler
-    if model_spec.startswith("module:"):
-        import importlib
-
-        mod_name, _, fn_name = model_spec[len("module:"):].rpartition(".")
-        return getattr(importlib.import_module(mod_name), fn_name)()
-    if model_spec.startswith("zoo:"):
-        from mmlspark_tpu.models import ImageFeaturizer
-
-        feat = ImageFeaturizer(
-            input_col="image", output_col="features",
-            model_name=model_spec[len("zoo:"):],
-        )
-        inner = feat._build()
-
-        def handler(reqs: list) -> dict:
-            out = {}
-            imgs, ids = [], []
-            for r in reqs:
-                try:
-                    imgs.append(
-                        np.asarray(json.loads(r.body)["image"], np.uint8)
-                    )
-                    ids.append(r.id)
-                except (ValueError, KeyError) as e:
-                    out[r.id] = (400, json.dumps({"error": str(e)}).encode(), {})
-            if imgs:
-                feats = inner.apply_batch(np.stack(imgs))
-                for rid, f in zip(ids, feats):
-                    out[rid] = (
-                        200,
-                        json.dumps({"features": np.asarray(f).tolist()}).encode(),
-                        {},
-                    )
-            return out
-
-        return handler
-    raise ValueError(f"unknown model spec {model_spec!r}")
+    return build_loaded_model(model_spec).handler
 
 
 def run_registry(
@@ -148,23 +104,53 @@ def run_worker(
     service_name: str = "serving",
     heartbeat_s: float = 5.0,
     advertise_host: Optional[str] = None,
+    extra_models: Optional[list] = None,
+    hbm_budget_bytes: Optional[int] = None,
+    default_deadline_ms: Optional[float] = None,
 ) -> tuple:
-    """Start a worker, register it, and re-register on a heartbeat thread
-    (a restarted registry re-learns live workers within one beat). The
-    returned stopper deregisters on shutdown (clean-SIGTERM path)."""
-    from mmlspark_tpu.serving.query import ServingQuery
+    """Start a ModelStore-backed worker, register it, and re-register on a
+    heartbeat thread (a restarted registry re-learns live workers within
+    one beat). The returned stopper deregisters on shutdown (clean-SIGTERM
+    path).
+
+    Cold-start ordering (the routable-before-jitted fix): the default
+    model is loaded AND warmed — its dummy bucket batch compiled — before
+    the worker registers, so the gateway never routes to a worker whose
+    first request would pay a compile; ``GET /health`` reports readiness
+    for probes that want to see it. ``extra_models``: additional
+    ``name=spec`` entries loaded (also pre-registration) for multi-model
+    serving; all names are advertised on the roster for model-aware
+    gateway routing."""
+    from mmlspark_tpu.serving.modelstore import (
+        ModelDispatcher,
+        ModelStore,
+        model_name_from_spec,
+    )
     from mmlspark_tpu.serving.registry import DriverRegistry
     from mmlspark_tpu.serving.server import WorkerServer
 
     srv = WorkerServer(host=host, port=port, name=service_name)
     info = srv.start()
+    store = ModelStore(budget_bytes=hbm_budget_bytes)
+    specs = [(model_name_from_spec(model), model)] if model else []
+    for entry in extra_models or ():
+        name, _, spec = entry.partition("=")
+        if not spec:
+            name, spec = model_name_from_spec(entry), entry
+        specs.append((name, spec))
+    for name, spec in specs:
+        store.load(name, spec, wait=True)  # warm BEFORE registering
+    q = ModelDispatcher(
+        srv, store, default_model=specs[0][0] if specs else None,
+        default_deadline_ms=default_deadline_ms,
+    ).start()
+    import dataclasses
+
     if advertise_host:
         # the registry roster must carry an address OTHER containers can
         # reach, not the 0.0.0.0 bind address
-        import dataclasses
-
         info = dataclasses.replace(info, host=advertise_host)
-    q = ServingQuery(srv, make_model_handler(model)).start()
+    info = dataclasses.replace(info, models=tuple(n for n, _ in specs))
     stop = threading.Event()
     stopper = _WorkerStopper(stop, registry_url, info)
 
@@ -174,15 +160,76 @@ def run_worker(
                 # checked INSIDE the try so a shutdown signaled between the
                 # loop test and the POST still skips the re-register
                 if not stop.is_set():
-                    DriverRegistry.register(registry_url, info)
+                    # re-advertise the store's CURRENT models each beat:
+                    # a model loaded at runtime through the control plane
+                    # becomes gateway-routable within one heartbeat
+                    DriverRegistry.register(
+                        registry_url,
+                        dataclasses.replace(
+                            info, models=tuple(store.model_names())
+                        ),
+                    )
             except Exception as e:  # noqa: BLE001 — registry may be restarting
                 print(f"worker: register failed: {e}", file=sys.stderr, flush=True)
             stop.wait(heartbeat_s)
 
     stopper._beat = threading.Thread(target=beat, name="worker-heartbeat", daemon=True)
     stopper._beat.start()
-    print(f"worker: {info.host}:{info.port} model={model}", flush=True)
+    print(
+        f"worker: {info.host}:{info.port} "
+        f"models={','.join(info.models or ())}",
+        flush=True,
+    )
     return srv, q, stopper
+
+
+def run_model_verb(
+    action: str,
+    url: str,
+    name: Optional[str] = None,
+    spec: Optional[str] = None,
+    version: Optional[int] = None,
+    pin: bool = False,
+    no_wait: bool = False,
+    activate: Optional[str] = None,
+) -> int:
+    """``fleet model <action>`` — drive a worker's (or, routed, a
+    gateway's) model control plane. Returns a process exit code; prints
+    the JSON response."""
+    from mmlspark_tpu.io.clients import send_request
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+    base = url.rstrip("/")
+    if action == "list":
+        req = HTTPRequestData(f"{base}/models", "GET")
+    else:
+        if not name:
+            print("fleet model: --name is required", file=sys.stderr)
+            return 2
+        body: dict = {}
+        if action == "load":
+            if not spec:
+                print("fleet model load: --spec is required", file=sys.stderr)
+                return 2
+            body["spec"] = spec
+            if pin:
+                body["pin"] = True
+            if no_wait:
+                body["wait"] = False
+            if activate:
+                body["activate"] = activate
+        if version is not None:
+            body["version"] = version
+        req = HTTPRequestData(
+            f"{base}/models/{name}/{action}", "POST",
+            {"Content-Type": "application/json"}, json.dumps(body),
+        )
+    resp = send_request(req, timeout=300.0)
+    entity = resp["entity"]
+    if isinstance(entity, bytes):
+        entity = entity.decode("utf-8", "replace")
+    print(entity, flush=True)
+    return 0 if resp["status_code"] in (200, 202) else 1
 
 
 def scrape_metrics(url: str, timeout: float = 5.0) -> Optional[dict]:
@@ -416,6 +463,21 @@ def main(argv: Optional[list] = None) -> None:
         "--advertise-host", default=None,
         help="hostname other containers reach this worker by (compose/k8s)",
     )
+    w.add_argument(
+        "--load", action="append", default=[], metavar="NAME=SPEC",
+        help="additional model to load+warm before registering "
+        "(repeatable; bare SPEC derives the name from the spec)",
+    )
+    w.add_argument(
+        "--hbm-budget-bytes", type=int, default=None,
+        help="cap resident model-weight bytes; past it the ModelStore "
+        "LRU-evicts unpinned non-serving versions (docs/modelstore.md)",
+    )
+    w.add_argument(
+        "--default-deadline-ms", type=float, default=None,
+        help="admission-control deadline applied to requests that carry "
+        "no x-mmlspark-deadline-ms header (None = shed only on request)",
+    )
     g = sub.add_parser("gateway")
     g.add_argument("--registry", required=True)
     g.add_argument("--host", default="0.0.0.0")
@@ -440,12 +502,47 @@ def main(argv: Optional[list] = None) -> None:
         "--watch", type=float, default=0.0,
         help="refresh every N seconds (0 = print once and exit)",
     )
+    m = sub.add_parser(
+        "model",
+        help="model lifecycle control against a worker or gateway "
+        "(GET/POST /models control plane)",
+    )
+    m.add_argument(
+        "action", choices=["list", "load", "swap", "unload", "pin", "unpin"],
+    )
+    m.add_argument(
+        "--url", required=True,
+        help="worker base URL (or gateway: the op routes to one backend "
+        "advertising the model)",
+    )
+    m.add_argument("--name", default=None, help="model name")
+    m.add_argument("--spec", default=None, help="model spec (load)")
+    m.add_argument("--version", type=int, default=None)
+    m.add_argument(
+        "--pin", action="store_true",
+        help="load: pin the new version against eviction",
+    )
+    m.add_argument(
+        "--no-wait", action="store_true",
+        help="load: return 202 immediately, load in the background",
+    )
+    m.add_argument(
+        "--activate", default=None, choices=["auto", "always", "never"],
+        help="load: alias policy (default auto: first version serves, "
+        "later versions wait for an explicit swap)",
+    )
     args = ap.parse_args(argv)
     if args.fault_plan:
         from mmlspark_tpu.core.faults import FaultPlan
 
         FaultPlan.from_spec(args.fault_plan).install()
         print(f"fleet: fault plan armed ({args.fault_plan})", flush=True)
+    if args.role == "model":
+        raise SystemExit(run_model_verb(
+            args.action, args.url, name=args.name, spec=args.spec,
+            version=args.version, pin=args.pin, no_wait=args.no_wait,
+            activate=args.activate,
+        ))
     if args.role == "top":
         while True:
             print(
@@ -466,6 +563,9 @@ def main(argv: Optional[list] = None) -> None:
         srv, q, stop = run_worker(
             args.registry, args.model, args.host, args.port,
             args.service_name, args.heartbeat_s, args.advertise_host,
+            extra_models=args.load,
+            hbm_budget_bytes=args.hbm_budget_bytes,
+            default_deadline_ms=args.default_deadline_ms,
         )
         _serve_forever([stop, q, srv])
     else:

@@ -1,0 +1,512 @@
+"""ModelDispatcher: per-model request routing on one WorkerServer.
+
+Replaces the single-handler :class:`~mmlspark_tpu.serving.query.ServingQuery`
+loop on multi-model workers. One fast **router thread** pops ingress
+requests and does no model work — it answers the control plane and
+``/health`` inline (spawning a side thread for verbs that may block on a
+load), applies admission control, and pushes data requests into
+**per-model queues**. Each model owns a dispatcher thread with its own
+batcher, so a slow model's batch never holds another model's traffic,
+and each batch resolves its model version through
+``ModelStore.acquire()`` — the refcount that lets hot-swap drain the old
+version without dropping a request.
+
+Routing: ``POST /models/<name>`` or the ``x-mmlspark-model`` header pick
+the model; bare ``POST /`` goes to ``default_model``.
+
+Admission control (deadline-aware shedding): a request carrying
+``x-mmlspark-deadline-ms`` (or, with ``default_deadline_ms`` set, every
+request) is rejected **429** at routing time when estimated queue wait
+plus one service time already blows the deadline — shedding at ingress
+costs microseconds, serving a reply the client will discard costs a full
+batch slot. The estimate is ``ceil(queue_len / max_batch) * svc + svc``
+with ``svc`` an EWMA of recent batch service times.
+
+Control plane (all answered by the worker itself, never queued):
+
+- ``GET  /models``                 — full store listing
+- ``GET  /models/<name>``          — one model's versions + serving alias
+- ``POST /models/<name>/load``     — body ``{"spec": ..., "version"?,
+  "pin"?, "activate"?, "wait"?}``; ``wait=false`` returns 202 and loads
+  in the background
+- ``POST /models/<name>/swap``     — body ``{"version"?}``
+- ``POST /models/<name>/unload``   — body ``{"version"?}``
+- ``POST /models/<name>/pin`` / ``/unpin`` — body ``{"version"?}``
+- ``GET  /health``                 — 200 once the default model (or, with
+  no default, any model) is ready; 503 with per-model states otherwise
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.serving.modelstore.store import (
+    HBMBudgetExceeded,
+    ModelStore,
+    ModelStoreError,
+    READY,
+)
+# the worker-level families ServingQuery emits: the dispatcher reports
+# into them too (labels server=<name>), so `fleet top`, dashboards and
+# alerts keyed on mmlspark_serving_* keep working on ModelStore workers
+from mmlspark_tpu.serving.query import (
+    _M_HANDLER_ERRS as _M_SRV_ERRS,
+    _M_LATENCY as _M_SRV_LATENCY,
+    LatencyRing,
+)
+from mmlspark_tpu.serving.server import WorkerServer
+
+MODEL_HEADER = "x-mmlspark-model"
+DEADLINE_HEADER = "x-mmlspark-deadline-ms"
+# stamped on 503s a routing layer may retry elsewhere (model still
+# loading/warming on THIS worker — another replica may already serve it)
+STATE_HEADER = "x-mmlspark-model-state"
+
+_CONTROL_VERBS = ("load", "swap", "unload", "pin", "unpin")
+_JSON = {"Content-Type": "application/json"}
+
+_M_DISPATCH_LAT = obs.histogram(
+    "mmlspark_modelstore_dispatch_latency_seconds",
+    "Per-model ingress arrival to reply", labels=("model",),
+)
+_M_SHED = obs.counter(
+    "mmlspark_modelstore_shed_total",
+    "Requests shed 429 by deadline-aware admission control",
+    labels=("model",),
+)
+_M_ERRS = obs.counter(
+    "mmlspark_modelstore_handler_errors_total",
+    "Handler exceptions turned into 500 batches", labels=("model",),
+)
+_M_QDEPTH = obs.gauge(
+    "mmlspark_modelstore_queue_depth_requests",
+    "Requests queued per model awaiting dispatch", labels=("model",),
+)
+
+
+class _ModelQueue:
+    """One model's queue + batcher thread + service-time EWMA."""
+
+    def __init__(self, disp: "ModelDispatcher", name: str):
+        self.disp = disp
+        self.name = name
+        self.q: deque = deque()
+        self.cond = threading.Condition()
+        self.dead = False  # set by the reaper; push() then refuses
+        self.svc_s = 0.0  # EWMA of one batch's service time (0 = unknown)
+        self._m_lat = _M_DISPATCH_LAT.labels(model=name)
+        self._m_errs = _M_ERRS.labels(model=name)
+        self._m_qdepth = _M_QDEPTH.labels(model=name)
+        self._m_srv_lat = _M_SRV_LATENCY.labels(server=disp.server.name)
+        self._m_srv_errs = _M_SRV_ERRS.labels(server=disp.server.name)
+        self.thread = threading.Thread(
+            target=self._loop, name=f"modelstore-dispatch-{name}", daemon=True
+        )
+        self.thread.start()
+
+    def push(self, req) -> bool:
+        """False when this queue was reaped between routing's lookup and
+        the push — the request must be answered not-ready, not stranded
+        on a queue nothing will ever pop."""
+        with self.cond:
+            if self.dead:
+                return False
+            self.q.append(req)
+            self._m_qdepth.set(len(self.q))
+            self.cond.notify()
+            return True
+
+    def depth(self) -> int:
+        with self.cond:
+            return len(self.q)
+
+    def estimate_s(self) -> float:
+        """Queue wait + one service time if a request joined now — the
+        admission-control estimate. 0 while no batch has been measured
+        (admit everything until the EWMA exists)."""
+        if self.svc_s <= 0.0:
+            return 0.0
+        with self.cond:
+            depth = len(self.q)
+        batches_ahead = -(-depth // max(self.disp.max_batch_size, 1))
+        return (batches_ahead + 1) * self.svc_s
+
+    def _pop_batch(self) -> list:
+        max_n = self.disp.max_batch_size
+        acc_s = self.disp.max_wait_ms / 1000.0
+        with self.cond:
+            if not self.q:
+                self.cond.wait(0.25)
+            if self.q and acc_s > 0:
+                deadline = time.monotonic() + acc_s
+                while len(self.q) < max_n:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self.cond.wait(remaining)
+            out = []
+            while self.q and len(out) < max_n:
+                out.append(self.q.popleft())
+            if out:
+                self._m_qdepth.set(len(self.q))
+            return out
+
+    def _reap_if_orphaned(self) -> bool:
+        """Exit this batcher when its model was unloaded: otherwise every
+        model name ever served leaves an idle 4 Hz-polling thread and a
+        live metric series behind (multi-tenant churn). A reload simply
+        recreates the queue lazily."""
+        disp = self.disp
+        if disp.store.serving_state(self.name) is not None:
+            return False
+        with disp._queues_lock:
+            if disp._queues.get(self.name) is not self:
+                return True  # a reload already replaced us: just exit
+            with self.cond:
+                if self.q:
+                    return False  # stragglers first; reap on a later pass
+                self.dead = True  # a racing push() now refuses
+            del disp._queues[self.name]
+        for fam in (_M_DISPATCH_LAT, _M_SHED, _M_ERRS, _M_QDEPTH):
+            fam.remove(model=self.name)
+        return True
+
+    def _loop(self) -> None:
+        disp = self.disp
+        while not disp._stop.is_set():
+            batch = self._pop_batch()
+            if not batch:
+                if self._reap_if_orphaned():
+                    return
+                continue
+            mv = disp.store.acquire(self.name)
+            if mv is None:
+                # swap/unload raced routing: the version vanished between
+                # admission and dispatch — tell the router's 503 story
+                disp._reply_not_ready(batch, self.name)
+                continue
+            t0 = time.perf_counter()
+            try:
+                ctx = (
+                    obs.span(
+                        "modelstore.dispatch",
+                        trace_id=batch[0].headers.get(obs.TRACE_HEADER),
+                    )
+                    if self._m_lat._on
+                    else contextlib.nullcontext()
+                )
+                with ctx:
+                    replies = mv.loaded.handler(batch)
+            except Exception as e:  # handler crash -> 500s, keep serving
+                disp.errors += 1
+                self._m_errs.inc()
+                self._m_srv_errs.inc()
+                msg = f"handler error: {type(e).__name__}: {e}".encode()
+                replies = {r.id: (500, msg, {}) for r in batch}
+            finally:
+                disp.store.release(mv)
+            svc = time.perf_counter() - t0
+            self.svc_s = svc if self.svc_s <= 0 else (
+                0.8 * self.svc_s + 0.2 * svc
+            )
+            done_ns = time.perf_counter_ns()
+            for r in batch:
+                code, body, headers = replies.get(
+                    r.id, (500, b"no reply produced", {})
+                )
+                disp.server.reply_to(r.id, body, code, headers)
+                if self._m_lat._on:
+                    lat_s = (done_ns - r.arrival_ns) / 1e9
+                    self._m_lat.observe(lat_s)
+                    self._m_srv_lat.observe(lat_s)
+                disp._lat.record(done_ns - r.arrival_ns)
+            disp.batches += 1
+        # stopped: nothing queued here gets a handler anymore
+        with self.cond:
+            leftovers, self.q = list(self.q), deque()
+        for r in leftovers:
+            disp.server.reply_to(r.id, b"worker stopping", 503)
+
+
+class ModelDispatcher:
+    """Multi-model dispatch loop between one WorkerServer and a ModelStore.
+
+    Same lifecycle surface as :class:`ServingQuery` (``start`` / ``stop``
+    / ``batches`` / ``errors`` / ``latency_quantiles_ms``) so fleet code
+    and tests treat them interchangeably."""
+
+    def __init__(
+        self,
+        server: WorkerServer,
+        store: ModelStore,
+        default_model: Optional[str] = None,
+        max_batch_size: int = 64,
+        max_wait_ms: float = 0.0,
+        default_deadline_ms: Optional[float] = None,
+    ):
+        self.server = server
+        self.store = store
+        self.default_model = default_model
+        self.max_batch_size = max_batch_size
+        self.max_wait_ms = max_wait_ms
+        self.default_deadline_ms = default_deadline_ms
+        self._stop = threading.Event()
+        self._router: Optional[threading.Thread] = None
+        self._queues: dict[str, _ModelQueue] = {}
+        self._queues_lock = threading.Lock()
+        self.batches = 0
+        self.errors = 0
+        self.shed = 0
+        self._lat = LatencyRing()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ModelDispatcher":
+        self._router = threading.Thread(
+            target=self._route_loop, name=f"{self.server.name}-router",
+            daemon=True,
+        )
+        self._router.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._router is not None:
+            self._router.join(5.0)
+        with self._queues_lock:
+            queues = list(self._queues.values())
+        for mq in queues:
+            with mq.cond:
+                mq.cond.notify_all()
+            mq.thread.join(5.0)
+
+    def latency_quantiles_ms(self) -> dict:
+        return self._lat.quantiles_ms()
+
+    # -- routing (router thread: no model work, O(µs) per request) -----------
+
+    def _route_loop(self) -> None:
+        while not self._stop.is_set():
+            reqs = self.server.get_next_batch(64, timeout_s=0.25)
+            for r in reqs:
+                if self._stop.is_set():
+                    self.server.reply_to(r.id, b"worker stopping", 503)
+                    continue
+                try:
+                    self._route(r)
+                except Exception as e:  # noqa: BLE001 — router must survive
+                    self.server.reply_to(
+                        r.id,
+                        json.dumps({"error": f"{type(e).__name__}: {e}"})
+                        .encode(),
+                        500, _JSON,
+                    )
+            if reqs:
+                self.server.auto_commit()
+        # drain whatever the ingress still holds so clients aren't hung
+        for r in self.server.get_next_batch(1_000_000, timeout_s=0.0):
+            self.server.reply_to(r.id, b"worker stopping", 503)
+
+    def _route(self, r) -> None:
+        path = r.path.split("?", 1)[0]
+        # a worker registered under a base path receives gateway-forwarded
+        # targets like /api/models/m/swap — strip the prefix so the
+        # control-plane and health routes match regardless of api_path
+        prefix = self.server.api_path.rstrip("/")
+        if prefix and path.startswith(prefix):
+            path = path[len(prefix):] or "/"
+        if path in ("/health", "/healthz") and r.method == "GET":
+            self._reply_health(r)
+            return
+        model = None
+        if path == "/models" or path == "/models/":
+            self._reply_json(r, self.store.models())
+            return
+        if path.startswith("/models/"):
+            parts = [p for p in path[len("/models/"):].split("/") if p]
+            if not parts:
+                self._reply_json(r, self.store.models())
+                return
+            name = parts[0]
+            if len(parts) == 2 and parts[1] in _CONTROL_VERBS:
+                if r.method != "POST":
+                    self._reply_json(
+                        r, {"error": "control verbs are POST"}, 400
+                    )
+                    return
+                self._control(r, name, parts[1])
+                return
+            if len(parts) == 1 and r.method == "GET":
+                listing = self.store.models().get(name)
+                if listing is None:
+                    self._reply_json(
+                        r, {"error": f"unknown model {name!r}"}, 404
+                    )
+                else:
+                    self._reply_json(r, {name: listing})
+                return
+            model = name  # data path: POST /models/<name>[/...]
+        if model is None:
+            model = r.headers.get(MODEL_HEADER) or self.default_model
+        if model is None:
+            self._reply_json(
+                r,
+                {"error": "no model named: set x-mmlspark-model or POST "
+                          "/models/<name>"},
+                404,
+            )
+            return
+        self._admit(r, model)
+
+    def _admit(self, r, model: str) -> None:
+        state = self.store.serving_state(model)
+        if state is None:
+            # worker-local unknown: another replica may serve this model
+            # without advertising it yet (runtime load, heartbeat lag) —
+            # the state header lets the gateway retry elsewhere
+            self._reply_json(
+                r, {"error": f"unknown model {model!r}"}, 404,
+                {STATE_HEADER: "unknown", **_JSON},
+            )
+            return
+        if state != READY:
+            self._reply_not_ready([r], model, state)
+            return
+        mq = self._queues.get(model)
+        if mq is None:
+            with self._queues_lock:
+                mq = self._queues.get(model)
+                if mq is None:
+                    mq = self._queues[model] = _ModelQueue(self, model)
+        # deadline-aware shedding: reject NOW when the queue already
+        # guarantees a blown deadline — a 429 at ingress beats a reply
+        # the client gave up on
+        deadline_ms = r.headers.get(DEADLINE_HEADER)
+        try:
+            deadline_ms = (
+                float(deadline_ms) if deadline_ms is not None
+                else self.default_deadline_ms
+            )
+        except ValueError:
+            deadline_ms = self.default_deadline_ms
+        if deadline_ms is not None:
+            waited_s = (time.perf_counter_ns() - r.arrival_ns) / 1e9
+            est_s = mq.estimate_s() + waited_s
+            if est_s * 1000.0 > deadline_ms:
+                self.shed += 1
+                _M_SHED.labels(model=model).inc()
+                self._reply_json(
+                    r,
+                    {
+                        "error": "deadline unmeetable",
+                        "estimate_ms": round(est_s * 1e3, 3),
+                        "deadline_ms": deadline_ms,
+                    },
+                    429, {"Retry-After": "1", **_JSON},
+                )
+                return
+        if not mq.push(r):
+            # the queue was reaped (model unloaded) between lookup and
+            # push: answer rather than strand the request
+            self._reply_not_ready([r], model)
+
+    # -- replies -------------------------------------------------------------
+
+    def _reply_json(self, r, obj, code: int = 200,
+                    headers: Optional[dict] = None) -> None:
+        self.server.reply_to(
+            r.id, json.dumps(obj).encode(), code, headers or _JSON
+        )
+
+    def _reply_not_ready(self, reqs: list, model: str,
+                         state: Optional[str] = None) -> None:
+        state = state or self.store.serving_state(model) or "unloaded"
+        body = json.dumps(
+            {"error": f"model {model!r} not ready", "state": state}
+        ).encode()
+        for r in reqs:
+            # STATE_HEADER marks this 503 as worker-local (the model is
+            # loading HERE) — the gateway retries another replica on it
+            self.server.reply_to(
+                r.id, body, 503, {STATE_HEADER: state, **_JSON}
+            )
+
+    def _reply_health(self, r) -> None:
+        """Readiness: the default model (or, with no default, any model)
+        has a ready serving version. The shape a registry-fronting LB or
+        k8s probe consumes — and what fleet.run_worker's warm-before-
+        register contract makes true by the time the worker is routable."""
+        states = {
+            name: {
+                "serving": self.store.serving_version(name),
+                "state": self.store.serving_state(name),
+            }
+            for name in self.store.model_names()
+        }
+        if self.default_model is not None:
+            ok = states.get(self.default_model, {}).get("state") == READY
+        else:
+            ok = any(s["state"] == READY for s in states.values())
+        self._reply_json(
+            r,
+            {"status": "ok" if ok else "loading", "models": states},
+            200 if ok else 503,
+        )
+
+    # -- control plane (side threads: a load must not stall routing) ---------
+
+    def _control(self, r, name: str, verb: str) -> None:
+        def run() -> None:
+            try:
+                body = json.loads(r.body) if r.body else {}
+                if not isinstance(body, dict):
+                    raise ValueError("control body must be a JSON object")
+                if verb == "load":
+                    spec = body.get("spec")
+                    if spec is None:
+                        raise ValueError('load needs {"spec": ...}')
+                    wait = bool(body.get("wait", True))
+                    v = self.store.load(
+                        name, spec, version=body.get("version"),
+                        wait=wait, pin=bool(body.get("pin", False)),
+                        activate=body.get("activate", "auto"),
+                    )
+                    out, code = {
+                        "model": name, "version": v,
+                        "state": READY if wait else "loading",
+                    }, (200 if wait else 202)
+                elif verb == "swap":
+                    v = self.store.swap(name, body.get("version"))
+                    out, code = {"model": name, "serving": v}, 200
+                elif verb == "unload":
+                    n = self.store.unload(name, body.get("version"))
+                    out, code = {"model": name, "unloaded": n}, 200
+                else:  # pin / unpin
+                    v = self.store.pin(
+                        name, body.get("version"), pinned=(verb == "pin")
+                    )
+                    out, code = {
+                        "model": name, "version": v,
+                        "pinned": verb == "pin",
+                    }, 200
+                self._reply_json(r, out, code)
+            except KeyError as e:
+                self._reply_json(r, {"error": str(e).strip("'\"")}, 404)
+            except HBMBudgetExceeded as e:
+                self._reply_json(r, {"error": str(e)}, 507)
+            except (ModelStoreError, ValueError, TypeError) as e:
+                self._reply_json(r, {"error": str(e)}, 400)
+            except Exception as e:  # noqa: BLE001 — loader crashes land here
+                self._reply_json(
+                    r, {"error": f"{type(e).__name__}: {e}"}, 500
+                )
+
+        threading.Thread(
+            target=run, name=f"modelstore-ctl-{verb}-{name}", daemon=True
+        ).start()

@@ -1,0 +1,155 @@
+"""Spec -> :class:`LoadedModel` resolution for the ModelStore.
+
+Understands the fleet CLI's model specs (``echo`` / ``zoo:<name>`` /
+``module:pkg.fn``) and adds what the store needs beyond a bare handler:
+a device-byte estimate for the residency budget, a warmup that runs one
+dummy bucket batch through the model (so the XLA compile happens before
+the version turns ``ready``), and a release hook for eviction.
+
+A ``module:`` factory may return either a plain handler (legacy fleet
+contract) or a :class:`LoadedModel` directly — the latter is how custom
+models report their true byte footprint and warmup shape.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.serving.modelstore.store import LoadedModel
+from mmlspark_tpu.serving.server import CachedRequest
+
+
+def model_name_from_spec(spec: str) -> str:
+    """The model name a spec serves under (fleet worker registration and
+    per-model routing): ``echo`` -> ``echo``, ``zoo:ResNet8`` ->
+    ``ResNet8``, ``module:pkg.make`` -> ``make``."""
+    if spec.startswith("zoo:"):
+        return spec[len("zoo:"):]
+    if spec.startswith("module:"):
+        return spec.rsplit(".", 1)[-1]
+    return spec
+
+
+def _dummy_request(body: bytes) -> CachedRequest:
+    return CachedRequest(
+        id="__warmup__", epoch=0, method="POST", path="/", headers={},
+        body=body,
+    )
+
+
+def tree_nbytes(obj: Any) -> int:
+    """Best-effort device-byte estimate: sum ``nbytes`` over the array
+    leaves of a pytree (jax or numpy). 0 when jax is unavailable or the
+    object holds no arrays."""
+    try:
+        import jax
+
+        return int(sum(
+            getattr(leaf, "nbytes", 0) or 0
+            for leaf in jax.tree_util.tree_leaves(obj)
+        ))
+    except Exception:  # noqa: BLE001 — accounting is advisory, not load-bearing
+        return 0
+
+
+def _echo_loaded() -> LoadedModel:
+    def handler(reqs: list) -> dict:
+        out = {}
+        for r in reqs:
+            try:
+                body = json.loads(r.body) if r.body else {}
+                out[r.id] = (200, json.dumps({"echo": body}).encode(), {})
+            except ValueError as e:
+                out[r.id] = (400, json.dumps({"error": str(e)}).encode(), {})
+        return out
+
+    def warmup() -> None:
+        handler([_dummy_request(b'{"x": 0}')])
+
+    return LoadedModel(handler=handler, nbytes=0, warmup=warmup,
+                       meta={"spec": "echo"})
+
+
+def _zoo_loaded(name: str) -> LoadedModel:
+    from mmlspark_tpu.models import ImageFeaturizer
+
+    feat = ImageFeaturizer(
+        input_col="image", output_col="features", model_name=name,
+    )
+    inner = feat._build()
+    size = feat.get("image_size") or (
+        feat._schema.image_size if feat._schema is not None else 224
+    )
+    nbytes = tree_nbytes(inner.get("variables"))
+
+    def handler(reqs: list) -> dict:
+        out = {}
+        imgs, ids = [], []
+        for r in reqs:
+            try:
+                imgs.append(np.asarray(json.loads(r.body)["image"], np.uint8))
+                ids.append(r.id)
+            except (ValueError, KeyError) as e:
+                out[r.id] = (400, json.dumps({"error": str(e)}).encode(), {})
+        if imgs:
+            feats = inner.apply_batch(np.stack(imgs))
+            for rid, f in zip(ids, feats):
+                out[rid] = (
+                    200,
+                    json.dumps(
+                        {"features": np.asarray(f).tolist()}
+                    ).encode(),
+                    {},
+                )
+        return out
+
+    def warmup() -> None:
+        # one dummy batch through the REAL handler: compiles the backbone
+        # for the 1-row bucket before the version turns ready
+        inner.apply_batch(np.zeros((1, size, size, 3), np.uint8))
+
+    def release() -> None:
+        # drop the jit cache + replicated device variables; the reload
+        # path is the spec itself
+        inner._jit_cache.clear()
+        inner._dev_vars = None
+
+    return LoadedModel(
+        handler=handler, nbytes=nbytes, warmup=warmup, release=release,
+        meta={"spec": f"zoo:{name}", "image_size": size},
+    )
+
+
+def build_loaded_model(spec: Any) -> LoadedModel:
+    """Resolve a model spec:
+
+    - :class:`LoadedModel` — passed through unchanged;
+    - callable            — treated as a bare batch handler;
+    - ``"echo"``          — JSON echo (smoke tests / drills);
+    - ``"zoo:<name>"``    — ImageFeaturizer on the named zoo backbone,
+      with weight-byte accounting and a compile-warmup batch;
+    - ``"module:pkg.fn"`` — ``pkg.fn()`` returning a handler OR a
+      :class:`LoadedModel`.
+    """
+    if isinstance(spec, LoadedModel):
+        return spec
+    if callable(spec):
+        return LoadedModel(handler=spec)
+    if not isinstance(spec, str):
+        raise ValueError(f"unsupported model spec {spec!r}")
+    if spec == "echo":
+        return _echo_loaded()
+    if spec.startswith("zoo:"):
+        return _zoo_loaded(spec[len("zoo:"):])
+    if spec.startswith("module:"):
+        import importlib
+
+        mod_name, _, fn_name = spec[len("module:"):].rpartition(".")
+        obj = getattr(importlib.import_module(mod_name), fn_name)()
+        if isinstance(obj, LoadedModel):
+            return obj
+        return LoadedModel(handler=obj, meta={"spec": spec})
+    raise ValueError(f"unknown model spec {spec!r}")

@@ -42,6 +42,40 @@ _M_HANDLER_ERRS = obs.counter(
 )
 
 
+class LatencyRing:
+    """Fixed-capacity ring of end-to-end latencies (ns) with quantile
+    readout — shared by :class:`ServingQuery` and the modelstore's
+    :class:`~mmlspark_tpu.serving.modelstore.ModelDispatcher` (whose
+    per-model batcher threads record concurrently, hence the lock)."""
+
+    def __init__(self, cap: int = 4096):
+        self._buf: list = []
+        self._cap = cap
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def record(self, latency_ns: int) -> None:
+        with self._lock:
+            if len(self._buf) < self._cap:
+                self._buf.append(latency_ns)
+            else:
+                self._buf[self._count % self._cap] = latency_ns
+            self._count += 1
+
+    def quantiles_ms(self) -> dict:
+        with self._lock:
+            buf = list(self._buf)
+        if not buf:
+            return {}
+        arr = np.asarray(buf, dtype=np.float64) / 1e6
+        return {
+            "p50": float(np.percentile(arr, 50)),
+            "p90": float(np.percentile(arr, 90)),
+            "p99": float(np.percentile(arr, 99)),
+            "n": int(arr.size),
+        }
+
+
 class ServingQuery:
     def __init__(
         self,
@@ -62,9 +96,7 @@ class ServingQuery:
         self.epoch_interval_ms = epoch_interval_ms
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._latencies_ns: list = []  # ring buffer of end-to-end latencies
-        self._lat_cap = 4096
-        self._lat_count = 0
+        self._lat = LatencyRing()
         self.batches = 0
         self.errors = 0
         self._m_latency = _M_LATENCY.labels(server=server.name)
@@ -156,36 +188,31 @@ class ServingQuery:
                     "serving.request", r.arrival_ns, done_ns,
                     trace_id=r.headers.get(obs.TRACE_HEADER),
                 )
-            if len(self._latencies_ns) < self._lat_cap:
-                self._latencies_ns.append(done_ns - r.arrival_ns)
-            else:
-                self._latencies_ns[self._lat_count % self._lat_cap] = (
-                    done_ns - r.arrival_ns
-                )
-            self._lat_count += 1
+            self._lat.record(done_ns - r.arrival_ns)
         self.batches += 1
 
     # -- stats ---------------------------------------------------------------
 
     def latency_quantiles_ms(self) -> dict:
-        if not self._latencies_ns:
-            return {}
-        arr = np.asarray(self._latencies_ns, dtype=np.float64) / 1e6
-        return {
-            "p50": float(np.percentile(arr, 50)),
-            "p90": float(np.percentile(arr, 90)),
-            "p99": float(np.percentile(arr, 99)),
-            "n": int(arr.size),
-        }
+        return self._lat.quantiles_ms()
 
 
 # --------------------------------------------------------------------------
 
 
-def _bucket(n: int) -> int:
+def _bucket(n: int, cap: Optional[int] = None) -> int:
+    """Next power of two >= ``n``, capped at the next power of two >=
+    ``cap``. The cap bounds the set of distinct padded shapes a handler
+    can produce — and with it the number of XLA compiles — to
+    ``log2(cap) + 1`` buckets regardless of what batch sizes arrive."""
     b = 1
     while b < n:
         b *= 2
+    if cap is not None:
+        c = 1
+        while c < cap:
+            c *= 2
+        b = min(b, c)
     return b
 
 
@@ -216,6 +243,9 @@ def serve_transformer(
         srv.start()
 
     is_transformer = hasattr(transformer, "transform")
+    from mmlspark_tpu.serving.server import _M_BATCH
+
+    m_bucket = _M_BATCH.labels(server=f"{srv.name}/buckets")
 
     def handler(reqs: list) -> dict:
         vals = [request_to_json(r) for r in reqs]
@@ -240,27 +270,41 @@ def serve_transformer(
                 continue
             groups.setdefault(arr.shape, []).append((r, arr))
         replies = dict(bad)
-        for items in groups.values():
-            n = len(items)
-            x = np.stack([a for _, a in items])
-            b = _bucket(n)
-            if b > n:  # fixed-shape batch: pad, run, slice
-                pad = np.repeat(x[:1], b - n, axis=0)
-                x = np.concatenate([x, pad], axis=0)
-            try:
-                if is_transformer:
-                    df = DataFrame([{input_col: x}])
-                    out = transformer.transform(df)[output_col][:n]
-                else:
-                    out = np.asarray(transformer(x))[:n]
-            except Exception as e:
-                msg = f"model rejected input: {type(e).__name__}: {e}".encode()
-                for r, _ in items:
-                    replies[r.id] = (400, msg, {})
-                continue
-            for (r, _), o in zip(items, out):
-                code, body, headers = make_reply(o)
-                replies[r.id] = (code, body, headers)
+        cap_b = _bucket(max_batch_size)
+        for group in groups.values():
+            # bucket capped at the next power of two >= max_batch_size:
+            # oversized groups (a caller handing the handler more than the
+            # query's pop limit) are split into cap-sized chunks, so the
+            # padded-shape set — and with it the compile count — is
+            # bounded at log2(cap)+1 buckets no matter what arrives.
+            # Chosen buckets land in the batch-size histogram under
+            # "<name>/buckets", next to the raw ingress batch sizes
+            for start in range(0, len(group), cap_b):
+                items = group[start:start + cap_b]
+                n = len(items)
+                x = np.stack([a for _, a in items])
+                b = _bucket(n, cap=max_batch_size)
+                if m_bucket._on:
+                    m_bucket.observe(b)
+                if b > n:  # fixed-shape batch: pad, run, slice
+                    pad = np.repeat(x[:1], b - n, axis=0)
+                    x = np.concatenate([x, pad], axis=0)
+                try:
+                    if is_transformer:
+                        df = DataFrame([{input_col: x}])
+                        out = transformer.transform(df)[output_col][:n]
+                    else:
+                        out = np.asarray(transformer(x))[:n]
+                except Exception as e:
+                    msg = (
+                        f"model rejected input: {type(e).__name__}: {e}"
+                    ).encode()
+                    for r, _ in items:
+                        replies[r.id] = (400, msg, {})
+                    continue
+                for (r, _), o in zip(items, out):
+                    code, body, headers = make_reply(o)
+                    replies[r.id] = (code, body, headers)
         return replies
 
     return ServingQuery(

@@ -207,13 +207,18 @@ class DriverRegistry:
     @staticmethod
     def register(registry_url: str, info: ServiceInfo) -> bool:
         """Worker-side: report a ServiceInfo to the driver registry."""
+        payload = {
+            "name": info.name, "host": info.host,
+            "port": info.port, "path": info.path,
+        }
+        if info.models is not None:
+            # advertised model names ride the roster entry so the gateway
+            # can route model-aware (serving/distributed.py)
+            payload["models"] = list(info.models)
         resp = send_request(
             HTTPRequestData(
                 registry_url, "POST", {"Content-Type": "application/json"},
-                json.dumps({
-                    "name": info.name, "host": info.host,
-                    "port": info.port, "path": info.path,
-                }),
+                json.dumps(payload),
             ),
             timeout=10.0,
         )

@@ -27,8 +27,10 @@ from typing import Any, Optional
 from mmlspark_tpu import obs
 from mmlspark_tpu.obs.registry import SIZE_BUCKETS
 
-_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found", 500: "Internal Server Error",
-             503: "Service Unavailable"}
+_REASONS = {200: "OK", 202: "Accepted", 400: "Bad Request", 404: "Not Found",
+             429: "Too Many Requests", 500: "Internal Server Error",
+             503: "Service Unavailable", 504: "Gateway Timeout",
+             507: "Insufficient Storage"}
 
 # ingress telemetry (docs/observability.md). Families are module-level;
 # each server pre-binds its label children in __init__ so the per-request
@@ -87,6 +89,9 @@ class ServiceInfo:
     # (HTTPSourceV2.scala :657-665 forwarding options)
     forwarded_host: Optional[str] = None
     forwarded_port: Optional[int] = None
+    # model names this worker serves (ModelStore-backed workers advertise
+    # them so the gateway can route model-aware); None = unadvertised
+    models: Optional[tuple] = None
 
 
 class WorkerServer:

@@ -602,6 +602,8 @@ def make_hashed_text(n=400, dim=1024, seed=0):
     return x, y
 
 
+@pytest.mark.slow  # ~45 s; sparse-path tier-1 coverage stays via
+# test_sparse_dart_training + the sparse binning/predict unit tests
 def test_sparse_csr_training_quality():
     x, y = make_hashed_text()
     cfg = TrainConfig(objective="binary", num_iterations=20, num_leaves=15,

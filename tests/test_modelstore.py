@@ -1,0 +1,764 @@
+"""ModelStore tests: versioned residency, budgeted eviction, per-model
+dispatch, the /models control plane over real HTTP, and the headline
+zero-downtime hot-swap property under chaos (gateway + worker + armed
+FaultPlan on the new ``modelstore.swap`` point)."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core.faults import FaultPlan
+from mmlspark_tpu.serving import ServiceInfo, WorkerServer
+from mmlspark_tpu.serving.modelstore import (
+    EVICTED,
+    HBMBudgetExceeded,
+    LOADING,
+    LoadedModel,
+    ModelDispatcher,
+    ModelStore,
+    ModelStoreError,
+    READY,
+    STATE_HEADER,
+)
+
+
+def _sum(name: str, match=None) -> float:
+    return obs.sum_samples(obs.parse_text(obs.render()), name, match)
+
+
+def _tagged_loaded(tag: str, nbytes: int = 0, sleep_s: float = 0.0,
+                   released=None) -> LoadedModel:
+    """A LoadedModel whose handler replies with its tag (who served me?)."""
+
+    def handler(reqs):
+        if sleep_s:
+            time.sleep(sleep_s)
+        out = {}
+        for r in reqs:
+            body = json.loads(r.body) if r.body else {}
+            out[r.id] = (
+                200,
+                json.dumps({"tag": tag, "echo": body}).encode(),
+                {"Content-Type": "application/json"},
+            )
+        return out
+
+    def release():
+        if released is not None:
+            released.append(tag)
+
+    return LoadedModel(handler=handler, nbytes=nbytes, release=release)
+
+
+def _post(port, path, obj, method="POST", headers=None):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        body = json.dumps(obj) if obj is not None else None
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        c.request(method, path, body=body, headers=h)
+        r = c.getresponse()
+        return r.status, r.read(), dict(r.getheaders())
+    finally:
+        c.close()
+
+
+# -- store lifecycle ----------------------------------------------------------
+
+
+def test_first_load_serves_later_loads_wait_for_swap():
+    store = ModelStore()
+    assert store.load("m", _tagged_loaded("v1")) == 1
+    assert store.serving_version("m") == 1
+    assert store.load("m", _tagged_loaded("v2")) == 2
+    assert store.serving_version("m") == 1  # activate=auto: no self-promotion
+    assert store.swap("m") == 2  # default: newest ready non-serving
+    assert store.serving_version("m") == 2
+    # idempotent swap-to-current is a no-op
+    assert store.swap("m", 2) == 2
+
+
+def test_swap_drains_inflight_then_evicts_old():
+    released: list = []
+    store = ModelStore()
+    store.load("m", _tagged_loaded("v1", nbytes=100, released=released))
+    store.load("m", _tagged_loaded("v2", nbytes=100, released=released))
+    mv1 = store.acquire("m")  # an in-flight batch on v1
+    assert mv1.version == 1
+    store.swap("m", 2)
+    # old version must stay resident until its batch releases it
+    listing = store.models()["m"]
+    v1 = [v for v in listing["versions"] if v["version"] == 1][0]
+    assert v1["state"] == READY and v1["inflight"] == 1
+    assert store.resident_bytes() == 200
+    store.release(mv1)
+    v1 = [v for v in store.models()["m"]["versions"] if v["version"] == 1][0]
+    assert v1["state"] == EVICTED
+    assert released == ["v1"]
+    assert store.resident_bytes() == 100
+    # new batches resolve v2
+    mv = store.acquire("m")
+    assert mv.version == 2
+    store.release(mv)
+
+
+def test_budget_lru_eviction_and_exhaustion():
+    store = ModelStore(budget_bytes=130)
+    store.load("a", _tagged_loaded("a1", nbytes=60))
+    # a second resident version (not serving) fits: 120 <= 130
+    store.load("a", _tagged_loaded("a2", nbytes=60))
+    assert store.resident_bytes() == 120
+    # the third evicts the LRU eligible version (a2: non-serving, drained)
+    store.load("a", _tagged_loaded("a3", nbytes=60))
+    states = {
+        v["version"]: v["state"] for v in store.models()["a"]["versions"]
+    }
+    assert states == {1: READY, 2: EVICTED, 3: READY}
+    assert store.resident_bytes() == 120
+    # serving + pinned versions are not evictable: nothing can make room
+    store.pin("a", 3)
+    with pytest.raises(HBMBudgetExceeded):
+        store.load("a", _tagged_loaded("a4", nbytes=60))
+    assert [
+        v["state"] for v in store.models()["a"]["versions"]
+        if v["version"] == 4
+    ] == ["failed"]
+    assert _sum("mmlspark_modelstore_resident_bytes") == 120
+
+
+def _gated_warmup_loader(entered, gate, nbytes=60):
+    """Loader whose warmup blocks on ``gate`` (signalling ``entered``) —
+    pins a version in WARMING so races against it are deterministic."""
+
+    def loader(spec):
+        lm = _tagged_loaded(str(spec), nbytes=nbytes)
+        if spec == "slow":
+            def warmup():
+                entered.set()
+                gate.wait(10.0)
+
+            lm.warmup = warmup
+        return lm
+
+    return loader
+
+
+def test_budget_never_evicts_a_warming_version():
+    """A WARMING version's load thread is still running warmup on its
+    weights: budget pressure must fail the competing load rather than
+    evict mid-warmup (which would resurrect as a ready-but-empty brick)."""
+    entered, gate = threading.Event(), threading.Event()
+    store = ModelStore(
+        budget_bytes=100, loader=_gated_warmup_loader(entered, gate)
+    )
+    try:
+        store.load("a", "slow", wait=False)  # 60 bytes, stuck in warmup
+        assert entered.wait(5.0)
+        with pytest.raises(HBMBudgetExceeded):
+            store.load("b", "other")  # +60 > 100 and nothing evictable
+    finally:
+        gate.set()
+    deadline = time.monotonic() + 5.0
+    while store.serving_state("a") != READY and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert store.serving_state("a") == READY  # warmup finished unharmed
+    mv = store.acquire("a")
+    assert mv is not None and mv.loaded is not None
+    store.release(mv)
+
+
+def test_unload_during_warmup_does_not_resurrect():
+    entered, gate = threading.Event(), threading.Event()
+    store = ModelStore(loader=_gated_warmup_loader(entered, gate))
+    store.load("m", "slow", wait=False)
+    assert entered.wait(5.0)
+    assert store.unload("m") == 1
+    gate.set()
+    time.sleep(0.2)  # give the load thread its chance to misbehave
+    assert store.serving_state("m") is None  # stays unloaded, no alias
+    assert store.resident_bytes() == 0
+    assert store.acquire("m") is None
+
+
+def test_unload_during_load_phase_leaks_nothing():
+    """unload() racing a background load still in its loader: the orphan
+    must not turn resident (leaking budget bytes nothing can evict) nor
+    resurrect the deleted model's serving alias."""
+    entered, gate = threading.Event(), threading.Event()
+
+    def blocking_loader(spec):
+        entered.set()
+        gate.wait(10.0)
+        return _tagged_loaded("late", nbytes=70)
+
+    store = ModelStore(budget_bytes=100, loader=blocking_loader)
+    store.load("m", "slow", wait=False)
+    assert entered.wait(5.0)
+    assert store.unload("m") == 1
+    gate.set()
+    deadline = time.monotonic() + 5.0
+    while store.resident_bytes() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert store.resident_bytes() == 0  # orphan bytes released
+    assert store.serving_state("m") is None  # no alias resurrection
+    # the whole budget is available again
+    store._loader = lambda spec: _tagged_loaded("fresh", nbytes=90)
+    store.load("m", "fresh")
+    assert store.serving_state("m") == READY
+
+
+def test_pinned_old_version_survives_swap_for_rollback():
+    store = ModelStore()
+    store.load("m", _tagged_loaded("v1", nbytes=10))
+    store.pin("m")  # pin the serving version
+    store.load("m", _tagged_loaded("v2", nbytes=10))
+    store.swap("m", 2)
+    v1 = [v for v in store.models()["m"]["versions"] if v["version"] == 1][0]
+    assert v1["state"] == READY and v1["pinned"]  # instant-rollback copy
+    assert store.swap("m", 1) == 1  # the rollback itself
+    v2 = [v for v in store.models()["m"]["versions"] if v["version"] == 2][0]
+    assert v2["state"] == EVICTED  # the unpinned loser drained out
+    # a pinned version displaced again is released by unpin alone
+    store.load("m", _tagged_loaded("v3", nbytes=10))
+    store.swap("m", 3)
+    v1 = [v for v in store.models()["m"]["versions"] if v["version"] == 1][0]
+    assert v1["state"] == READY  # still pinned: survives its retirement
+    store.pin("m", 1, pinned=False)
+    v1 = [v for v in store.models()["m"]["versions"] if v["version"] == 1][0]
+    assert v1["state"] == EVICTED
+
+
+def test_failed_load_is_visible_and_reloadable():
+    def bad_loader(spec):
+        raise RuntimeError("corrupt artifact")
+
+    store = ModelStore(loader=bad_loader)
+    with pytest.raises(RuntimeError):
+        store.load("m", "whatever")
+    v = store.models()["m"]["versions"][0]
+    assert v["state"] == "failed" and "corrupt artifact" in v["error"]
+    assert store.serving_version("m") is None
+    # the slot can be reloaded (failed versions are replaceable)
+    store2 = ModelStore()
+    store2.load("m", _tagged_loaded("ok"))
+    assert store2.serving_state("m") == READY
+
+
+def test_unload_model_and_version():
+    store = ModelStore()
+    store.load("m", _tagged_loaded("v1", nbytes=5))
+    store.load("m", _tagged_loaded("v2", nbytes=5))
+    assert store.unload("m", 2) == 1
+    assert [v["version"] for v in store.models()["m"]["versions"]] == [1]
+    assert store.unload("m") == 1
+    assert store.serving_state("m") is None
+    assert store.resident_bytes() == 0
+    with pytest.raises(KeyError):
+        store.unload("m")
+
+
+def test_dead_version_history_is_bounded():
+    """Months of hourly hot-swaps must not grow the listing without
+    bound: old evicted/failed tombstones are pruned at the next load."""
+    store = ModelStore()
+    store.load("m", _tagged_loaded("v1", nbytes=1))
+    for i in range(14):
+        v = store.load("m", _tagged_loaded(f"v{i + 2}", nbytes=1))
+        store.swap("m", v)
+    versions = store.models()["m"]["versions"]
+    dead = [v for v in versions if v["state"] == EVICTED]
+    # pruning runs at load time, so at most KEEP + the last swap's corpse
+    assert len(dead) <= ModelStore.KEEP_DEAD_VERSIONS + 1
+    assert store.serving_state("m") == READY  # the live version survives
+
+
+def test_swap_requires_ready_version():
+    store = ModelStore()
+    store.load("m", _tagged_loaded("v1"))
+    with pytest.raises(ModelStoreError):
+        store.swap("m")  # nothing to swap to
+    with pytest.raises(KeyError):
+        store.swap("nope")
+
+
+# -- dispatcher: routing, control plane, admission ----------------------------
+
+
+def _dispatcher(store, **kw):
+    srv = WorkerServer()
+    info = srv.start()
+    disp = ModelDispatcher(srv, store, **kw).start()
+    return srv, disp, info
+
+
+def test_dispatch_routes_by_path_header_and_default():
+    store = ModelStore()
+    store.load("a", _tagged_loaded("A"))
+    store.load("b", _tagged_loaded("B"))
+    srv, disp, info = _dispatcher(store, default_model="a")
+    try:
+        s, d, _ = _post(info.port, "/", {"x": 1})
+        assert s == 200 and json.loads(d)["tag"] == "A"
+        s, d, _ = _post(info.port, "/models/b", {"x": 2})
+        assert s == 200 and json.loads(d)["tag"] == "B"
+        s, d, _ = _post(
+            info.port, "/", {"x": 3}, headers={"x-mmlspark-model": "b"}
+        )
+        assert s == 200 and json.loads(d)["tag"] == "B"
+        s, d, _ = _post(info.port, "/models/nope", {"x": 4})
+        assert s == 404
+    finally:
+        disp.stop()
+        srv.stop()
+
+
+def test_control_plane_over_http():
+    store = ModelStore(loader=lambda spec: _tagged_loaded(spec))
+    store.load("m", "m-v1")
+    srv, disp, info = _dispatcher(store, default_model="m")
+    try:
+        s, d, _ = _post(info.port, "/models", None, "GET")
+        assert s == 200 and json.loads(d)["m"]["serving"] == 1
+        s, d, _ = _post(info.port, "/models/m/load", {"spec": "m-v2"})
+        assert s == 200 and json.loads(d)["version"] == 2
+        s, d, _ = _post(info.port, "/models/m/swap", {})
+        assert s == 200 and json.loads(d)["serving"] == 2
+        s, d, _ = _post(info.port, "/", {"q": 1})
+        assert json.loads(d)["tag"] == "m-v2"  # traffic moved to v2
+        s, d, _ = _post(info.port, "/models/m/pin", {"version": 2})
+        assert s == 200 and json.loads(d)["pinned"] is True
+        s, d, _ = _post(info.port, "/models/m/load", {"spec": None})
+        assert s == 400  # spec required
+        s, d, _ = _post(info.port, "/models/ghost/swap", {})
+        assert s == 404
+        s, d, _ = _post(info.port, "/models/m/unload", {})
+        assert s == 200 and json.loads(d)["unloaded"] == 2
+        s, d, _ = _post(info.port, "/", {"q": 2})
+        assert s == 404  # model gone
+    finally:
+        disp.stop()
+        srv.stop()
+
+
+def test_health_reports_loading_until_warm():
+    gate = threading.Event()
+
+    def slow_loader(spec):
+        gate.wait(10.0)
+        return _tagged_loaded(spec)
+
+    store = ModelStore(loader=slow_loader)
+    store.load("m", "m1", wait=False)
+    srv, disp, info = _dispatcher(store, default_model="m")
+    try:
+        s, d, _ = _post(info.port, "/health", None, "GET")
+        assert s == 503 and json.loads(d)["status"] == "loading"
+        # data-path requests during load: worker-local 503 with the
+        # state header a routing layer keys its retry on
+        s, d, h = _post(info.port, "/", {"x": 1})
+        assert s == 503
+        assert {k.lower(): v for k, v in h.items()}[STATE_HEADER] == LOADING
+        gate.set()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            s, d, _ = _post(info.port, "/health", None, "GET")
+            if s == 200:
+                break
+            time.sleep(0.02)
+        assert s == 200 and json.loads(d)["status"] == "ok"
+        assert _post(info.port, "/", {"x": 2})[0] == 200
+    finally:
+        disp.stop()
+        srv.stop()
+
+
+def test_admission_sheds_unmeetable_deadlines_429():
+    store = ModelStore()
+    store.load("m", _tagged_loaded("slow", sleep_s=0.15))
+    srv, disp, info = _dispatcher(store, default_model="m", max_batch_size=1)
+    try:
+        # prime the service-time EWMA (no estimate -> everything admits)
+        assert _post(info.port, "/", {"i": 0})[0] == 200
+        assert disp._queues["m"].svc_s > 0.05
+        # saturate the single-slot batcher, then ask for the impossible
+        results = {}
+
+        def client(i):
+            results[i] = _post(info.port, "/", {"i": i})
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # queue now holds work worth ~2+ service times
+        s, d, _ = _post(
+            info.port, "/", {"i": 99},
+            headers={"x-mmlspark-deadline-ms": "1"},
+        )
+        assert s == 429
+        body = json.loads(d)
+        assert body["deadline_ms"] == 1.0 and body["estimate_ms"] > 1.0
+        assert disp.shed == 1
+        # a generous deadline still admits
+        s, _, _ = _post(
+            info.port, "/", {"i": 100},
+            headers={"x-mmlspark-deadline-ms": "60000"},
+        )
+        assert s == 200
+        for t in threads:
+            t.join()
+        assert all(r[0] == 200 for r in results.values())
+        assert _sum("mmlspark_modelstore_shed_total", {"model": "m"}) >= 1
+    finally:
+        disp.stop()
+        srv.stop()
+
+
+def test_unload_reaps_the_model_queue():
+    """Multi-tenant churn must not leak a batcher thread + metric series
+    per model name ever served: unload reaps the queue, reload recreates
+    it lazily."""
+    store = ModelStore()
+    store.load("m", _tagged_loaded("x"))
+    srv, disp, info = _dispatcher(store, default_model="m")
+    try:
+        assert _post(info.port, "/", {"i": 1})[0] == 200
+        assert "m" in disp._queues
+        store.unload("m")
+        deadline = time.monotonic() + 3.0
+        while "m" in disp._queues and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert "m" not in disp._queues  # batcher exited, series removed
+        store.load("m", _tagged_loaded("y"))
+        s, d, _ = _post(info.port, "/", {"i": 2})
+        assert s == 200 and json.loads(d)["tag"] == "y"  # lazily recreated
+    finally:
+        disp.stop()
+        srv.stop()
+
+
+# -- gateway integration ------------------------------------------------------
+
+
+def _store_worker(models: dict, service="serving"):
+    """WorkerServer + ModelDispatcher serving ``models`` (name -> tag),
+    returning (srv, disp, ServiceInfo advertising the model names)."""
+    store = ModelStore()
+    for name, loaded in models.items():
+        store.load(name, loaded)
+    srv = WorkerServer()
+    info = srv.start()
+    disp = ModelDispatcher(
+        srv, store, default_model=next(iter(models))
+    ).start()
+    import dataclasses
+
+    info = dataclasses.replace(info, models=tuple(models))
+    return srv, disp, info
+
+
+def test_gateway_routes_model_aware():
+    from mmlspark_tpu.serving import ServingGateway
+
+    wa = _store_worker({"a": _tagged_loaded("on-A")})
+    wb = _store_worker({"b": _tagged_loaded("on-B")})
+    gw = ServingGateway(workers=[wa[2], wb[2]], request_timeout_s=5.0)
+    ginfo = gw.start()
+    try:
+        # every /models/<name> request lands on the advertising worker
+        for _ in range(6):
+            s, d, _ = _post(ginfo.port, "/models/a", {"x": 1})
+            assert s == 200 and json.loads(d)["tag"] == "on-A"
+            s, d, _ = _post(ginfo.port, "/models/b", {"x": 1})
+            assert s == 200 and json.loads(d)["tag"] == "on-B"
+        # header routing too
+        s, d, _ = _post(
+            ginfo.port, "/", {"x": 1}, headers={"x-mmlspark-model": "b"}
+        )
+        assert s == 200 and json.loads(d)["tag"] == "on-B"
+        assert gw.failed == 0
+    finally:
+        gw.stop()
+        for srv, disp, _ in (wa, wb):
+            disp.stop()
+            srv.stop()
+
+
+def test_gateway_retries_replica_still_loading():
+    """A replica that answers 503 + x-mmlspark-model-state (model still
+    warming THERE) is not a dead worker: the gateway re-dispatches to a
+    ready replica instead of failing the request or cooling the pool."""
+    from mmlspark_tpu.serving import ServingGateway
+
+    ready = _store_worker({"m": _tagged_loaded("ready-one")})
+    gate = threading.Event()
+
+    def slow_loader(spec):
+        gate.wait(10.0)
+        return _tagged_loaded("late-one")
+
+    store = ModelStore(loader=slow_loader)
+    store.load("m", "m1", wait=False)
+    srv2 = WorkerServer()
+    info2 = srv2.start()
+    disp2 = ModelDispatcher(srv2, store, default_model="m").start()
+    import dataclasses
+
+    info2 = dataclasses.replace(info2, models=("m",))
+    gw = ServingGateway(
+        workers=[ready[2], info2], request_timeout_s=5.0, max_attempts=4
+    )
+    ginfo = gw.start()
+    try:
+        for i in range(8):  # round-robin hits the loading replica too
+            s, d, _ = _post(ginfo.port, "/models/m", {"i": i})
+            assert s == 200, (s, d)
+            assert json.loads(d)["tag"] == "ready-one"
+        assert gw.retried > 0 and gw.failed == 0
+    finally:
+        gate.set()
+        gw.stop()
+        disp2.stop()
+        srv2.stop()
+        ready[1].stop()
+        ready[0].stop()
+
+
+def test_gateway_retries_unadvertised_model_past_404():
+    """A worker can serve a model its roster entry doesn't advertise yet
+    (runtime load, heartbeat lag). A replica answering 404 + state header
+    'unknown' is retried on the rest of the pool until the real server
+    answers — the client never sees a hard 404 for a model the fleet
+    serves."""
+    import dataclasses
+
+    from mmlspark_tpu.serving import ServingGateway
+
+    wa = _store_worker({"a": _tagged_loaded("on-A")})
+    storeb = ModelStore()
+    storeb.load("b", _tagged_loaded("on-B"))
+    storeb.load("c", _tagged_loaded("on-C"))  # served but NOT advertised
+    srvb = WorkerServer()
+    infob = srvb.start()
+    dispb = ModelDispatcher(srvb, storeb, default_model="b").start()
+    infob = dataclasses.replace(infob, models=("b",))
+    gw = ServingGateway(
+        workers=[wa[2], infob], request_timeout_s=5.0, max_attempts=4
+    )
+    ginfo = gw.start()
+    try:
+        for i in range(8):  # round-robin starts on either backend
+            s, d, _ = _post(ginfo.port, "/models/c", {"i": i})
+            assert s == 200, (s, d)
+            assert json.loads(d)["tag"] == "on-C"
+        assert gw.failed == 0
+    finally:
+        gw.stop()
+        dispb.stop()
+        srvb.stop()
+        wa[1].stop()
+        wa[0].stop()
+
+
+# -- the headline: zero-downtime hot-swap under chaos -------------------------
+
+
+@pytest.mark.chaos
+def test_hot_swap_zero_5xx_zero_drops_under_load():
+    """Sustained traffic through gateway + worker while the worker loads
+    v2 and swaps mid-stream — with an armed FaultPlan stretching the swap
+    (``modelstore.swap`` latency fault). Every request must get a 200 (no
+    5xx, no drops), replies must come from exactly the pre-swap version
+    before the flip and the post-swap version after, and the old version
+    must be evicted once drained."""
+    from mmlspark_tpu.serving import ServingGateway
+
+    store = ModelStore(loader=lambda spec: _tagged_loaded(spec, nbytes=10))
+    store.load("m", "v1")
+    srv = WorkerServer()
+    info = srv.start()
+    disp = ModelDispatcher(srv, store, default_model="m").start()
+    import dataclasses
+
+    info = dataclasses.replace(info, models=("m",))
+    gw = ServingGateway(workers=[info], request_timeout_s=10.0)
+    ginfo = gw.start()
+
+    results: dict = {}
+    errs: list = []
+    lock = threading.Lock()
+    stop_traffic = threading.Event()
+
+    def client(k):
+        try:
+            i = 0
+            while not stop_traffic.is_set():
+                x = k * 100000 + i
+                s, d, _ = _post(ginfo.port, "/models/m", {"x": x})
+                with lock:
+                    results[x] = (s, json.loads(d).get("tag"))
+                assert s == 200, (s, d)
+                i += 1
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(4)]
+    plan = FaultPlan().on("modelstore.swap", delay_s=0.3, at=(0,))
+    try:
+        with plan.armed():
+            for t in threads:
+                t.start()
+            time.sleep(0.2)  # traffic flowing on v1
+            assert store.load("m", "v2", wait=True) == 2
+            t_swap = time.monotonic()
+            store.swap("m", 2)  # stalls 0.3 s on the injected fault
+            swap_took = time.monotonic() - t_swap
+            time.sleep(0.2)  # traffic flowing on v2
+            stop_traffic.set()
+            for t in threads:
+                t.join(10.0)
+        assert not errs, errs[:3]
+        assert swap_took >= 0.3  # the fault really stretched the swap
+        assert plan.fires() == [("modelstore.swap", 0)]
+        statuses = {s for s, _ in results.values()}
+        assert statuses == {200}, statuses  # zero 5xx, zero drops
+        tags = {t for _, t in results.values()}
+        assert tags == {"v1", "v2"}  # both versions actually served
+        assert gw.failed == 0
+        # the drained old version was evicted and the byte gauge agrees
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            v1 = [
+                v for v in store.models()["m"]["versions"]
+                if v["version"] == 1
+            ][0]
+            if v1["state"] == EVICTED:
+                break
+            time.sleep(0.05)
+        assert v1["state"] == EVICTED
+        assert store.resident_bytes() == 10
+        assert _sum("mmlspark_modelstore_resident_bytes") == 10
+        assert _sum("mmlspark_modelstore_swaps_total", {"model": "m"}) >= 1
+    finally:
+        stop_traffic.set()
+        gw.stop()
+        disp.stop()
+        srv.stop()
+
+
+# -- satellites ---------------------------------------------------------------
+
+
+def test_bucket_is_capped_at_max_batch_pow2():
+    from mmlspark_tpu.serving.query import _bucket
+
+    assert _bucket(5) == 8
+    assert _bucket(1) == 1
+    assert _bucket(5, cap=64) == 8
+    assert _bucket(65, cap=64) == 64  # capped: bounded compile set
+    assert _bucket(100, cap=100) == 128
+    assert _bucket(3, cap=2) == 2
+
+
+def test_serve_transformer_records_bucket_sizes():
+    import numpy as np
+
+    from mmlspark_tpu.serving import serve_transformer
+
+    w = np.eye(3, dtype=np.float32)
+    q = serve_transformer(
+        lambda x: x @ w, "f", "s", max_batch_size=16, name="bkt"
+    )
+    try:
+        s, d, _ = _post(q.server.port, "/", [1.0, 2.0, 3.0])
+        assert s == 200
+        # chosen bucket (1 request -> bucket 1) landed in the batch-size
+        # histogram under the "<name>/buckets" series
+        n = _sum(
+            "mmlspark_serving_batch_size_requests_count",
+            {"server": "bkt/buckets"},
+        )
+        assert n >= 1
+    finally:
+        q.stop()
+        q.server.stop()
+
+
+def test_smoke_swap_drill_counts_balance_across_flip(capsys):
+    """The deploy smoke's --swap drill against a live in-process fleet:
+    traffic sustained through the gateway while the worker loads v2 and
+    swaps; exit 0 requires 100% successes AND the forwarded-counter delta
+    to match across the flip."""
+    from mmlspark_tpu.serving import fleet
+    from tools.deploy import smoke
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0)
+    srv, disp, stop = fleet.run_worker(
+        reg.url, model="echo", host="127.0.0.1", heartbeat_s=0.5
+    )
+    gw = fleet.run_gateway(reg.url, host="127.0.0.1", port=0)
+    try:
+        deadline = time.monotonic() + 5.0
+        while gw.pool.size() < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert gw.pool.size() == 1
+        rc = smoke.main(
+            [gw.url, "--n", "100", "--swap", "--registry", reg.url]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "swap drill — 1/1 backend(s) flipped" in out
+        assert disp.store.serving_version("echo") == 2  # the flip stuck
+    finally:
+        gw.stop()
+        stop.stop()
+        disp.stop()
+        srv.stop()
+        reg.stop()
+
+
+def test_fleet_worker_is_warm_and_advertised_before_registration():
+    """The cold-start fix: by the time the roster lists a worker, its
+    default model is loaded+warmed and /health answers 200 — the gateway
+    can never route to a not-yet-jitted worker."""
+    from mmlspark_tpu.serving import fleet
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0)
+    srv, disp, stop = fleet.run_worker(
+        reg.url, model="echo", host="127.0.0.1", heartbeat_s=0.5
+    )
+    try:
+        deadline = time.monotonic() + 5.0
+        entries = reg.services("serving")
+        while not entries and time.monotonic() < deadline:
+            time.sleep(0.02)
+            entries = reg.services("serving")
+        assert entries and entries[0]["models"] == ["echo"]
+        s, d, _ = _post(srv.port, "/health", None, "GET")
+        assert s == 200 and json.loads(d)["status"] == "ok"
+        assert disp.store.serving_state("echo") == READY
+        # warmup ran (the histogram saw the dummy batch)
+        assert _sum(
+            "mmlspark_modelstore_warmup_seconds_count", {"model": "echo"}
+        ) >= 1
+        # a model loaded at runtime is re-advertised within one heartbeat
+        disp.store.load("late", _tagged_loaded("late"))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            entries = reg.services("serving")
+            if entries and "late" in (entries[0].get("models") or ()):
+                break
+            time.sleep(0.05)
+        assert "late" in entries[0]["models"]
+    finally:
+        stop.stop()
+        disp.stop()
+        srv.stop()
+        reg.stop()

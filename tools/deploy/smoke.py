@@ -12,6 +12,14 @@ drops that the chaos suite's in-process assertions can't see. With
 too. Under ``--fault-plan`` the client retries, so the gate relaxes to
 ``forwarded >= successes``.
 
+Hot-swap drill (``--swap``): while the request phase runs, load a new
+version of ``--swap-model`` (spec ``--swap-spec``) on every backend and
+swap it in — with ``--registry`` each rostered worker's control plane is
+driven directly, otherwise the load/swap POSTs ride the target URL. The
+gate then requires the forwarded-counter delta to equal client successes
+ACROSS the flip (the two control ops per backend are accounted for), so
+a swap that drops even one request fails the smoke.
+
 Chaos smoke (``--fault-plan``): arm a deterministic fault plan
 (mmlspark_tpu/core/faults.py) in THIS client and route every request
 through the framework's retrying AdvancedHandler instead of a bare
@@ -108,11 +116,14 @@ def _fleet_counters(gateway_url: str, registry_url, service: str) -> dict:
     return out
 
 
-def _verify_metrics(before: dict, after: dict, ok: int,
-                    chaos: bool) -> bool:
+def _verify_metrics(before: dict, after: dict, ok: int, chaos: bool,
+                    extra_gw: int = 0, extra_workers: int = 0) -> bool:
     """Gate: forwarded-request delta must account for every client-observed
     success (equality without faults; >= under client-side fault
-    injection, where retries resend the same logical request)."""
+    injection, where retries resend the same logical request).
+    ``extra_gw`` / ``extra_workers``: control-plane requests the drill
+    itself sent through the gateway / to the workers (the --swap load+swap
+    POSTs), which the counters legitimately include."""
     good = True
     if after.get("gateway_forwarded") is None or (
         before.get("gateway_forwarded") is None
@@ -121,18 +132,123 @@ def _verify_metrics(before: dict, after: dict, ok: int,
               "skipping forwarded-counter gate")
     else:
         fwd = after["gateway_forwarded"] - before["gateway_forwarded"]
-        good = fwd >= ok if chaos else fwd == ok
-        print(f"smoke: gateway forwarded delta {fwd:.0f} vs {ok} client "
-              f"successes — {'ok' if good else 'MISMATCH'}")
+        want = ok + extra_gw
+        good = fwd >= want if chaos else fwd == want
+        print(f"smoke: gateway forwarded delta {fwd:.0f} vs {want} client "
+              f"successes{' + control ops' if extra_gw else ''} — "
+              f"{'ok' if good else 'MISMATCH'}")
     if after.get("workers_accepted") is not None and (
         before.get("workers_accepted") is not None
     ):
         wacc = after["workers_accepted"] - before["workers_accepted"]
-        w_good = wacc >= ok if chaos else wacc == ok
-        print(f"smoke: workers accepted delta {wacc:.0f} vs {ok} client "
-              f"successes — {'ok' if w_good else 'MISMATCH'}")
+        want = ok + extra_workers
+        w_good = wacc >= want if chaos else wacc == want
+        print(f"smoke: workers accepted delta {wacc:.0f} vs {want} client "
+              f"successes{' + control ops' if extra_workers else ''} — "
+              f"{'ok' if w_good else 'MISMATCH'}")
         good = good and w_good
     return good
+
+
+def _swap_drill(url: str, n: int, registry_url, service: str,
+                model: str, spec: str) -> tuple:
+    """Hot-swap drill: sustain ``n`` requests while every backend loads a
+    new version of ``model`` and swaps it in mid-traffic. With
+    ``--registry`` the control plane is driven on each rostered worker
+    directly; otherwise the load/swap POSTs ride the target URL (single-
+    backend fleets, or a worker smoked directly — through a gateway the
+    two control ops also count as forwarded requests, which the metrics
+    gate accounts for).
+
+    Returns (ok, latencies_ms, swap_ok, extra_gw, extra_workers)."""
+    import threading
+
+    _ensure_repo_path()
+    from mmlspark_tpu.io.clients import send_request
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+    result: dict = {}
+
+    def traffic() -> None:
+        try:
+            result["ok"], result["lat"] = _smoke_raw(
+                urllib.parse.urlparse(url), n
+            )
+        except Exception as e:  # noqa: BLE001 — report, don't KeyError later
+            result["error"] = f"{type(e).__name__}: {e}"
+            result.setdefault("ok", 0)
+            result.setdefault("lat", [float("nan")])
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    # the flip must land mid-traffic, not after a short run already ended
+    time.sleep(0.3 if n >= 200 else 0.05)
+    targets = None
+    if registry_url:
+        from mmlspark_tpu.serving.fleet import worker_urls_from_registry
+
+        try:
+            targets = worker_urls_from_registry(registry_url, service)
+        except Exception as e:  # noqa: BLE001 — degrade to the target URL
+            print(f"smoke: registry unavailable ({e}); swapping via {url}")
+    via_gateway = not targets
+    if via_gateway:
+        targets = [url]
+    swapped = 0
+    for base in targets:
+        base = base.rstrip("/")
+        if via_gateway:
+            # one control op: load-and-activate atomically on whichever
+            # backend the gateway picks. Two separate load+swap POSTs
+            # would round-robin onto DIFFERENT replicas in a multi-worker
+            # fleet (no stickiness) and the swap would find nothing to
+            # flip — use --registry to drill every replica's explicit
+            # swap verb instead
+            loaded = send_request(HTTPRequestData(
+                f"{base}/models/{model}/load", "POST",
+                {"Content-Type": "application/json"},
+                json.dumps({"spec": spec, "activate": "always"}),
+            ), timeout=300.0)
+            ok_flip = loaded["status_code"] in (200, 202)
+            if not ok_flip:
+                print(f"smoke: swap via {base} failed: load "
+                      f"{loaded['status_code']} {loaded['entity'][:200]}")
+            print("smoke: no registry — load+activate drilled ONE backend "
+                  "through the gateway (pass --registry to flip them all)")
+        else:
+            loaded = send_request(HTTPRequestData(
+                f"{base}/models/{model}/load", "POST",
+                {"Content-Type": "application/json"},
+                json.dumps({"spec": spec}),
+            ), timeout=300.0)
+            flipped = send_request(HTTPRequestData(
+                f"{base}/models/{model}/swap", "POST",
+                {"Content-Type": "application/json"}, "{}",
+            ), timeout=300.0)
+            ok_flip = (
+                loaded["status_code"] in (200, 202)
+                and flipped["status_code"] == 200
+            )
+            if not ok_flip:
+                print(f"smoke: swap on {base} failed: load "
+                      f"{loaded['status_code']} swap "
+                      f"{flipped['status_code']} {flipped['entity'][:200]}")
+        if ok_flip:
+            swapped += 1
+    t.join()
+    if "error" in result:
+        print(f"smoke: traffic phase died mid-drill: {result['error']}")
+    print(f"smoke: swap drill — {swapped}/{len(targets)} backend(s) "
+          "flipped mid-traffic")
+    # control ops also land in the counters: via the gateway the single
+    # load POST was forwarded (and accepted by one worker); driven
+    # directly, the 2 POSTs per worker touched only the accepted counters
+    extra_gw = 1 * len(targets) if via_gateway else 0
+    extra_workers = (1 if via_gateway else 2) * len(targets)
+    return (
+        result["ok"], result["lat"], swapped == len(targets),
+        extra_gw, extra_workers,
+    )
 
 
 def _smoke_chaos(url: str, n: int, fault_plan: str) -> tuple:
@@ -182,6 +298,16 @@ def main(argv=None) -> int:
         "--no-verify-metrics", action="store_true",
         help="skip the /metrics accepted-vs-observed drop gate",
     )
+    ap.add_argument(
+        "--swap", action="store_true",
+        help="hot-swap drill: load a new model version on every backend "
+        "and swap it in while the request phase runs; the gate then "
+        "requires zero drops ACROSS the flip",
+    )
+    ap.add_argument("--swap-model", default="echo",
+                    help="model name to swap (default: echo)")
+    ap.add_argument("--swap-spec", default="echo",
+                    help="spec to load as the new version (default: echo)")
     args = ap.parse_args(argv)
     n = args.n_requests if args.n_requests is not None else args.n
     verify = not args.no_verify_metrics
@@ -189,7 +315,22 @@ def main(argv=None) -> int:
         _fleet_counters(args.url, args.registry, args.service_name)
         if verify else None
     )
-    if args.fault_plan:
+    extra_gw = extra_workers = 0
+    swap_ok = True
+    if args.swap and args.fault_plan:
+        # the drill's whole point is the strict forwarded==successes
+        # equality across the flip; a fault plan relaxes that gate to >=
+        # and the drill's raw client wouldn't retry through it anyway
+        print("smoke: --swap and --fault-plan are mutually exclusive "
+              "(run the chaos smoke and the swap drill separately)",
+              file=sys.stderr)
+        return 2
+    if args.swap:
+        ok, lat, swap_ok, extra_gw, extra_workers = _swap_drill(
+            args.url, n, args.registry, args.service_name,
+            args.swap_model, args.swap_spec,
+        )
+    elif args.fault_plan:
         ok, lat = _smoke_chaos(args.url, n, args.fault_plan)
     else:
         ok, lat = _smoke_raw(urllib.parse.urlparse(args.url), n)
@@ -200,9 +341,10 @@ def main(argv=None) -> int:
     if verify:
         after = _fleet_counters(args.url, args.registry, args.service_name)
         metrics_ok = _verify_metrics(
-            before, after, ok, chaos=bool(args.fault_plan)
+            before, after, ok, chaos=bool(args.fault_plan),
+            extra_gw=extra_gw, extra_workers=extra_workers,
         )
-    return 0 if (ok == n and metrics_ok) else 1
+    return 0 if (ok == n and metrics_ok and swap_ok) else 1
 
 
 if __name__ == "__main__":
