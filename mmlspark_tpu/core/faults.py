@@ -215,6 +215,12 @@ class FaultPlan:
         if fire is None:
             return None
         _M_INJECTED.labels(point=point).inc()
+        # every fire also lands in the flight recorder, so a post-incident
+        # dump shows the injected faults interleaved with the requests
+        # they broke — and the chaos smoke can gate recorded == injected
+        from mmlspark_tpu.obs import flightrec
+
+        flightrec.record("fault", path=point, detail=f"step={s}")
         return fire.raise_or_payload()
 
     # -- arming ---------------------------------------------------------------

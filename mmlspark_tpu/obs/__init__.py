@@ -39,14 +39,22 @@ from mmlspark_tpu.obs.registry import (
     sum_samples,
 )
 from mmlspark_tpu.obs.tracing import (
+    BUFFER,
+    PARENT_HEADER,
     Span,
+    SpanBuffer,
     TRACE_HEADER,
     clear_recent_spans,
     current_trace_id,
+    new_span_id,
     new_trace_id,
+    process_label,
     recent_spans,
     record_span,
+    render_traces,
+    set_process_label,
     span,
+    traces_payload,
 )
 
 
@@ -64,18 +72,24 @@ def enabled() -> bool:
 def reset() -> None:
     """Zero every metric in the default registry IN PLACE (children stay
     bound — call sites pre-resolve label children for hot-path speed) and
-    drop recorded spans. Test isolation helper."""
+    drop recorded spans + flight records. Test isolation helper."""
+    from mmlspark_tpu.obs import flightrec
+
     REGISTRY.reset()
     clear_recent_spans()
+    flightrec.FLIGHT.clear()
 
 
 __all__ = [
+    "BUFFER",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "PARENT_HEADER",
     "REGISTRY",
     "Span",
+    "SpanBuffer",
     "TRACE_HEADER",
     "clear_recent_spans",
     "counter",
@@ -83,13 +97,18 @@ __all__ = [
     "enabled",
     "gauge",
     "histogram",
+    "new_span_id",
     "new_trace_id",
     "parse_text",
+    "process_label",
     "recent_spans",
     "record_span",
     "render",
+    "render_traces",
     "reset",
     "set_enabled",
+    "set_process_label",
     "span",
     "sum_samples",
+    "traces_payload",
 ]

@@ -224,7 +224,8 @@ class Gauge(_Family, _GaugeChild):
 
 
 class _HistogramChild:
-    __slots__ = ("_on", "_lock", "_bounds", "counts", "sum", "count")
+    __slots__ = ("_on", "_lock", "_bounds", "counts", "sum", "count",
+                 "exemplars")
 
     def __init__(self, registry: "MetricsRegistry", lock: threading.Lock,
                  bounds: Sequence[float]):
@@ -234,8 +235,13 @@ class _HistogramChild:
         self.counts = [0] * (len(bounds) + 1)  # last slot = +Inf
         self.sum = 0.0
         self.count = 0
+        # per-bucket exemplar: (trace_id, value) of the LAST observation
+        # that carried a trace id — the bucket -> real-trace jump table
+        # (`fleet traces --slowest`). Lazily allocated: histograms whose
+        # call sites never pass a trace id pay nothing.
+        self.exemplars: Optional[list] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         if not self._on:
             return
         i = bisect.bisect_left(self._bounds, v)
@@ -243,11 +249,16 @@ class _HistogramChild:
             self.counts[i] += 1
             self.sum += v
             self.count += 1
+            if trace_id is not None:
+                if self.exemplars is None:
+                    self.exemplars = [None] * (len(self._bounds) + 1)
+                self.exemplars[i] = (trace_id, v)
 
     def _zero(self) -> None:
         self.counts = [0] * (len(self._bounds) + 1)
         self.sum = 0.0
         self.count = 0
+        self.exemplars = None
 
 
 class Histogram(_Family, _HistogramChild):
@@ -265,6 +276,32 @@ class Histogram(_Family, _HistogramChild):
 
     def _make_child(self) -> _HistogramChild:
         return _HistogramChild(self._reg, self._lock, self.buckets)
+
+    def exemplar_samples(self) -> list:
+        """[{labels, le, trace_id, value}] for every bucket exemplar this
+        family holds (materialized under the family lock)."""
+        out = []
+        with self._lock:
+            items = (
+                sorted(self._children.items()) if self.label_names
+                else [((), self)]
+            )
+            for values, child in items:
+                ex = child.exemplars
+                if not ex:
+                    continue
+                ld = dict(zip(self.label_names, values))
+                bounds = list(self.buckets) + [math.inf]
+                for b, slot in zip(bounds, ex):
+                    if slot is None:
+                        continue
+                    out.append({
+                        "labels": ld,
+                        "le": "+Inf" if b == math.inf else _fmt(b),
+                        "trace_id": slot[0],
+                        "value": slot[1],
+                    })
+        return out
 
 
 class MetricsRegistry:
@@ -338,6 +375,19 @@ class MetricsRegistry:
     def families(self) -> list:
         with self._lock:
             return sorted(self._families.values(), key=lambda f: f.name)
+
+    def exemplars(self) -> dict:
+        """{histogram name: [{labels, le, trace_id, value}]} across the
+        registry — only histograms that recorded at least one trace-id
+        exemplar appear."""
+        out: dict = {}
+        for fam in self.families():
+            if fam.kind != "histogram":
+                continue
+            samples = fam.exemplar_samples()
+            if samples:
+                out[fam.name] = samples
+        return out
 
     def reset(self) -> None:
         for fam in self.families():

@@ -1,0 +1,423 @@
+"""SLO engine: declarative targets evaluated into multi-window burn rates.
+
+The SRE alerting shape for the serving fleet: an operator declares what
+"good" means (availability %, p99 latency budget) per model/route; the
+engine samples cumulative counters/histograms (the same families
+``/metrics`` exposes), maintains a short history, and computes **burn
+rates** over 5m/1h windows — the ratio of the observed bad fraction to
+the error budget (``1 - availability``). Burn 1.0 = exactly spending the
+budget; 14.4 on the 5m window = the classic page-now threshold (budget
+gone in ~50 minutes).
+
+The SLI is unified: a request is *bad* when it errored OR (with a
+``p99_ms`` budget set) finished over the latency budget — the
+over-budget count comes straight from the cumulative histogram buckets,
+so no extra instrumentation rides the request path.
+
+Exported per target (``/metrics`` on whatever process runs the engine):
+
+- ``mmlspark_slo_burn_rate_ratio{slo, window}``
+- ``mmlspark_slo_error_budget_remaining_ratio{slo}`` (lifetime)
+- ``mmlspark_slo_bad_fraction_ratio{slo}`` (lifetime bad/total)
+- ``mmlspark_slo_p99_latency_seconds{slo}`` (bucket estimate, lifetime)
+- ``mmlspark_slo_status_count{slo}`` — 0 green / 1 yellow / 2 red
+- ``mmlspark_slo_evaluations_total``
+
+Fleet wiring: workers and the gateway run an engine thread over their
+own registry (``fleet worker/gateway --slo-targets ...``; sensible
+defaults otherwise), ``fleet top`` renders the scraped status gauges as
+a red/yellow/green column, and the deploy smoke fails on a red target.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from mmlspark_tpu.obs.registry import (
+    REGISTRY,
+    counter,
+    gauge,
+    parse_text,
+    sum_samples,
+)
+
+# evaluation windows: (label, seconds). Multi-window per SRE practice —
+# the short window catches fast burns, the long one filters blips.
+WINDOWS = (("5m", 300.0), ("1h", 3600.0))
+
+# burn-rate thresholds for the status column: red pages, yellow warns
+RED_BURN = 14.4
+YELLOW_BURN = 1.0
+
+GREEN, YELLOW, RED = 0, 1, 2
+STATUS_NAMES = {GREEN: "green", YELLOW: "yellow", RED: "red"}
+
+_M_BURN = gauge(
+    "mmlspark_slo_burn_rate_ratio",
+    "Error-budget burn rate per target and window (1.0 = spending the "
+    "budget exactly; >= 14.4 on 5m is page-now)",
+    labels=("slo", "window"),
+)
+_M_BUDGET = gauge(
+    "mmlspark_slo_error_budget_remaining_ratio",
+    "Fraction of the lifetime error budget still unspent, per target",
+    labels=("slo",),
+)
+_M_BAD = gauge(
+    "mmlspark_slo_bad_fraction_ratio",
+    "Lifetime bad-request fraction (errors + over-latency-budget), per "
+    "target", labels=("slo",),
+)
+_M_P99 = gauge(
+    "mmlspark_slo_p99_latency_seconds",
+    "Bucket-estimated p99 of the target's latency histogram",
+    labels=("slo",),
+)
+_M_STATUS = gauge(
+    "mmlspark_slo_status_count",
+    "Target status: 0 green, 1 yellow, 2 red", labels=("slo",),
+)
+_M_EVALS = counter(
+    "mmlspark_slo_evaluations_total", "SLO engine evaluation ticks",
+)
+
+
+@dataclass
+class SLOTarget:
+    """One declarative objective over a metric family selection.
+
+    ``match`` narrows by labels (e.g. ``{"server": "serving"}`` or
+    ``{"model": "resnet"}``) — the per-model/route knob. When the three
+    families carry DIFFERENT label sets (the gateway: its request count
+    rides the labeled serving family but its failure counter and latency
+    histogram are process-global), the per-metric overrides
+    ``total_match`` / ``error_match`` / ``latency_match`` replace
+    ``match`` for that family alone — a match selecting zero series
+    would silently evaluate to a permanently-green target."""
+
+    name: str
+    availability: float = 0.999
+    p99_ms: Optional[float] = None
+    total_metric: str = "mmlspark_serving_requests_total"
+    error_metric: str = "mmlspark_serving_handler_errors_total"
+    latency_metric: str = "mmlspark_serving_request_latency_seconds"
+    match: Dict[str, str] = field(default_factory=dict)
+    total_match: Optional[Dict[str, str]] = None
+    error_match: Optional[Dict[str, str]] = None
+    latency_match: Optional[Dict[str, str]] = None
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.availability < 1.0:
+            raise ValueError(
+                f"slo {self.name!r}: availability must be in (0, 1), "
+                f"got {self.availability}"
+            )
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.availability
+
+    def _match_for(self, which: str) -> Dict[str, str]:
+        override = getattr(self, f"{which}_match")
+        return self.match if override is None else override
+
+    @staticmethod
+    def from_spec(spec: Any) -> "SLOTarget":
+        """Dict / JSON string -> target. Unknown keys raise (a typo'd
+        field silently ignored is an alert that never fires)."""
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        if not isinstance(spec, dict):
+            raise ValueError("SLO target spec must be a JSON object")
+        known = {
+            "name", "availability", "p99_ms", "total_metric",
+            "error_metric", "latency_metric", "match",
+            "total_match", "error_match", "latency_match",
+        }
+        unknown = set(spec) - known
+        if unknown:
+            raise ValueError(
+                f"unknown SLO target field(s) {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        if "name" not in spec:
+            raise ValueError('SLO target needs a "name"')
+        return SLOTarget(**spec)
+
+
+def load_targets(spec: Any) -> list:
+    """``--slo-targets`` grammar: a JSON list of target objects, inline
+    or a path to a file holding one."""
+    if isinstance(spec, str):
+        s = spec.strip()
+        if not s.startswith("["):
+            with open(s, encoding="utf-8") as f:
+                s = f.read()
+        spec = json.loads(s)
+    if not isinstance(spec, list):
+        raise ValueError("SLO targets spec must be a JSON list")
+    return [SLOTarget.from_spec(t) for t in spec]
+
+
+def default_targets(
+    service_name: str = "serving",
+    availability: float = 0.999,
+    p99_ms: Optional[float] = 250.0,
+    gateway: bool = False,
+) -> list:
+    """The out-of-the-box objectives a fleet role evaluates when no
+    ``--slo-targets`` was given: one availability+latency target over the
+    role's own serving family."""
+    if gateway:
+        return [SLOTarget(
+            name=f"{service_name}-gateway",
+            availability=availability,
+            p99_ms=p99_ms,
+            total_metric="mmlspark_serving_requests_total",
+            error_metric="mmlspark_gateway_failures_total",
+            latency_metric="mmlspark_gateway_request_latency_seconds",
+            # the gateway's ingress count rides the labeled serving
+            # family, but its failure counter (labels: reason) and
+            # latency histogram (unlabeled) are process-global — a
+            # server-label match there would select ZERO series and the
+            # target could never leave green
+            match={"server": f"{service_name}-gateway"},
+            error_match={},
+            latency_match={},
+        )]
+    return [SLOTarget(
+        name=service_name,
+        availability=availability,
+        p99_ms=p99_ms,
+        match={"server": service_name},
+    )]
+
+
+def _buckets_of(parsed: dict, name: str, match: dict) -> dict:
+    """{le_bound: cumulative_count} summed across matching series."""
+    want = set(match.items())
+    out: dict = {}
+    for (n, labels), v in parsed.items():
+        if n != f"{name}_bucket":
+            continue
+        ld = dict(labels)
+        le = ld.pop("le", None)
+        if le is None or not want <= set(ld.items()):
+            continue
+        bound = math.inf if le == "+Inf" else float(le)
+        out[bound] = out.get(bound, 0.0) + v
+    return out
+
+
+def _quantile_from_buckets(buckets: dict, q: float) -> float:
+    """Smallest bucket bound whose cumulative count reaches the q-th
+    observation (the standard scrape-side estimate; inf collapses to the
+    largest finite bound)."""
+    if not buckets:
+        return 0.0
+    total = buckets.get(math.inf, max(buckets.values()))
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    finite = sorted(b for b in buckets if b != math.inf)
+    for b in finite:
+        if buckets[b] >= rank:
+            return b
+    return finite[-1] if finite else 0.0
+
+
+def _over_budget(buckets: dict, budget_s: float) -> float:
+    """Observations strictly over the latency budget: total minus the
+    cumulative count at the smallest bound >= budget (conservative when
+    the budget falls between bounds)."""
+    if not buckets:
+        return 0.0
+    total = buckets.get(math.inf, max(buckets.values()))
+    at_or_under = 0.0
+    best = None
+    for b in sorted(b for b in buckets if b != math.inf):
+        if b >= budget_s:
+            best = b
+            break
+    if best is not None:
+        at_or_under = buckets[best]
+    else:
+        at_or_under = total  # budget beyond the largest bound: all pass
+    return max(0.0, total - at_or_under)
+
+
+@dataclass
+class _Sample:
+    t: float
+    total: float
+    bad: float
+
+
+class SLOEngine:
+    """Ticks over a metrics source, maintains per-target sample history,
+    exports burn-rate gauges.
+
+    ``source``: a callable returning parsed exposition samples (the
+    :func:`parse_text` dict shape). Default: render+parse the process
+    registry — the in-process fleet-role deployment. ``fleet top`` feeds
+    scraped text instead via :meth:`tick(parsed=...)`."""
+
+    def __init__(
+        self,
+        targets: list,
+        interval_s: float = 15.0,
+        source: Optional[Callable[[], dict]] = None,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        self.targets = list(targets)
+        self.interval_s = float(interval_s)
+        self._source = source or (lambda: parse_text(REGISTRY.render()))
+        self._now = time_fn
+        # history long enough to anchor the largest window at the tick
+        # interval (plus slack for jittered ticks)
+        depth = max(64, int(WINDOWS[-1][1] / max(self.interval_s, 1.0)) + 8)
+        self._hist: dict = {t.name: deque(maxlen=depth) for t in self.targets}
+        self._report: dict = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "SLOEngine":
+        self.tick()  # gauges exist from the first scrape onward
+        self._thread = threading.Thread(
+            target=self._loop, name="slo-engine", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the engine must outlive a tick
+                pass
+
+    # -- evaluation ------------------------------------------------------------
+
+    def tick(self, parsed: Optional[dict] = None,
+             now: Optional[float] = None) -> dict:
+        """One evaluation pass. Returns the per-target report dict (also
+        readable later via :meth:`report`)."""
+        parsed = self._source() if parsed is None else parsed
+        now = self._now() if now is None else now
+        out: dict = {}
+        for t in self.targets:
+            total = sum_samples(parsed, t.total_metric, t._match_for("total"))
+            bad = sum_samples(parsed, t.error_metric, t._match_for("error"))
+            buckets = _buckets_of(
+                parsed, t.latency_metric, t._match_for("latency")
+            )
+            if t.p99_ms is not None:
+                bad += _over_budget(buckets, t.p99_ms / 1e3)
+            bad = min(bad, total) if total > 0 else bad
+            hist = self._hist[t.name]
+            hist.append(_Sample(now, total, bad))
+            burns = {
+                w: self._burn(hist, seconds, t.budget, now)
+                for w, seconds in WINDOWS
+            }
+            bad_frac = (bad / total) if total > 0 else 0.0
+            budget_left = (
+                max(0.0, 1.0 - bad_frac / t.budget) if t.budget > 0 else 0.0
+            )
+            p99 = _quantile_from_buckets(buckets, 0.99)
+            status = self._status(burns)
+            out[t.name] = {
+                "burn": burns,
+                "bad_fraction": bad_frac,
+                "budget_remaining": budget_left,
+                "p99_s": p99,
+                "status": STATUS_NAMES[status],
+                "total": total,
+                "bad": bad,
+            }
+            if REGISTRY._enabled:
+                for w, b in burns.items():
+                    if b is not None:
+                        _M_BURN.labels(slo=t.name, window=w).set(b)
+                _M_BUDGET.labels(slo=t.name).set(budget_left)
+                _M_BAD.labels(slo=t.name).set(bad_frac)
+                _M_P99.labels(slo=t.name).set(p99)
+                _M_STATUS.labels(slo=t.name).set(status)
+        _M_EVALS.inc()
+        with self._lock:
+            self._report = out
+        return out
+
+    @staticmethod
+    def _burn(hist: deque, window_s: float, budget: float,
+              now: float) -> Optional[float]:
+        """Bad-fraction over the window divided by the error budget.
+        Anchored at the oldest sample inside the window (or the oldest
+        held, for young engines); None until two samples exist or while
+        the window saw no traffic."""
+        if len(hist) < 2 or budget <= 0:
+            return None
+        floor = now - window_s
+        anchor = hist[0]
+        for s in hist:
+            if s.t >= floor:
+                anchor = s
+                break
+        cur = hist[-1]
+        d_total = cur.total - anchor.total
+        if d_total <= 0:
+            return None
+        d_bad = max(0.0, cur.bad - anchor.bad)
+        return (d_bad / d_total) / budget
+
+    @staticmethod
+    def _status(burns: dict) -> int:
+        vals = [b for b in burns.values() if b is not None]
+        if not vals:
+            return GREEN
+        if burns.get(WINDOWS[0][0]) is not None and (
+            burns[WINDOWS[0][0]] >= RED_BURN
+        ):
+            return RED
+        if max(vals) >= YELLOW_BURN:
+            return YELLOW
+        return GREEN
+
+    def report(self) -> dict:
+        with self._lock:
+            return dict(self._report)
+
+    def status(self, name: str) -> Optional[str]:
+        return self.report().get(name, {}).get("status")
+
+
+def status_from_scrape(parsed: dict) -> Optional[int]:
+    """Worst ``mmlspark_slo_status_count`` in a scrape (the fleet-top
+    column source); None when the endpoint exports no SLO gauges (a
+    pre-SLO worker — the column degrades to '-')."""
+    worst = None
+    for (n, _labels), v in parsed.items():
+        if n == "mmlspark_slo_status_count":
+            worst = v if worst is None else max(worst, v)
+    return int(worst) if worst is not None else None
+
+
+__all__ = [
+    "GREEN", "RED", "RED_BURN", "SLOEngine", "SLOTarget", "STATUS_NAMES",
+    "WINDOWS", "YELLOW", "YELLOW_BURN", "default_targets", "load_targets",
+    "status_from_scrape",
+]

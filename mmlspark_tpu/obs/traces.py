@@ -1,0 +1,201 @@
+"""Trace collector: scrape per-process span buffers, join by trace id,
+render a cross-process tree.
+
+Dapper-shape assembly with zero pipeline infrastructure: every
+instrumented server keeps its own bounded :class:`~.tracing.SpanBuffer`
+and serves it on ``GET /traces`` (``GET /traces/<id>`` for one trace);
+the collector fans a scrape across the fleet (gateway + registry roster
++ explicit workers), deduplicates spans by span id (co-located roles
+share one process buffer), and stitches parent/child edges — real edges:
+the gateway stamps its forward span's id into
+:data:`~.tracing.PARENT_HEADER`, so worker spans name their upstream
+parent instead of being glued on heuristics.
+
+``fleet trace <id>`` renders one request's tree with per-hop timings;
+``fleet traces --slowest N`` starts from the latency histograms'
+**exemplars** (each bucket remembers the trace id of its last
+observation) and jumps straight from the p99 bucket to real traces.
+
+A worker that predates the ``/traces`` endpoint answers 404; the
+collector skips it (the rest of the fleet still assembles) — rolling
+upgrades must not break the debugging tool they most need.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional, Tuple
+
+from mmlspark_tpu.obs.tracing import Span
+
+# exemplar sources consulted for --slowest, most-informative first: the
+# gateway's end-to-end latency sees every hop, the worker's only its own
+SLOWEST_METRICS = (
+    "mmlspark_gateway_request_latency_seconds",
+    "mmlspark_serving_request_latency_seconds",
+    "mmlspark_modelstore_dispatch_latency_seconds",
+)
+
+
+def fetch_traces(
+    url: str, trace_id: Optional[str] = None, timeout: float = 5.0
+) -> Optional[dict]:
+    """GET one endpoint's ``/traces[/<id>]`` -> parsed payload, or None
+    when unreachable or the endpoint doesn't serve traces (404 from a
+    pre-trace worker: skip, don't crash)."""
+    from mmlspark_tpu.io.clients import send_request
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+    base = url.rstrip("/")
+    if not base.endswith("/traces"):
+        base = base + "/traces"
+    if trace_id:
+        base = f"{base}/{trace_id}"
+    try:
+        resp = send_request(HTTPRequestData(base, "GET"), timeout=timeout)
+    except Exception:  # noqa: BLE001 — a dead worker is a skip, not a crash
+        return None
+    if resp["status_code"] != 200:
+        return None
+    body = resp["entity"]
+    if isinstance(body, bytes):
+        body = body.decode("utf-8", "replace")
+    try:
+        payload = json.loads(body)
+    except ValueError:
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def collect(
+    endpoints: Iterable[str],
+    trace_id: Optional[str] = None,
+    timeout: float = 5.0,
+) -> Tuple[List[Span], dict, List[str]]:
+    """Scrape every endpoint's buffer and join.
+
+    Returns ``(spans, exemplars, scraped)``: spans deduplicated by span
+    id (an in-process gateway+worker pair shares one buffer and would
+    otherwise double every span), exemplars merged per histogram name,
+    and the endpoints that actually answered."""
+    spans: dict = {}
+    exemplars: dict = {}
+    scraped: List[str] = []
+    for url in endpoints:
+        payload = fetch_traces(url, trace_id=trace_id, timeout=timeout)
+        if payload is None:
+            continue
+        scraped.append(url)
+        for d in payload.get("spans", ()):
+            if not isinstance(d, dict) or not d.get("span_id"):
+                continue
+            spans.setdefault(d["span_id"], Span.from_dict(d))
+        for name, samples in (payload.get("exemplars") or {}).items():
+            exemplars.setdefault(name, []).extend(samples)
+    out = sorted(spans.values(), key=lambda s: (s.wall_ns, s.span_id))
+    return out, exemplars, scraped
+
+
+def slowest_traces(
+    exemplars: dict,
+    n: int = 5,
+    metrics: Iterable[str] = SLOWEST_METRICS,
+) -> List[Tuple[float, str]]:
+    """Distinct trace ids with the highest exemplar latencies, worst
+    first — the p99-bucket -> real-trace jump. Uses the first metric in
+    ``metrics`` that has exemplars (gateway view preferred: it times the
+    whole hop chain)."""
+    for name in metrics:
+        samples = exemplars.get(name) or ()
+        best: dict = {}
+        for s in samples:
+            tid = s.get("trace_id")
+            if not tid:
+                continue
+            v = float(s.get("value") or 0.0)
+            if v > best.get(tid, -1.0):
+                best[tid] = v
+        if best:
+            ranked = sorted(
+                ((v, tid) for tid, v in best.items()), reverse=True
+            )
+            return ranked[:n]
+    return []
+
+
+class _Node:
+    __slots__ = ("span", "children")
+
+    def __init__(self, span: Span):
+        self.span = span
+        self.children: list = []
+
+
+def assemble(spans: List[Span]) -> List[_Node]:
+    """Parent/child forest for ONE trace's spans. Spans whose parent was
+    not collected (evicted from a ring, or a process that was never
+    scraped) surface as roots — a partial tree beats no tree."""
+    nodes = {s.span_id: _Node(s) for s in spans}
+    roots: list = []
+    for s in spans:
+        node = nodes[s.span_id]
+        parent = nodes.get(s.parent_id) if s.parent_id else None
+        if parent is not None and parent is not node:
+            parent.children.append(node)
+        else:
+            roots.append(node)
+    for node in nodes.values():
+        node.children.sort(key=lambda c: (c.span.wall_ns, c.span.span_id))
+    roots.sort(key=lambda r: (r.span.wall_ns, r.span.span_id))
+    return roots
+
+
+def _span_line(sp: Span) -> str:
+    attrs = ""
+    if sp.attrs:
+        attrs = " " + " ".join(
+            f"{k}={v}" for k, v in sorted(sp.attrs.items())
+        )
+    return (
+        f"{sp.name} {sp.duration_ns / 1e6:.2f} ms "
+        f"[{sp.process or '?'}]{attrs}"
+    )
+
+
+def render_tree(spans: List[Span], trace_id: str) -> str:
+    """ASCII tree with per-hop durations, the ``fleet trace <id>`` view."""
+    if not spans:
+        return f"trace {trace_id}: no spans found (buffers are bounded " \
+               "rings — old traces age out)"
+    procs = {sp.process for sp in spans if sp.process}
+    total_ms = max(sp.duration_ns for sp in spans) / 1e6
+    lines = [
+        f"trace {trace_id} — {len(spans)} span(s), "
+        f"{len(procs)} process(es), {total_ms:.2f} ms"
+    ]
+
+    def walk(node: _Node, prefix: str, last: bool) -> None:
+        branch = "└─ " if last else "├─ "
+        lines.append(prefix + branch + _span_line(node.span))
+        child_prefix = prefix + ("   " if last else "│  ")
+        for i, c in enumerate(node.children):
+            walk(c, child_prefix, i == len(node.children) - 1)
+
+    roots = assemble(spans)
+    for i, r in enumerate(roots):
+        walk(r, "", i == len(roots) - 1)
+    return "\n".join(lines)
+
+
+def span_names(spans: List[Span]) -> set:
+    return {s.name for s in spans}
+
+
+def has_gateway_and_worker_hop(spans: List[Span]) -> bool:
+    """The smoke/e2e gate: one assembled trace crosses the gateway AND a
+    worker (either dispatcher flavor)."""
+    names = span_names(spans)
+    gateway = {"gateway.request", "gateway.forward"}
+    worker = {"serving.request", "serving.dispatch", "serving.queue",
+              "modelstore.dispatch"}
+    return bool(names & gateway) and bool(names & worker)

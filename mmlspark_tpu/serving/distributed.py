@@ -29,10 +29,11 @@ import logging
 import threading
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Any, Optional
 
 from mmlspark_tpu import obs
 from mmlspark_tpu.core import faults
+from mmlspark_tpu.obs.flightrec import FLIGHT
 from mmlspark_tpu.serving.server import ServiceInfo, WorkerServer
 
 log = logging.getLogger("mmlspark_tpu.serving")
@@ -313,6 +314,9 @@ class ServingGateway:
         self.forwarded = 0
         self.retried = 0
         self.failed = 0
+        # optional in-process SLO engine (fleet.run_gateway attaches one);
+        # owned here so stop() tears it down with the dispatchers
+        self.slo_engine: Any = None
 
     @staticmethod
     def _as_backend(w) -> Backend:
@@ -354,6 +358,8 @@ class ServingGateway:
         # order matters: dispatchers drain and 503 the queue while the
         # ingress can still deliver replies; only then does the ingress
         # close client sockets
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
         self._stop.set()
         for t in self._threads:
             t.join(5.0)
@@ -524,6 +530,13 @@ class ServingGateway:
             except OSError:
                 pass
 
+    # stash key for the pre-minted gateway.request span id (_forward sets
+    # it; _reply records the span under it so forward spans, minted
+    # earlier, already parent correctly). Lowercased like real headers
+    # but never forwarded (_SKIP-independent: the forward header dict is
+    # built before the stash lands).
+    _ROOT_SPAN_KEY = "x-mmlspark-gateway-root-span"
+
     def _reply(self, req, body: bytes, code: int,
                headers: Optional[dict] = None) -> None:
         """Answer the client and close out the request's gateway metrics
@@ -531,10 +544,24 @@ class ServingGateway:
         self._ingress.reply_to(req.id, body, code, headers)
         if _M_GW_LATENCY._on:
             done_ns = time.perf_counter_ns()
-            _M_GW_LATENCY.observe((done_ns - req.arrival_ns) / 1e9)
+            tid = req.headers.get(obs.TRACE_HEADER)
+            lat_s = (done_ns - req.arrival_ns) / 1e9
+            # exemplar: a p99 gateway bucket names a real, fetchable trace
+            _M_GW_LATENCY.observe(lat_s, trace_id=tid)
             obs.record_span(
                 "gateway.request", req.arrival_ns, done_ns,
-                trace_id=req.headers.get(obs.TRACE_HEADER),
+                trace_id=tid,
+                span_id=req.headers.get(self._ROOT_SPAN_KEY),
+                parent_id=req.headers.get(obs.PARENT_HEADER),
+                attrs={"status": code},
+            )
+            FLIGHT.record(
+                "ok" if code < 500 else "error",
+                status=code,
+                trace_id=tid,
+                model=req.headers.get("x-mmlspark-model"),
+                path=req.path,
+                latency_ms=lat_s * 1e3,
             )
 
     @staticmethod
@@ -567,6 +594,12 @@ class ServingGateway:
         trace_id = req.headers.get(obs.TRACE_HEADER) or obs.new_trace_id()
         headers[obs.TRACE_HEADER] = trace_id
         req.headers[obs.TRACE_HEADER] = trace_id
+        # pre-mint the gateway.request span id (recorded at _reply time):
+        # each forward span parents under it NOW, and the worker parents
+        # under the forward span via PARENT_HEADER — the assembled tree
+        # has real edges across all three layers
+        root_sid = obs.new_span_id()
+        req.headers[self._ROOT_SPAN_KEY] = root_sid
         for attempt in range(attempts):
             b = self._pool.next(exclude=tried, model=model)
             if b is None:
@@ -590,11 +623,22 @@ class ServingGateway:
                     context={"backend": (b.host, b.port), "attempt": attempt},
                 )
                 fwd_ctx = (
-                    obs.span("gateway.forward", trace_id=trace_id)
+                    obs.span(
+                        "gateway.forward", trace_id=trace_id,
+                        parent_id=root_sid,
+                        attrs={
+                            "backend": f"{b.host}:{b.port}",
+                            "attempt": attempt,
+                        },
+                    )
                     if _M_GW_LATENCY._on
                     else contextlib.nullcontext()
                 )
-                with fwd_ctx:
+                with fwd_ctx as fsp:
+                    # the worker parents its spans under THIS hop's span
+                    # (fsp is None only when telemetry is disabled)
+                    if fsp is not None:
+                        headers[obs.PARENT_HEADER] = fsp.span_id
                     conn, cached = self._conn_for(b)
                     # request() returning means the body was fully flushed;
                     # an exception DURING it leaves an incomplete body the

@@ -51,9 +51,11 @@ def make_model_handler(model_spec: str) -> Callable:
 def run_registry(
     host: str = "0.0.0.0", port: int = 9090, ttl_s: Optional[float] = None
 ) -> Any:
+    from mmlspark_tpu import obs
     from mmlspark_tpu.serving.registry import DriverRegistry
 
     reg = DriverRegistry(host=host, port=port, ttl_s=ttl_s)
+    obs.set_process_label(f"registry@{reg.host}:{reg.port}")
     print(f"registry: {reg.url}", flush=True)
     return reg
 
@@ -70,6 +72,7 @@ class _WorkerStopper:
         self._registry_url = registry_url
         self._info = info
         self._beat: Optional[threading.Thread] = None
+        self.slo_engine: Any = None
 
     def set(self) -> None:
         from mmlspark_tpu.serving.registry import DriverRegistry
@@ -77,6 +80,8 @@ class _WorkerStopper:
         if self._ev.is_set():
             return
         self._ev.set()
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
         if self._beat is not None:
             # no heartbeat may land AFTER the goodbye, or the entry would
             # resurrect until the next expiry — so outwait even a register
@@ -96,6 +101,28 @@ class _WorkerStopper:
         return self._ev.wait(timeout)
 
 
+def _start_slo_engine(
+    service_name: str,
+    targets_spec: Optional[str],
+    availability: float,
+    p99_ms: Optional[float],
+    interval_s: float,
+    gateway: bool = False,
+) -> Any:
+    """Start the in-process SLO engine a fleet role exports burn-rate
+    gauges from (``--slo-targets`` JSON overrides the role default)."""
+    from mmlspark_tpu.obs import slo
+
+    targets = (
+        slo.load_targets(targets_spec) if targets_spec
+        else slo.default_targets(
+            service_name, availability=availability, p99_ms=p99_ms,
+            gateway=gateway,
+        )
+    )
+    return slo.SLOEngine(targets, interval_s=interval_s).start()
+
+
 def run_worker(
     registry_url: str,
     model: str = "echo",
@@ -107,6 +134,10 @@ def run_worker(
     extra_models: Optional[list] = None,
     hbm_budget_bytes: Optional[int] = None,
     default_deadline_ms: Optional[float] = None,
+    slo_targets: Optional[str] = None,
+    slo_availability: float = 0.999,
+    slo_p99_ms: Optional[float] = 250.0,
+    slo_interval_s: float = 15.0,
 ) -> tuple:
     """Start a ModelStore-backed worker, register it, and re-register on a
     heartbeat thread (a restarted registry re-learns live workers within
@@ -131,6 +162,13 @@ def run_worker(
 
     srv = WorkerServer(host=host, port=port, name=service_name)
     info = srv.start()
+    from mmlspark_tpu import obs
+
+    # trace-tree hop attribution: spans from this process carry an
+    # operator-recognizable label instead of a bare pid
+    obs.set_process_label(
+        f"{service_name}@{advertise_host or info.host}:{info.port}"
+    )
     store = ModelStore(budget_bytes=hbm_budget_bytes)
     specs = [(model_name_from_spec(model), model)] if model else []
     for entry in extra_models or ():
@@ -153,6 +191,10 @@ def run_worker(
     info = dataclasses.replace(info, models=tuple(n for n, _ in specs))
     stop = threading.Event()
     stopper = _WorkerStopper(stop, registry_url, info)
+    stopper.slo_engine = _start_slo_engine(
+        service_name, slo_targets, slo_availability, slo_p99_ms,
+        slo_interval_s,
+    )
 
     def beat() -> None:
         while not stop.is_set():
@@ -276,33 +318,23 @@ def worker_urls_from_registry(
 
 
 def _hist_stats(parsed: dict, name: str, match: Optional[dict] = None) -> tuple:
-    """(p50_estimate, mean) in the histogram's native unit from exposition
-    samples: p50 is the smallest bucket bound whose cumulative count
-    reaches half the total (the standard scrape-side estimate)."""
+    """(p50_estimate, mean, p99_estimate) in the histogram's native unit
+    from exposition samples. Quantiles come from the SLO engine's bucket
+    helpers — ONE implementation of "smallest bound reaching the rank",
+    so fleet-top p99 and the SLO engine's p99 can never diverge."""
     from mmlspark_tpu import obs
+    from mmlspark_tpu.obs.slo import _buckets_of, _quantile_from_buckets
 
     count = obs.sum_samples(parsed, f"{name}_count", match)
     total = obs.sum_samples(parsed, f"{name}_sum", match)
     if count <= 0:
-        return (0.0, 0.0)
-    mean = total / count
-    want = set((match or {}).items())
-    by_le: dict = {}
-    for (n, labels), v in parsed.items():
-        if n != f"{name}_bucket":
-            continue
-        ld = dict(labels)
-        le = ld.pop("le", None)
-        if le is None or not want <= set(ld.items()):
-            continue
-        bound = float("inf") if le == "+Inf" else float(le)
-        by_le[bound] = by_le.get(bound, 0.0) + v
-    p50 = 0.0
-    for bound in sorted(by_le):
-        if by_le[bound] >= count / 2:
-            p50 = bound
-            break
-    return (p50, mean)
+        return (0.0, 0.0, 0.0)
+    buckets = _buckets_of(parsed, name, match or {})
+    return (
+        _quantile_from_buckets(buckets, 0.5),
+        total / count,
+        _quantile_from_buckets(buckets, 0.99),
+    )
 
 
 def run_top(
@@ -331,12 +363,24 @@ def run_top(
             # the registry being the one dead component is exactly when
             # the operator needs the rest of the picture
             notes.append(f"fleet top: registry scrape failed: {e}")
+    from mmlspark_tpu.obs import slo as slo_mod
+
+    def slo_cell(parsed: dict) -> str:
+        # each endpoint's own SLO engine exports its status gauge; a
+        # pre-SLO worker simply has none — show '-', don't crash
+        status = slo_mod.status_from_scrape(parsed)
+        return (
+            "-" if status is None
+            else slo_mod.STATUS_NAMES.get(status, "?")
+        )
+
     lines = notes + [
         f"fleet top — service {service_name!r}, {len(endpoints)} worker(s)"
     ]
     hdr = (
         f"{'WORKER':<26} {'ACCEPT':>8} {'QDEPTH':>7} {'ERR':>5} "
-        f"{'QWAIT_P50_MS':>13} {'LAT_P50_MS':>11} {'BATCH_AVG':>10}"
+        f"{'ERR_PCT':>7} {'QWAIT_P50_MS':>13} {'LAT_P50_MS':>11} "
+        f"{'LAT_P99_MS':>11} {'BATCH_AVG':>10} {'SLO':>6}"
     )
     lines.append(hdr)
     tot_accept = 0.0
@@ -354,20 +398,22 @@ def run_top(
         errs = obs.sum_samples(
             parsed, "mmlspark_serving_handler_errors_total", m
         )
-        qwait_p50, _ = _hist_stats(
+        err_pct = (100.0 * errs / accept) if accept > 0 else 0.0
+        qwait_p50, _, _ = _hist_stats(
             parsed, "mmlspark_serving_queue_wait_seconds", m
         )
-        lat_p50, _ = _hist_stats(
+        lat_p50, _, lat_p99 = _hist_stats(
             parsed, "mmlspark_serving_request_latency_seconds", m
         )
-        _, batch_avg = _hist_stats(
+        _, batch_avg, _ = _hist_stats(
             parsed, "mmlspark_serving_batch_size_requests", m
         )
         tot_accept += accept
         lines.append(
             f"{addr:<26} {accept:>8.0f} {qdepth:>7.0f} {errs:>5.0f} "
-            f"{qwait_p50 * 1e3:>13.2f} {lat_p50 * 1e3:>11.2f} "
-            f"{batch_avg:>10.1f}"
+            f"{err_pct:>7.2f} {qwait_p50 * 1e3:>13.2f} "
+            f"{lat_p50 * 1e3:>11.2f} {lat_p99 * 1e3:>11.2f} "
+            f"{batch_avg:>10.1f} {slo_cell(parsed):>6}"
         )
     if gateway_url:
         parsed = scrape_metrics(gateway_url)
@@ -385,16 +431,118 @@ def run_top(
             backends = obs.sum_samples(
                 parsed, "mmlspark_gateway_backends_count"
             )
-            lat_p50, _ = _hist_stats(
+            lat_p50, _, lat_p99 = _hist_stats(
                 parsed, "mmlspark_gateway_request_latency_seconds"
             )
             lines.append(
                 f"gateway {addr}: accepted {accepted:.0f}, forwarded "
                 f"{fwd:.0f}, retried {retried:.0f}, failed {failed:.0f}, "
-                f"backends {backends:.0f}, p50 {lat_p50 * 1e3:.2f} ms"
+                f"backends {backends:.0f}, p50 {lat_p50 * 1e3:.2f} ms, "
+                f"p99 {lat_p99 * 1e3:.2f} ms, slo {slo_cell(parsed)}"
             )
     lines.append(f"total accepted across workers: {tot_accept:.0f}")
     return "\n".join(lines)
+
+
+def _trace_endpoints(
+    registry_url: Optional[str],
+    gateway_url: Optional[str],
+    worker_urls: Optional[list],
+    service_name: str = "serving",
+) -> tuple:
+    """(endpoints, notes): every /traces-scrapeable base URL the caller
+    named plus the registry roster — and the registry's OWN endpoint,
+    whose spans cover control-plane traffic."""
+    endpoints: list = [u.rstrip("/") for u in (worker_urls or ())]
+    notes: list = []
+    if gateway_url:
+        gu = gateway_url.rstrip("/")
+        if gu not in endpoints:
+            endpoints.append(gu)
+    if registry_url:
+        try:
+            for ep in worker_urls_from_registry(registry_url, service_name):
+                if ep not in endpoints:
+                    endpoints.append(ep)
+        except Exception as e:  # noqa: BLE001 — assemble what's reachable
+            notes.append(f"trace: registry roster unavailable: {e}")
+        ru = registry_url.rstrip("/")
+        if ru not in endpoints:
+            endpoints.append(ru)
+    return endpoints, notes
+
+
+def run_trace(
+    trace_id: str,
+    registry_url: Optional[str] = None,
+    gateway_url: Optional[str] = None,
+    worker_urls: Optional[list] = None,
+    service_name: str = "serving",
+) -> str:
+    """``fleet trace <id>``: scrape every span buffer in the fleet, join
+    the trace, render the cross-process tree. Endpoints that don't serve
+    ``/traces`` (pre-trace workers: 404) are skipped."""
+    from mmlspark_tpu.obs import traces as traces_mod
+
+    endpoints, notes = _trace_endpoints(
+        registry_url, gateway_url, worker_urls, service_name
+    )
+    spans, _, scraped = traces_mod.collect(endpoints, trace_id=trace_id)
+    if not scraped:
+        notes.append(
+            f"trace: none of {len(endpoints)} endpoint(s) served /traces"
+        )
+    notes.append(traces_mod.render_tree(spans, trace_id))
+    return "\n".join(notes)
+
+
+def run_traces_slowest(
+    n: int = 5,
+    registry_url: Optional[str] = None,
+    gateway_url: Optional[str] = None,
+    worker_urls: Optional[list] = None,
+    service_name: str = "serving",
+) -> str:
+    """``fleet traces --slowest N``: jump from the latency histograms'
+    p99-bucket exemplars to real traces and render each tree, worst
+    first. Falls back to the longest buffered request spans when no
+    exemplar carried a trace id yet."""
+    from mmlspark_tpu.obs import traces as traces_mod
+
+    endpoints, notes = _trace_endpoints(
+        registry_url, gateway_url, worker_urls, service_name
+    )
+    spans, exemplars, scraped = traces_mod.collect(endpoints)
+    if not scraped:
+        notes.append(
+            f"traces: none of {len(endpoints)} endpoint(s) served /traces"
+        )
+        return "\n".join(notes)
+    ranked = traces_mod.slowest_traces(exemplars, n=n)
+    if not ranked:
+        # no exemplars yet (cold fleet): rank the buffered request spans
+        best: dict = {}
+        for s in spans:
+            if s.name in ("gateway.request", "serving.request"):
+                best[s.trace_id] = max(
+                    best.get(s.trace_id, 0.0), s.duration_ns / 1e9
+                )
+        ranked = sorted(
+            ((v, t) for t, v in best.items()), reverse=True
+        )[:n]
+    if not ranked:
+        notes.append("traces: no request traces buffered yet")
+        return "\n".join(notes)
+    by_trace: dict = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    notes.append(
+        f"slowest {len(ranked)} trace(s) across {len(scraped)} endpoint(s):"
+    )
+    for v, tid in ranked:
+        notes.append(f"--- {v * 1e3:.2f} ms ---")
+        notes.append(traces_mod.render_tree(by_trace.get(tid, []), tid))
+    return "\n".join(notes)
 
 
 def run_gateway(
@@ -402,7 +550,12 @@ def run_gateway(
     host: str = "0.0.0.0",
     port: int = 8080,
     service_name: str = "serving",
+    slo_targets: Optional[str] = None,
+    slo_availability: float = 0.999,
+    slo_p99_ms: Optional[float] = 250.0,
+    slo_interval_s: float = 15.0,
 ) -> Any:
+    from mmlspark_tpu import obs
     from mmlspark_tpu.serving.distributed import ServingGateway
 
     gw = ServingGateway(
@@ -410,6 +563,13 @@ def run_gateway(
         host=host, port=port,
     )
     ginfo = gw.start()
+    obs.set_process_label(
+        f"{service_name}-gateway@{ginfo.host}:{ginfo.port}"
+    )
+    gw.slo_engine = _start_slo_engine(
+        service_name, slo_targets, slo_availability, slo_p99_ms,
+        slo_interval_s, gateway=True,
+    )
     print(f"gateway: http://{ginfo.host}:{ginfo.port}/", flush=True)
     return gw
 
@@ -478,6 +638,24 @@ def main(argv: Optional[list] = None) -> None:
         help="admission-control deadline applied to requests that carry "
         "no x-mmlspark-deadline-ms header (None = shed only on request)",
     )
+
+    def add_slo_flags(p) -> None:
+        p.add_argument(
+            "--slo-targets", default=None,
+            help="JSON list of SLO targets (inline or a file path; "
+            "obs/slo.py SLOTarget fields) — overrides the role default",
+        )
+        p.add_argument(
+            "--slo-availability", type=float, default=0.999,
+            help="default target availability (good/total)",
+        )
+        p.add_argument(
+            "--slo-p99-ms", type=float, default=250.0,
+            help="default p99 latency budget; requests over it burn the "
+            "error budget too (0 disables the latency SLI)",
+        )
+
+    add_slo_flags(w)
     g = sub.add_parser("gateway")
     g.add_argument("--registry", required=True)
     g.add_argument("--host", default="0.0.0.0")
@@ -488,6 +666,7 @@ def main(argv: Optional[list] = None) -> None:
         help="on SIGTERM: finish accepted requests for up to this long "
         "(0 = stop immediately)",
     )
+    add_slo_flags(g)
     t = sub.add_parser(
         "top", help="scrape /metrics across the fleet, print a summary"
     )
@@ -502,6 +681,32 @@ def main(argv: Optional[list] = None) -> None:
         "--watch", type=float, default=0.0,
         help="refresh every N seconds (0 = print once and exit)",
     )
+    def add_trace_endpoint_flags(p) -> None:
+        p.add_argument("--registry", default=None)
+        p.add_argument("--gateway", default=None)
+        p.add_argument("--service-name", default="serving")
+        p.add_argument(
+            "--worker", action="append", default=[],
+            help="explicit worker base URL (repeatable)",
+        )
+
+    tr = sub.add_parser(
+        "trace",
+        help="fetch one trace id across the fleet's span buffers and "
+        "render the cross-process tree",
+    )
+    tr.add_argument("trace_id")
+    add_trace_endpoint_flags(tr)
+    trs = sub.add_parser(
+        "traces",
+        help="rank recent traces by latency (histogram-bucket exemplars) "
+        "and render the slowest trees",
+    )
+    trs.add_argument(
+        "--slowest", type=int, default=5, metavar="N",
+        help="how many traces to render, worst first",
+    )
+    add_trace_endpoint_flags(trs)
     m = sub.add_parser(
         "model",
         help="model lifecycle control against a worker or gateway "
@@ -543,6 +748,20 @@ def main(argv: Optional[list] = None) -> None:
             version=args.version, pin=args.pin, no_wait=args.no_wait,
             activate=args.activate,
         ))
+    if args.role == "trace":
+        print(run_trace(
+            args.trace_id, registry_url=args.registry,
+            gateway_url=args.gateway, worker_urls=args.worker or None,
+            service_name=args.service_name,
+        ), flush=True)
+        return
+    if args.role == "traces":
+        print(run_traces_slowest(
+            args.slowest, registry_url=args.registry,
+            gateway_url=args.gateway, worker_urls=args.worker or None,
+            service_name=args.service_name,
+        ), flush=True)
+        return
     if args.role == "top":
         while True:
             print(
@@ -557,19 +776,36 @@ def main(argv: Optional[list] = None) -> None:
                 break
             time.sleep(args.watch)
     elif args.role == "registry":
+        from mmlspark_tpu.obs.flightrec import install_sigusr1
+
+        install_sigusr1()
         reg = run_registry(args.host, args.port, args.ttl_s)
         _serve_forever([reg])
     elif args.role == "worker":
+        from mmlspark_tpu.obs.flightrec import install_sigusr1
+
+        install_sigusr1()  # SIGUSR1 -> flight-recorder dump
         srv, q, stop = run_worker(
             args.registry, args.model, args.host, args.port,
             args.service_name, args.heartbeat_s, args.advertise_host,
             extra_models=args.load,
             hbm_budget_bytes=args.hbm_budget_bytes,
             default_deadline_ms=args.default_deadline_ms,
+            slo_targets=args.slo_targets,
+            slo_availability=args.slo_availability,
+            slo_p99_ms=args.slo_p99_ms or None,
         )
         _serve_forever([stop, q, srv])
     else:
-        gw = run_gateway(args.registry, args.host, args.port, args.service_name)
+        from mmlspark_tpu.obs.flightrec import install_sigusr1
+
+        install_sigusr1()
+        gw = run_gateway(
+            args.registry, args.host, args.port, args.service_name,
+            slo_targets=args.slo_targets,
+            slo_availability=args.slo_availability,
+            slo_p99_ms=args.slo_p99_ms or None,
+        )
         _serve_forever([gw], drain_s=args.drain_s)
 
 

@@ -46,6 +46,7 @@ from collections import deque
 from typing import Optional
 
 from mmlspark_tpu import obs
+from mmlspark_tpu.obs.flightrec import FLIGHT
 from mmlspark_tpu.serving.modelstore.store import (
     HBMBudgetExceeded,
     ModelStore,
@@ -191,14 +192,30 @@ class _ModelQueue:
                 # admission and dispatch — tell the router's 503 story
                 disp._reply_not_ready(batch, self.name)
                 continue
+            obs_on = self._m_lat._on
+            dispatch_ns = time.perf_counter_ns()
+            # pre-minted per-request span AND trace ids: same tree shape
+            # as ServingQuery (request span parenting queue + batch
+            # spans, itself parented under the gateway's forward span;
+            # headerless direct traffic mints its trace ids here)
+            req_sids = req_tids = None
+            if obs_on:
+                req_sids = {r.id: obs.new_span_id() for r in batch}
+                req_tids = {
+                    r.id: r.headers.get(obs.TRACE_HEADER)
+                    or obs.new_trace_id()
+                    for r in batch
+                }
             t0 = time.perf_counter()
             try:
                 ctx = (
                     obs.span(
                         "modelstore.dispatch",
-                        trace_id=batch[0].headers.get(obs.TRACE_HEADER),
+                        trace_id=req_tids[batch[0].id],
+                        parent_id=req_sids[batch[0].id],
+                        attrs={"model": self.name, "batch": len(batch)},
                     )
-                    if self._m_lat._on
+                    if obs_on
                     else contextlib.nullcontext()
                 )
                 with ctx:
@@ -216,15 +233,44 @@ class _ModelQueue:
                 0.8 * self.svc_s + 0.2 * svc
             )
             done_ns = time.perf_counter_ns()
+            # replies first, telemetry second: this batcher thread is the
+            # model's pipeline bottleneck — recording before replying
+            # would tax every queued request's latency (see query.py)
+            codes = {}
             for r in batch:
                 code, body, headers = replies.get(
                     r.id, (500, b"no reply produced", {})
                 )
                 disp.server.reply_to(r.id, body, code, headers)
-                if self._m_lat._on:
+                codes[r.id] = code
+            for r in batch:
+                if obs_on:
+                    code = codes[r.id]
+                    sid = req_sids[r.id]
+                    tid = req_tids[r.id]
+                    obs.record_span(
+                        "serving.request", r.arrival_ns, done_ns,
+                        trace_id=tid,
+                        span_id=sid,
+                        parent_id=r.headers.get(obs.PARENT_HEADER),
+                        attrs={"status": code, "model": self.name},
+                    )
+                    obs.record_span(
+                        "serving.queue", r.arrival_ns, dispatch_ns,
+                        trace_id=tid, parent_id=sid,
+                    )
                     lat_s = (done_ns - r.arrival_ns) / 1e9
-                    self._m_lat.observe(lat_s)
-                    self._m_srv_lat.observe(lat_s)
+                    self._m_lat.observe(lat_s, trace_id=tid)
+                    self._m_srv_lat.observe(lat_s, trace_id=tid)
+                    FLIGHT.record(
+                        "ok" if code < 500 else "error",
+                        status=code,
+                        trace_id=tid,
+                        model=self.name,
+                        path=r.path,
+                        latency_ms=lat_s * 1e3,
+                        queue_wait_ms=(dispatch_ns - r.arrival_ns) / 1e6,
+                    )
                 disp._lat.record(done_ns - r.arrival_ns)
             disp.batches += 1
         # stopped: nothing queued here gets a handler anymore
@@ -410,6 +456,23 @@ class ModelDispatcher:
                     },
                     429, {"Retry-After": "1", **_JSON},
                 )
+                if _M_SHED._on:
+                    # a shed is exactly what a flight-recorder dump should
+                    # explain: deadline, estimate and queue wait survive.
+                    # Recorded AFTER the reply: a shed auto-dumps the
+                    # ring, and that disk write must not stall the router
+                    # thread's 429 (nor every other model's routing)
+                    # longer than it already has to
+                    FLIGHT.record(
+                        "shed",
+                        status=429,
+                        trace_id=r.headers.get(obs.TRACE_HEADER),
+                        model=model,
+                        path=r.path,
+                        queue_wait_ms=waited_s * 1e3,
+                        deadline_ms=deadline_ms,
+                        detail=f"estimate_ms={round(est_s * 1e3, 3)}",
+                    )
                 return
         if not mq.push(r):
             # the queue was reaped (model unloaded) between lookup and

@@ -25,6 +25,7 @@ import numpy as np
 
 from mmlspark_tpu import obs
 from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.obs.flightrec import FLIGHT
 from mmlspark_tpu.serving.server import CachedRequest, WorkerServer
 from mmlspark_tpu.serving.udfs import make_reply, request_to_json
 
@@ -157,6 +158,21 @@ class ServingQuery:
                 self.server.auto_commit()
 
     def _process(self, reqs: list) -> None:
+        obs_on = self._m_latency._on
+        dispatch_ns = time.perf_counter_ns()  # ~= queue-pop time
+        # per-request span AND trace ids are minted BEFORE dispatch so
+        # the batch span can parent under the first request's span in the
+        # first request's trace (headerless direct traffic mints here) —
+        # the collector then renders queue wait and model time as
+        # children of the request, under the gateway's forward span
+        # (PARENT_HEADER) when there is one
+        req_sids = req_tids = None
+        if obs_on:
+            req_sids = {r.id: obs.new_span_id() for r in reqs}
+            req_tids = {
+                r.id: r.headers.get(obs.TRACE_HEADER) or obs.new_trace_id()
+                for r in reqs
+            }
         try:
             # the dispatch span wraps the model call, so inside a
             # jax.profiler capture the XLA dispatch nests under it; the
@@ -164,9 +180,11 @@ class ServingQuery:
             ctx = (
                 obs.span(
                     "serving.dispatch",
-                    trace_id=reqs[0].headers.get(obs.TRACE_HEADER),
+                    trace_id=req_tids[reqs[0].id],
+                    parent_id=req_sids[reqs[0].id],
+                    attrs={"batch": len(reqs)},
                 )
-                if self._m_latency._on
+                if obs_on
                 else contextlib.nullcontext()
             )
             with ctx:
@@ -177,16 +195,44 @@ class ServingQuery:
             msg = f"handler error: {type(e).__name__}: {e}".encode()
             replies = {r.id: (500, msg, {}) for r in reqs}
         done_ns = time.perf_counter_ns()
+        # two passes: every reply goes out BEFORE any telemetry is
+        # recorded. The dispatcher thread is the pipeline bottleneck
+        # under concurrency — recording first would add its cost to every
+        # queued request's latency, recording after overlaps it with the
+        # clients' own processing
+        codes = {}
         for r in reqs:
             code, body, headers = replies.get(
                 r.id, (500, b"no reply produced", {})
             )
             self.server.reply_to(r.id, body, code, headers)
-            if self._m_latency._on:
-                self._m_latency.observe((done_ns - r.arrival_ns) / 1e9)
+            codes[r.id] = code
+        for r in reqs:
+            if obs_on:
+                code = codes[r.id]
+                sid = req_sids[r.id]
+                tid = req_tids[r.id]
                 obs.record_span(
                     "serving.request", r.arrival_ns, done_ns,
-                    trace_id=r.headers.get(obs.TRACE_HEADER),
+                    trace_id=tid,
+                    span_id=sid,
+                    parent_id=r.headers.get(obs.PARENT_HEADER),
+                    attrs={"status": code},
+                )
+                obs.record_span(
+                    "serving.queue", r.arrival_ns, dispatch_ns,
+                    trace_id=tid, parent_id=sid,
+                )
+                lat_s = (done_ns - r.arrival_ns) / 1e9
+                # exemplar: the p99 bucket remembers a real trace id
+                self._m_latency.observe(lat_s, trace_id=tid)
+                FLIGHT.record(
+                    "ok" if code < 500 else "error",
+                    status=code,
+                    trace_id=tid,
+                    path=r.path,
+                    latency_ms=lat_s * 1e3,
+                    queue_wait_ms=(dispatch_ns - r.arrival_ns) / 1e6,
                 )
             self._lat.record(done_ns - r.arrival_ns)
         self.batches += 1

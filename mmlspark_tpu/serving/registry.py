@@ -85,6 +85,23 @@ class DriverRegistry:
                 pass
 
             def do_POST(self):
+                if self.path.split("?", 1)[0] == "/debug/dump":
+                    # on-demand flight-recorder dump, same contract as the
+                    # WorkerServer endpoint (docs/observability.md)
+                    from mmlspark_tpu.obs.flightrec import FLIGHT
+
+                    dump_path = FLIGHT.dump("manual")
+                    body = json.dumps({
+                        "dumped": dump_path is not None,
+                        "path": dump_path,
+                        "records": len(FLIGHT),
+                    }).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 try:
                     n = int(self.headers.get("Content-Length") or 0)
                     info = json.loads(self.rfile.read(n))
@@ -151,6 +168,16 @@ class DriverRegistry:
                 self.wfile.write(body)
 
             def do_GET(self):
+                path_only = self.path.split("?", 1)[0]
+                if path_only == "/traces" or path_only.startswith("/traces/"):
+                    tid = path_only[len("/traces/"):] or None
+                    body = obs.render_traces(tid).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path.split("?", 1)[0] == "/metrics":
                     with registry._lock:
                         registry._expire_locked()  # scrape sees fresh TTLs

@@ -16,6 +16,7 @@ stays O(µs) and the end-to-end budget is spent on the XLA call.
 from __future__ import annotations
 
 import asyncio
+import json
 import socket as socket_mod
 import threading
 import time
@@ -272,6 +273,37 @@ class WorkerServer:
                     self._write_response(
                         writer, 200, obs.render().encode(), keep,
                         {"Content-Type": _METRICS_CONTENT_TYPE},
+                    )
+                    if not keep:
+                        return
+                    continue
+                if method == "GET" and (
+                    path_only == "/traces"
+                    or path_only.startswith("/traces/")
+                ):
+                    # span-buffer scrape (trace assembly): same inline,
+                    # never-counted contract as /metrics
+                    tid = path_only[len("/traces/"):] or None
+                    self._write_response(
+                        writer, 200, obs.render_traces(tid).encode(), keep,
+                        {"Content-Type": "application/json"},
+                    )
+                    if not keep:
+                        return
+                    continue
+                if path_only == "/debug/dump" and method == "POST":
+                    # on-demand flight-recorder dump (docs/observability.md)
+                    from mmlspark_tpu.obs.flightrec import FLIGHT
+
+                    dump_path = FLIGHT.dump("manual")
+                    body_out = json.dumps({
+                        "dumped": dump_path is not None,
+                        "path": dump_path,
+                        "records": len(FLIGHT),
+                    }).encode()
+                    self._write_response(
+                        writer, 200, body_out, keep,
+                        {"Content-Type": "application/json"},
                     )
                     if not keep:
                         return

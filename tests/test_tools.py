@@ -58,3 +58,21 @@ def test_metric_name_linter_catches_violations(tmp_path):
     assert sorted(v[1] for v in violations) == [
         "mmlspark_nonexistent_thing_total", "mmlspark_serving_oops",
     ]
+
+
+def test_metric_name_linter_knows_slo_subsystem(tmp_path):
+    """The SLO engine's families (obs/slo.py) are a first-class
+    subsystem: burn-rate gauges pass, and the subsystem list the error
+    message advertises includes it."""
+    from tools.lint_metric_names import SUBSYSTEMS, lint
+
+    assert "slo" in SUBSYSTEMS
+    src = tmp_path / "slo.py"
+    src.write_text(
+        'b = obs.gauge("mmlspark_slo_burn_rate_ratio")\n'
+        'c = obs.counter("mmlspark_slo_evaluations_total")\n'
+        'bad = obs.gauge("mmlspark_slo_burn_rate")\n'  # no unit suffix
+    )
+    violations, seen = lint([str(src)])
+    assert seen == 3
+    assert [v[1] for v in violations] == ["mmlspark_slo_burn_rate"]

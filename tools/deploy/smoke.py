@@ -251,6 +251,124 @@ def _swap_drill(url: str, n: int, registry_url, service: str,
     )
 
 
+def _verify_trace(url: str, registry_url, service: str) -> bool:
+    """Trace-assembly gate (default on): fetch the slowest trace via the
+    collector and require both a gateway hop and a worker hop in the
+    assembled tree. Degrades rather than failing a healthy fleet (the
+    PR 2 metrics-gate precedent): skips when nothing serves ``/traces``
+    (pre-trace build) or when the target buffers no gateway spans
+    (smoking a worker directly), and only requires the worker hop when
+    worker span buffers were actually scraped (``--registry``) or the
+    target's own buffer already holds worker spans (co-located roles)."""
+    _ensure_repo_path()
+    from mmlspark_tpu.obs import traces as traces_mod
+    from mmlspark_tpu.serving.fleet import worker_urls_from_registry
+
+    target = url.rstrip("/")
+    endpoints = [target]
+    if registry_url:
+        try:
+            endpoints += [
+                u for u in worker_urls_from_registry(registry_url, service)
+                if u not in endpoints
+            ]
+        except Exception as e:  # noqa: BLE001 — gate degrades, smoke goes on
+            print(f"smoke: registry unavailable for trace gate ({e})")
+    spans, exemplars, scraped = traces_mod.collect(endpoints)
+    if not scraped:
+        print("smoke: no endpoint serves /traces; skipping trace gate")
+        return True
+    if not any(s.name == "gateway.request" for s in spans):
+        # a worker smoked directly has no gateway spans to assemble
+        print("smoke: target buffers no gateway traces; skipping trace gate")
+        return True
+    ranked = traces_mod.slowest_traces(exemplars, n=1)
+    if ranked:
+        tid = ranked[0][1]
+        tspans = [s for s in spans if s.trace_id == tid]
+        how = f"slowest exemplar trace {tid} ({ranked[0][0] * 1e3:.2f} ms)"
+    else:
+        # cold exemplars: any gateway-rooted trace will do
+        gw_spans = [s for s in spans if s.name == "gateway.request"]
+        tid = gw_spans[-1].trace_id
+        tspans = [s for s in spans if s.trace_id == tid]
+        how = f"latest gateway trace {tid}"
+    # worker spans are only observable when worker buffers were scraped
+    # (or the target process co-hosts the worker); without --registry a
+    # gateway-only smoke must not fail on spans it cannot see
+    workers_scraped = len(scraped) > 1
+    worker_seen = any(
+        s.name in ("serving.request", "serving.dispatch", "serving.queue",
+                   "modelstore.dispatch")
+        for s in spans
+    )
+    require_worker = workers_scraped or worker_seen
+    ok = traces_mod.has_gateway_and_worker_hop(tspans) if require_worker \
+        else any(s.name.startswith("gateway.") for s in tspans)
+    hops = "gateway+worker" if require_worker else \
+        "gateway-only (pass --registry to scrape worker buffers)"
+    print(
+        f"smoke: {how} — {len(tspans)} span(s) across "
+        f"{len({s.process for s in tspans})} process(es), {hops} "
+        f"hops {'ok' if ok else 'MISMATCH'}"
+    )
+    if not ok:
+        print(traces_mod.render_tree(tspans, tid))
+    return ok
+
+
+def _verify_slo(url: str) -> bool:
+    """SLO gate: when the target exports ``mmlspark_slo_*`` gauges, fail
+    on a red (page-now) target; skip on fleets without the engine."""
+    _ensure_repo_path()
+    from mmlspark_tpu.obs import slo as slo_mod
+    from mmlspark_tpu.serving.fleet import scrape_metrics
+
+    parsed = scrape_metrics(url)
+    if parsed is None:
+        print("smoke: target /metrics unreachable; skipping SLO gate")
+        return True
+    status = slo_mod.status_from_scrape(parsed)
+    if status is None:
+        print("smoke: target exports no SLO gauges; skipping SLO gate")
+        return True
+    burns = sorted(
+        (dict(labels).get("slo", "?"), dict(labels).get("window", "?"), v)
+        for (name, labels), v in parsed.items()
+        if name == "mmlspark_slo_burn_rate_ratio"
+    )
+    for slo_name, window, v in burns:
+        print(f"smoke: slo {slo_name} burn[{window}] = {v:.3f}")
+    ok = status < slo_mod.RED
+    print(
+        f"smoke: slo status {slo_mod.STATUS_NAMES.get(status, '?')} — "
+        f"{'ok' if ok else 'RED (error budget burning at page rate)'}"
+    )
+    return ok
+
+
+def _count_fault_records() -> int:
+    _ensure_repo_path()
+    from mmlspark_tpu.obs.flightrec import FLIGHT
+
+    return len(FLIGHT.snapshot(outcome="fault"))
+
+
+def _verify_flightrec(plan, recorded_before: int) -> bool:
+    """Chaos-smoke gate: every injected fault must appear in this
+    process's flight recorder (faults.inject records one event per
+    fire), so a dump explains exactly what chaos did. Compared as a
+    delta: an in-process caller may hold records from earlier runs."""
+    injected = len(plan.fires())
+    recorded = _count_fault_records() - recorded_before
+    ok = recorded == injected
+    print(
+        f"smoke: flight recorder captured {recorded}/{injected} injected "
+        f"fault(s) — {'ok' if ok else 'MISMATCH'}"
+    )
+    return ok
+
+
 def _smoke_chaos(url: str, n: int, fault_plan: str) -> tuple:
     _ensure_repo_path()
     from mmlspark_tpu.core.faults import FaultPlan
@@ -274,7 +392,7 @@ def _smoke_chaos(url: str, n: int, fault_plan: str) -> tuple:
         ):
             ok += 1
     print(f"smoke: {len(plan.fires())} faults injected")
-    return ok, lat
+    return ok, lat, plan
 
 
 def main(argv=None) -> int:
@@ -297,6 +415,11 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--no-verify-metrics", action="store_true",
         help="skip the /metrics accepted-vs-observed drop gate",
+    )
+    ap.add_argument(
+        "--no-verify-trace", action="store_true",
+        help="skip the trace-assembly gate (slowest trace must contain "
+        "a gateway hop AND a worker hop)",
     )
     ap.add_argument(
         "--swap", action="store_true",
@@ -325,13 +448,15 @@ def main(argv=None) -> int:
               "(run the chaos smoke and the swap drill separately)",
               file=sys.stderr)
         return 2
+    plan = None
+    faults_before = _count_fault_records() if args.fault_plan else 0
     if args.swap:
         ok, lat, swap_ok, extra_gw, extra_workers = _swap_drill(
             args.url, n, args.registry, args.service_name,
             args.swap_model, args.swap_spec,
         )
     elif args.fault_plan:
-        ok, lat = _smoke_chaos(args.url, n, args.fault_plan)
+        ok, lat, plan = _smoke_chaos(args.url, n, args.fault_plan)
     else:
         ok, lat = _smoke_raw(urllib.parse.urlparse(args.url), n)
     lat.sort()
@@ -344,7 +469,16 @@ def main(argv=None) -> int:
             before, after, ok, chaos=bool(args.fault_plan),
             extra_gw=extra_gw, extra_workers=extra_workers,
         )
-    return 0 if (ok == n and metrics_ok and swap_ok) else 1
+        metrics_ok = _verify_slo(args.url) and metrics_ok
+    trace_ok = True
+    if not args.no_verify_trace:
+        trace_ok = _verify_trace(args.url, args.registry, args.service_name)
+    flight_ok = True
+    if plan is not None:
+        flight_ok = _verify_flightrec(plan, faults_before)
+    return 0 if (
+        ok == n and metrics_ok and swap_ok and trace_ok and flight_ok
+    ) else 1
 
 
 if __name__ == "__main__":

@@ -31,7 +31,7 @@ SCAN_DIRS = ("mmlspark_tpu", "tools")
 
 SUBSYSTEMS = (
     "core", "io", "serving", "gateway", "registry", "parallel", "gbdt",
-    "faults", "trace", "modelstore",
+    "faults", "trace", "modelstore", "slo",
 )
 UNITS = ("total", "seconds", "requests", "count", "bytes", "ratio", "rows")
 
