@@ -34,6 +34,16 @@ point                     fires inside
                           latency stalls only the control op while traffic
                           keeps serving the old version (the zero-downtime
                           hot-swap property the chaos suite asserts)
+``admission.shed``        serving/server.py ingress admission check — a
+                          truthy payload forces a 429 shed (chaos for the
+                          client's Retry-After handling), delay stalls
+                          admission itself
+``gateway.hedge``         serving/distributed.py as a tail hedge launches —
+                          an error suppresses the duplicate (the primary
+                          must still win eventually)
+``supervisor.restart``    serving/supervisor.py before a worker respawn —
+                          an error is "the scheduler refused", retried next
+                          tick; delay simulates slow node allocation
 ========================  ====================================================
 
 Schedules are **seeded and step-indexed**: a rule fires by absolute step
@@ -184,6 +194,12 @@ class FaultPlan:
 
     def points(self) -> list:
         return sorted(self._rules)
+
+    def rules(self, point: str) -> list:
+        """The :class:`FaultRule` list installed at ``point`` (a copy —
+        callers inspect schedules, e.g. the smoke containment gate
+        deciding whether a plan guarantees a breaker-tripping burst)."""
+        return list(self._rules.get(point, ()))
 
     def fires(self, point: Optional[str] = None) -> list:
         with self._lock:

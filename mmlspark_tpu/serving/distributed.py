@@ -26,14 +26,22 @@ import contextlib
 import http.client
 import json
 import logging
+import queue as queue_mod
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Optional
 
 from mmlspark_tpu import obs
 from mmlspark_tpu.core import faults
 from mmlspark_tpu.obs.flightrec import FLIGHT
+from mmlspark_tpu.serving.admission import (
+    DEADLINE_HEADER,
+    RETRY_BUDGET_HEADER,
+    SHED_HEADER,
+    deadline_ms_from,
+)
 from mmlspark_tpu.serving.server import ServiceInfo, WorkerServer
 
 log = logging.getLogger("mmlspark_tpu.serving")
@@ -67,8 +75,236 @@ _M_BE_ERRS = obs.counter(
 )
 _M_BE_EVICT = obs.counter(
     "mmlspark_gateway_backend_evictions_total",
-    "DEAD-mark evictions per backend", labels=("backend",),
+    "Breaker-open events per backend (kept under the pre-breaker name "
+    "so eviction dashboards keep working)", labels=("backend",),
 )
+_M_BE_BACKPRESSURE = obs.counter(
+    "mmlspark_gateway_backend_backpressure_total",
+    "429 sheds per backend (load shedding, classified as backpressure "
+    "rather than failure)", labels=("backend",),
+)
+_M_BREAKER_STATE = obs.gauge(
+    "mmlspark_gateway_breaker_state",
+    "Per-backend circuit-breaker state (0=closed, 1=open, 2=half-open)",
+    labels=("backend",),
+)
+_M_BREAKER_TRANSITIONS = obs.counter(
+    "mmlspark_gateway_breaker_transitions_total",
+    "Breaker state transitions", labels=("backend", "state"),
+)
+_M_RETRY_BUDGET_RATIO = obs.gauge(
+    "mmlspark_gateway_retry_budget_remaining_ratio",
+    "Fraction of the retry token bucket still available (1 = untouched)",
+)
+_M_RETRY_BUDGET_EXHAUSTED = obs.counter(
+    "mmlspark_gateway_retry_budget_exhausted_total",
+    "Re-dispatches refused because the retry budget was spent",
+)
+_M_HEDGES = obs.counter(
+    "mmlspark_gateway_hedges_total",
+    "Hedge requests fired (tail-latency duplicates)",
+)
+_M_HEDGE_WINS = obs.counter(
+    "mmlspark_gateway_hedge_wins_total",
+    "Requests answered by the hedge before the primary",
+)
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN = 0, 1, 2
+BREAKER_STATE_NAMES = {
+    BREAKER_CLOSED: "closed",
+    BREAKER_OPEN: "open",
+    BREAKER_HALF_OPEN: "half_open",
+}
+
+
+class CircuitBreaker:
+    """Per-backend closed -> open -> half-open state machine.
+
+    Opens after ``open_after`` consecutive failures OR when the error
+    rate over a sliding ``rate_window_s`` window crosses
+    ``rate_threshold`` (with at least ``rate_min_volume`` outcomes — a
+    1-for-1 sample must not open anything). While open, the backend is
+    skipped entirely; after the open period (``cooldown_s``, doubled per
+    consecutive open up to ``max_open_s``) ONE probe request is admitted
+    (half-open). The probe's success closes the breaker; its failure
+    re-opens with a longer period. ``open_after=0`` disables opening —
+    the static-pool setting, where cooldown alone rate-limits attempts.
+
+    Not self-locking: :class:`BackendPool` drives it under the pool lock.
+    """
+
+    def __init__(
+        self,
+        open_after: int = 3,
+        cooldown_s: float = 5.0,
+        rate_threshold: float = 0.5,
+        rate_window_s: float = 30.0,
+        rate_min_volume: int = 10,
+        max_open_s: float = 60.0,
+    ):
+        self.open_after = open_after
+        self.cooldown_s = cooldown_s
+        self.rate_threshold = rate_threshold
+        self.rate_window_s = rate_window_s
+        self.rate_min_volume = rate_min_volume
+        self.max_open_s = max_open_s
+        self.state = BREAKER_CLOSED
+        self.fails = 0          # consecutive failures
+        self.opened_at = 0.0
+        self.opens_in_a_row = 0  # exponential open-period backoff
+        self.probe_inflight = False
+        # (ts, ok) outcomes; maxlen bounds memory even at rates where the
+        # time prune in _prune() lags (the rate check then covers the most
+        # recent 4096 outcomes within the window, which is plenty of volume)
+        self._window: deque = deque(maxlen=4096)
+
+    def _prune(self, now: float) -> None:
+        w = self._window
+        while w and now - w[0][0] > self.rate_window_s:
+            w.popleft()
+
+    def _rate_trips(self, now: float) -> bool:
+        self._prune(now)
+        w = self._window
+        if len(w) < self.rate_min_volume:
+            return False
+        errs = sum(1 for _, ok in w if not ok)
+        return errs / len(w) >= self.rate_threshold
+
+    def open_for_s(self) -> float:
+        return min(
+            self.cooldown_s * (2 ** max(0, self.opens_in_a_row - 1)),
+            self.max_open_s,
+        )
+
+    def record_ok(self, now: float) -> Optional[int]:
+        """Returns the new state on a transition, else None."""
+        self._prune(now)  # the success path must not grow the window forever
+        self._window.append((now, True))
+        self.fails = 0
+        self.probe_inflight = False
+        if self.state != BREAKER_CLOSED:
+            self.state = BREAKER_CLOSED
+            self.opens_in_a_row = 0
+            return BREAKER_CLOSED
+        return None
+
+    def record_failure(self, now: float) -> Optional[int]:
+        self._prune(now)
+        self._window.append((now, False))
+        self.fails += 1
+        self.probe_inflight = False
+        if self.state == BREAKER_HALF_OPEN:
+            # the probe failed: back to open, with a longer period
+            self.state = BREAKER_OPEN
+            self.opened_at = now
+            self.opens_in_a_row += 1
+            return BREAKER_OPEN
+        if (
+            self.state == BREAKER_CLOSED
+            and self.open_after
+            and (self.fails >= self.open_after or self._rate_trips(now))
+        ):
+            self.state = BREAKER_OPEN
+            self.opened_at = now
+            self.opens_in_a_row += 1
+            return BREAKER_OPEN
+        return None
+
+    def allow(self, now: float) -> bool:
+        """May a request be routed to this backend right now? Open ->
+        half-open happens here (time-based), admitting exactly one
+        probe until its outcome is reported."""
+        if self.state == BREAKER_CLOSED:
+            return True
+        if self.state == BREAKER_OPEN:
+            if now - self.opened_at >= self.open_for_s():
+                self.state = BREAKER_HALF_OPEN
+                self.probe_inflight = True
+                return True
+            return False
+        # half-open: one probe at a time
+        if not self.probe_inflight:
+            self.probe_inflight = True
+            return True
+        return False
+
+    def reset(self) -> None:
+        """Back to closed with a clean slate (a re-registered backend is
+        a new process — its predecessor's failures prove nothing)."""
+        self.state = BREAKER_CLOSED
+        self.fails = 0
+        self.opens_in_a_row = 0
+        self.probe_inflight = False
+        self._window.clear()
+
+
+class RetryBudget:
+    """Token bucket capping re-dispatch volume at ``ratio`` of recent
+    request volume (plus ``min_reserve`` so a cold gateway can still
+    retry at all). The containment property: under a brownout where
+    every request fails once, retries add at most ~``ratio`` extra
+    load instead of multiplying the storm by the attempt cap."""
+
+    def __init__(self, ratio: float = 0.2, window_s: float = 10.0,
+                 min_reserve: int = 3):
+        self.ratio = ratio
+        self.window_s = window_s
+        self.min_reserve = min_reserve
+        self._lock = threading.Lock()
+        self._requests: deque = deque()
+        self._retries: deque = deque()
+        self.exhausted = 0
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._requests and self._requests[0] < horizon:
+            self._requests.popleft()
+        while self._retries and self._retries[0] < horizon:
+            self._retries.popleft()
+
+    def _allowance(self) -> float:
+        return self.ratio * len(self._requests) + self.min_reserve
+
+    def note_request(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._requests.append(now)
+            self._prune(now)
+            self._update_gauge()
+
+    def try_spend(self) -> bool:
+        """One retry/hedge token, or False (the caller fails fast)."""
+        now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            if len(self._retries) >= self._allowance():
+                self.exhausted += 1
+                _M_RETRY_BUDGET_EXHAUSTED.inc()
+                self._update_gauge()
+                return False
+            self._retries.append(now)
+            self._update_gauge()
+            return True
+
+    def remaining_ratio(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            allowed = self._allowance()
+            if allowed <= 0.0:  # zero-token config: nothing to remain
+                return 0.0
+            return max(0.0, 1.0 - len(self._retries) / allowed)
+
+    def _update_gauge(self) -> None:
+        allowed = self._allowance()
+        _M_RETRY_BUDGET_RATIO.set(
+            0.0 if allowed <= 0.0
+            else round(max(0.0, 1.0 - len(self._retries) / allowed), 4)
+        )
 
 
 @dataclass(frozen=True)
@@ -89,32 +325,44 @@ class Backend:
 
 
 class BackendPool:
-    """Round-robin roster with failure cooldown + dead-entry eviction.
+    """Round-robin roster with per-backend circuit breakers.
 
-    A worker that fails ``evict_after`` consecutive times is marked DEAD:
-    registry refreshes skip it until its registration timestamp changes
-    (i.e. the worker actually re-registered) — a crashed worker's stale
-    ephemeral-port entry cannot keep adding failed-connect latency forever.
-    ``evict_after=0`` disables eviction — the right setting for a STATIC
-    pool (no registry refresh would ever revive an evicted backend);
-    cooldown alone then rate-limits attempts on a down worker, and
-    ``next()``'s cooled-down fallback lets it rejoin when it recovers.
+    Failure containment is a closed -> open -> half-open
+    :class:`CircuitBreaker` per backend (replacing the earlier binary
+    evict/revive logic): a backend that fails ``evict_after`` consecutive
+    times — or whose windowed error rate crosses the breaker threshold —
+    is OPEN and skipped entirely; after the open period one probe is
+    admitted, and its success closes the breaker. A roster refresh
+    carrying a **newer boot stamp** (the worker's per-process
+    ``ServiceInfo.boot``, constant across heartbeats) resets the breaker
+    immediately (the worker actually restarted — its predecessor's
+    failures prove nothing about the new process).
 
-    Statically configured backends (the constructor list) are pinned:
-    ``refresh`` merges them with the roster instead of replacing them.
+    Sub-threshold failures still set a ``cooldown_s`` cooldown that
+    deprioritizes (but doesn't exclude) the backend; ``next()`` falls
+    back to a cooled-down backend rather than refusing traffic.
+
+    ``evict_after=0`` disables breaker opens — the right setting for a
+    STATIC pool (constructor list; no registry to announce a restart),
+    where cooldown alone rate-limits attempts on a down worker. Static
+    backends are pinned: ``refresh`` merges them with the roster.
     """
 
     def __init__(
         self, backends: Optional[list] = None, cooldown_s: float = 5.0,
         evict_after: int = 3, models: Optional[dict] = None,
+        breaker_rate_threshold: float = 0.5,
+        breaker_rate_window_s: float = 30.0,
+        breaker_rate_min_volume: int = 10,
     ):
         self._lock = threading.Lock()
         self._static: list = list(backends or ())
         self._backends: list = list(self._static)
         self._cooldown: dict = {}
-        self._fails: dict = {}
-        self._dead: dict = {}    # backend -> roster stamp at eviction
-        self._stamps: dict = {}  # backend -> latest roster stamp
+        self._breakers: dict = {}  # backend -> CircuitBreaker
+        self._stamps: dict = {}    # backend -> latest roster stamp
+        self._breaker_stamps: dict = {}  # backend -> stamp when it opened
+        self._svc_ewma: dict = {}  # backend -> EWMA service seconds
         # backend -> frozenset of advertised model names (ModelStore
         # workers); a backend with no entry serves any model as far as
         # routing knows. Constructor-provided entries belong to static
@@ -125,6 +373,10 @@ class BackendPool:
         self._rr = 0
         self.cooldown_s = cooldown_s
         self.evict_after = evict_after
+        self._breaker_rate = (
+            breaker_rate_threshold, breaker_rate_window_s,
+            breaker_rate_min_volume,
+        )
         # per-backend pre-resolved label children: labels() does set
         # comparisons per call — too slow for the per-request report_ok
         self._m_by_backend: dict = {}
@@ -138,8 +390,39 @@ class BackendPool:
                 _M_BE_REQS.labels(backend=addr),
                 _M_BE_ERRS.labels(backend=addr),
                 _M_BE_EVICT.labels(backend=addr),
+                _M_BREAKER_STATE.labels(backend=addr),
+                _M_BE_BACKPRESSURE.labels(backend=addr),
             )
+            m[3].set(BREAKER_CLOSED)
         return m
+
+    def _breaker_for(self, b: Backend) -> CircuitBreaker:
+        br = self._breakers.get(b)
+        if br is None:
+            rate, window, volume = self._breaker_rate
+            br = self._breakers[b] = CircuitBreaker(
+                open_after=(
+                    0 if b in self._static else self.evict_after
+                ),
+                cooldown_s=self.cooldown_s,
+                rate_threshold=rate, rate_window_s=window,
+                rate_min_volume=volume,
+            )
+        return br
+
+    def _note_transition(self, b: Backend, state: Optional[int]) -> None:
+        if state is None:
+            return
+        m = self._metrics_for(b)
+        m[3].set(state)
+        _M_BREAKER_TRANSITIONS.labels(
+            backend=f"{b.host}:{b.port}", state=BREAKER_STATE_NAMES[state]
+        ).inc()
+        if state == BREAKER_OPEN:
+            m[2].inc()  # the eviction counter's successor event
+            log.warning(
+                "gateway: breaker OPEN for backend %s:%s", b.host, b.port
+            )
 
     def refresh(self, backends: list, stamps: Optional[dict] = None,
                 models: Optional[dict] = None) -> None:
@@ -147,19 +430,19 @@ class BackendPool:
             self._stamps = dict(stamps or {})
             if models is not None:
                 self._models = {**self._static_models, **models}
-            live = []
-            for b in self._static + [
+            live = self._static + [
                 b for b in backends if b not in self._static
-            ]:
-                dead_at = self._dead.get(b)
-                if dead_at is not None:
-                    if self._stamps.get(b, 0.0) > dead_at:
-                        # re-registered since eviction: give it another life
-                        del self._dead[b]
-                        self._fails.pop(b, None)
-                    else:
-                        continue
-                live.append(b)
+            ]
+            for b in live:
+                br = self._breakers.get(b)
+                if br is not None and br.state != BREAKER_CLOSED:
+                    opened_stamp = self._breaker_stamps.get(b, 0.0)
+                    if self._stamps.get(b, 0.0) > opened_stamp:
+                        # the worker re-registered since the breaker
+                        # opened: a NEW process — close immediately
+                        br.reset()
+                        self._note_transition(b, BREAKER_CLOSED)
+                        self._cooldown.pop(b, None)
             self._backends = live
             self._cooldown = {
                 b: t for b, t in self._cooldown.items() if b in self._backends
@@ -171,26 +454,65 @@ class BackendPool:
             for b in [x for x in self._m_by_backend if x not in live]:
                 del self._m_by_backend[b]
                 addr = f"{b.host}:{b.port}"
-                for fam in (_M_BE_REQS, _M_BE_ERRS, _M_BE_EVICT):
+                for fam in (_M_BE_REQS, _M_BE_ERRS, _M_BE_EVICT,
+                            _M_BREAKER_STATE, _M_BE_BACKPRESSURE):
                     fam.remove(backend=addr)
+            for b in [x for x in self._breakers if x not in live]:
+                del self._breakers[b]
+                self._breaker_stamps.pop(b, None)
+                self._svc_ewma.pop(b, None)
             for b in [x for x in self._models if x not in live]:
                 del self._models[b]
-            _M_GW_BACKENDS.set(len(self._backends))
+            _M_GW_BACKENDS.set(self._routable_locked())
+
+    def _routable_locked(self) -> int:
+        now = time.monotonic()
+        n = 0
+        for b in self._backends:
+            br = self._breakers.get(b)
+            if br is None or br.state != BREAKER_OPEN or (
+                now - br.opened_at >= br.open_for_s()
+            ):
+                n += 1
+        return n
 
     def size(self) -> int:
+        """Routable backends: roster members whose breaker would admit
+        traffic right now (closed, half-open, or open-period elapsed)."""
         with self._lock:
-            return len(self._backends)
+            return self._routable_locked()
 
     def members(self) -> list:
-        """Snapshot of the live backends (for cache pruning)."""
+        """Snapshot of the rostered backends (for cache pruning)."""
         with self._lock:
             return list(self._backends)
 
+    def breaker_states(self) -> dict:
+        """{'host:port': 'closed'|'open'|'half_open'} — /health payload
+        and ``fleet top``'s BREAKER column source."""
+        with self._lock:
+            return {
+                f"{b.host}:{b.port}": BREAKER_STATE_NAMES[
+                    self._breakers[b].state
+                    if b in self._breakers else BREAKER_CLOSED
+                ]
+                for b in self._backends
+            }
+
+    def svc_ewma_s(self, b: Backend) -> float:
+        """EWMA service time of successful forwards to ``b`` (0 while
+        unmeasured) — the deadline check's 'can this backend even answer
+        in time' estimate."""
+        with self._lock:
+            return self._svc_ewma.get(b, 0.0)
+
     def next(self, exclude: Optional[set] = None,
              model: Optional[str] = None) -> Optional[Backend]:
-        """The next live backend, skipping cooled-down and ``exclude``d
-        ones; falls back to a cooled-down backend rather than none (it may
-        have recovered — better one retry than a refused request).
+        """The next routable backend, skipping open-breaker, cooled-down
+        and ``exclude``d ones; falls back to a cooled-down backend rather
+        than none (it may have recovered — better one retry than a
+        refused request). An open breaker whose open period elapsed
+        admits ONE half-open probe here.
 
         ``model``: prefer backends advertising that model name; when no
         advertiser is available the pick falls back to the whole pool
@@ -214,6 +536,18 @@ class BackendPool:
                 advertised = self._models.get(b)
                 if advertised is not None and model not in advertised:
                     continue
+            br = self._breakers.get(b)
+            if br is not None and br.state != BREAKER_CLOSED:
+                was = br.state
+                if not br.allow(now):
+                    continue  # open: no traffic, not even as fallback
+                if was == BREAKER_OPEN and br.state == BREAKER_HALF_OPEN:
+                    # a re-admitted probe slot (report_abandoned returned
+                    # it with the breaker already half-open) is NOT a new
+                    # transition — count only the open -> half-open edge
+                    self._note_transition(b, BREAKER_HALF_OPEN)
+                self._rr = (self._rr + i + 1) % n
+                return b  # the half-open probe
             if self._cooldown.get(b, 0.0) > now:
                 fallback = fallback or b
                 continue
@@ -222,25 +556,63 @@ class BackendPool:
         return fallback
 
     def report_failure(self, b: Backend) -> None:
+        """A connection-level failure (refused, reset, timeout, torn
+        response) — the breaker's signal. NOT for 429 sheds: those are
+        :meth:`report_backpressure` (a shedding replica is alive and
+        correct; evicting it shrinks the pool exactly when capacity is
+        lowest)."""
         self._metrics_for(b)[1].inc()
         with self._lock:
             self._cooldown[b] = time.monotonic() + self.cooldown_s
-            self._fails[b] = self._fails.get(b, 0) + 1
-            if (
-                self.evict_after
-                and self._fails[b] >= self.evict_after
-                and b not in self._static  # static backends only cool down
-            ):
-                self._dead[b] = self._stamps.get(b, 0.0)
-                self._backends = [x for x in self._backends if x != b]
-                self._metrics_for(b)[2].inc()
-                _M_GW_BACKENDS.set(len(self._backends))
+            br = self._breaker_for(b)
+            was_closed = br.state == BREAKER_CLOSED
+            transition = br.record_failure(time.monotonic())
+            if transition == BREAKER_OPEN and was_closed:
+                self._breaker_stamps[b] = self._stamps.get(b, 0.0)
+            if transition is not None:
+                self._note_transition(b, transition)
+                _M_GW_BACKENDS.set(self._routable_locked())
 
-    def report_ok(self, b: Backend) -> None:
+    def report_ok(self, b: Backend, elapsed_s: Optional[float] = None) -> None:
         self._metrics_for(b)[0].inc()
         with self._lock:
             self._cooldown.pop(b, None)
-            self._fails.pop(b, None)
+            br = self._breakers.get(b)
+            if br is not None:
+                transition = br.record_ok(time.monotonic())
+                if transition is not None:
+                    self._note_transition(b, transition)
+                    _M_GW_BACKENDS.set(self._routable_locked())
+            if elapsed_s is not None:
+                prev = self._svc_ewma.get(b)
+                self._svc_ewma[b] = (
+                    elapsed_s if prev is None
+                    else 0.8 * prev + 0.2 * elapsed_s
+                )
+
+    def report_backpressure(self, b: Backend) -> None:
+        """The backend answered 429 (admission shed): it is alive and
+        protecting itself — close a half-open breaker, clear the failure
+        streak, but record nothing that could open one."""
+        self._metrics_for(b)[4].inc()
+        with self._lock:
+            br = self._breakers.get(b)
+            if br is not None:
+                transition = br.record_ok(time.monotonic())
+                if transition is not None:
+                    self._note_transition(b, transition)
+                    _M_GW_BACKENDS.set(self._routable_locked())
+
+    def report_abandoned(self, b: Backend) -> None:
+        """``next()`` admitted ``b`` but no outcome will ever be reported
+        (deadline fast-fail, unfired hedge, cancelled loser, post-send
+        timeout with no blame). If ``b`` held the half-open probe slot,
+        return it — otherwise the breaker waits forever for a probe
+        outcome that never comes and the backend stays unroutable."""
+        with self._lock:
+            br = self._breakers.get(b)
+            if br is not None and br.state == BREAKER_HALF_OPEN:
+                br.probe_inflight = False
 
 
 class ServingGateway:
@@ -277,7 +649,24 @@ class ServingGateway:
         max_attempts: Optional[int] = None,
         evict_after: Optional[int] = None,
         retry_after_send: bool = False,
+        hedge_ms: Optional[float] = None,
+        retry_budget_ratio: float = 0.2,
+        retry_budget_window_s: float = 10.0,
+        retry_budget_min: int = 3,
     ):
+        """``hedge_ms``: tail-latency hedging — a request still pending
+        after this many ms is duplicated to a second backend, first
+        answer wins, the loser is cancelled. ``hedge_ms=0`` derives the
+        delay from the observed forward-latency p95 (re-estimated as
+        traffic flows). Hedges duplicate execution post-send, so enable
+        it only for idempotent handlers; every hedge spends a retry-
+        budget token, so hedging can never amplify a brownout.
+
+        ``retry_budget_*``: a token bucket capping re-dispatches (and
+        hedges) at ``ratio`` of the request volume over ``window_s``
+        (plus ``min`` reserve tokens). An exhausted budget fails fast
+        with ``x-mmlspark-retry-budget: exhausted`` instead of retrying
+        a storm into the floor."""
         self.service_name = service_name
         self._ingress = WorkerServer(
             host=host, port=port, name=f"{service_name}-gateway"
@@ -314,6 +703,21 @@ class ServingGateway:
         self.forwarded = 0
         self.retried = 0
         self.failed = 0
+        self.hedged = 0
+        self.hedge_wins = 0
+        self._hedge_ms = hedge_ms
+        self._retry_budget = RetryBudget(
+            ratio=retry_budget_ratio, window_s=retry_budget_window_s,
+            min_reserve=retry_budget_min,
+        )
+        # forward-latency reservoir for the auto-derived (hedge_ms=0)
+        # hedge delay: p95 of recent successful forwards. Locked: the
+        # dispatcher threads record concurrently, and sorting a deque
+        # another thread is appending to raises RuntimeError
+        self._fwd_lat_ns: deque = deque(maxlen=512)
+        self._fwd_lat_lock = threading.Lock()
+        self._fwd_lat_count = 0
+        self._hedge_auto_ms = 50.0  # until measured
         # optional in-process SLO engine (fleet.run_gateway attaches one);
         # owned here so stop() tears it down with the dispatchers
         self.slo_engine: Any = None
@@ -408,8 +812,15 @@ class ServingGateway:
         if infos:
             self._pool.refresh(
                 [Backend.from_info(i) for i in infos],
+                # restart detection keys on the worker's per-process
+                # "boot" stamp, NOT the registry "ts": heartbeats bump
+                # ts every beat, so a wedged-but-heartbeating worker
+                # would reset its own open breaker within one refresh.
+                # Pre-boot-stamp workers (no field) map to 0.0 — never
+                # "newer", so their breakers recover only through the
+                # half-open probe, which is the safe degradation
                 stamps={
-                    Backend.from_info(i): float(i.get("ts") or 0.0)
+                    Backend.from_info(i): float(i.get("boot") or 0.0)
                     for i in infos
                 },
                 models={
@@ -443,6 +854,11 @@ class ServingGateway:
                 "forwarded": self.forwarded,
                 "retried": self.retried,
                 "failed": self.failed,
+                "hedged": self.hedged,
+                "breakers": self._pool.breaker_states(),
+                "retry_budget_remaining": round(
+                    self._retry_budget.remaining_ratio(), 4
+                ),
             }
         ).encode()
         self._ingress.reply_to(
@@ -579,11 +995,54 @@ class ServingGateway:
                 return parts[0]
         return None
 
+    def _target_for(self, req, b) -> str:
+        """Preserve the request's own path (the /models/<name> data and
+        control routes must survive the hop); a worker registered under
+        a base path gets it prefixed."""
+        return (
+            req.path if b.path in ("", "/")
+            else b.path.rstrip("/") + (
+                req.path if req.path.startswith("/") else "/" + req.path
+            )
+        )
+
+    def _fail(self, req, reason: str, code: int, body: bytes,
+              headers: Optional[dict] = None) -> None:
+        self.failed += 1
+        _M_GW_FAILED.labels(reason=reason).inc()
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        self._reply(req, body, code, hdrs)
+
+    @staticmethod
+    def _remaining_ms(req, deadline_ms: Optional[float]) -> Optional[float]:
+        """What is left of the client's deadline after the time this
+        request already burned at the gateway (queue wait + connects +
+        prior attempts — everything since ingress arrival)."""
+        if deadline_ms is None:
+            return None
+        return deadline_ms - (time.perf_counter_ns() - req.arrival_ns) / 1e6
+
+    def _note_fwd_latency(self, elapsed_s: float) -> None:
+        with self._fwd_lat_lock:
+            lat = self._fwd_lat_ns
+            lat.append(elapsed_s)
+            self._fwd_lat_count += 1
+            # re-derive every 32 OBSERVATIONS (len(lat) stalls at maxlen,
+            # so a len-based stride would sort on every call once full)
+            if self._hedge_ms == 0 and self._fwd_lat_count % 32 == 0:
+                arr = sorted(lat)
+                self._hedge_auto_ms = max(
+                    1.0, arr[min(len(arr) - 1, int(len(arr) * 0.95))] * 1e3
+                )
+
     def _forward(self, req) -> None:
         attempts = self._max_attempts or max(2, self._pool.size() + 1)
         tried: set = set()
         model = self._model_of(req)
         not_ready = None  # last worker-local model-loading 503, if any
+        backpressured = None  # last 429 shed, relayed when nothing admits
         headers = {
             k: v for k, v in req.headers.items()
             if k.lower() not in self._SKIP_HEADERS
@@ -600,20 +1059,74 @@ class ServingGateway:
         # has real edges across all three layers
         root_sid = obs.new_span_id()
         req.headers[self._ROOT_SPAN_KEY] = root_sid
+        self._retry_budget.note_request()
+        deadline_ms = deadline_ms_from(req.headers)
+        if self._hedge_ms is not None:
+            replied, hedge_tried, h_not_ready, h_shed = self._forward_hedged(
+                req, headers, model, trace_id, root_sid, deadline_ms
+            )
+            if replied:
+                return
+            if hedge_tried:
+                # the hedged attempts concluded without a good answer
+                # (failures, sheds, model-not-ready): continuing is a
+                # retry and pays the retry budget like any other
+                # re-dispatch. The stashed worker answers seed the relay
+                # fallbacks below so a shed still relays as a 429 (not a
+                # budget 503) when nothing better admits.
+                not_ready, backpressured = h_not_ready, h_shed
+                if not self._retry_budget.try_spend():
+                    if not_ready is None and backpressured is None:
+                        self._fail(
+                            req, "retry_budget", 503,
+                            b'{"error": "retry budget exhausted"}',
+                            {RETRY_BUDGET_HEADER: "exhausted"},
+                        )
+                        return
+                    # the budget refused the re-dispatch but the fleet is
+                    # alive (it shed / is still loading): skip straight
+                    # to relaying the worker's own answer
+                    attempts = 0
+                else:
+                    tried |= hedge_tried
+                    self.retried += 1
+                    _M_GW_RETRIES.inc()
         for attempt in range(attempts):
+            remaining_ms = self._remaining_ms(req, deadline_ms)
+            if remaining_ms is not None and remaining_ms <= 0:
+                # the budget is already burned (dead backend attempts,
+                # queue wait): answering 504 now beats forwarding a
+                # request whose client has given up
+                self._fail(
+                    req, "deadline", 504,
+                    b'{"error": "deadline expired at gateway"}',
+                )
+                return
             b = self._pool.next(exclude=tried, model=model)
             if b is None:
                 break
-            # preserve the request's own path (the /models/<name> data and
-            # control routes must survive the hop); a worker registered
-            # under a base path gets it prefixed
-            target = (
-                req.path if b.path in ("", "/")
-                else b.path.rstrip("/") + (
-                    req.path if req.path.startswith("/") else "/" + req.path
-                )
-            )
+            if remaining_ms is not None:
+                if tried or attempt:
+                    # retrying: don't bother when the leftover budget
+                    # cannot even cover this backend's typical service
+                    # time — fail fast instead of a doomed forward
+                    ewma_ms = self._pool.svc_ewma_s(b) * 1e3
+                    if ewma_ms > 0.0 and remaining_ms < ewma_ms:
+                        # b was admitted (possibly as the half-open probe)
+                        # but never contacted — give the slot back
+                        self._pool.report_abandoned(b)
+                        self._fail(
+                            req, "deadline", 504,
+                            b'{"error": "remaining deadline below backend '
+                            b'service time"}',
+                        )
+                        return
+                # true deadline propagation: the worker sees what is
+                # LEFT, not the client's original budget
+                headers[DEADLINE_HEADER] = f"{remaining_ms:.1f}"
+            target = self._target_for(req, b)
             sent = False
+            t_attempt = time.perf_counter()
             try:
                 # fault point gateway.forward: an injected OSError here is
                 # indistinguishable from a worker that died before the
@@ -679,25 +1192,59 @@ class ServingGateway:
                     # the worker may be mid-execution (slow, not dead):
                     # re-dispatching would double-process a non-idempotent
                     # POST, and cooling down a healthy-but-slow worker
-                    # would starve the pool — fail this request instead
-                    self.failed += 1
-                    _M_GW_FAILED.labels(reason="post_send_timeout").inc()
-                    self._reply(
-                        req,
-                        b'{"error": "worker timed out after request was sent"}',
-                        504, {"Content-Type": "application/json"},
+                    # would starve the pool — fail this request instead.
+                    # No outcome is reported against b, so a half-open
+                    # probe slot it held must be returned explicitly
+                    self._pool.report_abandoned(b)
+                    self._fail(
+                        req, "post_send_timeout", 504,
+                        b'{"error": "worker timed out after request was '
+                        b'sent"}',
                     )
                     return
                 # the cross-worker replay: this worker is down or died
                 # mid-request (refused connect OR a half-written response
                 # — IncompleteRead/BadStatusLine are HTTPException, not
-                # OSError); cool it down and re-dispatch elsewhere
+                # OSError); cool it down and re-dispatch elsewhere — IF
+                # the retry budget still has tokens. An exhausted budget
+                # fails fast: under a brownout, every request retrying
+                # its full attempt tab multiplies the offered load
+                # exactly when capacity is lowest
                 tried.add(b)
                 self._pool.report_failure(b)
+                if not self._retry_budget.try_spend():
+                    self._fail(
+                        req, "retry_budget", 503,
+                        b'{"error": "backend failed and retry budget '
+                        b'exhausted"}',
+                        {RETRY_BUDGET_HEADER: "exhausted"},
+                    )
+                    return
                 self.retried += 1
                 _M_GW_RETRIES.inc()
                 continue
-            self._pool.report_ok(b)
+            elapsed_s = time.perf_counter() - t_attempt
+            if resp.status == 429 and resp.getheader(SHED_HEADER):
+                # the replica is load-shedding (admission control), not
+                # failing: classify as backpressure — cooling it down or
+                # opening its breaker would shrink the pool under
+                # overload, the exact wrong direction. Another replica
+                # may have headroom, so re-dispatch (against the retry
+                # budget); when nothing admits, relay the shed
+                self._pool.report_backpressure(b)
+                if backpressured is None:
+                    backpressured = (
+                        body, resp.getheader("Content-Type"),
+                        resp.getheader("Retry-After"),
+                    )
+                if attempt + 1 < attempts and self._retry_budget.try_spend():
+                    tried.add(b)
+                    self.retried += 1
+                    _M_GW_RETRIES.inc()
+                    continue
+                break
+            self._pool.report_ok(b, elapsed_s=elapsed_s)
+            self._note_fwd_latency(elapsed_s)
             if (
                 resp.status in (503, 404)
                 and resp.getheader("x-mmlspark-model-state")
@@ -713,6 +1260,7 @@ class ServingGateway:
                 if not_ready is None or resp.status == 503:
                     not_ready = (
                         resp.status, body, resp.getheader("Content-Type"),
+                        resp.getheader("x-mmlspark-model-state"),
                     )
                 tried.add(b)
                 self.retried += 1
@@ -729,13 +1277,27 @@ class ServingGateway:
         if not_ready is not None:
             # every candidate said "model still loading here": relay the
             # worker's own 503 (clients with a retrying handler back off)
-            status, body, ct = not_ready
+            status, body, ct, model_state = not_ready
             self.failed += 1
             _M_GW_FAILED.labels(reason="model_not_ready").inc()
-            self._reply(
-                req, body, status,
-                {"Content-Type": ct} if ct else None,
-            )
+            hdrs = {"x-mmlspark-model-state": model_state}
+            if ct:
+                hdrs["Content-Type"] = ct
+            self._reply(req, body, status, hdrs)
+            return
+        if backpressured is not None:
+            # every candidate (or the retry budget) declined: relay the
+            # worker's own 429 so the client's Retry-After backoff kicks
+            # in — the fleet is alive, just at capacity
+            body, ct, retry_after = backpressured
+            self.failed += 1
+            _M_GW_FAILED.labels(reason="backpressure").inc()
+            hdrs = {SHED_HEADER: "admission"}
+            if ct:
+                hdrs["Content-Type"] = ct
+            if retry_after:
+                hdrs["Retry-After"] = retry_after
+            self._reply(req, body, 429, hdrs)
             return
         self.failed += 1
         _M_GW_FAILED.labels(reason="no_backends").inc()
@@ -743,3 +1305,243 @@ class ServingGateway:
             req, b'{"error": "no live serving workers"}', 503,
             {"Content-Type": "application/json"},
         )
+
+    # -- tail hedging ---------------------------------------------------------
+
+    def _forward_hedged(self, req, headers: dict, model, trace_id,
+                        root_sid, deadline_ms) -> tuple:
+        """Hedged dispatch: send to one backend; if no answer within the
+        hedge delay, duplicate to a second backend (spending a retry-
+        budget token; fault point ``gateway.hedge`` fires as it launches)
+        and take whichever answers first, cancelling the loser by
+        closing its socket.
+
+        First *good* answer wins: a 429 shed or a model-state 503/404 is
+        classified (backpressure / not-ready), stashed while the other
+        attempt may still answer, and relayed — counted as
+        ``failed{backpressure|model_not_ready}`` — only when nothing
+        better arrives.
+
+        Returns ``(replied, tried_backends, not_ready, backpressured)``:
+        ``replied=True`` means the client was answered here; otherwise
+        ``tried_backends`` (every attempt with a concluded outcome —
+        failed, shed, or model-not-ready) seeds the standard retry
+        loop's exclusion set, and the stashed ``not_ready`` /
+        ``backpressured`` worker answers seed its relay fallbacks.
+        Hedged attempts use fresh connections (not the per-thread
+        keep-alive cache — they run on short-lived helper threads)."""
+        if self._pool.size() < 2:
+            return False, set(), None, None  # nothing to hedge against
+        b1 = self._pool.next(model=model)
+        if b1 is None:
+            return False, set(), None, None
+        remaining_ms = self._remaining_ms(req, deadline_ms)
+        if remaining_ms is not None:
+            if remaining_ms <= 0:
+                # b1 was admitted (possibly as the half-open probe) but
+                # never contacted — give the slot back before failing
+                self._pool.report_abandoned(b1)
+                self._fail(
+                    req, "deadline", 504,
+                    b'{"error": "deadline expired at gateway"}',
+                )
+                return True, set(), None, None
+            headers = dict(headers)
+            headers[DEADLINE_HEADER] = f"{remaining_ms:.1f}"
+        results: Any = queue_mod.Queue()
+        conns: dict = {}
+
+        def attempt(tag: str, b) -> None:
+            t0 = time.perf_counter()
+            try:
+                faults.inject(
+                    "gateway.forward",
+                    context={"backend": (b.host, b.port), "attempt": tag},
+                )
+                conn = http.client.HTTPConnection(
+                    b.host, b.port, timeout=self._timeout
+                )
+                conns[tag] = conn
+                hdrs = dict(headers)
+                ctx = (
+                    obs.span(
+                        "gateway.forward", trace_id=trace_id,
+                        parent_id=root_sid,
+                        attrs={"backend": f"{b.host}:{b.port}",
+                               "attempt": tag},
+                    )
+                    if _M_GW_LATENCY._on
+                    else contextlib.nullcontext()
+                )
+                with ctx as fsp:
+                    if fsp is not None:
+                        hdrs[obs.PARENT_HEADER] = fsp.span_id
+                    conn.request(
+                        req.method, self._target_for(req, b),
+                        body=req.body, headers=hdrs,
+                    )
+                    faults.inject(
+                        "gateway.response",
+                        context={"backend": (b.host, b.port),
+                                 "attempt": tag},
+                    )
+                    resp = conn.getresponse()
+                    body = resp.read()
+                results.put(
+                    (tag, b, resp, body, time.perf_counter() - t0, None)
+                )
+            except Exception as e:  # noqa: BLE001 — relayed via the queue
+                results.put(
+                    (tag, b, None, None, time.perf_counter() - t0, e)
+                )
+
+        threading.Thread(
+            target=attempt, args=("primary", b1), daemon=True,
+        ).start()
+        launched = {"primary": b1}
+        hedge_s = (
+            (self._hedge_ms if self._hedge_ms else self._hedge_auto_ms)
+            / 1e3
+        )
+        first = None
+        try:
+            first = results.get(timeout=hedge_s)
+        except queue_mod.Empty:
+            # still pending past the hedge delay: fire the duplicate
+            b2 = self._pool.next(exclude={b1}, model=model)
+            if b2 is not None and self._retry_budget.try_spend():
+                try:
+                    faults.inject(
+                        "gateway.hedge",
+                        context={"backend": (b2.host, b2.port)},
+                    )
+                    self.hedged += 1
+                    _M_HEDGES.inc()
+                    threading.Thread(
+                        target=attempt, args=("hedge", b2), daemon=True,
+                    ).start()
+                    launched["hedge"] = b2
+                except Exception:  # injected fault: hedge suppressed
+                    self._pool.report_abandoned(b2)
+            elif b2 is not None:
+                # admitted by next() but the retry budget refused the
+                # hedge: b2 never sees the request — return its slot
+                self._pool.report_abandoned(b2)
+        failed: set = set()
+        reported: set = set()  # backends whose outcome reached the pool
+        backpressured = None  # stashed 429 shed: (body, ct, retry_after)
+        not_ready = None  # stashed model-state reply: (status, body, ct, st)
+        concluded = 0  # attempts with a terminal outcome
+        replied = False
+        end_t = time.monotonic() + self._timeout + 5.0
+        while concluded < len(launched):
+            if first is None:
+                try:
+                    first = results.get(
+                        timeout=max(0.05, end_t - time.monotonic())
+                    )
+                except queue_mod.Empty:
+                    break  # every remaining attempt is hung
+            tag, b, resp, body, elapsed, err = first
+            first = None
+            concluded += 1
+            if err is not None or resp is None:
+                failed.add(b)
+                reported.add(b)
+                self._pool.report_failure(b)
+                continue  # the other attempt may still answer
+            if resp.status == 429 and resp.getheader(SHED_HEADER):
+                # the replica is load-shedding, not failing: backpressure,
+                # never a winner while the other attempt may still answer
+                # — stash the shed for relay when nothing better arrives
+                reported.add(b)
+                self._pool.report_backpressure(b)
+                if backpressured is None:
+                    backpressured = (
+                        body, resp.getheader("Content-Type"),
+                        resp.getheader("Retry-After"),
+                    )
+                continue
+            model_state = resp.getheader("x-mmlspark-model-state")
+            if resp.status in (503, 404) and model_state:
+                # healthy worker, model still loading/unknown HERE: the
+                # other attempt may already serve it — stash and wait
+                # (prefer a loading 503 over an unknown 404)
+                reported.add(b)
+                self._pool.report_ok(b, elapsed_s=elapsed)
+                if not_ready is None or resp.status == 503:
+                    not_ready = (
+                        resp.status, body,
+                        resp.getheader("Content-Type"), model_state,
+                    )
+                continue
+            # first good answer wins
+            reported.add(b)
+            self._pool.report_ok(b, elapsed_s=elapsed)
+            self._note_fwd_latency(elapsed)
+            if tag == "hedge":
+                self.hedge_wins += 1
+                _M_HEDGE_WINS.inc()
+            self.forwarded += 1
+            _M_GW_FORWARDED.inc()
+            out_headers = {}
+            ct = resp.getheader("Content-Type")
+            if ct:
+                out_headers["Content-Type"] = ct
+            self._reply(req, body, resp.status, out_headers)
+            replied = True
+            break
+        # cancel whatever is still in flight (the loser's blocked read
+        # raises when its socket closes; its queued result is ignored
+        # and never reported against the backend) — and return the
+        # half-open probe slot of any attempt that got no outcome report,
+        # or its breaker waits forever for a probe that never concludes
+        for conn in conns.values():
+            with contextlib.suppress(OSError):
+                conn.close()
+        for b in launched.values():
+            if b not in reported:
+                self._pool.report_abandoned(b)
+        if replied:
+            return True, failed, None, None
+        if concluded == len(launched) and (
+            failed or not_ready is not None or backpressured is not None
+        ):
+            # every attempt concluded without a good answer — genuine
+            # failures, 429 sheds, or model-not-ready: hand off to the
+            # standard retry loop so ANOTHER replica gets a chance
+            # (relaying a fast shed or loading-503 here would skip the
+            # non-hedged loop's cross-replica retry). The stashes ride
+            # along so the loop can still relay the worker's own answer
+            # when nothing else admits.
+            return False, set(reported), not_ready, backpressured
+        if not_ready is not None:
+            # every attempt said "model still loading here": relay the
+            # worker's own answer (with its model-state evidence) and
+            # count it as the failure it is, not a forward
+            status, body, ct, model_state = not_ready
+            self.failed += 1
+            _M_GW_FAILED.labels(reason="model_not_ready").inc()
+            hdrs = {"x-mmlspark-model-state": model_state}
+            if ct:
+                hdrs["Content-Type"] = ct
+            self._reply(req, body, status, hdrs)
+        elif backpressured is not None:
+            # every attempt shed (or failed): relay the 429 so the
+            # client's Retry-After backoff kicks in — the fleet is
+            # alive, just at capacity
+            body, ct, retry_after = backpressured
+            self.failed += 1
+            _M_GW_FAILED.labels(reason="backpressure").inc()
+            hdrs = {SHED_HEADER: "admission"}
+            if ct:
+                hdrs["Content-Type"] = ct
+            if retry_after:
+                hdrs["Retry-After"] = retry_after
+            self._reply(req, body, 429, hdrs)
+        else:
+            self._fail(
+                req, "post_send_timeout", 504,
+                b'{"error": "hedged attempts timed out"}',
+            )
+        return True, failed, None, None

@@ -10,6 +10,8 @@ gateway). Each role is one process:
         --registry http://registry:9090/ --model zoo:ResNet8_Digits
     python -m mmlspark_tpu.serving.fleet gateway \
         --registry http://registry:9090/ --port 8080
+    python -m mmlspark_tpu.serving.fleet supervise \
+        --registry http://registry:9090/ --worker "--model echo --port 9101"
 
 Workers register with the driver registry on start and heartbeat by
 re-registering; the gateway discovers them by polling the registry
@@ -138,6 +140,8 @@ def run_worker(
     slo_availability: float = 0.999,
     slo_p99_ms: Optional[float] = 250.0,
     slo_interval_s: float = 15.0,
+    admission: bool = True,
+    admission_initial_limit: int = 32,
 ) -> tuple:
     """Start a ModelStore-backed worker, register it, and re-register on a
     heartbeat thread (a restarted registry re-learns live workers within
@@ -151,7 +155,12 @@ def run_worker(
     for probes that want to see it. ``extra_models``: additional
     ``name=spec`` entries loaded (also pre-registration) for multi-model
     serving; all names are advertised on the roster for model-aware
-    gateway routing."""
+    gateway routing.
+
+    ``admission`` (default on): attach an adaptive-concurrency
+    :class:`~mmlspark_tpu.serving.admission.AdmissionController` — the
+    AIMD in-flight limit that sheds 429 + Retry-After at ingress instead
+    of queueing past every deadline (docs/robustness.md)."""
     from mmlspark_tpu.serving.modelstore import (
         ModelDispatcher,
         ModelStore,
@@ -178,9 +187,19 @@ def run_worker(
         specs.append((name, spec))
     for name, spec in specs:
         store.load(name, spec, wait=True)  # warm BEFORE registering
+    ctrl = None
+    if admission:
+        # adaptive-concurrency shed at ingress (serving/admission.py):
+        # beyond the AIMD in-flight limit, requests get a fast 429 +
+        # Retry-After instead of joining a queue past every deadline
+        from mmlspark_tpu.serving.admission import AdmissionController
+
+        ctrl = AdmissionController(
+            server=service_name, initial_limit=admission_initial_limit
+        )
     q = ModelDispatcher(
         srv, store, default_model=specs[0][0] if specs else None,
-        default_deadline_ms=default_deadline_ms,
+        default_deadline_ms=default_deadline_ms, admission=ctrl,
     ).start()
     import dataclasses
 
@@ -374,13 +393,33 @@ def run_top(
             else slo_mod.STATUS_NAMES.get(status, "?")
         )
 
-    lines = notes + [
+    title = (
         f"fleet top — service {service_name!r}, {len(endpoints)} worker(s)"
-    ]
+    )
+    if registry_url:
+        # fleet supervise status (when a supervisor is registered) rides
+        # the header line — the "is anything auto-healing?" glance
+        sup = supervisor_status_from_registry(registry_url, service_name)
+        if sup:
+            title += f" — {sup}"
+    lines = notes + [title]
+    # the gateway scrape feeds BOTH its own summary line and the
+    # per-worker BREAKER column (breaker state lives in the gateway —
+    # it is the gateway's verdict about each backend)
+    gw_parsed = scrape_metrics(gateway_url) if gateway_url else None
+    breaker_names = {0: "closed", 1: "OPEN", 2: "half_open"}
+    breakers: dict = {}
+    if gw_parsed is not None:
+        for (name, labels), v in gw_parsed.items():
+            if name == "mmlspark_gateway_breaker_state":
+                breakers[dict(labels).get("backend", "")] = (
+                    breaker_names.get(int(v), "?")
+                )
     hdr = (
         f"{'WORKER':<26} {'ACCEPT':>8} {'QDEPTH':>7} {'ERR':>5} "
         f"{'ERR_PCT':>7} {'QWAIT_P50_MS':>13} {'LAT_P50_MS':>11} "
-        f"{'LAT_P99_MS':>11} {'BATCH_AVG':>10} {'SLO':>6}"
+        f"{'LAT_P99_MS':>11} {'BATCH_AVG':>10} {'INFL/LIM':>9} "
+        f"{'BREAKER':>9} {'SLO':>6}"
     )
     lines.append(hdr)
     tot_accept = 0.0
@@ -408,15 +447,35 @@ def run_top(
         _, batch_avg, _ = _hist_stats(
             parsed, "mmlspark_serving_batch_size_requests", m
         )
+        # adaptive-concurrency cell: a pre-PR-5 worker (or --no-admission)
+        # exports no admission gauges FOR THIS SERVICE — show '-', don't
+        # invent zeros (label-matched: a co-located process may export
+        # another server's admission series)
+        has_adm = any(
+            name == "mmlspark_admission_limit_requests"
+            and ("server", service_name) in labels
+            for (name, labels) in parsed
+        )
+        if has_adm:
+            infl = obs.sum_samples(
+                parsed, "mmlspark_admission_inflight_requests", m
+            )
+            lim = obs.sum_samples(
+                parsed, "mmlspark_admission_limit_requests", m
+            )
+            adm_cell = f"{infl:.0f}/{lim:.0f}"
+        else:
+            adm_cell = "-"
         tot_accept += accept
         lines.append(
             f"{addr:<26} {accept:>8.0f} {qdepth:>7.0f} {errs:>5.0f} "
             f"{err_pct:>7.2f} {qwait_p50 * 1e3:>13.2f} "
             f"{lat_p50 * 1e3:>11.2f} {lat_p99 * 1e3:>11.2f} "
-            f"{batch_avg:>10.1f} {slo_cell(parsed):>6}"
+            f"{batch_avg:>10.1f} {adm_cell:>9} "
+            f"{breakers.get(addr, '-'):>9} {slo_cell(parsed):>6}"
         )
     if gateway_url:
-        parsed = scrape_metrics(gateway_url)
+        parsed = gw_parsed
         addr = gateway_url.rstrip("/").split("//", 1)[-1]
         if parsed is None:
             lines.append(f"gateway {addr}: DOWN")
@@ -434,11 +493,26 @@ def run_top(
             lat_p50, _, lat_p99 = _hist_stats(
                 parsed, "mmlspark_gateway_request_latency_seconds"
             )
+            containment = ""
+            if breakers:
+                n_open = sum(1 for s in breakers.values() if s != "closed")
+                budget = obs.sum_samples(
+                    parsed, "mmlspark_gateway_retry_budget_remaining_ratio"
+                )
+                hedges = obs.sum_samples(
+                    parsed, "mmlspark_gateway_hedges_total"
+                )
+                containment = (
+                    f", breakers {n_open}/{len(breakers)} open, "
+                    f"retry budget {budget * 100:.0f}%"
+                    + (f", hedges {hedges:.0f}" if hedges else "")
+                )
             lines.append(
                 f"gateway {addr}: accepted {accepted:.0f}, forwarded "
                 f"{fwd:.0f}, retried {retried:.0f}, failed {failed:.0f}, "
                 f"backends {backends:.0f}, p50 {lat_p50 * 1e3:.2f} ms, "
-                f"p99 {lat_p99 * 1e3:.2f} ms, slo {slo_cell(parsed)}"
+                f"p99 {lat_p99 * 1e3:.2f} ms{containment}, "
+                f"slo {slo_cell(parsed)}"
             )
     lines.append(f"total accepted across workers: {tot_accept:.0f}")
     return "\n".join(lines)
@@ -554,13 +628,18 @@ def run_gateway(
     slo_availability: float = 0.999,
     slo_p99_ms: Optional[float] = 250.0,
     slo_interval_s: float = 15.0,
+    hedge_ms: Optional[float] = None,
+    retry_budget_ratio: float = 0.2,
+    breaker_cooldown_s: float = 5.0,
 ) -> Any:
     from mmlspark_tpu import obs
     from mmlspark_tpu.serving.distributed import ServingGateway
 
     gw = ServingGateway(
         registry_url=registry_url, service_name=service_name,
-        host=host, port=port,
+        host=host, port=port, hedge_ms=hedge_ms,
+        retry_budget_ratio=retry_budget_ratio,
+        cooldown_s=breaker_cooldown_s,
     )
     ginfo = gw.start()
     obs.set_process_label(
@@ -572,6 +651,81 @@ def run_gateway(
     )
     print(f"gateway: http://{ginfo.host}:{ginfo.port}/", flush=True)
     return gw
+
+
+def run_supervise(
+    registry_url: str,
+    workers: list,
+    service_name: str = "serving",
+    probe_s: float = 2.0,
+    wedge_after: int = 3,
+    backoff_s: float = 1.0,
+    backoff_max_s: float = 30.0,
+    host: str = "127.0.0.1",
+    port: int = 0,
+) -> Any:
+    """``fleet supervise``: spawn each ``--worker`` charge as a ``fleet
+    worker`` process and keep it alive — restart on crash, kill+restart
+    on a wedged ``/health``, capped exponential backoff between restarts
+    (serving/supervisor.py). The supervisor registers its own status
+    endpoint under ``<service-name>-supervisor`` so ``fleet top`` shows
+    it in the header."""
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.serving.supervisor import (
+        FleetSupervisor,
+        charge_from_worker_args,
+    )
+
+    charges = [
+        charge_from_worker_args(w, registry_url, i)
+        for i, w in enumerate(workers)
+    ]
+    sup = FleetSupervisor(
+        charges, registry_url=registry_url, service_name=service_name,
+        probe_s=probe_s, wedge_after=wedge_after, backoff_s=backoff_s,
+        backoff_max_s=backoff_max_s, host=host, port=port,
+    ).start()
+    obs.set_process_label(
+        f"{service_name}-supervisor@{sup._info.host}:{sup._info.port}"
+    )
+    print(
+        f"supervisor: {sup.url} watching {len(charges)} worker(s)",
+        flush=True,
+    )
+    return sup
+
+
+def supervisor_status_from_registry(
+    registry_url: str, service_name: str = "serving",
+) -> Optional[str]:
+    """One-line ``fleet supervise`` status for ``fleet top``'s header, or
+    None when no supervisor is registered / reachable."""
+    from mmlspark_tpu import obs
+
+    try:
+        urls = worker_urls_from_registry(
+            registry_url, f"{service_name}-supervisor"
+        )
+    except Exception:  # noqa: BLE001 — registry down: top degrades already
+        return None
+    for u in urls:
+        parsed = scrape_metrics(u)
+        if parsed is None:
+            continue
+        charges = obs.sum_samples(
+            parsed, "mmlspark_supervisor_charges_count"
+        )
+        up = obs.sum_samples(
+            parsed, "mmlspark_supervisor_charges_up_count"
+        )
+        restarts = obs.sum_samples(
+            parsed, "mmlspark_supervisor_restarts_total"
+        )
+        return (
+            f"supervise: up {up:.0f}/{charges:.0f}, "
+            f"restarts {restarts:.0f}"
+        )
+    return None
 
 
 def _serve_forever(stoppables: list, drain_s: float = 0.0) -> None:
@@ -638,6 +792,15 @@ def main(argv: Optional[list] = None) -> None:
         help="admission-control deadline applied to requests that carry "
         "no x-mmlspark-deadline-ms header (None = shed only on request)",
     )
+    w.add_argument(
+        "--no-admission", action="store_true",
+        help="disable the adaptive in-flight limit (AIMD admission "
+        "control, on by default; serving/admission.py)",
+    )
+    w.add_argument(
+        "--admission-initial-limit", type=int, default=32,
+        help="starting in-flight limit for the AIMD controller",
+    )
 
     def add_slo_flags(p) -> None:
         p.add_argument(
@@ -666,7 +829,54 @@ def main(argv: Optional[list] = None) -> None:
         help="on SIGTERM: finish accepted requests for up to this long "
         "(0 = stop immediately)",
     )
+    g.add_argument(
+        "--hedge-ms", type=float, default=None,
+        help="tail hedging: duplicate a request still pending after this "
+        "many ms to a second backend, first answer wins (0 = derive the "
+        "delay from the forward-latency p95; idempotent handlers only)",
+    )
+    g.add_argument(
+        "--retry-budget-ratio", type=float, default=0.2,
+        help="retries+hedges capped at this fraction of recent request "
+        "volume (the anti-retry-storm token bucket)",
+    )
+    g.add_argument(
+        "--breaker-cooldown-s", type=float, default=5.0,
+        help="circuit-breaker open period (doubles per consecutive "
+        "open, capped; half-open probe after it elapses)",
+    )
     add_slo_flags(g)
+    sv = sub.add_parser(
+        "supervise",
+        help="spawn and watch local fleet workers: restart crashed/"
+        "wedged processes with capped exponential backoff",
+    )
+    sv.add_argument("--registry", required=True)
+    sv.add_argument(
+        "--worker", action="append", default=[], required=True,
+        metavar="\"WORKER ARGS\"",
+        help="one supervised worker's `fleet worker` arguments, quoted "
+        "(repeatable); --registry is prepended automatically. A fixed "
+        "--port enables /health wedge detection",
+    )
+    sv.add_argument("--service-name", default="serving")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument(
+        "--port", type=int, default=0,
+        help="status endpoint port (GET /metrics; registered under "
+        "<service-name>-supervisor)",
+    )
+    sv.add_argument("--probe-s", type=float, default=2.0,
+                    help="health-probe / process-poll interval")
+    sv.add_argument(
+        "--wedge-after", type=int, default=3,
+        help="consecutive failed /health probes before a running worker "
+        "is declared wedged and killed+restarted",
+    )
+    sv.add_argument("--backoff-s", type=float, default=1.0,
+                    help="base restart backoff (doubles per fast death)")
+    sv.add_argument("--backoff-max-s", type=float, default=30.0,
+                    help="restart backoff cap")
     t = sub.add_parser(
         "top", help="scrape /metrics across the fleet, print a summary"
     )
@@ -794,8 +1004,18 @@ def main(argv: Optional[list] = None) -> None:
             slo_targets=args.slo_targets,
             slo_availability=args.slo_availability,
             slo_p99_ms=args.slo_p99_ms or None,
+            admission=not args.no_admission,
+            admission_initial_limit=args.admission_initial_limit,
         )
         _serve_forever([stop, q, srv])
+    elif args.role == "supervise":
+        sup = run_supervise(
+            args.registry, args.worker, service_name=args.service_name,
+            probe_s=args.probe_s, wedge_after=args.wedge_after,
+            backoff_s=args.backoff_s, backoff_max_s=args.backoff_max_s,
+            host=args.host, port=args.port,
+        )
+        _serve_forever([sup])
     else:
         from mmlspark_tpu.obs.flightrec import install_sigusr1
 
@@ -805,6 +1025,9 @@ def main(argv: Optional[list] = None) -> None:
             slo_targets=args.slo_targets,
             slo_availability=args.slo_availability,
             slo_p99_ms=args.slo_p99_ms or None,
+            hedge_ms=args.hedge_ms,
+            retry_budget_ratio=args.retry_budget_ratio,
+            breaker_cooldown_s=args.breaker_cooldown_s,
         )
         _serve_forever([gw], drain_s=args.drain_s)
 

@@ -47,6 +47,11 @@ from typing import Optional
 
 from mmlspark_tpu import obs
 from mmlspark_tpu.obs.flightrec import FLIGHT
+from mmlspark_tpu.serving.admission import (
+    DEADLINE_HEADER,
+    SHED_HEADER,
+    deadline_ms_from,
+)
 from mmlspark_tpu.serving.modelstore.store import (
     HBMBudgetExceeded,
     ModelStore,
@@ -57,6 +62,7 @@ from mmlspark_tpu.serving.modelstore.store import (
 # into them too (labels server=<name>), so `fleet top`, dashboards and
 # alerts keyed on mmlspark_serving_* keep working on ModelStore workers
 from mmlspark_tpu.serving.query import (
+    _M_DEADLINE_EXPIRED as _M_SRV_DEADLINE,
     _M_HANDLER_ERRS as _M_SRV_ERRS,
     _M_LATENCY as _M_SRV_LATENCY,
     LatencyRing,
@@ -64,7 +70,8 @@ from mmlspark_tpu.serving.query import (
 from mmlspark_tpu.serving.server import WorkerServer
 
 MODEL_HEADER = "x-mmlspark-model"
-DEADLINE_HEADER = "x-mmlspark-deadline-ms"
+# DEADLINE_HEADER is canonical in serving/admission.py (re-exported here
+# for back-compat with pre-PR-5 imports)
 # stamped on 503s a routing layer may retry elsewhere (model still
 # loading/warming on THIS worker — another replica may already serve it)
 STATE_HEADER = "x-mmlspark-model-state"
@@ -106,6 +113,7 @@ class _ModelQueue:
         self._m_qdepth = _M_QDEPTH.labels(model=name)
         self._m_srv_lat = _M_SRV_LATENCY.labels(server=disp.server.name)
         self._m_srv_errs = _M_SRV_ERRS.labels(server=disp.server.name)
+        self._m_srv_deadline = _M_SRV_DEADLINE.labels(server=disp.server.name)
         self.thread = threading.Thread(
             target=self._loop, name=f"modelstore-dispatch-{name}", daemon=True
         )
@@ -178,6 +186,29 @@ class _ModelQueue:
             fam.remove(model=self.name)
         return True
 
+    def _shed_expired(self, batch: list) -> list:
+        """Deadline propagation's worker half: a request whose (possibly
+        gateway-decremented) deadline expired while queued here is dead
+        work — shed it 504 before it costs a batch slot. The admission
+        estimate sheds *predictably* late requests at routing; this
+        catches the ones that became late after admission (a slow batch
+        ahead, a hot-swap stall)."""
+        disp = self.disp
+        now_ns = time.perf_counter_ns()
+        live = []
+        for r in batch:
+            dl_ms = deadline_ms_from(r.headers, disp.default_deadline_ms)
+            if dl_ms is not None and (now_ns - r.arrival_ns) / 1e6 > dl_ms:
+                disp.deadline_expired += 1
+                self._m_srv_deadline.inc()
+                disp.server.reply_to(
+                    r.id, b'{"error": "deadline expired in queue"}', 504,
+                    {SHED_HEADER: "deadline", **_JSON},
+                )
+            else:
+                live.append(r)
+        return live
+
     def _loop(self) -> None:
         disp = self.disp
         while not disp._stop.is_set():
@@ -185,6 +216,9 @@ class _ModelQueue:
             if not batch:
                 if self._reap_if_orphaned():
                     return
+                continue
+            batch = self._shed_expired(batch)
+            if not batch:
                 continue
             mv = disp.store.acquire(self.name)
             if mv is None:
@@ -272,6 +306,13 @@ class _ModelQueue:
                         queue_wait_ms=(dispatch_ns - r.arrival_ns) / 1e6,
                     )
                 disp._lat.record(done_ns - r.arrival_ns)
+            if disp.admission is not None:
+                # AIMD signal: worst queue wait in the batch (FIFO: the
+                # first request waited longest) + per-request service
+                disp.admission.observe(
+                    (dispatch_ns - batch[0].arrival_ns) / 1e9,
+                    svc / len(batch),
+                )
             disp.batches += 1
         # stopped: nothing queued here gets a handler anymore
         with self.cond:
@@ -295,6 +336,7 @@ class ModelDispatcher:
         max_batch_size: int = 64,
         max_wait_ms: float = 0.0,
         default_deadline_ms: Optional[float] = None,
+        admission: Optional[object] = None,
     ):
         self.server = server
         self.store = store
@@ -302,6 +344,12 @@ class ModelDispatcher:
         self.max_batch_size = max_batch_size
         self.max_wait_ms = max_wait_ms
         self.default_deadline_ms = default_deadline_ms
+        # adaptive-concurrency limit (serving/admission.py): attached to
+        # the ingress so sheds happen before routing; fed per-batch by
+        # every model queue's wait/service samples
+        self.admission = admission
+        if admission is not None:
+            server.admission = admission
         self._stop = threading.Event()
         self._router: Optional[threading.Thread] = None
         self._queues: dict[str, _ModelQueue] = {}
@@ -309,6 +357,7 @@ class ModelDispatcher:
         self.batches = 0
         self.errors = 0
         self.shed = 0
+        self.deadline_expired = 0
         self._lat = LatencyRing()
 
     # -- lifecycle -----------------------------------------------------------

@@ -26,6 +26,7 @@ import numpy as np
 from mmlspark_tpu import obs
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.obs.flightrec import FLIGHT
+from mmlspark_tpu.serving.admission import SHED_HEADER, deadline_ms_from
 from mmlspark_tpu.serving.server import CachedRequest, WorkerServer
 from mmlspark_tpu.serving.udfs import make_reply, request_to_json
 
@@ -40,6 +41,11 @@ _M_LATENCY = obs.histogram(
 _M_HANDLER_ERRS = obs.counter(
     "mmlspark_serving_handler_errors_total",
     "Handler exceptions turned into 500 batches", labels=("server",),
+)
+_M_DEADLINE_EXPIRED = obs.counter(
+    "mmlspark_serving_deadline_expired_total",
+    "Requests shed because their deadline expired while queued",
+    labels=("server",),
 )
 
 
@@ -86,7 +92,16 @@ class ServingQuery:
         max_batch_size: int = 64,
         max_wait_ms: float = 0.0,
         epoch_interval_ms: float = 100.0,
+        admission: Optional[Any] = None,
+        default_deadline_ms: Optional[float] = None,
     ):
+        """``admission``: an
+        :class:`~mmlspark_tpu.serving.admission.AdmissionController` —
+        attached to the server's ingress (429 shed beyond the adaptive
+        in-flight limit) and fed queue-wait/service samples per batch.
+        ``default_deadline_ms``: deadline applied to requests carrying no
+        ``x-mmlspark-deadline-ms`` header; work whose deadline expired
+        while queued is shed 504 without running the handler."""
         if mode not in ("continuous", "microbatch"):
             raise ValueError(f"unknown serving mode {mode!r}")
         self.server = server
@@ -95,13 +110,19 @@ class ServingQuery:
         self.max_batch_size = max_batch_size
         self.max_wait_ms = max_wait_ms
         self.epoch_interval_ms = epoch_interval_ms
+        self.admission = admission
+        self.default_deadline_ms = default_deadline_ms
+        if admission is not None:
+            server.admission = admission
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lat = LatencyRing()
         self.batches = 0
         self.errors = 0
+        self.deadline_expired = 0
         self._m_latency = _M_LATENCY.labels(server=server.name)
         self._m_handler_errs = _M_HANDLER_ERRS.labels(server=server.name)
+        self._m_deadline = _M_DEADLINE_EXPIRED.labels(server=server.name)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -157,7 +178,32 @@ class ServingQuery:
                 self._process(reqs)
                 self.server.auto_commit()
 
+    def _shed_expired(self, reqs: list) -> list:
+        """Drop requests whose deadline already expired while they sat in
+        the queue: the client gave up — running the handler for them
+        burns a batch slot on a reply nobody reads, exactly when the
+        queue is longest. Replies 504 so a gateway relays the expiry
+        rather than retrying it."""
+        now_ns = time.perf_counter_ns()
+        live = []
+        for r in reqs:
+            dl_ms = deadline_ms_from(r.headers, self.default_deadline_ms)
+            if dl_ms is not None and (now_ns - r.arrival_ns) / 1e6 > dl_ms:
+                self.deadline_expired += 1
+                self._m_deadline.inc()
+                self.server.reply_to(
+                    r.id, b'{"error": "deadline expired in queue"}', 504,
+                    {"Content-Type": "application/json",
+                     SHED_HEADER: "deadline"},
+                )
+            else:
+                live.append(r)
+        return live
+
     def _process(self, reqs: list) -> None:
+        reqs = self._shed_expired(reqs)
+        if not reqs:
+            return
         obs_on = self._m_latency._on
         dispatch_ns = time.perf_counter_ns()  # ~= queue-pop time
         # per-request span AND trace ids are minted BEFORE dispatch so
@@ -235,6 +281,13 @@ class ServingQuery:
                     queue_wait_ms=(dispatch_ns - r.arrival_ns) / 1e6,
                 )
             self._lat.record(done_ns - r.arrival_ns)
+        if self.admission is not None:
+            # AIMD signal: the batch's worst queue wait (reqs are FIFO,
+            # so the first request waited longest) + per-request service
+            self.admission.observe(
+                (dispatch_ns - reqs[0].arrival_ns) / 1e9,
+                (done_ns - dispatch_ns) / 1e9 / len(reqs),
+            )
         self.batches += 1
 
     # -- stats ---------------------------------------------------------------

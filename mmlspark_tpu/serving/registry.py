@@ -242,6 +242,11 @@ class DriverRegistry:
             # advertised model names ride the roster entry so the gateway
             # can route model-aware (serving/distributed.py)
             payload["models"] = list(info.models)
+        if info.boot is not None:
+            # process-generation stamp: constant across heartbeats, new
+            # per restart — the gateway's restart-detection signal (the
+            # server-side "ts" is bumped by EVERY re-registration)
+            payload["boot"] = info.boot
         resp = send_request(
             HTTPRequestData(
                 registry_url, "POST", {"Content-Type": "application/json"},

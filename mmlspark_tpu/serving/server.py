@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from mmlspark_tpu import obs
+from mmlspark_tpu.core import faults
 from mmlspark_tpu.obs.registry import SIZE_BUCKETS
 
 _REASONS = {200: "OK", 202: "Accepted", 400: "Bad Request", 404: "Not Found",
@@ -93,10 +94,23 @@ class ServiceInfo:
     # model names this worker serves (ModelStore-backed workers advertise
     # them so the gateway can route model-aware); None = unadvertised
     models: Optional[tuple] = None
+    # process-generation stamp: set once when the server starts, constant
+    # across heartbeat re-registrations, new on every restart. Roster
+    # consumers use it to tell "new process" from "same process, fresh
+    # heartbeat" — the registry's own ``ts`` is bumped by every beat, so
+    # it cannot carry that distinction (the gateway resets a backend's
+    # circuit breaker only on a new boot)
+    boot: Optional[float] = None
 
 
 class WorkerServer:
     """Epoch-queued HTTP ingress with reply routing and history replay."""
+
+    # health probes may queue past max_queue (they are never bounced with
+    # an inline answer — see _handle_conn), but only this many: beyond it
+    # the connection closes unanswered, preserving the wedge signal
+    # without letting a probing supervisor grow the queue forever
+    _PROBE_OVERFLOW = 64
 
     def __init__(
         self,
@@ -139,8 +153,16 @@ class WorkerServer:
         # gateway, instead of cleanly dead
         self._writers: set = set()
         self.requests_seen = 0
+        # optional AdmissionController (serving/admission.py): consulted
+        # before a request is queued — the adaptive-concurrency shed path.
+        # Attribute, not constructor arg: the query/dispatcher layer that
+        # owns the controller attaches it (ServingQuery/ModelDispatcher)
+        self.admission: Any = None
         self._m_accepted = _M_ACCEPTED.labels(server=name)
         self._m_rej_full = _M_REJECTED.labels(server=name, reason="queue_full")
+        self._m_rej_admission = _M_REJECTED.labels(
+            server=name, reason="admission"
+        )
         self._m_rej_404 = _M_REJECTED.labels(server=name, reason="not_found")
         self._m_rej_400 = _M_REJECTED.labels(server=name, reason="bad_request")
         self._m_qdepth = _M_QDEPTH.labels(server=name)
@@ -157,7 +179,10 @@ class WorkerServer:
         self._thread.start()
         if not self._started.wait(10.0):
             raise RuntimeError("WorkerServer failed to start")
-        info = ServiceInfo(self.name, self.host, self.port, self.api_path)
+        info = ServiceInfo(
+            self.name, self.host, self.port, self.api_path,
+            boot=time.time(),
+        )
         if self._forwarding_cfg:
             from mmlspark_tpu.io.port_forwarding import PortForwarding
 
@@ -319,6 +344,48 @@ class WorkerServer:
                     if not keep:
                         return
                     continue
+                # Health probes (supervisor, orchestrators, humans) are
+                # monitoring, not traffic: never counted as accepted,
+                # never admission-shed, never bounced by a full queue —
+                # a saturated worker answering 429 to its supervisor
+                # would be wedge-killed, shrinking the fleet under
+                # overload. The probe still rides the QUEUE though: a
+                # wedged dispatcher answers nothing, which is exactly
+                # the signal wedge detection needs.
+                bare = (
+                    path_only[len(prefix):]
+                    if prefix and path_only.startswith(prefix)
+                    else path_only
+                )
+                is_probe = (
+                    method == "GET" and bare in ("/health", "/healthz")
+                )
+                admission = self.admission if not is_probe else None
+                if admission is not None:
+                    # adaptive-concurrency shed (serving/admission.py):
+                    # beyond the AIMD in-flight limit the request is
+                    # answered 429 + Retry-After HERE, in microseconds,
+                    # instead of joining a queue that already guarantees
+                    # a blown deadline. Fault point admission.shed: a
+                    # truthy payload forces the shed, delay_s stalls the
+                    # admission path (chaos latency fault)
+                    forced = None
+                    try:
+                        forced = faults.inject("admission.shed")
+                    except Exception:  # noqa: BLE001 — injected error = shed
+                        forced = True
+                    if forced or not admission.try_acquire():
+                        if forced:
+                            admission.force_shed()
+                        self._m_rej_admission.inc()
+                        self._write_response(
+                            writer, 429,
+                            b'{"error": "over concurrency limit"}', keep,
+                            admission.shed_headers(),
+                        )
+                        if not keep:
+                            return
+                        continue
                 req = CachedRequest(
                     id=uuid.uuid4().hex,
                     epoch=self._epoch,
@@ -330,17 +397,33 @@ class WorkerServer:
                 )
                 replied = asyncio.Event()
                 with self._not_empty:
-                    if len(self._queue) >= self._max_queue:
+                    qlen = len(self._queue)
+                    if not is_probe and qlen >= self._max_queue:
+                        if admission is not None:
+                            admission.release()  # the slot never queued
                         self._m_rej_full.inc()
                         self._write_response(writer, 503, b"queue full", keep)
                         if not keep:
                             return
                         continue
-                    self._routing[req.id] = (writer, keep, replied)
+                    if is_probe and qlen >= self._max_queue + \
+                            self._PROBE_OVERFLOW:
+                        # probes ride the queue so a wedged dispatcher
+                        # answers nothing (the wedge signal) — but they
+                        # must not grow it unboundedly either. Past a
+                        # small overflow allowance, close unanswered:
+                        # any inline answer (even a 503) would read as
+                        # "alive" to the supervisor and defeat wedge
+                        # detection; a dropped connection reads as a
+                        # failed probe, exactly the signal intended
+                        return
+                    self._routing[req.id] = (
+                        writer, keep, replied, admission is not None
+                    )
                     self._queue.append(req)
                     self._history.setdefault(req.epoch, []).append(req)
                     self.requests_seen += 1
-                    if self._m_accepted._on:
+                    if not is_probe and self._m_accepted._on:
                         self._m_accepted.inc()
                         self._m_qdepth.set(len(self._queue))
                     self._not_empty.notify()
@@ -420,9 +503,17 @@ class WorkerServer:
         HTTPSourceV2.scala:516-527)."""
         with self._lock:
             entry = self._routing.pop(request_id, None)
-        if entry is None or self._loop is None:
+        if entry is None:
             return False
-        writer, keep, replied = entry
+        writer, keep, replied, admitted = entry
+        if admitted and self.admission is not None:
+            # the admitted request is answered (any status): free its
+            # concurrency slot exactly once (the routing-table pop above
+            # is the idempotency guard). Probes were never admitted —
+            # releasing for one would mint a phantom slot.
+            self.admission.release()
+        if self._loop is None:
+            return False
 
         def _send() -> None:
             try:

@@ -1,0 +1,374 @@
+"""Fleet supervisor: restart crashed/wedged local workers automatically.
+
+The last open loop in the self-healing story: breakers and retry budgets
+(serving/distributed.py) contain a dead worker's blast radius, admission
+control (serving/admission.py) keeps the survivors meeting deadlines —
+but the dead worker itself stayed dead until an operator noticed.
+``fleet supervise`` closes that loop for locally-managed workers:
+
+    python -m mmlspark_tpu.serving.fleet supervise \
+        --registry http://registry:9090/ \
+        --worker "--model echo --port 9101 --load resnet=zoo:ResNet8_Digits" \
+        --worker "--model echo --port 9102"
+
+Each ``--worker`` charge is one ``fleet worker`` process the supervisor
+spawns and watches. A charge is restarted when
+
+- its **process exits** (crash, OOM-kill, preemption), or
+- it is **wedged**: ``wedge_after`` consecutive ``GET /health`` probes
+  fail or time out while the process is still running (an event loop
+  stuck behind a blocked thread answers nothing — exactly the state a
+  process poll cannot see). Wedged charges are killed first.
+
+Restarts re-issue the charge's full original argv — including its
+``--load name=spec`` flags — so a restarted ModelStore worker loads and
+warms the same models BEFORE re-registering (the fleet worker's
+warm-before-register ordering), and the roster heals without operator
+action. Restart pacing is capped exponential backoff
+(``backoff_s * 2^(streak-1)``, capped at ``backoff_max_s``); the streak
+resets once a charge stays up ``stable_s``, so a crash-loop cannot spin
+a hot respawn loop while a one-off crash restarts almost immediately.
+
+Fault point ``supervisor.restart`` fires as each restart is about to
+spawn: an injected error suppresses that restart attempt (retried next
+tick — chaos for "the scheduler refused"), ``delay_s`` stalls it.
+
+The supervisor is observable like every other fleet role: it runs a
+minimal ingress serving ``GET /metrics`` (``mmlspark_supervisor_*``
+gauges/counters) and heartbeat-registers under
+``<service-name>-supervisor`` so ``fleet top`` finds it on the roster
+and surfaces its status in the header line.
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core import faults
+
+_M_CHARGES = obs.gauge(
+    "mmlspark_supervisor_charges_count",
+    "Worker processes under supervision",
+)
+_M_UP = obs.gauge(
+    "mmlspark_supervisor_charges_up_count",
+    "Supervised workers currently running (process alive, not wedged)",
+)
+_M_RESTARTS = obs.counter(
+    "mmlspark_supervisor_restarts_total",
+    "Worker restarts by the supervisor", labels=("worker", "reason"),
+)
+_M_PROBE_FAILS = obs.counter(
+    "mmlspark_supervisor_probe_failures_total",
+    "Failed /health probes against supervised workers", labels=("worker",),
+)
+_M_BACKOFF = obs.counter(
+    "mmlspark_supervisor_backoff_seconds_total",
+    "Cumulative restart-backoff delay imposed on crash-looping workers",
+)
+
+
+class WorkerCharge:
+    """One supervised worker: the argv to (re)spawn and how to probe it.
+
+    ``argv`` is the FULL command line (``sys.executable -m ... worker
+    ...``) — re-running it verbatim is what brings ``--load`` models back
+    warm. ``health_url`` is probed when set; a charge without one (e.g.
+    an ephemeral ``--port 0`` worker whose address changes per restart)
+    is supervised on process liveness alone."""
+
+    def __init__(self, argv: list, name: str,
+                 health_url: Optional[str] = None):
+        self.argv = list(argv)
+        self.name = name
+        self.health_url = health_url
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self.streak = 0            # consecutive fast deaths (backoff input)
+        self.started_at = 0.0
+        self.restart_due = 0.0     # monotonic ts the next spawn may happen
+        self.probe_fails = 0       # consecutive failed health probes
+        self.healthy_once = False  # has /health ever answered this spawn?
+        self.last_reason = ""
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+def _probe_health(url: str, timeout_s: float) -> bool:
+    import http.client
+    import urllib.parse
+
+    u = urllib.parse.urlparse(url)
+    try:
+        c = http.client.HTTPConnection(
+            u.hostname, u.port or 80, timeout=timeout_s
+        )
+        try:
+            c.request("GET", u.path or "/health")
+            resp = c.getresponse()
+            resp.read()
+            # ANY answer is an alive worker — 503 is warming, 429 is
+            # shedding (alive and protecting itself; killing it would
+            # shrink the fleet under overload, the exact wrong
+            # direction). Only no-answer-at-all counts as wedged.
+            return True
+        finally:
+            c.close()
+    except Exception:  # noqa: BLE001 — any transport failure = probe miss
+        return False
+
+
+class FleetSupervisor:
+    """Watch charges, restart the dead and the wedged, export status.
+
+    ``registry_url``: when set, the supervisor heartbeat-registers its
+    own status endpoint under ``<service_name>-supervisor`` so ``fleet
+    top`` can find it. ``spawn`` is injectable for tests (defaults to
+    ``subprocess.Popen``)."""
+
+    def __init__(
+        self,
+        charges: list,
+        registry_url: Optional[str] = None,
+        service_name: str = "serving",
+        probe_s: float = 2.0,
+        probe_timeout_s: float = 2.0,
+        wedge_after: int = 3,
+        backoff_s: float = 1.0,
+        backoff_max_s: float = 30.0,
+        stable_s: float = 30.0,
+        startup_grace_s: float = 60.0,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        spawn: Any = None,
+    ):
+        self.charges: list = list(charges)
+        self.registry_url = registry_url
+        self.service_name = service_name
+        self.probe_s = probe_s
+        self.probe_timeout_s = probe_timeout_s
+        self.wedge_after = max(1, int(wedge_after))
+        self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        self.stable_s = stable_s
+        self.startup_grace_s = startup_grace_s
+        self._host = host
+        self._port = port
+        self._spawn = spawn or (lambda argv: subprocess.Popen(argv))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ingress: Any = None
+        self._info: Any = None
+        self._lock = threading.Lock()
+        _M_CHARGES.set(len(self.charges))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetSupervisor":
+        from mmlspark_tpu.serving.server import WorkerServer
+
+        # minimal status ingress: GET /metrics is answered inline by the
+        # WorkerServer machinery; nothing ever dispatches from its queue
+        self._ingress = WorkerServer(
+            host=self._host, port=self._port,
+            name=f"{self.service_name}-supervisor",
+        )
+        self._info = self._ingress.start()
+        for c in self.charges:
+            self._spawn_charge(c, first=True)
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-supervisor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, kill_charges: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        if kill_charges:
+            for c in self.charges:
+                if c.alive():
+                    c.proc.terminate()
+            for c in self.charges:
+                if c.proc is not None:
+                    try:
+                        c.proc.wait(5.0)
+                    except Exception:  # noqa: BLE001 — escalate to SIGKILL
+                        c.proc.kill()
+        if self.registry_url and self._info is not None:
+            from mmlspark_tpu.serving.registry import DriverRegistry
+
+            try:
+                DriverRegistry.deregister(self.registry_url, self._info)
+            except Exception:  # noqa: BLE001 — registry may be gone
+                pass
+        if self._ingress is not None:
+            self._ingress.stop()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._info.host}:{self._info.port}/"
+
+    # -- supervision ---------------------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "charges": len(self.charges),
+                "up": sum(1 for c in self.charges if c.alive()),
+                "restarts": sum(c.restarts for c in self.charges),
+                "workers": {
+                    c.name: {
+                        "alive": c.alive(),
+                        "restarts": c.restarts,
+                        "last_reason": c.last_reason,
+                    }
+                    for c in self.charges
+                },
+            }
+
+    def _spawn_charge(self, c: WorkerCharge, first: bool = False) -> bool:
+        try:
+            # fault point supervisor.restart: an injected error is "the
+            # scheduler refused this respawn" — retried next tick; delay
+            # stalls the restart like a slow node allocation
+            if not first:
+                faults.inject(
+                    "supervisor.restart", context={"worker": c.name}
+                )
+            c.proc = self._spawn(c.argv)
+            c.started_at = time.monotonic()
+            c.probe_fails = 0
+            c.healthy_once = False
+            return True
+        except Exception as e:  # noqa: BLE001 — injected or spawn failure
+            c.last_reason = f"spawn failed: {e}"
+            c.restart_due = time.monotonic() + self.backoff_s
+            return False
+
+    def _restart(self, c: WorkerCharge, reason: str) -> None:
+        now = time.monotonic()
+        if c.alive():  # wedged: the process must die before its successor
+            c.proc.kill()
+            try:
+                c.proc.wait(5.0)
+            except Exception:  # noqa: BLE001
+                pass
+        if c.restart_due == 0.0:
+            # first detection of this death: compute the backoff window
+            if now - c.started_at >= self.stable_s:
+                c.streak = 0  # it ran fine for a while — fresh slate
+            c.streak += 1
+            delay = min(
+                self.backoff_max_s, self.backoff_s * (2 ** (c.streak - 1))
+            )
+            _M_BACKOFF.inc(delay)
+            c.restart_due = now + delay
+            c.last_reason = reason
+            print(
+                f"supervisor: worker {c.name} {reason}; restart in "
+                f"{delay:.1f}s (streak {c.streak})",
+                file=sys.stderr, flush=True,
+            )
+            return
+        if now < c.restart_due:
+            return  # still inside the backoff window
+        if self._spawn_charge(c):
+            c.restarts += 1
+            c.restart_due = 0.0
+            _M_RESTARTS.labels(worker=c.name, reason=reason).inc()
+            print(
+                f"supervisor: worker {c.name} restarted ({reason}, "
+                f"restart #{c.restarts})", file=sys.stderr, flush=True,
+            )
+
+    def _tick(self) -> None:
+        with self._lock:
+            up = 0
+            for c in self.charges:
+                if not c.alive():
+                    self._restart(c, c.last_reason or "exited")
+                    if c.alive():
+                        up += 1
+                    continue
+                c.restart_due = 0.0
+                c.last_reason = ""
+                if c.health_url:
+                    if _probe_health(c.health_url, self.probe_timeout_s):
+                        c.probe_fails = 0
+                        c.healthy_once = True
+                    elif (
+                        c.healthy_once
+                        or time.monotonic() - c.started_at
+                        > self.startup_grace_s
+                    ):
+                        # startup grace: a worker that has never answered
+                        # yet may still be importing/warming — killing it
+                        # mid-warmup would crash-loop a healthy charge.
+                        # Once it HAS been healthy (or the grace is
+                        # blown), silence means wedged
+                        c.probe_fails += 1
+                        _M_PROBE_FAILS.labels(worker=c.name).inc()
+                        if c.probe_fails >= self.wedge_after:
+                            self._restart(c, "wedged")
+                            continue
+                up += 1
+            _M_UP.set(up)
+            _M_CHARGES.set(len(self.charges))
+        if self.registry_url and self._info is not None:
+            from mmlspark_tpu.serving.registry import DriverRegistry
+
+            try:
+                DriverRegistry.register(self.registry_url, self._info)
+            except Exception:  # noqa: BLE001 — registry may be restarting
+                pass
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception as e:  # noqa: BLE001 — supervision must survive
+                print(f"supervisor: tick failed: {e}", file=sys.stderr,
+                      flush=True)
+            self._stop.wait(self.probe_s)
+
+
+def charge_from_worker_args(
+    args_str: str, registry_url: str, index: int,
+    python: Optional[str] = None,
+) -> WorkerCharge:
+    """One ``--worker "<fleet worker args>"`` CLI string -> a charge.
+
+    The charge's argv re-enters ``fleet worker`` with ``--registry``
+    prepended (the supervisor's registry is authoritative); a fixed
+    ``--port`` yields a ``/health`` probe URL, an ephemeral port leaves
+    the charge on process-liveness supervision only."""
+    extra = shlex.split(args_str)
+    argv = [
+        python or sys.executable, "-m", "mmlspark_tpu.serving.fleet",
+        "worker", "--registry", registry_url, *extra,
+    ]
+    host, port = "127.0.0.1", None
+    for flag in ("--advertise-host", "--host"):
+        if flag in extra:
+            v = extra[extra.index(flag) + 1]
+            if v not in ("0.0.0.0", ""):
+                host = v
+            if flag == "--advertise-host":
+                break
+    if "--port" in extra:
+        try:
+            port = int(extra[extra.index("--port") + 1]) or None
+        except (ValueError, IndexError):
+            port = None
+    health = f"http://{host}:{port}/health" if port else None
+    return WorkerCharge(argv, name=f"worker-{index}:{port or 'ephemeral'}",
+                        health_url=health)

@@ -169,7 +169,13 @@ def test_gateway_worker_death_mid_flight_zero_lost():
 
     s1, q1, i1 = _worker()
     s2, q2, i2 = _worker()
-    gw = ServingGateway(workers=[i1, i2], request_timeout_s=5.0)
+    # a 25%-of-attempts fault rate sits ABOVE the default 20% retry
+    # budget by design elsewhere (the budget exists to clamp exactly this
+    # much amplification); here the property under test is zero-loss
+    # re-dispatch itself, so size the budget for the injected rate
+    gw = ServingGateway(
+        workers=[i1, i2], request_timeout_s=5.0, retry_budget_ratio=0.5,
+    )
     ginfo = gw.start()
     plan = FaultPlan().on(
         "gateway.forward", error=ConnectionResetError, every=4
@@ -653,7 +659,205 @@ def test_retry_full_jitter_desynchronizes_and_deadline_caps():
     assert sleeps == [0.1]
 
 
+# -- self-healing soak: supervisor + breakers + retry budget -----------------
+
+
+@pytest.mark.xdist_group("latency")
+def test_chaos_soak_supervisor_restores_fleet_and_breakers_cycle():
+    """The PR-5 acceptance soak: ~30 s of sustained traffic through
+    gateway + 2 subprocess workers while one worker is SIGKILLed
+    mid-soak and latency faults run on the forward path. The fleet
+    supervisor must restore the roster without operator action, the dead
+    worker's breaker must demonstrably cycle (open -> half-open ->
+    closed, metric evidence), no request may be dropped, and retry
+    amplification must stay <= 1.25 — containment, not a retry storm."""
+    import os
+    import socket
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.serving import fleet
+    from mmlspark_tpu.serving.supervisor import (
+        FleetSupervisor,
+        charge_from_worker_args,
+    )
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    soak_s = float(os.environ.get("MMLSPARK_CHAOS_SOAK_S", "30"))
+    reg = fleet.run_registry(host="127.0.0.1", port=0)
+    ports = [free_port(), free_port()]
+    charges = [
+        charge_from_worker_args(
+            f"--model echo --host 127.0.0.1 --port {p} --heartbeat-s 0.5",
+            reg.url, i,
+        )
+        for i, p in enumerate(ports)
+    ]
+    sup = FleetSupervisor(
+        charges, registry_url=reg.url, probe_s=0.3, backoff_s=0.3,
+        stable_s=20.0,
+    ).start()
+    from mmlspark_tpu.serving.distributed import ServingGateway
+
+    gw = ServingGateway(
+        registry_url=reg.url, refresh_s=0.2, cooldown_s=0.4,
+        evict_after=3, request_timeout_s=5.0,
+    )
+    ginfo = gw.start()
+    counters: dict = {"ok": 0, "other": 0, "dropped": 0, "n": 0}
+    stop_traffic = threading.Event()
+    lock = threading.Lock()
+
+    def scrape():
+        return fleet.scrape_metrics(f"http://127.0.0.1:{ginfo.port}")
+
+    def client_loop():
+        i = 0
+        while not stop_traffic.is_set():
+            i += 1
+            try:
+                status, _ = _post(ginfo.port, "/", {"i": i})
+            except Exception:  # noqa: BLE001 — a DROP, the thing we gate on
+                status = None
+            with lock:
+                counters["n"] += 1
+                if status == 200:
+                    counters["ok"] += 1
+                elif status is None:
+                    counters["dropped"] += 1
+                else:
+                    counters["other"] += 1
+            time.sleep(0.002)
+
+    try:
+        deadline = time.monotonic() + 60.0
+        while gw.pool.size() < 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert gw.pool.size() == 2, "both workers must be routable pre-soak"
+        before = scrape()
+        victim = charges[0]
+        victim_addr = f"127.0.0.1:{ports[0]}"
+        # latency faults on the forward path for the whole soak (the
+        # injected-delay half of "worker crash + latency faults")
+        plan = FaultPlan(seed=5).on(
+            "gateway.forward", delay_s=0.02, probability=0.05
+        )
+        threads = [threading.Thread(target=client_loop) for _ in range(2)]
+        t0 = time.monotonic()
+        with plan.armed():
+            for t in threads:
+                t.start()
+            time.sleep(soak_s * 0.2)
+            victim.proc.kill()              # the worker crash, for real
+            while time.monotonic() - t0 < soak_s:
+                time.sleep(0.25)
+            stop_traffic.set()
+            for t in threads:
+                t.join(10.0)
+        assert len(plan.fires()) > 0        # latency chaos actually ran
+        # -- self-healing: the supervisor restored the roster ----------------
+        assert victim.restarts >= 1, "supervisor never restarted the victim"
+        deadline = time.monotonic() + 20.0
+        while gw.pool.size() < 2 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert gw.pool.size() == 2, "roster not restored after the kill"
+        assert victim.alive()
+        # -- no request was dropped ------------------------------------------
+        assert counters["n"] > 100          # the soak actually soaked
+        assert counters["dropped"] == 0, (
+            f"{counters['dropped']}/{counters['n']} requests got no reply"
+        )
+        assert counters["other"] == 0, (
+            f"{counters['other']}/{counters['n']} requests failed "
+            f"(expected every request to complete via retry containment)"
+        )
+        # -- breaker cycle, from the exported counters -----------------------
+        after = scrape()
+
+        def delta(name, match=None):
+            return obs.sum_samples(after, name, match) - obs.sum_samples(
+                before, name, match
+            )
+
+        opened = delta(
+            "mmlspark_gateway_breaker_transitions_total",
+            {"backend": victim_addr, "state": "open"},
+        )
+        half = delta(
+            "mmlspark_gateway_breaker_transitions_total",
+            {"backend": victim_addr, "state": "half_open"},
+        )
+        closed = delta(
+            "mmlspark_gateway_breaker_transitions_total",
+            {"backend": victim_addr, "state": "closed"},
+        )
+        assert opened >= 1, "the dead worker's breaker never opened"
+        assert half >= 1, "the breaker never probed half-open"
+        assert closed >= 1, "the breaker never re-closed"
+        assert gw.pool.breaker_states()[victim_addr] == "closed"
+        # -- retry amplification ---------------------------------------------
+        forwarded = delta("mmlspark_gateway_requests_total")
+        retried = delta("mmlspark_gateway_retries_total")
+        amplification = (forwarded + retried) / max(1, counters["n"])
+        assert amplification <= 1.25, (
+            f"retry amplification {amplification:.3f} — containment failed "
+            f"(forwarded {forwarded:.0f} + retried {retried:.0f} for "
+            f"{counters['n']} requests)"
+        )
+    finally:
+        stop_traffic.set()
+        sup.stop()
+        gw.stop()
+        reg.stop()
+        # the soak floods the process-global obs state (latency-bucket
+        # exemplars pointing at traces that age out of the span ring,
+        # hundreds of injected-fault flight records in the bounded
+        # flight ring) — reset so later in-process tests (the smoke
+        # gates especially) start from clean counters
+        obs.reset()
+
+
 # -- chaos smoke through the deployed-fleet client ---------------------------
+
+
+@pytest.mark.xdist_group("latency")
+def test_smoke_containment_gate_enforces_bursts_waives_scattered():
+    """The breaker-must-have-opened requirement applies only to plans
+    that guarantee a contiguous failure burst: scattered schedules
+    (every-N strides, probability draws) interleave successes that reset
+    the streak — chaos the breaker is *right* not to trip on."""
+    from tools.deploy import smoke
+
+    before = {"gateway_raw": {}}
+
+    def after(fires, opened):
+        return {"gateway_raw": {
+            ("mmlspark_gateway_breaker_state",
+             (("backend", "10.0.0.1:1"),)): 0.0,
+            ("mmlspark_gateway_retry_budget_remaining_ratio", ()): 1.0,
+            ("mmlspark_faults_injected_total",
+             (("point", "gateway.forward"),)): float(fires),
+            ("mmlspark_gateway_breaker_transitions_total",
+             (("backend", "10.0.0.1:1"), ("state", "open"))): float(opened),
+        }}
+
+    scattered = FaultPlan().on(
+        "gateway.forward", error=ConnectionError, every=4
+    )
+    assert smoke._verify_containment(before, after(8, 0), scattered)
+    burst = FaultPlan().on(
+        "gateway.forward", error=ConnectionError, at=(0, 1, 2)
+    )
+    # a contiguous burst with zero opens: the layer slept through chaos
+    assert not smoke._verify_containment(before, after(3, 0), burst)
+    assert smoke._verify_containment(before, after(3, 1), burst)
+    # no plan at all (raw/swap smoke): sane gauges suffice
+    assert smoke._verify_containment(before, after(0, 0), None)
 
 
 def test_smoke_script_fault_plan_chaos_smokes_the_fleet(capsys):
@@ -664,21 +868,33 @@ def test_smoke_script_fault_plan_chaos_smokes_the_fleet(capsys):
     srv, q, stop = fleet.run_worker(
         reg.url, model="echo", host="127.0.0.1", heartbeat_s=0.5
     )
-    gw = fleet.run_gateway(reg.url, host="127.0.0.1", port=0)
+    # short breaker open period: the worker's breaker trips under the
+    # injected forward faults, then half-open-probes closed again well
+    # inside the retrying client's backoff schedule
+    gw = fleet.run_gateway(
+        reg.url, host="127.0.0.1", port=0, breaker_cooldown_s=0.2
+    )
     try:
         deadline = time.monotonic() + 5.0
         while gw.pool.size() < 1 and time.monotonic() < deadline:
             time.sleep(0.05)
         assert gw.pool.size() == 1
+        # in-process smoke: the plan arms THIS process, which also hosts
+        # the gateway — the 3 consecutive gateway.forward faults open the
+        # single worker's breaker (containment-gate evidence) and the
+        # retrying client rides it out
         plan = json.dumps({
             "seed": 0,
-            "rules": [{"point": "io.send_request", "payload": 503,
-                       "every": 4}],
+            "rules": [
+                {"point": "gateway.forward", "error": "ConnectionError",
+                 "at": [0, 1, 2]},
+            ],
         })
         rc = smoke.main([gw.url, "--n", "12", "--fault-plan", plan])
         out = capsys.readouterr().out
-        assert rc == 0, out           # 100% completion under injected 5xx
+        assert rc == 0, out           # 100% completion under injected chaos
         assert "faults injected" in out
+        assert "breaker opened 1 time(s) — ok" in out
     finally:
         from mmlspark_tpu.core import faults
 

@@ -1,0 +1,1039 @@
+"""Self-healing serving: overload containment + supervision.
+
+Covers the PR-5 failure-containment layer end-to-end on CPU:
+
+- :class:`CircuitBreaker` state machine (closed -> open -> half-open),
+  consecutive-failure and error-rate trips, single-probe half-open,
+  exponential open periods, re-registration reset;
+- :class:`RetryBudget` token bucket (retries capped at a fraction of
+  recent request volume);
+- :class:`AdmissionController` AIMD limit + ingress 429 shed;
+- true deadline propagation (gateway decrements per hop, workers shed
+  expired work, EWMA fail-fast);
+- 429-shed classification as backpressure, not failure;
+- tail hedging (first answer wins, ``gateway.hedge`` fault point);
+- :class:`FleetSupervisor` restart-on-exit / restart-on-wedge with
+  capped exponential backoff and the ``supervisor.restart`` fault point.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from mmlspark_tpu.core.faults import FaultPlan
+from mmlspark_tpu.serving.admission import (
+    DEADLINE_HEADER,
+    RETRY_BUDGET_HEADER,
+    SHED_HEADER,
+    AdmissionController,
+)
+from mmlspark_tpu.serving.distributed import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    RetryBudget,
+    ServingGateway,
+)
+
+
+def _echo_handler(reqs):
+    out = {}
+    for r in reqs:
+        body = json.loads(r.body) if r.body else {}
+        out[r.id] = (200, json.dumps({"echo": body}).encode(), {})
+    return out
+
+
+def _worker(handler=_echo_handler, admission=None, **query_kw):
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer()
+    info = srv.start()
+    q = ServingQuery(srv, handler, admission=admission, **query_kw).start()
+    return srv, q, info
+
+
+def _post(port, path, obj, method="POST", headers=None, timeout=10):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        body = json.dumps(obj) if obj is not None else None
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        c.request(method, path, body=body, headers=hdrs)
+        r = c.getresponse()
+        return r.status, r.read(), dict(r.getheaders())
+    finally:
+        c.close()
+
+
+def _closed_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- circuit breaker (unit) ---------------------------------------------------
+
+
+def test_breaker_opens_on_consecutive_failures_and_probes_closed():
+    br = CircuitBreaker(open_after=3, cooldown_s=0.05)
+    t = 100.0
+    assert br.record_failure(t) is None
+    assert br.record_failure(t) is None
+    assert br.record_failure(t) == BREAKER_OPEN
+    assert not br.allow(t + 0.01)          # open: no traffic at all
+    assert br.allow(t + 0.06)              # open period elapsed: ONE probe
+    assert br.state == BREAKER_HALF_OPEN
+    assert not br.allow(t + 0.06)          # second request: probe in flight
+    assert br.record_ok(t + 0.07) == BREAKER_CLOSED
+    assert br.allow(t + 0.08) and br.fails == 0
+
+
+def test_breaker_failed_probe_reopens_with_doubled_period():
+    br = CircuitBreaker(open_after=2, cooldown_s=0.05, max_open_s=0.15)
+    t = 10.0
+    br.record_failure(t)
+    assert br.record_failure(t) == BREAKER_OPEN
+    assert br.open_for_s() == pytest.approx(0.05)
+    assert br.allow(t + 0.06)              # half-open probe
+    assert br.record_failure(t + 0.07) == BREAKER_OPEN  # probe failed
+    assert br.open_for_s() == pytest.approx(0.10)       # doubled
+    assert not br.allow(t + 0.12)          # 0.05 after reopen: still open
+    assert br.allow(t + 0.18)
+    br.record_failure(t + 0.19)
+    assert br.open_for_s() == pytest.approx(0.15)       # capped at max
+
+    br.reset()                              # a re-registered backend
+    assert br.state == BREAKER_CLOSED and br.opens_in_a_row == 0
+
+
+def test_breaker_error_rate_trip_requires_min_volume():
+    br = CircuitBreaker(
+        open_after=100,  # consecutive trip effectively off
+        rate_threshold=0.5, rate_window_s=10.0, rate_min_volume=10,
+    )
+    t = 50.0
+    # alternate ok/fail: never 100 consecutive, but 50% error rate
+    for i in range(9):
+        (br.record_failure if i % 2 else br.record_ok)(t + i * 0.01)
+    assert br.state == BREAKER_CLOSED       # below min volume: no trip
+    for i in range(9, 14):
+        transition = (br.record_failure if i % 2 else br.record_ok)(
+            t + i * 0.01
+        )
+        if transition == BREAKER_OPEN:
+            break
+    assert br.state == BREAKER_OPEN
+
+
+def test_breaker_open_after_zero_never_opens():
+    br = CircuitBreaker(open_after=0)
+    t = 0.0
+    for i in range(20):
+        br.record_failure(t + i)
+    assert br.state == BREAKER_CLOSED       # static-pool setting
+
+
+# -- retry budget (unit) ------------------------------------------------------
+
+
+def test_retry_budget_caps_retries_at_ratio_of_volume():
+    rb = RetryBudget(ratio=0.2, window_s=10.0, min_reserve=0)
+    for _ in range(50):
+        rb.note_request()
+    spent = sum(1 for _ in range(50) if rb.try_spend())
+    assert spent == 10                      # 20% of 50, not one more
+    assert rb.exhausted == 40
+    assert rb.remaining_ratio() == 0.0
+
+
+def test_retry_budget_min_reserve_lets_a_cold_gateway_retry():
+    rb = RetryBudget(ratio=0.2, window_s=10.0, min_reserve=3)
+    assert [rb.try_spend() for _ in range(4)] == [True] * 3 + [False]
+
+
+def test_retry_budget_window_prunes_old_volume():
+    rb = RetryBudget(ratio=1.0, window_s=0.05, min_reserve=0)
+    rb.note_request()
+    assert rb.try_spend()
+    time.sleep(0.08)                        # request AND retry age out
+    assert not rb.try_spend()               # no recent volume -> no budget
+
+
+# -- admission controller (unit) ----------------------------------------------
+
+
+def test_admission_acquire_release_and_shed():
+    a = AdmissionController(
+        server="selfheal-unit", initial_limit=2, min_limit=1
+    )
+    assert a.try_acquire() and a.try_acquire()
+    assert not a.try_acquire()              # over the limit: shed
+    assert a.shed == 1 and a.inflight == 2
+    a.release()
+    assert a.try_acquire()
+    a.release(), a.release()
+    assert a.inflight == 0
+
+
+def test_admission_aimd_decreases_on_queue_wait_and_recovers():
+    a = AdmissionController(
+        server="selfheal-aimd",
+        initial_limit=32, window_samples=1, window_s=0.0,
+        wait_factor=1.5, min_target_s=0.002, decrease=0.5,
+    )
+    # queue wait far above 1.5x the 10 ms service EWMA: halve the limit
+    a.observe(queue_wait_s=0.5, service_s=0.01)
+    assert a.limit == 16
+    a.observe(queue_wait_s=0.5, service_s=0.01)
+    assert a.limit == 8
+    # healthy windows: additive increase, +1 each
+    for _ in range(3):
+        a.observe(queue_wait_s=0.001, service_s=0.01)
+    assert a.limit == 11
+    # floor: the limit can never shed everything
+    for _ in range(20):
+        a.observe(queue_wait_s=5.0, service_s=0.01)
+    assert a.limit >= a.min_limit
+
+
+@pytest.mark.xdist_group("latency")
+def test_admission_sheds_429_at_ingress_with_retry_after():
+    ctrl = AdmissionController(
+        server="selfheal-adm", initial_limit=1, min_limit=1, max_limit=1,
+        retry_after_s=2.0,
+    )
+    gate = threading.Event()
+
+    def slow(reqs):
+        gate.wait(5.0)
+        return _echo_handler(reqs)
+
+    srv, q, info = _worker(slow, admission=ctrl)
+    try:
+        results = []
+
+        def client():
+            results.append(_post(info.port, "/", {"i": 1}))
+
+        t = threading.Thread(target=client)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while ctrl.inflight < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert ctrl.inflight == 1
+        # second request while the slot is held: fast 429, never queued
+        status, body, headers = _post(info.port, "/", {"i": 2})
+        assert status == 429
+        assert headers.get("Retry-After") == "2"
+        assert headers.get(SHED_HEADER) == "admission"
+        assert ctrl.shed == 1
+        gate.set()
+        t.join(5.0)
+        assert results and results[0][0] == 200
+        # the slot was released on reply: a new request is admitted
+        assert _post(info.port, "/", {"i": 3})[0] == 200
+        assert ctrl.inflight == 0
+    finally:
+        gate.set()
+        q.stop()
+        srv.stop()
+
+
+def test_admission_shed_fault_point_forces_429():
+    ctrl = AdmissionController(server="selfheal-forced", initial_limit=64)
+    srv, q, info = _worker(admission=ctrl)
+    plan = FaultPlan().on("admission.shed", payload=True, at=(0,))
+    try:
+        with plan.armed():
+            status, _, headers = _post(info.port, "/", {"i": 0})
+            assert status == 429 and headers.get(SHED_HEADER) == "admission"
+            assert _post(info.port, "/", {"i": 1})[0] == 200
+        assert plan.fires() == [("admission.shed", 0)]
+    finally:
+        q.stop()
+        srv.stop()
+
+
+# -- deadline propagation -----------------------------------------------------
+
+
+def _headers_handler(reqs):
+    """Echoes back the request headers the worker actually saw."""
+    out = {}
+    for r in reqs:
+        out[r.id] = (
+            200,
+            json.dumps({"deadline": r.headers.get(DEADLINE_HEADER)}).encode(),
+            {},
+        )
+    return out
+
+
+@pytest.mark.xdist_group("latency")
+def test_gateway_decrements_deadline_across_retries():
+    """Satellite fix: a retry must forward what is LEFT of the client's
+    deadline, not the original budget."""
+    s1, q1, i1 = _worker(_headers_handler)
+    dead = {"host": "127.0.0.1", "port": _closed_port()}
+    gw = ServingGateway(workers=[dead, i1], request_timeout_s=5.0)
+    ginfo = gw.start()
+    try:
+        t0 = time.perf_counter()
+        status, body, _ = _post(
+            ginfo.port, "/", {"i": 0},
+            headers={DEADLINE_HEADER: "5000"},
+        )
+        burned_ms = (time.perf_counter() - t0) * 1e3
+        assert status == 200
+        fwd = float(json.loads(body)["deadline"])
+        # decremented by the dead-backend attempt, but by no more than
+        # the request's actual wall time at the gateway
+        assert fwd < 5000.0
+        assert 5000.0 - fwd <= burned_ms + 1.0
+        assert gw.retried == 1
+    finally:
+        gw.stop()
+        q1.stop()
+        s1.stop()
+
+
+def test_gateway_expired_deadline_fails_504_without_forwarding():
+    s1, q1, i1 = _worker()
+    gw = ServingGateway(workers=[i1], request_timeout_s=5.0)
+    ginfo = gw.start()
+    try:
+        time.sleep(0.02)  # any queue wait at all blows a 0.01 ms budget
+        status, body, _ = _post(
+            ginfo.port, "/", {"i": 0}, headers={DEADLINE_HEADER: "0.01"},
+        )
+        assert status == 504 and b"deadline" in body
+        assert gw.forwarded == 0            # never reached a worker
+    finally:
+        gw.stop()
+        q1.stop()
+        s1.stop()
+
+
+def test_gateway_skips_retry_when_ewma_exceeds_remaining():
+    """Satellite fix, part 2: don't bother retrying on a backend whose
+    typical service time can't fit in the leftover budget."""
+    s1, q1, i1 = _worker()
+    dead = {"host": "127.0.0.1", "port": _closed_port()}
+    gw = ServingGateway(workers=[dead, i1], request_timeout_s=5.0)
+    ginfo = gw.start()
+    try:
+        live = [b for b in gw.pool.members() if b.port == i1.port][0]
+        gw.pool.report_ok(live, elapsed_s=10.0)  # EWMA: 10 s service time
+        status, body, _ = _post(
+            ginfo.port, "/", {"i": 0}, headers={DEADLINE_HEADER: "2000"},
+        )
+        # first attempt (dead) burned ~nothing, 2 s remain — but the only
+        # retry candidate needs ~10 s: fail fast instead of a doomed send
+        assert status == 504 and b"service time" in body
+        assert gw.forwarded == 0
+    finally:
+        gw.stop()
+        q1.stop()
+        s1.stop()
+
+
+@pytest.mark.xdist_group("latency")
+def test_worker_sheds_requests_whose_deadline_expired_in_queue():
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer()
+    info = srv.start()
+    results = []
+
+    def client():
+        results.append(
+            _post(info.port, "/", {"i": 0}, headers={DEADLINE_HEADER: "20"})
+        )
+
+    t = threading.Thread(target=client)
+    t.start()
+    time.sleep(0.15)  # queued with NO dispatcher running: deadline burns
+    q = ServingQuery(srv, _echo_handler).start()
+    try:
+        t.join(5.0)
+        status, body, headers = results[0]
+        assert status == 504 and b"deadline" in body
+        assert headers.get(SHED_HEADER) == "deadline"
+        assert q.deadline_expired == 1
+        # a fresh request with budget to spare is served normally
+        assert _post(info.port, "/", {"i": 1})[0] == 200
+    finally:
+        q.stop()
+        srv.stop()
+
+
+# -- 429 backpressure classification ------------------------------------------
+
+
+def test_shedding_replica_is_backpressure_not_failure():
+    """Satellite fix: a 429-shedding replica is alive and correct —
+    re-dispatch elsewhere, never cool it down or open its breaker."""
+    ctrl = AdmissionController(
+        server="selfheal-bp", initial_limit=1, min_limit=1, max_limit=1
+    )
+    ctrl.try_acquire()                      # wedge the only slot: all shed
+    s1, q1, i1 = _worker(admission=ctrl)
+    s2, q2, i2 = _worker()
+    gw = ServingGateway(workers=[i1, i2], request_timeout_s=5.0)
+    ginfo = gw.start()
+    try:
+        for i in range(4):
+            status, body, _ = _post(ginfo.port, "/", {"i": i})
+            assert status == 200            # re-dispatched to the healthy one
+        states = gw.pool.breaker_states()
+        assert all(v == "closed" for v in states.values())
+        assert gw.pool.size() == 2          # the shedder was NOT evicted
+        assert gw.failed == 0
+    finally:
+        gw.stop()
+        for s, q in ((s1, q1), (s2, q2)):
+            q.stop()
+            s.stop()
+
+
+def test_gateway_relays_429_when_every_backend_sheds():
+    ctrl = AdmissionController(
+        server="selfheal-bp2", initial_limit=1, min_limit=1, max_limit=1,
+        retry_after_s=3.0,
+    )
+    ctrl.try_acquire()
+    s1, q1, i1 = _worker(admission=ctrl)
+    gw = ServingGateway(workers=[i1], request_timeout_s=5.0)
+    ginfo = gw.start()
+    try:
+        status, _, headers = _post(ginfo.port, "/", {"i": 0})
+        assert status == 429                # the shed, relayed — not a 5xx
+        assert headers.get(SHED_HEADER) == "admission"
+        assert headers.get("Retry-After") == "3"
+        assert gw.pool.size() == 1
+    finally:
+        gw.stop()
+        q1.stop()
+        s1.stop()
+
+
+# -- retry budget at the gateway ----------------------------------------------
+
+
+def test_exhausted_retry_budget_fails_fast_with_header():
+    s1, q1, i1 = _worker()
+    dead = {"host": "127.0.0.1", "port": _closed_port()}
+    gw = ServingGateway(
+        workers=[dead, i1], request_timeout_s=5.0,
+        retry_budget_ratio=0.0, retry_budget_min=0,  # zero tokens, ever
+    )
+    ginfo = gw.start()
+    try:
+        # round-robin starts at the dead backend: the failure wants a
+        # retry, the empty bucket refuses it
+        status, body, headers = _post(ginfo.port, "/", {"i": 0})
+        assert status == 503
+        assert headers.get(RETRY_BUDGET_HEADER) == "exhausted"
+        assert gw.retried == 0
+        # the healthy backend still serves the NEXT request (round robin)
+        assert _post(ginfo.port, "/", {"i": 1})[0] == 200
+    finally:
+        gw.stop()
+        q1.stop()
+        s1.stop()
+
+
+# -- circuit breaker through the gateway --------------------------------------
+
+
+@pytest.mark.xdist_group("latency")
+def test_breaker_cycles_open_half_open_closed_through_gateway():
+    """A registry-discovered backend that fails repeatedly trips its
+    breaker OPEN (skipped entirely), then recovers through a half-open
+    probe once the open period elapses."""
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    reg = DriverRegistry(host="127.0.0.1", port=0)
+    s1, q1, i1 = _worker()
+    gw = ServingGateway(
+        registry_url=reg.url, request_timeout_s=5.0, refresh_s=0.1,
+        cooldown_s=0.3, evict_after=3,
+    )
+    try:
+        DriverRegistry.register(reg.url, i1)
+        ginfo = gw.start()
+        deadline = time.monotonic() + 5.0
+        while gw.pool.size() < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        b = gw.pool.members()[0]
+        for _ in range(3):
+            gw.pool.report_failure(b)
+        assert gw.pool.breaker_states() == {
+            f"{b.host}:{b.port}": "open"
+        }
+        assert gw.pool.size() == 0 and gw.pool.next() is None
+        time.sleep(0.35)                    # the open period elapses
+        nxt = gw.pool.next()
+        assert nxt == b                     # the half-open probe
+        assert gw.pool.breaker_states()[f"{b.host}:{b.port}"] == "half_open"
+        assert gw.pool.next() is None       # one probe at a time
+        gw.pool.report_failure(b)           # probe failed: reopen, doubled
+        assert gw.pool.breaker_states()[f"{b.host}:{b.port}"] == "open"
+        assert gw.pool.next() is None
+        time.sleep(0.7)                     # the doubled open period
+        # a REAL request through the gateway is the next probe — its
+        # success closes the breaker
+        status, _, _ = _post(ginfo.port, "/", {"i": 0})
+        assert status == 200
+        assert gw.pool.breaker_states()[f"{b.host}:{b.port}"] == "closed"
+        assert gw.pool.size() == 1
+    finally:
+        gw.stop()
+        q1.stop()
+        s1.stop()
+        reg.stop()
+
+
+# -- tail hedging -------------------------------------------------------------
+
+
+def _slow_then_echo(delay_s):
+    def handler(reqs):
+        time.sleep(delay_s)
+        return _echo_handler(reqs)
+
+    return handler
+
+
+@pytest.mark.xdist_group("latency")
+def test_hedge_duplicates_to_second_backend_and_first_answer_wins():
+    s1, q1, i1 = _worker(_slow_then_echo(1.0))   # round-robin primary
+    s2, q2, i2 = _worker()
+    gw = ServingGateway(
+        workers=[i1, i2], request_timeout_s=5.0, hedge_ms=60.0,
+    )
+    ginfo = gw.start()
+    try:
+        t0 = time.perf_counter()
+        status, body, _ = _post(ginfo.port, "/", {"i": 7})
+        elapsed = time.perf_counter() - t0
+        assert status == 200 and json.loads(body)["echo"]["i"] == 7
+        assert gw.hedged == 1 and gw.hedge_wins == 1
+        assert elapsed < 0.9                 # did NOT wait out the primary
+        # the slow loser was cancelled, not failed: breaker stays closed
+        assert all(
+            v == "closed" for v in gw.pool.breaker_states().values()
+        )
+        assert gw.failed == 0
+    finally:
+        gw.stop()
+        for s, q in ((s1, q1), (s2, q2)):
+            q.stop()
+            s.stop()
+
+
+@pytest.mark.xdist_group("latency")
+def test_hedge_fault_point_suppresses_the_duplicate():
+    s1, q1, i1 = _worker(_slow_then_echo(0.3))
+    s2, q2, i2 = _worker()
+    gw = ServingGateway(
+        workers=[i1, i2], request_timeout_s=5.0, hedge_ms=40.0,
+    )
+    ginfo = gw.start()
+    plan = FaultPlan().on("gateway.hedge", error=RuntimeError, at=(0,))
+    try:
+        with plan.armed():
+            status, body, _ = _post(ginfo.port, "/", {"i": 1})
+        assert status == 200                 # primary answered eventually
+        assert json.loads(body)["echo"]["i"] == 1
+        assert gw.hedged == 0                # the duplicate never launched
+        assert plan.fires() == [("gateway.hedge", 0)]
+    finally:
+        gw.stop()
+        for s, q in ((s1, q1), (s2, q2)):
+            q.stop()
+            s.stop()
+
+
+@pytest.mark.xdist_group("latency")
+def test_hedge_failed_primary_falls_back_to_retry_loop():
+    """Both hedged attempts dying must not lose the request: the normal
+    retry loop picks it up against the retry budget."""
+    s2, q2, i2 = _worker()
+    dead1 = {"host": "127.0.0.1", "port": _closed_port()}
+    dead2 = {"host": "127.0.0.1", "port": _closed_port()}
+    gw = ServingGateway(
+        workers=[dead1, dead2, i2], request_timeout_s=5.0, hedge_ms=20.0,
+    )
+    ginfo = gw.start()
+    try:
+        status, body, _ = _post(ginfo.port, "/", {"i": 3})
+        assert status == 200 and json.loads(body)["echo"]["i"] == 3
+    finally:
+        gw.stop()
+        q2.stop()
+        s2.stop()
+
+
+# -- fleet supervisor ---------------------------------------------------------
+
+
+def _sleeper_charge(name="sleeper", health_url=None):
+    from mmlspark_tpu.serving.supervisor import WorkerCharge
+
+    return WorkerCharge(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        name=name, health_url=health_url,
+    )
+
+
+@pytest.mark.xdist_group("latency")
+def test_supervisor_restarts_exited_charge():
+    from mmlspark_tpu.serving.supervisor import FleetSupervisor
+
+    c = _sleeper_charge()
+    sup = FleetSupervisor(
+        [c], probe_s=0.05, backoff_s=0.05, stable_s=10.0
+    ).start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not c.alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert c.alive()
+        first_pid = c.proc.pid
+        c.proc.kill()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if c.restarts >= 1 and c.alive():
+                break
+            time.sleep(0.02)
+        assert c.restarts == 1 and c.alive()
+        assert c.proc.pid != first_pid
+        assert sup.status()["up"] == 1
+    finally:
+        sup.stop()
+    assert not c.alive()                     # stop() reaps the charge
+
+
+@pytest.mark.xdist_group("latency")
+def test_supervisor_crash_loop_backs_off_exponentially():
+    from mmlspark_tpu.serving.supervisor import FleetSupervisor, WorkerCharge
+
+    # exits immediately: a crash loop
+    c = WorkerCharge([sys.executable, "-c", "pass"], name="crashy")
+    sup = FleetSupervisor(
+        [c], probe_s=0.02, backoff_s=0.05, backoff_max_s=0.2, stable_s=30.0
+    ).start()
+    try:
+        deadline = time.monotonic() + 4.0
+        while c.restarts < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert c.restarts >= 3
+        assert c.streak >= 3                 # fast deaths kept the streak
+        # the streak implies the NEXT delay would be capped
+        assert min(
+            sup.backoff_max_s, sup.backoff_s * (2 ** (c.streak - 1))
+        ) <= sup.backoff_max_s
+    finally:
+        sup.stop()
+
+
+@pytest.mark.xdist_group("latency")
+def test_supervisor_kills_and_restarts_wedged_charge():
+    from mmlspark_tpu.serving.supervisor import FleetSupervisor
+
+    # alive process, but /health points at nothing: wedged
+    c = _sleeper_charge(
+        name="wedged", health_url=f"http://127.0.0.1:{_closed_port()}/health"
+    )
+    sup = FleetSupervisor(
+        [c], probe_s=0.05, probe_timeout_s=0.2, wedge_after=2,
+        backoff_s=0.05, stable_s=30.0, startup_grace_s=0.0,
+    ).start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while c.restarts < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert c.restarts >= 1
+        assert c.last_reason == "wedged" or c.restarts >= 1
+    finally:
+        sup.stop()
+
+
+@pytest.mark.xdist_group("latency")
+def test_supervisor_restart_fault_point_defers_the_respawn():
+    from mmlspark_tpu.serving.supervisor import FleetSupervisor
+
+    c = _sleeper_charge(name="faulted")
+    sup = FleetSupervisor(
+        [c], probe_s=0.05, backoff_s=0.05, stable_s=10.0
+    ).start()
+    plan = FaultPlan().on("supervisor.restart", error=RuntimeError, at=(0,))
+    try:
+        deadline = time.monotonic() + 5.0
+        while not c.alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        with plan.armed():
+            c.proc.kill()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if c.restarts >= 1 and c.alive():
+                    break
+                time.sleep(0.02)
+        # the first respawn attempt was refused (chaos), the next tick
+        # retried and succeeded — self-healing heals its own hiccups
+        assert c.restarts == 1 and c.alive()
+        assert plan.fires() == [("supervisor.restart", 0)]
+    finally:
+        sup.stop()
+
+
+def test_charge_from_worker_args_derives_health_url():
+    from mmlspark_tpu.serving.supervisor import charge_from_worker_args
+
+    c = charge_from_worker_args(
+        "--model echo --port 9101 --host 0.0.0.0", "http://r:9090/", 0
+    )
+    assert c.health_url == "http://127.0.0.1:9101/health"
+    assert "--registry" in c.argv and "http://r:9090/" in c.argv
+    assert c.argv.count("--port") == 1
+
+    c2 = charge_from_worker_args(
+        "--model echo --port 9102 --advertise-host worker-a",
+        "http://r:9090/", 1,
+    )
+    assert c2.health_url == "http://worker-a:9102/health"
+
+    c3 = charge_from_worker_args("--model echo", "http://r:9090/", 2)
+    assert c3.health_url is None             # ephemeral port: liveness only
+
+
+# -- breaker reset keyed on boot, not heartbeat ts ----------------------------
+
+
+def test_roster_refresh_resets_breaker_only_on_new_boot():
+    """The registry bumps ``ts`` on every heartbeat — the breaker reset
+    must key on the per-process ``boot`` stamp instead, or a wedged-but-
+    heartbeating worker's open breaker flaps closed every refresh."""
+    from mmlspark_tpu.serving.distributed import Backend, BackendPool
+
+    pool = BackendPool(cooldown_s=60.0, evict_after=2)
+    b = Backend("127.0.0.1", 19999)
+    pool.refresh([b], stamps={b: 100.0})
+    pool.report_failure(b)
+    pool.report_failure(b)
+    key = f"{b.host}:{b.port}"
+    assert pool.breaker_states()[key] == "open"
+    # heartbeat: same process delivers the same boot stamp — stays open
+    pool.refresh([b], stamps={b: 100.0})
+    assert pool.breaker_states()[key] == "open"
+    assert pool.next() is None
+    # restart: a NEW boot stamp closes the breaker immediately
+    pool.refresh([b], stamps={b: 200.0})
+    assert pool.breaker_states()[key] == "closed"
+    assert pool.next() == b
+
+
+def test_worker_boot_stamp_constant_across_heartbeats():
+    from mmlspark_tpu.serving.registry import DriverRegistry
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer(name="selfheal-boot")
+    reg = DriverRegistry(host="127.0.0.1", port=0)
+    try:
+        info = srv.start()
+        assert info.boot is not None
+        DriverRegistry.register(reg.url, info)
+        first = reg.services("selfheal-boot")[0]
+        time.sleep(0.02)
+        DriverRegistry.register(reg.url, info)  # the heartbeat re-register
+        second = reg.services("selfheal-boot")[0]
+        assert second["ts"] > first["ts"]       # ts bumps every beat...
+        assert second["boot"] == first["boot"] == info.boot  # ...boot doesn't
+    finally:
+        reg.stop()
+        srv.stop()
+
+
+# -- half-open probe slot return ----------------------------------------------
+
+
+def test_report_abandoned_returns_half_open_probe_slot():
+    """``next()`` hands out the single half-open probe; a caller that
+    never contacts the backend must return the slot or the breaker waits
+    forever for an outcome and the backend stays unroutable."""
+    from mmlspark_tpu.serving.distributed import Backend, BackendPool
+
+    pool = BackendPool(cooldown_s=0.05, evict_after=1)
+    b = Backend("127.0.0.1", 19998)
+    pool.refresh([b], stamps={b: 1.0})
+    pool.report_failure(b)                  # opens the breaker
+    key = f"{b.host}:{b.port}"
+    assert pool.breaker_states()[key] == "open"
+    time.sleep(0.06)                        # the open period elapses
+    assert pool.next() == b                 # the half-open probe
+    assert pool.next() is None              # slot held by the probe
+    pool.report_abandoned(b)                # probe never sent
+    assert pool.breaker_states()[key] == "half_open"
+    assert pool.next() == b                 # the slot came back
+    pool.report_ok(b)
+    assert pool.breaker_states()[key] == "closed"
+
+
+def test_report_abandoned_is_noop_for_closed_breaker():
+    from mmlspark_tpu.serving.distributed import Backend, BackendPool
+
+    pool = BackendPool(cooldown_s=0.05, evict_after=3)
+    b = Backend("127.0.0.1", 19997)
+    pool.refresh([b], stamps={b: 1.0})
+    pool.report_abandoned(b)                # no breaker minted, no crash
+    pool.report_failure(b)
+    pool.report_abandoned(b)                # closed breaker: untouched
+    assert pool.breaker_states()[f"{b.host}:{b.port}"] == "closed"
+    assert pool.next() == b
+
+
+# -- forced-shed accounting ---------------------------------------------------
+
+
+def test_force_shed_counts_like_a_real_shed():
+    a = AdmissionController(server="selfheal-forceshed", initial_limit=4)
+    a.force_shed()
+    a.force_shed()
+    assert a.shed == 2
+    assert a.inflight == 0                  # never touches admission state
+    assert a.try_acquire()                  # and never blocks admission
+
+
+# -- hedged shed / model-state classification ---------------------------------
+
+
+@pytest.mark.xdist_group("latency")
+def test_hedged_gateway_relays_shed_as_backpressure_not_forward():
+    """A 429 shed must not 'win' a hedged race as a forwarded answer:
+    it is stashed, classified backpressure, and relayed with its
+    Retry-After when nothing better arrives."""
+    ctrl = AdmissionController(
+        server="selfheal-hbp", initial_limit=1, min_limit=1, max_limit=1,
+        retry_after_s=3.0,
+    )
+    ctrl.try_acquire()                      # wedge the only slot: all shed
+    s1, q1, i1 = _worker(admission=ctrl)
+    gw = ServingGateway(
+        workers=[i1], request_timeout_s=5.0, hedge_ms=60.0,
+    )
+    ginfo = gw.start()
+    try:
+        status, _, headers = _post(ginfo.port, "/", {"i": 0})
+        assert status == 429
+        assert headers.get(SHED_HEADER) == "admission"
+        assert headers.get("Retry-After") == "3"
+        assert gw.forwarded == 0            # a shed is not a forward
+        assert gw.failed == 1
+        # and the shedding replica was never blamed for it
+        assert all(
+            v == "closed" for v in gw.pool.breaker_states().values()
+        )
+    finally:
+        gw.stop()
+        q1.stop()
+        s1.stop()
+
+
+@pytest.mark.xdist_group("latency")
+def test_hedged_shed_retries_on_second_replica_before_relaying():
+    """With hedging on, a replica that sheds FASTER than the hedge delay
+    must not short-circuit the cross-replica retry: the standard loop
+    gets the request and the replica with headroom serves it."""
+    ctrl = AdmissionController(
+        server="selfheal-hedgeshed", initial_limit=1, min_limit=1,
+        max_limit=1,
+    )
+    ctrl.try_acquire()                      # wedge the only slot: A sheds
+    s1, q1, i1 = _worker(admission=ctrl)    # round-robin primary
+    s2, q2, i2 = _worker()
+    gw = ServingGateway(
+        workers=[i1, i2], request_timeout_s=5.0, hedge_ms=60.0,
+    )
+    ginfo = gw.start()
+    try:
+        status, body, _ = _post(ginfo.port, "/", {"i": 5})
+        assert status == 200 and json.loads(body)["echo"]["i"] == 5
+        assert gw.forwarded == 1
+        # the shedding replica was classified backpressure, never blamed
+        assert all(
+            v == "closed" for v in gw.pool.breaker_states().values()
+        )
+    finally:
+        gw.stop()
+        for s, q in ((s1, q1), (s2, q2)):
+            q.stop()
+            s.stop()
+
+
+@pytest.mark.xdist_group("latency")
+def test_hedged_not_ready_retries_on_second_replica_before_relaying():
+    """Same for a fast model-state 503 (mid-swap/loading replica): with
+    hedging on, the other replica — which already serves the model —
+    must get the request before the gateway relays the 503."""
+    def _loading(reqs):
+        return {
+            r.id: (
+                503, b'{"error": "model loading"}',
+                {"x-mmlspark-model-state": "loading"},
+            )
+            for r in reqs
+        }
+
+    s1, q1, i1 = _worker(_loading)          # round-robin primary
+    s2, q2, i2 = _worker()
+    gw = ServingGateway(
+        workers=[i1, i2], request_timeout_s=5.0, hedge_ms=60.0,
+    )
+    ginfo = gw.start()
+    try:
+        status, body, _ = _post(ginfo.port, "/", {"i": 6})
+        assert status == 200 and json.loads(body)["echo"]["i"] == 6
+        assert gw.forwarded == 1
+    finally:
+        gw.stop()
+        for s, q in ((s1, q1), (s2, q2)):
+            q.stop()
+            s.stop()
+
+
+# -- breaker bookkeeping bounds -----------------------------------------------
+
+
+def test_breaker_outcome_window_bounded_on_success_path():
+    """The happy path must not grow the outcome window forever: record_ok
+    prunes by time (and the deque is hard-capped regardless of rate)."""
+    br = CircuitBreaker(rate_window_s=1.0)
+    for i in range(10_000):
+        br.record_ok(i * 0.01)              # 100 ok/s for 100 simulated s
+    assert len(br._window) <= 110           # ~one window's worth, not 10k
+    assert br._window.maxlen is not None    # hard cap at any rate
+
+
+def test_half_open_probe_readmission_counts_one_transition():
+    """report_abandoned returning the probe slot re-admits a probe while
+    the breaker is ALREADY half-open — that is not a new transition and
+    must not inflate the cycle-evidence counter."""
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.serving.distributed import Backend, BackendPool
+
+    pool = BackendPool(cooldown_s=0.05, evict_after=1)
+    b = Backend("127.0.0.1", 19996)
+    pool.refresh([b], stamps={b: 1.0})
+
+    def half_open_count():
+        parsed = obs.parse_text(obs.render())
+        return obs.sum_samples(
+            parsed, "mmlspark_gateway_breaker_transitions_total",
+            {"backend": f"{b.host}:{b.port}", "state": "half_open"},
+        )
+
+    base = half_open_count()
+    pool.report_failure(b)                  # opens the breaker
+    time.sleep(0.06)                        # the open period elapses
+    assert pool.next() == b                 # open -> half-open: the probe
+    pool.report_abandoned(b)                # probe never sent, slot back
+    assert pool.next() == b                 # re-admitted, SAME half-open
+    assert half_open_count() - base == 1
+
+
+# -- probe overflow bound at ingress ------------------------------------------
+
+
+def _fire_raw(port: int, data: bytes) -> socket.socket:
+    """Send a raw request and keep the socket open (the request stays
+    pending — no dispatcher is draining the queue)."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    s.sendall(data)
+    return s
+
+
+@pytest.mark.xdist_group("latency")
+def test_probe_overflow_closes_unanswered_past_bound():
+    """Probes may queue past max_queue (never bounced inline — a 429/503
+    would read as 'alive' and defeat wedge detection) but only up to the
+    overflow allowance; beyond it the connection closes unanswered,
+    which reads as a failed probe."""
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer(name="selfheal-probeflood", max_queue=1)
+    srv._PROBE_OVERFLOW = 2
+    info = srv.start()
+    opened = []
+    probe = b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n"
+    post = (b"POST / HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 2\r\n\r\n{}")
+    try:
+        def wait_pending(n):
+            deadline = time.monotonic() + 5.0
+            while srv.pending() < n and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert srv.pending() == n
+
+        opened.append(_fire_raw(info.port, post))     # fills max_queue
+        wait_pending(1)
+        # a normal request past max_queue bounces 503 inline
+        assert _post(info.port, "/", {"i": 1})[0] == 503
+        # probes still ride the queue, up to the overflow allowance
+        opened.append(_fire_raw(info.port, probe))
+        wait_pending(2)
+        opened.append(_fire_raw(info.port, probe))
+        wait_pending(3)
+        # past max_queue + overflow: closed unanswered (a failed probe)
+        with pytest.raises((http.client.BadStatusLine, ConnectionError)):
+            _post(info.port, "/health", None, method="GET", timeout=5)
+        assert srv.pending() == 3            # the flood never grew the queue
+    finally:
+        for s in opened:
+            s.close()
+        srv.stop()
+
+
+# -- fleet top degradation ----------------------------------------------------
+
+
+def test_fleet_top_admission_and_breaker_columns_degrade():
+    from mmlspark_tpu.serving import fleet
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    # a worker WITHOUT admission control and no gateway: both new
+    # columns must show '-' (pre-PR-5 fleet), not crash or invent
+    # zeros. A unique service label: the process-global registry may
+    # hold admission series for "serving" from other tests' workers
+    srv = WorkerServer(name="selfheal-top")
+    info = srv.start()
+    q = ServingQuery(srv, _echo_handler).start()
+    try:
+        out = fleet.run_top(
+            worker_urls=[f"http://127.0.0.1:{info.port}"],
+            service_name="selfheal-top",
+        )
+        assert "INFL/LIM" in out and "BREAKER" in out
+        row = [
+            ln for ln in out.splitlines()
+            if ln.startswith(f"127.0.0.1:{info.port}")
+        ][0]
+        cells = row.split()
+        assert cells[-2] == "-" and cells[-3] == "-"
+    finally:
+        q.stop()
+        srv.stop()

@@ -665,21 +665,25 @@ def _pool_backend(port):
     return Backend(host="10.0.0.1", port=port)
 
 
-def test_pool_evicted_backend_same_stamp_stays_dead():
+def test_pool_breaker_opens_and_closes_on_reregistration():
     """A dead worker's roster entry keeps its registration timestamp; a
-    refresh carrying the SAME stamp must not resurrect an evicted backend
-    — only an actual re-registration (newer stamp) revives it."""
+    refresh carrying the SAME stamp must not close its open breaker —
+    only an actual re-registration (newer stamp, i.e. a new process)
+    resets it immediately."""
     from mmlspark_tpu.serving.distributed import BackendPool
 
     b = _pool_backend(9001)
-    pool = BackendPool(cooldown_s=0.0, evict_after=3)
+    pool = BackendPool(cooldown_s=60.0, evict_after=3)
     pool.refresh([b], stamps={b: 100.0})
     for _ in range(3):
         pool.report_failure(b)
-    assert pool.size() == 0
+    # breaker OPEN: skipped entirely, not even as a cooled-down fallback
+    assert pool.breaker_states() == {"10.0.0.1:9001": "open"}
+    assert pool.size() == 0 and pool.next() is None
     pool.refresh([b], stamps={b: 100.0})  # stale roster echo: same stamp
     assert pool.size() == 0 and pool.next() is None
     pool.refresh([b], stamps={b: 101.0})  # real re-registration: new stamp
+    assert pool.breaker_states() == {"10.0.0.1:9001": "closed"}
     assert pool.size() == 1 and pool.next() == b
 
 

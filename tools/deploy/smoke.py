@@ -20,6 +20,14 @@ gate then requires the forwarded-counter delta to equal client successes
 ACROSS the flip (the two control ops per backend are accounted for), so
 a swap that drops even one request fails the smoke.
 
+Containment gate (default on, with the metrics gate): after the run the
+gateway's breaker gauges must be sane (every
+``mmlspark_gateway_breaker_state`` in {closed, open, half-open}, retry
+budget in [0, 1]); when ``gateway.forward`` faults were injected in the
+gateway's own process (a fleet role armed with ``--fault-plan``), a
+breaker must additionally have OPENED at least once — proof the
+containment layer reacts to chaos rather than sleeping through it.
+
 Chaos smoke (``--fault-plan``): arm a deterministic fault plan
 (mmlspark_tpu/core/faults.py) in THIS client and route every request
 through the framework's retrying AdvancedHandler instead of a bare
@@ -97,6 +105,9 @@ def _fleet_counters(gateway_url: str, registry_url, service: str) -> dict:
             if has_gw else None
         ),
         "workers_accepted": None,
+        # raw gateway scrape for the containment gate (breaker/budget
+        # deltas need more than one pre-summed counter)
+        "gateway_raw": gw if has_gw else None,
     }
     if registry_url:
         try:
@@ -251,6 +262,109 @@ def _swap_drill(url: str, n: int, registry_url, service: str,
     )
 
 
+def _verify_containment(before: dict, after: dict, plan=None) -> bool:
+    """Containment gate (default on): the gateway's failure-containment
+    surfaces must be present and sane after the run — every
+    ``mmlspark_gateway_breaker_state`` gauge in {closed, open,
+    half-open}, the retry-budget gauge in [0, 1] — and when the fault
+    plan guarantees a breaker-tripping burst (a contiguous always-fire
+    ``gateway.forward`` error rule, with enough fires *in the gateway
+    process* for >= 3 consecutive failures per backend — the default
+    consecutive-failure threshold), a breaker must actually have OPENED
+    at least once: chaos that the containment layer slept through is a
+    failed gate, not a quiet pass. Scattered schedules (probability
+    draws, ``every``-N strides, sparse ``at`` lists) interleave
+    successes that reset the failure streak — chaos the breaker is
+    *right* not to trip on, so the opened requirement is waived. Skips
+    on targets without breaker gauges (pre-containment build, or a
+    worker smoked directly)."""
+    _ensure_repo_path()
+    from mmlspark_tpu import obs
+
+    gw_b, gw_a = before.get("gateway_raw"), after.get("gateway_raw")
+    if gw_a is None:
+        print("smoke: target exposes no gateway metrics; "
+              "skipping containment gate")
+        return True
+    states = {
+        dict(labels).get("backend", "?"): v
+        for (name, labels), v in gw_a.items()
+        if name == "mmlspark_gateway_breaker_state"
+    }
+    if not states:
+        print("smoke: gateway exports no breaker gauges; "
+              "skipping containment gate")
+        return True
+    good = all(v in (0.0, 1.0, 2.0) for v in states.values())
+    budget = [
+        v for (name, _labels), v in gw_a.items()
+        if name == "mmlspark_gateway_retry_budget_remaining_ratio"
+    ]
+    budget_ok = bool(budget) and all(0.0 <= v <= 1.0 for v in budget)
+    n_open = sum(1 for v in states.values() if v != 0.0)
+    budget_str = (
+        f"retry budget {budget[0] * 100:.0f}%" if budget
+        else "retry budget gauge MISSING"
+    )
+    print(
+        f"smoke: containment — {len(states)} breaker(s), {n_open} not "
+        f"closed, {budget_str}"
+    )
+    good = good and budget_ok
+
+    def delta(name, match=None):
+        a = obs.sum_samples(gw_a, name, match)
+        b = obs.sum_samples(gw_b, name, match) if gw_b is not None else 0.0
+        return a - b
+
+    injected_fw = delta(
+        "mmlspark_faults_injected_total", {"point": "gateway.forward"}
+    )
+    # a contiguous always-fire error rule means EVERY forward failed while
+    # it was live: round-robined across the pool, each backend's streak
+    # grows uninterrupted, so >= 3 fires per breaker guarantees a trip.
+    # An `at` list counts when it contains a run of >= 3 consecutive steps
+    def _longest_run(at) -> int:
+        s = sorted(at)
+        best = run = 1 if s else 0
+        for a, b in zip(s, s[1:]):
+            run = run + 1 if b == a + 1 else 1
+            best = max(best, run)
+        return best
+
+    burst = plan is not None and any(
+        r.error is not None and r.probability >= 1.0 and r.every <= 1
+        and (r.at is None or _longest_run(r.at) >= 3)
+        for r in plan.rules("gateway.forward")
+    )
+    # fires-per-backend denominator: the pool's live-backend gauge (the
+    # breaker-gauge count includes stale series from departed backends —
+    # and, in-process, from other gateway instances sharing the registry)
+    pool_size = next(
+        (v for (name, _l), v in gw_a.items()
+         if name == "mmlspark_gateway_backends_count"), 0.0,
+    )
+    per_backend = int(pool_size) if pool_size >= 1 else len(states)
+    if burst and injected_fw >= 3 * max(1, per_backend):
+        opened = delta(
+            "mmlspark_gateway_breaker_transitions_total", {"state": "open"}
+        )
+        opened_ok = opened >= 1
+        verdict = "ok" if opened_ok else "MISMATCH (chaos never tripped one)"
+        print(
+            f"smoke: {injected_fw:.0f} gateway.forward fault(s) hit the "
+            f"gateway, breaker opened {opened:.0f} time(s) — {verdict}"
+        )
+        good = good and opened_ok
+    elif injected_fw:
+        print(
+            f"smoke: {injected_fw:.0f} gateway.forward fault(s) hit the "
+            f"gateway (schedule not guaranteed to trip a breaker — "
+            f"open requirement waived)"
+        )
+    return good
+
+
 def _verify_trace(url: str, registry_url, service: str) -> bool:
     """Trace-assembly gate (default on): fetch the slowest trace via the
     collector and require both a gateway hop and a worker hop in the
@@ -282,13 +396,21 @@ def _verify_trace(url: str, registry_url, service: str) -> bool:
         # a worker smoked directly has no gateway spans to assemble
         print("smoke: target buffers no gateway traces; skipping trace gate")
         return True
-    ranked = traces_mod.slowest_traces(exemplars, n=1)
-    if ranked:
-        tid = ranked[0][1]
-        tspans = [s for s in spans if s.trace_id == tid]
-        how = f"slowest exemplar trace {tid} ({ranked[0][0] * 1e3:.2f} ms)"
-    else:
-        # cold exemplars: any gateway-rooted trace will do
+    # slowest exemplar first — but exemplars outlive the bounded span
+    # rings (a bucket remembers its LAST observation's trace id forever,
+    # the ring ages out), so fall back through the ranking to the first
+    # exemplar that still resolves to buffered spans, then to the latest
+    # gateway-rooted trace. A long-lived fleet must not fail the gate on
+    # a stale exemplar.
+    tid, tspans, how = None, [], ""
+    for v, cand in traces_mod.slowest_traces(exemplars, n=5):
+        cand_spans = [s for s in spans if s.trace_id == cand]
+        if cand_spans:
+            tid, tspans = cand, cand_spans
+            how = f"slowest live exemplar trace {cand} ({v * 1e3:.2f} ms)"
+            break
+    if tid is None:
+        # cold (or fully aged-out) exemplars: any gateway-rooted trace
         gw_spans = [s for s in spans if s.name == "gateway.request"]
         tid = gw_spans[-1].trace_id
         tspans = [s for s in spans if s.trace_id == tid]
@@ -470,6 +592,7 @@ def main(argv=None) -> int:
             extra_gw=extra_gw, extra_workers=extra_workers,
         )
         metrics_ok = _verify_slo(args.url) and metrics_ok
+        metrics_ok = _verify_containment(before, after, plan) and metrics_ok
     trace_ok = True
     if not args.no_verify_trace:
         trace_ok = _verify_trace(args.url, args.registry, args.service_name)

@@ -31,9 +31,12 @@ SCAN_DIRS = ("mmlspark_tpu", "tools")
 
 SUBSYSTEMS = (
     "core", "io", "serving", "gateway", "registry", "parallel", "gbdt",
-    "faults", "trace", "modelstore", "slo",
+    "faults", "trace", "modelstore", "slo", "admission", "supervisor",
 )
-UNITS = ("total", "seconds", "requests", "count", "bytes", "ratio", "rows")
+# "state" is for enum-valued gauges (e.g. the circuit-breaker gauge
+# mmlspark_gateway_breaker_state: 0=closed 1=open 2=half-open)
+UNITS = ("total", "seconds", "requests", "count", "bytes", "ratio", "rows",
+         "state")
 
 # registration call with a literal first argument, possibly wrapped to the
 # next line: obs.counter(\n    "mmlspark_io_requests_total", ...
