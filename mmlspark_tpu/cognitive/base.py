@@ -169,6 +169,20 @@ class CognitiveServiceBase(Transformer, _HasServiceParams, HasOutputCol):
         url = self.get_or_fail("url").rstrip("/") + path + (f"?{query}" if query else "")
         return HTTPRequestData(url, "POST", self._headers(vals), json.dumps(body))
 
+    # -- pipeline-compiler declaration ---------------------------------------
+
+    def pipeline_io(self) -> tuple:
+        """Declared I/O for the pipeline compiler: reads the columns bound
+        via ``set_col``, writes the output then error column (staged
+        insertion order). Host-bound, row-local, row-preserving — the
+        scheduler overlaps independent cognitive calls on separate
+        branches."""
+        out_col = self.get_or_fail("output_col")
+        return (
+            tuple(self._service_cols()),
+            (out_col, self.get("error_col") or f"{out_col}_error"),
+        )
+
     # -- transform -----------------------------------------------------------
 
     def transform(self, df: DataFrame) -> DataFrame:

@@ -1,0 +1,61 @@
+"""Pipeline compiler: fitted pipelines -> partitioned, scheduled XLA programs.
+
+The reference executes a fitted ``PipelineModel`` stage by stage — a zoo
+of independent transformers, each paying its own dispatch and
+materializing every intermediate column on the host. This package turns
+that zoo into (close to) one partitioned XLA program per pipeline:
+
+- :mod:`planner`     — stage DAG from column I/O + fusability classes;
+- :mod:`kernels`     — the ``StageKernel`` fusability contract;
+- :mod:`fuser`       — maximal fusable runs -> single jitted programs with
+  bounded compile-cache buckets;
+- :mod:`partitioner` — Automap-style NamedSharding propagation with search
+  only at conflict points (arXiv:2112.02958);
+- :mod:`scheduler`   — critical-path ordering of independent branches
+  (arXiv:1711.01912) + overlapped host segments;
+- :mod:`compiled`    — :class:`CompiledPipeline`, the drop-in Transformer
+  (``PipelineModel.compile()``).
+
+Correctness contract: compiled output is element-wise equal to staged
+execution (tests/test_compiler.py goldens), with graceful per-call
+fallback to staged execution whenever a segment cannot run an input.
+"""
+
+from mmlspark_tpu.compiler.compiled import CompiledPipeline
+from mmlspark_tpu.compiler.fuser import FusedSegment, HostSegment, build_segments
+from mmlspark_tpu.compiler.kernels import (
+    StageKernel,
+    guard_dense_numeric,
+    pairwise_sum,
+    stage_kernel,
+)
+from mmlspark_tpu.compiler.partitioner import ShardingPlan, plan_sharding
+from mmlspark_tpu.compiler.planner import PipelinePlan, plan_pipeline, stage_io
+from mmlspark_tpu.compiler.scheduler import (
+    CostModel,
+    ScheduledExecutor,
+    critical_path,
+    schedule_order,
+    segment_deps,
+)
+
+__all__ = [
+    "CompiledPipeline",
+    "CostModel",
+    "FusedSegment",
+    "HostSegment",
+    "PipelinePlan",
+    "ScheduledExecutor",
+    "ShardingPlan",
+    "StageKernel",
+    "build_segments",
+    "critical_path",
+    "guard_dense_numeric",
+    "pairwise_sum",
+    "plan_pipeline",
+    "plan_sharding",
+    "schedule_order",
+    "segment_deps",
+    "stage_io",
+    "stage_kernel",
+]

@@ -1,0 +1,197 @@
+"""The fusability contract between pipeline stages and the compiler.
+
+A stage opts into jit-fusion by implementing ``fusable_kernel()`` and
+returning a :class:`StageKernel` — a *pure array→array* description of its
+transform: which columns it reads, which it writes, and a jit-traceable
+function mapping input column arrays to output column arrays. The fuser
+(:mod:`mmlspark_tpu.compiler.fuser`) merges runs of adjacent kernels into
+one XLA program; the partitioner propagates shardings through them.
+
+The correctness contract a kernel author signs (docs/compiler.md):
+
+- ``fn`` run under ``jax.jit`` on the declared reads must produce, for
+  every row, exactly the values the stage's own ``transform`` would —
+  including dtype-cast behaviour. Mirror the staged path's casts inside
+  the kernel (and declare host-side output dtypes via ``out_dtypes`` for
+  values the staged path materializes beyond float32, e.g. ``float64``
+  prediction columns: with x64 disabled those casts must happen on host).
+- ``fn`` must be row-independent along axis 0 (``row_wise=True``): the
+  fuser pads batches to power-of-two buckets and slices the pad back off,
+  which is only sound when one row's output never depends on another row.
+  Declare ``row_wise=False`` for cross-row kernels — the partitioner then
+  treats the kernel's columns as a replication demand (a sharding
+  conflict point) and the fuser never pads through it.
+- ``guard`` (optional) inspects the *host* input columns before tracing
+  and returns a reason string when the kernel cannot handle them (object
+  dtype, unrolled layouts, ...); the fused segment then falls back to
+  staged execution for that DataFrame, recorded in
+  ``mmlspark_compiler_fallback_total{reason=...}``.
+- ``finalize`` (optional) is a **host epilogue**: with x64 disabled the
+  device cannot bit-match every host op the staged path uses (libm
+  ``exp`` in a sigmoid/softmax, float64 arithmetic). A kernel whose
+  staged transform ends in such ops declares ``device_writes`` (the raw
+  device outputs, e.g. summed tree scores) and a ``finalize(host_cols)
+  -> {col: array}`` that replays the staged path's *exact numpy
+  epilogue* on the fetched device arrays. The heavy array math stays in
+  the one fused XLA program; the epilogue is the same host code staged
+  execution runs, so equality is by construction. The fuser closes a
+  fusion run after a finalize kernel (its outputs live on host).
+
+Floating-point summation is the other exactness trap: ``np.sum`` uses
+pairwise summation, XLA reduces in a different order, and float32 adds do
+not associate. :func:`pairwise_sum` reproduces numpy's exact association
+order with jnp ops (IEEE adds in a fixed order are deterministic on both
+sides), so a kernel can sum on device and still bit-match a staged
+``np.sum`` — verified in tests/test_compiler.py.
+
+Stages that are NOT fusable but know their column I/O can implement
+``pipeline_io() -> (reads, writes)`` so the planner still gets an exact
+DAG edge set (e.g. ``SimpleHTTPTransformer`` declares its output *and*
+error columns); stages declaring neither are planned as opaque barriers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+@dataclass
+class StageKernel:
+    """Jit-fusable description of one stage's transform."""
+
+    reads: tuple
+    writes: tuple
+    # jit-traceable: dict[col -> array] (reads) -> dict[col -> array] (writes)
+    fn: Callable[[dict], dict]
+    # host-side np dtype per output column, applied AFTER device fetch —
+    # for columns the staged path materializes as float64/ints while the
+    # device program (x64 disabled) computes float32/int32
+    out_dtypes: dict = field(default_factory=dict)
+    # host-side pre-check: dict[col -> np array] -> None (ok) | reason str
+    guard: Optional[Callable[[dict], Optional[str]]] = None
+    # relative cost estimate used by the scheduler before real timings exist
+    cost_hint: float = 1.0
+    # row-independent along axis 0 (padding-safe); False is a sharding
+    # conflict point (replication demand) for the partitioner
+    row_wise: bool = True
+    # input columns that must be fully replicated on the mesh regardless of
+    # batch sharding (e.g. a lookup table column) — a partitioner demand
+    needs_replicated: tuple = ()
+    # False: this kernel's ops are not bit-stable across batch shapes /
+    # shardings (convolution lowerings), so exact-mode compilation plans
+    # the stage host-bound and only ``exact=False`` fuses it
+    exact_capable: bool = True
+    # host epilogue: fn's device outputs are the ``device_writes`` keys;
+    # finalize(fetched host arrays, sliced to the true row count) returns
+    # the final ``writes`` columns by replaying the staged path's numpy
+    # tail ops (libm transcendentals, float64 casts) bit-for-bit
+    finalize: Optional[Callable[[dict], dict]] = None
+    device_writes: tuple = ()  # defaults to ``writes`` when finalize is None
+
+    @property
+    def fn_outputs(self) -> tuple:
+        """The columns ``fn`` actually returns from the device program."""
+        if self.finalize is not None and self.device_writes:
+            return self.device_writes
+        return self.writes
+
+
+def stage_kernel(stage: Any) -> Optional[StageKernel]:
+    """The stage's kernel, or None for host-bound stages. Never raises:
+    a kernel constructor that fails (missing weights, unsupported plan)
+    classifies the stage host-bound rather than failing compilation."""
+    getter = getattr(stage, "fusable_kernel", None)
+    if getter is None:
+        return None
+    try:
+        k = getter()
+    except Exception:  # noqa: BLE001 — unfusable, not an error
+        return None
+    if k is None:
+        return None
+    if not isinstance(k, StageKernel):
+        raise TypeError(
+            f"{type(stage).__name__}.fusable_kernel() returned "
+            f"{type(k).__name__}, expected StageKernel or None"
+        )
+    return k
+
+
+def guard_dense_numeric(cols: dict) -> Optional[str]:
+    """Common guard: every input column must be a dense numeric array."""
+    for name, arr in cols.items():
+        a = np.asarray(arr)
+        if a.dtype == object:
+            return f"object column {name!r}"
+        if a.dtype.kind not in ("f", "i", "u", "b"):
+            return f"non-numeric column {name!r} ({a.dtype})"
+    return None
+
+
+def guard_f32_safe(cols: dict) -> Optional[str]:
+    """Guard for kernels whose staged path computes float32 (possibly via a
+    float64 upcast): dtypes where jax's 32-bit canonicalization yields the
+    same single rounding the staged ``astype`` chain does — floats, bool,
+    and ints that fit 32 bits (int64 would wrap through jax's x64-disabled
+    world instead of rounding like the host cast)."""
+    for name, arr in cols.items():
+        a = np.asarray(arr)
+        if a.dtype == object:
+            return f"object column {name!r}"
+        if a.dtype.kind == "f" or a.dtype.kind == "b":
+            continue
+        if a.dtype.kind in ("i", "u") and a.dtype.itemsize <= 4:
+            continue
+        return f"dtype {a.dtype} column {name!r}"
+    return None
+
+
+# width at which numpy's pairwise summation switches from the 8-accumulator
+# block loop to recursive halving (numpy's PW_BLOCKSIZE)
+_PW_BLOCKSIZE = 128
+
+
+def pairwise_sum(a: Any):
+    """Sum a 2-D array over axis 1 in **numpy's exact association order**.
+
+    ``np.sum`` on float32 uses pairwise summation (sequential under 8
+    elements; 8 interleaved accumulators tree-combined up to 128; recursive
+    halving above) while XLA's ``reduce`` associates differently — so a
+    device sum is *not* bit-equal to the staged path's host sum. This
+    helper emits the same adds in the same order as jnp ops: each add is
+    an IEEE float32 add on both sides and XLA does not re-associate floats,
+    so the jitted result matches ``np.sum(a, axis=1)`` bitwise. Cost is
+    O(T) unrolled adds for T columns — negligible against the traversal or
+    matmul that produced them.
+
+    Works under ``jax.jit`` tracing (shape is static) and on plain numpy
+    arrays (the ops are identical), which is how the tests pin it.
+    """
+    import jax.numpy as jnp
+
+    n = a.shape[1]
+    zeros = (jnp if not isinstance(a, np.ndarray) else np).zeros
+    if n == 0:
+        return zeros(a.shape[:1], np.float32)
+    if n < 8:
+        res = a[:, 0]
+        for i in range(1, n):
+            res = res + a[:, i]
+        return res
+    if n <= _PW_BLOCKSIZE:
+        r = [a[:, j] for j in range(8)]
+        i = 8
+        while i < n - (n % 8):
+            for j in range(8):
+                r[j] = r[j] + a[:, i + j]
+            i += 8
+        res = ((r[0] + r[1]) + (r[2] + r[3])) + ((r[4] + r[5]) + (r[6] + r[7]))
+        while i < n:
+            res = res + a[:, i]
+            i += 1
+        return res
+    n2 = (n // 2) - ((n // 2) % 8)
+    return pairwise_sum(a[:, :n2]) + pairwise_sum(a[:, n2:])

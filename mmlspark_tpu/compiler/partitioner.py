@@ -1,0 +1,174 @@
+"""Partitioner: propagate NamedShardings through fused segments.
+
+Automap (arXiv:2112.02958) observes that most sharding decisions in an ML
+program are *forced* by their neighbours — annotations propagate through
+elementwise/row-wise ops unambiguously, and search is only needed at the
+few points where propagation meets a conflicting constraint. The fused
+segments here are exactly that easy case made explicit: every
+:class:`~mmlspark_tpu.compiler.kernels.StageKernel` declares whether it is
+row-wise (batch axis 0 flows through untouched) and which inputs it needs
+replicated. So:
+
+1. **Propagate**: union-find columns that must share a spec (all reads +
+   writes of a row-wise kernel form one group — the batch axis flows
+   through). A group nobody constrains resolves to the default
+   ``data``-axis batch sharding; a group with one consistent demand
+   resolves to that demand. No search.
+2. **Search at conflicts**: a group carrying *both* batch-preferring uses
+   and replication demands (a non-row-wise kernel, or
+   ``needs_replicated``) is ambiguous. Enumerate the candidate specs and
+   score each: choosing ``batch`` pays one resharding (allgather) per
+   replication demand; choosing ``replicated`` pays duplicated
+   compute/placement for every batch-preferring use. Pick the minimum —
+   the conflict set is tiny, so exhaustive scoring is exact.
+3. **Fall back to replicated** when the mesh cannot batch-shard at all —
+   one device, a CPU backend in ``auto`` mode, or a bucket the mesh size
+   does not divide.
+
+The result feeds ``jax.jit(..., in_shardings=...)`` on the fused program;
+XLA/GSPMD inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+BATCH = "batch"
+REPLICATED = "replicated"
+
+
+class _UnionFind:
+    def __init__(self) -> None:
+        self.parent: dict = {}
+
+    def find(self, x: Any) -> Any:
+        p = self.parent.setdefault(x, x)
+        if p != x:
+            p = self.parent[x] = self.find(p)
+        return p
+
+    def union(self, a: Any, b: Any) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self.parent[rb] = ra
+
+
+@dataclass
+class ShardingPlan:
+    """Per-column spec decisions for one fused segment."""
+
+    decisions: dict                      # col -> BATCH | REPLICATED
+    searched: list = field(default_factory=list)  # groups resolved by search
+    mesh: Any = None
+    data_axis: str = "data"
+
+    def in_shardings(self, cols: dict) -> Optional[dict]:
+        """NamedSharding pytree for the segment's (bucketed) input columns,
+        or None when everything is replicated on a trivial mesh (let jit
+        use default placement). Called per compile bucket: a batch-destined
+        column whose *actual* leading dim the mesh does not divide (a small
+        pow2 bucket on a larger mesh) degrades to replicated for that
+        bucket — sharding it would ValueError inside jit."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        size = int(self.mesh.devices.size)
+        out = {}
+        for name, arr in cols.items():
+            if (
+                self.decisions.get(name) == BATCH
+                and arr.ndim
+                and arr.shape[0] % size == 0
+            ):
+                spec = P(self.data_axis, *([None] * (arr.ndim - 1)))
+            else:
+                spec = P()
+            out[name] = NamedSharding(self.mesh, spec)
+        return out
+
+
+def plan_sharding(
+    kernels: list,
+    mesh: Any = None,
+    bucket: Optional[int] = None,
+    mode: str = "auto",
+) -> ShardingPlan:
+    """Assign a spec to every column a run of kernels touches.
+
+    ``mode``: ``auto`` (batch-shard on a real accelerator mesh, replicate
+    on CPU), ``batch`` (force batch sharding when divisible — used by
+    tests and by callers who know their CPU mesh is the deployment), or
+    ``replicated``.
+    """
+    cols: list = []
+    uf = _UnionFind()
+    batch_pref: dict = {}   # col -> count of batch-preferring uses
+    repl_demand: dict = {}  # col -> count of replication demands
+    for k in kernels:
+        touched = list(k.reads) + list(k.writes)
+        for c in touched:
+            if c not in batch_pref:
+                cols.append(c)
+                batch_pref[c] = 0
+                repl_demand[c] = 0
+        if k.row_wise:
+            # batch axis flows through: all touched columns share a spec
+            for c in touched[1:]:
+                uf.union(touched[0], c)
+            for c in touched:
+                batch_pref[c] += 1
+        else:
+            for c in touched:
+                repl_demand[c] += 1
+        for c in k.needs_replicated:
+            repl_demand[c] = repl_demand.get(c, 0) + 1
+
+    mesh_size = int(mesh.devices.size) if mesh is not None else 1
+    divisible = bucket is None or (mesh_size > 0 and bucket % mesh_size == 0)
+    platform = ""
+    if mesh is not None and mesh_size:
+        platform = mesh.devices.reshape(-1)[0].platform
+    can_batch = (
+        mesh is not None and mesh_size > 1 and divisible
+        and mode != "replicated"
+        and (mode == "batch" or platform not in ("", "cpu"))
+    )
+
+    groups: dict = {}
+    for c in cols:
+        groups.setdefault(uf.find(c), []).append(c)
+
+    decisions: dict = {}
+    searched: list = []
+    for members in groups.values():
+        prefs = sum(batch_pref[c] for c in members)
+        demands = sum(repl_demand[c] for c in members)
+        if not can_batch:
+            spec = REPLICATED
+        elif demands == 0:
+            spec = BATCH            # unambiguous propagation
+        elif prefs == 0:
+            spec = REPLICATED       # unambiguous propagation
+        else:
+            # conflict point: score the candidates (Automap's search step).
+            # batch   -> one reshard (allgather) per replication demand;
+            # replicated -> duplicated compute for each batch use, scaled
+            # by the fraction of the mesh doing redundant work.
+            cost_batch = float(demands)
+            cost_repl = prefs * (1.0 - 1.0 / mesh_size)
+            spec = BATCH if cost_batch <= cost_repl else REPLICATED
+            searched.append({
+                "columns": sorted(members),
+                "chosen": spec,
+                "cost_batch": cost_batch,
+                "cost_replicated": round(cost_repl, 3),
+            })
+        for c in members:
+            decisions[c] = spec
+    return ShardingPlan(
+        decisions=decisions,
+        searched=searched,
+        mesh=mesh if can_batch else None,
+    )

@@ -126,5 +126,18 @@ class PipelineModel(Model):
             df = stage.transform(df)
         return df
 
+    def compile(self, **options: Any) -> Any:
+        """Compile this fitted pipeline into a
+        :class:`~mmlspark_tpu.compiler.CompiledPipeline` — a drop-in
+        Transformer that fuses adjacent fusable stages into single
+        partitioned XLA programs and schedules independent branches by
+        critical path, with output element-wise equal to staged
+        execution. ``options`` forward to CompiledPipeline params
+        (``exact``, ``max_bucket``, ``partition_mode``,
+        ``parallel_hosts``)."""
+        from mmlspark_tpu.compiler import CompiledPipeline
+
+        return CompiledPipeline(stages=list(self.get("stages")), **options)
+
 
 

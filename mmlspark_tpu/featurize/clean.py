@@ -43,6 +43,14 @@ class CleanMissingData(Estimator, HasInputCols, HasOutputCols):
 class CleanMissingDataModel(Model, HasInputCols, HasOutputCols):
     fill_values = Param("per-column fill values", default=[], type_=list)
 
+    def pipeline_io(self) -> tuple:
+        """Declared I/O for the pipeline compiler. Host-bound by design:
+        the staged transform fills in float64 (fitted means/medians are
+        not float32-representable), which an x64-disabled device program
+        cannot bit-match."""
+        ins = self.get_or_fail("input_cols")
+        return tuple(ins), tuple(self.get("output_cols") or ins)
+
     def transform(self, df: DataFrame) -> DataFrame:
         ins = self.get_or_fail("input_cols")
         outs = self.get("output_cols") or ins

@@ -81,6 +81,51 @@ class Featurize(Estimator, HasOutputCol):
 class FeaturizeModel(Model, HasOutputCol):
     plans = Param("per-column featurization plans", default=[], type_=list)
 
+    def pipeline_io(self) -> tuple:
+        """Exact column deps for the pipeline compiler's planner."""
+        return (
+            tuple(p["col"] for p in self.get("plans")),
+            (self.get("output_col"),),
+        )
+
+    def fusable_kernel(self) -> Any:
+        """Jit-fusable when every plan is numeric or vector: the staged
+        path then computes f64-upcast -> NaN-fill -> f32-cast and dense
+        reshapes, all of which lower to bit-identical f32 ops on device
+        (the guard pins input dtypes for which the double-rounding paths
+        agree). One-hot/hash plans walk object columns on host — those
+        configurations classify host-bound."""
+        from mmlspark_tpu.compiler.kernels import StageKernel
+
+        plans = self.get("plans")
+        if not plans or any(p["kind"] not in ("numeric", "vector") for p in plans):
+            return None
+        oc = self.get("output_col")
+        reads = tuple(dict.fromkeys(p["col"] for p in plans))
+
+        def fn(cols: dict) -> dict:
+            import jax.numpy as jnp
+
+            n = None
+            blocks = []
+            for plan in plans:
+                x = cols[plan["col"]]
+                n = x.shape[0] if n is None else n
+                if plan["kind"] == "numeric":
+                    x = x.astype(jnp.float32)
+                    x = jnp.where(
+                        jnp.isnan(x), jnp.float32(plan["fill"]), x
+                    )
+                    blocks.append(x[:, None])
+                else:  # vector
+                    blocks.append(x.astype(jnp.float32).reshape(n, -1))
+            return {oc: jnp.concatenate(blocks, axis=1)}
+
+        from mmlspark_tpu.compiler.kernels import guard_f32_safe
+
+        return StageKernel(reads=reads, writes=(oc,), fn=fn,
+                           guard=guard_f32_safe, cost_hint=0.5)
+
     @property
     def feature_dim(self) -> int:
         d = 0
@@ -108,8 +153,17 @@ class FeaturizeModel(Model, HasOutputCol):
                     x = np.where(np.isnan(x), plan["fill"], x)
                     blocks.append(x[:, None].astype(np.float32))
                 elif kind == "vector":
-                    x = np.asarray(col, dtype=np.float32).reshape(n, -1)
-                    blocks.append(x)
+                    x = np.asarray(col)
+                    if x.dtype == object and n:
+                        # rows arriving from JSON (from_rows/from_dict) carry
+                        # per-row python lists in an object column
+                        x = np.stack([
+                            np.asarray(v, dtype=np.float32).ravel() for v in col
+                        ])
+                    x = np.asarray(x, dtype=np.float32)
+                    # reshape(-1) cannot infer a width from 0 rows
+                    shape = (n, -1) if n else (0, plan["dim"])
+                    blocks.append(x.reshape(shape))
                 elif kind == "onehot":
                     levels = {v: i for i, v in enumerate(plan["levels"])}
                     out = np.zeros((n, len(levels)), dtype=np.float32)

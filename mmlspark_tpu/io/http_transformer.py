@@ -57,6 +57,12 @@ class _HasHandler(Params):
 class HTTPTransformer(Transformer, _HasHandler, HasInputCol, HasOutputCol):
     """Request-row column -> response-row column, async per partition."""
 
+    def pipeline_io(self) -> tuple:
+        """Declared I/O for the pipeline compiler: host-bound (network),
+        row-local, row-preserving — exactly the stage the critical-path
+        scheduler can overlap with an independent branch."""
+        return (self.get_or_fail("input_col"),), (self.get_or_fail("output_col"),)
+
     def transform(self, df: DataFrame) -> DataFrame:
         in_col = self.get_or_fail("input_col")
         out_col = self.get_or_fail("output_col")
@@ -99,6 +105,18 @@ class SimpleHTTPTransformer(Transformer, _HasHandler, HasInputCol, HasOutputCol)
 
     def _error_col(self) -> str:
         return self.get("error_col") or f"{self.get_or_fail('output_col')}_error"
+
+    def pipeline_io(self) -> Any:
+        """Declared I/O for the pipeline compiler: reads the data column,
+        writes the error column then the output column (staged insertion
+        order). Declines (None -> opaque barrier) when a minibatcher or
+        ``flatten_output`` changes row structure."""
+        if self.get("mini_batcher") is not None or self.get("flatten_output"):
+            return None
+        return (
+            (self.get_or_fail("input_col"),),
+            (self._error_col(), self.get_or_fail("output_col")),
+        )
 
     def transform(self, df: DataFrame) -> DataFrame:
         in_col = self.get_or_fail("input_col")

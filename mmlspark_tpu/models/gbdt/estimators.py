@@ -309,6 +309,66 @@ class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPr
         return m
 
 
+def _booster_raw_device_fn(booster: Any, features_col: str, raw_key: str) -> Any:
+    """Jit-traceable ``cols -> {raw_key: predict_raw(x)}`` bit-matching the
+    host :meth:`Booster.predict_raw` for the pipeline compiler.
+
+    The staged path already runs the tree traversal on device
+    (``treegrow.predict_leaves``) — here the same program is traced into
+    the fused segment (integer leaf outputs are exact under any lowering),
+    the leaf-value gather is pure selection, and the cross-tree float32
+    reduction uses :func:`~mmlspark_tpu.compiler.kernels.pairwise_sum`,
+    which reproduces ``np.sum``'s association order so the device total is
+    bit-equal to the host's. Returns None for an empty booster (host path
+    covers the broadcast-base degenerate case).
+    """
+    from mmlspark_tpu.compiler.kernels import pairwise_sum
+    from mmlspark_tpu.models.gbdt import treegrow
+    from mmlspark_tpu.models.gbdt.booster import _stack_trees
+
+    trees = booster.trees
+    if booster.best_iteration > 0:
+        trees = trees[: booster.best_iteration * booster.num_class]
+    if not trees:
+        return None
+    stacked = _stack_trees(trees)
+    (rec_leaf, rec_feature, rec_threshold, rec_active, values, is_cat,
+     catmask, default_left) = stacked
+    k = booster.num_class
+    T = len(trees)
+    denom = float((T // k) if booster.boosting_type == "rf" else 1)
+    base = np.asarray(booster.base_score, np.float32)
+
+    def fn(cols: dict) -> dict:
+        import jax.numpy as jnp
+
+        x = cols[features_col].astype(jnp.float32)
+        leaves = treegrow.predict_leaves(
+            x,
+            jnp.asarray(rec_leaf),
+            jnp.asarray(rec_feature),
+            jnp.asarray(rec_threshold),
+            jnp.asarray(rec_active),
+            jnp.asarray(is_cat) if is_cat is not None else None,
+            jnp.asarray(catmask) if catmask is not None else None,
+            jnp.asarray(default_left) if default_left is not None else None,
+        )  # (n, T) int32 — exact
+        vals = jnp.asarray(values)  # (T, L)
+        per_tree = vals[jnp.arange(T)[None, :], leaves]  # (n, T) gather
+        d = jnp.float32(denom)
+        b = jnp.asarray(base)
+        if k == 1:
+            raw = pairwise_sum(per_tree) / d + b
+        else:
+            raw = jnp.stack(
+                [pairwise_sum(per_tree[:, c::k]) / d for c in range(k)],
+                axis=1,
+            ) + b
+        return {raw_key: raw}
+
+    return fn
+
+
 class LightGBMClassificationModel(
     Model, _NativeModelIO, HasFeaturesCol, HasPredictionCol, HasProbabilityCol, HasRawPredictionCol
 ):
@@ -349,6 +409,48 @@ class LightGBMClassificationModel(
             return q
 
         return df.map_partitions(fn, parallel=False)
+
+    def fusable_kernel(self) -> Any:
+        """Device traversal + gather + numpy-order summed scores in the
+        fused program; the sigmoid/softmax/argmax/float64 epilogue replays
+        the exact staged numpy code as a host ``finalize`` (libm ``exp``
+        has no bit-equal device twin with x64 off)."""
+        from mmlspark_tpu.compiler.kernels import StageKernel, guard_f32_safe
+
+        booster = self.booster
+        fc = self.get("features_col")
+        raw_c = self.get("raw_prediction_col")
+        prob_c = self.get("probability_col")
+        pred_c = self.get("prediction_col")
+        raw_key = f"__device_raw__{raw_c}"
+        fn = _booster_raw_device_fn(booster, fc, raw_key)
+        if fn is None:
+            return None
+
+        def finalize(host: dict) -> dict:
+            raw = host[raw_key]
+            if booster.num_class == 1:
+                probs1 = objectives.sigmoid(booster.sigmoid * raw)
+                probs = np.stack([1 - probs1, probs1], axis=1)
+                raw2 = np.stack([-raw, raw], axis=1)
+            else:
+                probs = objectives.softmax(raw)
+                raw2 = raw
+            return {
+                raw_c: raw2.astype(np.float64),
+                prob_c: probs.astype(np.float64),
+                pred_c: probs.argmax(axis=1).astype(np.float64),
+            }
+
+        return StageKernel(
+            reads=(fc,),
+            writes=(raw_c, prob_c, pred_c),
+            fn=fn,
+            guard=guard_f32_safe,
+            finalize=finalize,
+            device_writes=(raw_key,),
+            cost_hint=1.0 + len(booster.trees) / 100.0,
+        )
 
     def predict_leaf(self, x: np.ndarray) -> np.ndarray:
         return self.booster.predict_leaf(np.asarray(x, np.float32))
@@ -422,6 +524,35 @@ class LightGBMRegressionModel(Model, _NativeModelIO, HasFeaturesCol, HasPredicti
         return df.with_column(
             self.get("prediction_col"),
             lambda p: booster.predict(np.asarray(p[fc], np.float32)).astype(np.float64),
+        )
+
+    def fusable_kernel(self) -> Any:
+        """Like the classifier's kernel: scores on device, the objective's
+        output transform (log-link ``np.exp``) + float64 cast on host."""
+        from mmlspark_tpu.compiler.kernels import StageKernel, guard_f32_safe
+
+        booster = self.booster
+        fc = self.get("features_col")
+        pred_c = self.get("prediction_col")
+        raw_key = f"__device_raw__{pred_c}"
+        fn = _booster_raw_device_fn(booster, fc, raw_key)
+        if fn is None:
+            return None
+
+        def finalize(host: dict) -> dict:
+            raw = host[raw_key]
+            if booster.objective in objectives.LOG_LINK_KINDS:
+                raw = np.exp(raw)
+            return {pred_c: raw.astype(np.float64)}
+
+        return StageKernel(
+            reads=(fc,),
+            writes=(pred_c,),
+            fn=fn,
+            guard=guard_f32_safe,
+            finalize=finalize,
+            device_writes=(raw_key,),
+            cost_hint=1.0 + len(booster.trees) / 100.0,
         )
 
     def features_shap(self, x: np.ndarray, approximate: bool = False) -> np.ndarray:

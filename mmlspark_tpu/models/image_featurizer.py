@@ -150,6 +150,49 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol, HasBatchSize):
             return np.stack(good), keep
         return np.stack([a.astype(np.float32) for a in good]), keep
 
+    def pipeline_io(self) -> tuple:
+        """Column deps for the pipeline compiler."""
+        return (self.get_or_fail("input_col"),), (self.get_or_fail("output_col"),)
+
+    @property
+    def pipeline_row_preserving(self) -> bool:
+        # drop_na may remove undecodable rows at runtime (object inputs
+        # only) — the scheduler must not reorder branches around that
+        return not self.get("drop_na")
+
+    def fusable_kernel(self) -> Any:
+        """Fusable for dense (N,H,W,C) pixel batches: the whole
+        preprocess+backbone program (already one jitted fn in the staged
+        path) traces into the fused segment with the weights as constants.
+        Object columns (bytes/structs needing host decode) and unrolled
+        2-D layouts guard-fall back to the staged path.
+
+        ``exact_capable=False``: convolution lowerings are not bit-stable
+        across batch shapes, so exact-mode compilation (the default) keeps
+        this stage host-bound; ``compile(exact=False)`` fuses the backbone
+        into the segment at allclose-level equality."""
+        from mmlspark_tpu.compiler.kernels import StageKernel
+
+        ic = self.get_or_fail("input_col")
+        oc = self.get_or_fail("output_col")
+        inner = self._build()
+        apply_fn = inner.get_or_fail("apply_fn")
+        variables = inner.get_or_fail("variables")
+
+        def fn(cols: dict) -> dict:
+            return {oc: apply_fn(variables, cols[ic])}
+
+        def guard(cols: dict) -> Any:
+            a = np.asarray(cols.get(ic))
+            if a.dtype == object:
+                return "object image column (host decode path)"
+            if a.ndim != 4:
+                return f"image column ndim={a.ndim} (unrolled host path)"
+            return None
+
+        return StageKernel(reads=(ic,), writes=(oc,), fn=fn, guard=guard,
+                           cost_hint=20.0, exact_capable=False)
+
     def transform(self, df: DataFrame) -> DataFrame:
         ic = self.get_or_fail("input_col")
         inner = self._build()

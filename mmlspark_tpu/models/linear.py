@@ -126,6 +126,40 @@ class LogisticRegressionModel(
 
         return df.map_partitions(fn, parallel=False)
 
+    def fusable_kernel(self) -> Any:
+        """The transform above is already one jitted program (matmul +
+        softmax + argmax on f32): the kernel re-traces the identical ops
+        into the fused segment, so exact-mode output is bit-equal."""
+        from mmlspark_tpu.compiler.kernels import StageKernel, guard_f32_safe
+
+        W = np.asarray(self.get_or_fail("weights"))
+        b = np.asarray(self.get_or_fail("bias"))
+        fc = self.get("features_col")
+        raw_c = self.get("raw_prediction_col")
+        prob_c = self.get("probability_col")
+        pred_c = self.get("prediction_col")
+
+        def fn(cols: dict) -> dict:
+            import jax
+
+            x = cols[fc].astype(jnp.float32)
+            logits = x @ jnp.asarray(W) + jnp.asarray(b)
+            return {
+                raw_c: logits,
+                prob_c: jax.nn.softmax(logits),
+                pred_c: jnp.argmax(logits, -1),
+            }
+
+        return StageKernel(
+            reads=(fc,),
+            writes=(raw_c, prob_c, pred_c),
+            fn=fn,
+            # staged prediction is argmax cast to float64 on host
+            out_dtypes={pred_c: np.dtype(np.float64)},
+            guard=guard_f32_safe,
+            cost_hint=1.0,
+        )
+
 
 class LinearRegression(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
     """Ridge regression by normal equations on device (one MXU solve)."""
@@ -151,6 +185,12 @@ class LinearRegression(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol):
 class LinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     weights = ComplexParam("(d,) weights")
     bias = Param("intercept", default=0.0, type_=float)
+
+    def pipeline_io(self) -> tuple:
+        """Declared I/O for the pipeline compiler: the staged transform is
+        a float64 host matmul, which an x64-disabled device program cannot
+        bit-match — so this model plans host-bound, with exact DAG edges."""
+        return (self.get("features_col"),), (self.get("prediction_col"),)
 
     def transform(self, df: DataFrame) -> DataFrame:
         W = np.asarray(self.get_or_fail("weights"))

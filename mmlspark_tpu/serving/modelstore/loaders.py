@@ -25,11 +25,16 @@ from mmlspark_tpu.serving.server import CachedRequest
 def model_name_from_spec(spec: str) -> str:
     """The model name a spec serves under (fleet worker registration and
     per-model routing): ``echo`` -> ``echo``, ``zoo:ResNet8`` ->
-    ``ResNet8``, ``module:pkg.make`` -> ``make``."""
+    ``ResNet8``, ``module:pkg.make`` -> ``make``, ``pipeline:/m/churn``
+    -> ``churn``."""
     if spec.startswith("zoo:"):
         return spec[len("zoo:"):]
     if spec.startswith("module:"):
         return spec.rsplit(".", 1)[-1]
+    if spec.startswith("pipeline:"):
+        import os
+
+        return os.path.basename(spec[len("pipeline:"):].rstrip("/")) or "pipeline"
     return spec
 
 
@@ -123,6 +128,170 @@ def _zoo_loaded(name: str) -> LoadedModel:
     )
 
 
+def _pipeline_loaded(path: str) -> LoadedModel:
+    """``pipeline:<saved-model-dir>`` — serve a compiled pipeline.
+
+    Load: ``core.serialize.load_stage`` on the dir (a saved
+    ``PipelineModel``, ``CompiledPipeline`` or any fitted Transformer).
+    Compile: PipelineModels go through ``.compile()``; other transformers
+    are wrapped in a one-stage CompiledPipeline so the fusable case still
+    fuses. Warmup: plan+fuse+partition always; if the dir carries a
+    ``warmup.json`` ({column: [values...]}) one transform runs through it
+    so the bucket XLA compiles also happen before the version turns ready.
+    Byte accounting sums array leaves across the fitted stages' params
+    (same jax-tree walk as ``zoo:``), so the HBM budget sees real weights.
+
+    Wire contract (documented in docs/modelstore.md): POST body is either
+    one JSON row ({column: value}) or {"rows": [{column: value}, ...]};
+    the reply carries only the pipeline's *output* columns per row.
+    """
+    import json as _json
+    import os
+
+    from mmlspark_tpu.compiler import CompiledPipeline
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.core.pipeline import PipelineModel, load_stage
+
+    stage = load_stage(path)
+    if isinstance(stage, CompiledPipeline):
+        compiled = stage
+    elif isinstance(stage, PipelineModel):
+        compiled = stage.compile()
+    else:
+        compiled = CompiledPipeline(stages=[stage])
+    compiled.build()
+    nbytes = tree_nbytes([
+        {name: s.get(name) for name in type(s).params()}
+        for s in compiled.get("stages")
+    ])
+    out_cols = tuple(dict.fromkeys(
+        c for n in compiled.plan.nodes for c in n.writes
+    ))
+    # an opaque stage (RenameColumn, Explode, Lambda) may produce columns
+    # the plan cannot name — declared writes would silently drop them
+    has_opaque = any(n.opaque for n in compiled.plan.nodes)
+
+    def _dense(values: list) -> Any:
+        """Stack uniform numeric-list columns to dense float64 arrays.
+        JSON rows arrive as python lists, which ``_as_column`` keeps as an
+        object column — and the fused segments' guards rightly refuse
+        object dtype, so without this every serving request (and the
+        warmup) would fall back to staged execution. float64 is JSON's
+        own number precision; the staged and fused paths round it to f32
+        identically. Ragged/non-numeric columns pass through untouched."""
+        if values and all(isinstance(v, (list, tuple)) for v in values):
+            try:
+                return np.stack([np.asarray(v, dtype=np.float64) for v in values])
+            except Exception:  # noqa: BLE001 — ragged/non-numeric: object path
+                pass
+        return values
+
+    def _score_rows(rows: list) -> list:
+        # union of keys: first-row keys would silently drop a column only
+        # later rows carry; a row missing a key raises (isolated per
+        # request by the handler's fallback)
+        names = list(dict.fromkeys(k for r in rows for k in r.keys()))
+        cols = {k: _dense([r[k] for r in rows]) for k in names}
+        df = DataFrame.from_dict(cols)
+        res = compiled.transform(df)
+        if has_opaque or not out_cols:
+            sent = set().union(*(r.keys() for r in rows))
+            keep = [c for c in res.columns if c not in sent]
+        else:
+            keep = [c for c in out_cols if c in res.columns]
+        mats = {c: res[c] for c in keep}
+        n = res.count()
+        if n != len(rows):
+            # a row-dropping stage (drop_na) broke the 1:1 reply
+            # correspondence — a 400 beats silently mis-attributed scores
+            raise ValueError(
+                f"pipeline dropped {len(rows) - n} of {len(rows)} rows; "
+                "per-row replies would misalign"
+            )
+        return [
+            {
+                c: (v[i].tolist() if hasattr(v[i], "tolist") else v[i])
+                for c, v in mats.items()
+            }
+            for i in range(n)
+        ]
+
+    def _reply(body: Any, scored: list) -> tuple:
+        payload = (
+            {"rows": scored}
+            if isinstance(body, dict) and "rows" in body else scored[0]
+        )
+        return (200, _json.dumps(payload).encode(), {})
+
+    def _err(e: Exception) -> tuple:
+        return (400, _json.dumps({"error": str(e)[:300]}).encode(), {})
+
+    def handler(reqs: list) -> dict:
+        out = {}
+        parsed: list = []  # (request, body, rows)
+        for r in reqs:
+            try:
+                body = _json.loads(r.body) if r.body else {}
+                rows = (
+                    body["rows"]
+                    if isinstance(body, dict) and "rows" in body else [body]
+                )
+                if (
+                    not isinstance(rows, list)
+                    or not rows
+                    or not all(isinstance(x, dict) for x in rows)
+                ):
+                    raise ValueError("rows must be a non-empty list of objects")
+                parsed.append((r, body, rows))
+            except Exception as e:  # noqa: BLE001 — bad row must not kill the batch
+                out[r.id] = _err(e)
+        if not parsed:
+            return out
+        try:
+            # one transform for the whole dispatcher batch (the batching
+            # the dispatcher exists to provide), split back by row spans
+            flat = [row for _, _, rows in parsed for row in rows]
+            scored = _score_rows(flat)
+            pos = 0
+            for r, body, rows in parsed:
+                out[r.id] = _reply(body, scored[pos:pos + len(rows)])
+                pos += len(rows)
+        except Exception:  # noqa: BLE001 — isolate the poisoned request
+            for r, body, rows in parsed:
+                try:
+                    out[r.id] = _reply(body, _score_rows(rows))
+                except Exception as e:  # noqa: BLE001
+                    out[r.id] = _err(e)
+        return out
+
+    warmup_path = os.path.join(path, "warmup.json")
+
+    def warmup() -> None:
+        compiled.build()
+        if os.path.exists(warmup_path):
+            with open(warmup_path) as f:
+                cols = _json.load(f)
+            cols = {k: _dense(v) for k, v in cols.items()}
+            compiled.transform(DataFrame.from_dict(cols))
+
+    def release() -> None:
+        # drop segment jit caches; the reload path is the spec itself
+        for seg in compiled.segments:
+            cache = getattr(seg, "_jit_cache", None)
+            if cache is not None:
+                cache.clear()
+
+    return LoadedModel(
+        handler=handler, nbytes=nbytes, warmup=warmup, release=release,
+        meta={
+            "spec": f"pipeline:{path}",
+            "stages": [type(s).__name__ for s in compiled.get("stages")],
+            "fused_stages": compiled.num_fused_stages,
+            "output_columns": list(out_cols),
+        },
+    )
+
+
 def build_loaded_model(spec: Any) -> LoadedModel:
     """Resolve a model spec:
 
@@ -132,7 +301,10 @@ def build_loaded_model(spec: Any) -> LoadedModel:
     - ``"zoo:<name>"``    — ImageFeaturizer on the named zoo backbone,
       with weight-byte accounting and a compile-warmup batch;
     - ``"module:pkg.fn"`` — ``pkg.fn()`` returning a handler OR a
-      :class:`LoadedModel`.
+      :class:`LoadedModel`;
+    - ``"pipeline:<dir>"`` — a saved PipelineModel/CompiledPipeline dir,
+      compiled (plan+fuse+partition) before ready, with jax-tree byte
+      accounting over the fitted stages.
     """
     if isinstance(spec, LoadedModel):
         return spec
@@ -144,6 +316,8 @@ def build_loaded_model(spec: Any) -> LoadedModel:
         return _echo_loaded()
     if spec.startswith("zoo:"):
         return _zoo_loaded(spec[len("zoo:"):])
+    if spec.startswith("pipeline:"):
+        return _pipeline_loaded(spec[len("pipeline:"):])
     if spec.startswith("module:"):
         import importlib
 

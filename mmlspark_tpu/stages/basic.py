@@ -41,6 +41,10 @@ class SelectColumns(Transformer):
 
 
 class RenameColumn(Transformer, HasInputCol, HasOutputCol):
+    # removes its input column — column-level deps can't express that, so
+    # the pipeline compiler must plan it as a barrier
+    pipeline_opaque = True
+
     def transform(self, df: DataFrame) -> DataFrame:
         return df.rename({self.get_or_fail("input_col"): self.get_or_fail("output_col")})
 
@@ -87,6 +91,13 @@ class UDFTransformer(Transformer, HasInputCol, HasOutputCol):
     udf = ComplexParam("per-row function")
     vector_udf = ComplexParam("whole-column function (array -> array)")
     input_cols = Param("multiple input columns (passed as dict to udf)", type_=list)
+    jit_compatible = Param(
+        "author-declared: vector_udf is a pure jnp-traceable row-wise "
+        "array fn. The staged path then runs it under jax.jit and the "
+        "pipeline compiler may fuse it into adjacent stages (both sides "
+        "trace the identical ops, so compiled output stays element-wise "
+        "equal)", default=False, type_=bool,
+    )
 
     def transform(self, df: DataFrame) -> DataFrame:
         oc = self.get_or_fail("output_col")
@@ -94,6 +105,18 @@ class UDFTransformer(Transformer, HasInputCol, HasOutputCol):
         cols = self.get("input_cols")
         if vec is not None:
             ic = self.get_or_fail("input_col")
+            if self.get("jit_compatible"):
+                import jax
+
+                # cache per udf object: a fresh jax.jit wrapper would
+                # retrace on every transform call
+                cached = getattr(self, "_jitted_udf", None)
+                if cached is None or cached[0] is not vec:
+                    cached = self._jitted_udf = (vec, jax.jit(vec))
+                jitted = cached[1]
+                return df.with_column(
+                    oc, lambda p: np.asarray(jitted(np.asarray(p[ic])))
+                )
             return df.with_column(oc, lambda p: vec(p[ic]))
         fn = self.get_or_fail("udf")
         if cols:
@@ -101,9 +124,32 @@ class UDFTransformer(Transformer, HasInputCol, HasOutputCol):
         ic = self.get_or_fail("input_col")
         return df.with_row_column(oc, lambda r: fn(r[ic]))
 
+    def fusable_kernel(self) -> Any:
+        """Fusable only when the author set ``jit_compatible`` on a
+        ``vector_udf`` (the fusability contract: pure, jit-traceable,
+        row-independent along axis 0)."""
+        if not self.get("jit_compatible"):
+            return None
+        vec = self.get("vector_udf")
+        if vec is None:
+            return None
+        from mmlspark_tpu.compiler.kernels import StageKernel, guard_f32_safe
+
+        ic = self.get_or_fail("input_col")
+        oc = self.get_or_fail("output_col")
+
+        def fn(cols: dict) -> dict:
+            return {oc: vec(cols[ic])}
+
+        return StageKernel(reads=(ic,), writes=(oc,), fn=fn,
+                           guard=guard_f32_safe, cost_hint=0.2)
+
 
 class Explode(Transformer, HasInputCol, HasOutputCol):
     """Explode an array column into one row per element."""
+
+    # rewrites every column's rows — a planner barrier, not a column dep
+    pipeline_opaque = True
 
     def transform(self, df: DataFrame) -> DataFrame:
         ic = self.get_or_fail("input_col")

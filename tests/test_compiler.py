@@ -1,0 +1,841 @@
+"""Pipeline compiler tests: planner DAG semantics, Automap-style sharding
+propagation/search, fuser exactness + bounded buckets + fallback, the
+critical-path scheduler, and the golden equivalence suite — compiled
+output must be **element-wise equal** (values AND dtypes AND column order)
+to staged execution on every representative pipeline, including through
+StreamingDataFrame chunked scoring."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame, Pipeline, PipelineModel, obs
+from mmlspark_tpu.compiler import (
+    CompiledPipeline,
+    CostModel,
+    FusedSegment,
+    HostSegment,
+    StageKernel,
+    build_segments,
+    critical_path,
+    pairwise_sum,
+    plan_pipeline,
+    plan_sharding,
+    schedule_order,
+    segment_deps,
+    stage_io,
+)
+from mmlspark_tpu.compiler.partitioner import BATCH, REPLICATED
+from mmlspark_tpu.featurize.clean import CleanMissingData
+from mmlspark_tpu.featurize.featurize import Featurize
+from mmlspark_tpu.models.linear import LinearRegression, LogisticRegression
+from mmlspark_tpu.stages.basic import Explode, Lambda, RenameColumn, UDFTransformer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    obs.reset()
+    yield
+
+
+def assert_no_fallbacks() -> None:
+    """No fused segment fell back to staged execution (zero-valued series
+    registered by earlier tests in the process are fine)."""
+    import re
+
+    hits = re.findall(
+        r"mmlspark_compiler_fallback_total\{[^}]*\} (\d+)", obs.render()
+    )
+    assert all(v == "0" for v in hits), hits
+
+
+def assert_exact(staged: DataFrame, compiled: DataFrame) -> None:
+    """Element-wise equality: same columns in the same order, same dtypes,
+    bit-identical values (object columns compared per element)."""
+    assert staged.columns == compiled.columns
+    for c in staged.columns:
+        a, b = staged[c], compiled[c]
+        assert a.dtype == b.dtype, f"{c}: {a.dtype} != {b.dtype}"
+        if a.dtype == object:
+            assert len(a) == len(b)
+            for x, y in zip(a, b):
+                assert x == y, f"{c}: {x!r} != {y!r}"
+        else:
+            assert np.array_equal(a, b, equal_nan=True), (
+                f"{c}: max |diff| = "
+                f"{np.max(np.abs(np.asarray(a, np.float64) - np.asarray(b, np.float64)))}"
+            )
+
+
+def _df(n=200, parts=3, seed=0, classes=2):
+    rng = np.random.default_rng(seed)
+    return DataFrame.from_dict(
+        {
+            "a": rng.standard_normal(n),
+            "b": rng.standard_normal(n).astype(np.float32),
+            "v": rng.standard_normal((n, 5)).astype(np.float32),
+            "label": rng.integers(0, classes, n),
+        },
+        num_partitions=parts,
+    )
+
+
+def _fit_featurize_logistic(df, classes=2):
+    import jax.numpy as jnp
+
+    return Pipeline([
+        Featurize(input_cols=["a", "b", "v"], output_col="features"),
+        UDFTransformer(
+            input_col="features", output_col="features_s",
+            vector_udf=lambda x: jnp.tanh(x) * jnp.float32(2.0),
+            jit_compatible=True,
+        ),
+        LogisticRegression(features_col="features_s", label_col="label",
+                           max_iter=15),
+    ]).fit(df)
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+
+def test_planner_linear_chain_deps():
+    df = _df()
+    model = _fit_featurize_logistic(df)
+    plan = plan_pipeline(model.get("stages"))
+    kinds = [n.kind for n in plan.nodes]
+    assert kinds == ["fused", "fused", "fused"]
+    assert plan.nodes[1].deps == {0}
+    assert plan.nodes[2].deps == {1}
+    assert set(plan.external_inputs) == {"a", "b", "v"}
+    assert plan.all_row_preserving
+
+
+def test_planner_opaque_barrier():
+    df = _df()
+    model = _fit_featurize_logistic(df)
+    stages = list(model.get("stages"))
+    stages.insert(1, Lambda.of(lambda d: d))  # declares nothing: barrier
+    plan = plan_pipeline(stages)
+    lam = plan.nodes[1]
+    assert lam.kind == "opaque"
+    assert lam.deps == {0}
+    # every later stage depends on the barrier (directly or transitively)
+    assert 1 in plan.nodes[2].deps
+    assert plan.final_columns(["a"]) == []  # order unknowable past a barrier
+
+
+def test_planner_independent_branches():
+    df = _df()
+    feat_a = Featurize(input_cols=["a"], output_col="fa").fit(df)
+    feat_b = Featurize(input_cols=["b"], output_col="fb").fit(df)
+    plan = plan_pipeline([feat_a, feat_b])
+    assert plan.nodes[0].deps == set()
+    assert plan.nodes[1].deps == set()  # disjoint columns: parallel branches
+
+
+def test_planner_write_after_read_hazard():
+    # stage 1 reads "x"; stage 2 overwrites "x": 2 must wait for 1
+    k1 = StageKernel(reads=("x",), writes=("y",), fn=lambda c: c)
+    k2 = StageKernel(reads=("z",), writes=("x",), fn=lambda c: c)
+
+    class S1:
+        def fusable_kernel(self):
+            return k1
+
+    class S2:
+        def fusable_kernel(self):
+            return k2
+
+    plan = plan_pipeline([S1(), S2()])
+    assert 0 in plan.nodes[1].deps
+
+
+def test_stage_io_explicit_and_param_fallback():
+    clean = CleanMissingData(input_cols=["a"], output_cols=["a2"])
+    model = clean.fit(DataFrame.from_dict({"a": [1.0, np.nan, 3.0]}))
+    reads, writes, known = stage_io(model)
+    assert known and reads == ("a",) and writes == ("a2",)
+    lr = LinearRegression(features_col="f").fit(
+        DataFrame.from_dict({"f": np.ones((4, 2), np.float32), "label": [0.0, 1, 0, 1]})
+    )
+    reads, writes, known = stage_io(lr)
+    assert known and reads == ("f",) and writes == ("prediction",)
+
+
+def test_rename_and_explode_plan_opaque():
+    assert stage_io(RenameColumn(input_col="a", output_col="b"))[2] is False
+    assert stage_io(Explode(input_col="a", output_col="b"))[2] is False
+
+
+# ---------------------------------------------------------------------------
+# partitioner
+# ---------------------------------------------------------------------------
+
+
+def _mesh8():
+    from mmlspark_tpu.parallel.mesh import make_mesh
+
+    return make_mesh()  # conftest forces 8 virtual CPU devices
+
+
+def test_sharding_propagates_batch_on_forced_mesh():
+    k = StageKernel(reads=("x",), writes=("y",), fn=lambda c: c, row_wise=True)
+    plan = plan_sharding([k], mesh=_mesh8(), bucket=64, mode="batch")
+    assert plan.decisions == {"x": BATCH, "y": BATCH}
+    assert plan.searched == []  # unambiguous: no search needed
+
+
+def test_sharding_cpu_auto_replicates():
+    k = StageKernel(reads=("x",), writes=("y",), fn=lambda c: c)
+    plan = plan_sharding([k], mesh=_mesh8(), bucket=64, mode="auto")
+    assert plan.decisions == {"x": REPLICATED, "y": REPLICATED}
+    assert plan.mesh is None  # trivial placement: jit default
+
+
+def test_sharding_indivisible_bucket_replicates():
+    k = StageKernel(reads=("x",), writes=("y",), fn=lambda c: c)
+    plan = plan_sharding([k], mesh=_mesh8(), bucket=4, mode="batch")
+    assert plan.decisions["x"] == REPLICATED
+
+
+def test_sharding_search_at_conflict():
+    # x is both batch-preferred (3 row-wise kernels) and replication-
+    # demanded (1 cross-row kernel): a conflict point, resolved by scoring
+    row = [
+        StageKernel(reads=("x",), writes=(f"y{i}",), fn=lambda c: c)
+        for i in range(3)
+    ]
+    cross = StageKernel(reads=("x",), writes=("z",), fn=lambda c: c,
+                        row_wise=False)
+    plan = plan_sharding(row + [cross], mesh=_mesh8(), bucket=64, mode="batch")
+    assert len(plan.searched) == 1
+    g = plan.searched[0]
+    # batch costs 1 reshard; replicated wastes 7/8 of 7 batch uses: batch wins
+    assert g["chosen"] == BATCH
+    assert plan.decisions["x"] == BATCH
+
+    # flip the balance: replication demands dominate
+    crosses = [
+        StageKernel(reads=("x",), writes=(f"z{i}",), fn=lambda c: c,
+                    row_wise=False)
+        for i in range(9)
+    ]
+    plan2 = plan_sharding(row[:1] + crosses, mesh=_mesh8(), bucket=64,
+                          mode="batch")
+    assert plan2.decisions["x"] == REPLICATED
+
+
+def test_in_shardings_specs():
+    from jax.sharding import NamedSharding
+
+    k = StageKernel(reads=("x",), writes=("y",), fn=lambda c: c)
+    plan = plan_sharding([k], mesh=_mesh8(), bucket=64, mode="batch")
+    sh = plan.in_shardings({"x": np.zeros((64, 3), np.float32)})
+    assert isinstance(sh["x"], NamedSharding)
+    assert "data" in str(sh["x"].spec)
+    # a small bucket the mesh does not divide degrades to replicated for
+    # that bucket instead of erroring inside jit (runtime buckets are
+    # per-call pow2s, not the planning-time cap)
+    sh4 = plan.in_shardings({"x": np.zeros((4, 3), np.float32)})
+    assert "data" not in str(sh4["x"].spec)
+
+
+def test_small_batch_runs_fused_on_mesh():
+    # 3 rows bucket to 4 on an 8-device mesh: indivisible — must still run
+    # fused (replicated for that bucket), not ValueError-fall back to staged
+    df = _df(n=40, parts=1)
+    model = _fit_featurize_logistic(df)
+    comp = model.compile(partition_mode="batch")
+    small = DataFrame.from_dict({c: df[c][:3] for c in df.columns})
+    assert_exact(model.transform(small), comp.transform(small))
+    assert_no_fallbacks()
+
+
+# ---------------------------------------------------------------------------
+# pairwise_sum exactness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("t", [1, 2, 5, 7, 8, 9, 17, 64, 127, 128, 129, 300])
+def test_pairwise_sum_matches_numpy_bitwise(t):
+    rng = np.random.default_rng(t)
+    a = (rng.standard_normal((57, t)) * 100).astype(np.float32)
+    assert np.array_equal(pairwise_sum(a), a.sum(axis=1))
+
+
+def test_pairwise_sum_matches_under_jit_with_padding():
+    import jax
+
+    rng = np.random.default_rng(0)
+    a = (rng.standard_normal((100, 37)) * 10).astype(np.float32)
+    padded = np.concatenate([a, np.repeat(a[:1], 28, axis=0)], axis=0)
+    dev = np.asarray(jax.jit(pairwise_sum)(padded))[:100]
+    assert np.array_equal(dev, a.sum(axis=1))
+
+
+# ---------------------------------------------------------------------------
+# fuser
+# ---------------------------------------------------------------------------
+
+
+def test_fused_bucket_cache_is_bounded():
+    df = _df(n=400, parts=1)
+    model = _fit_featurize_logistic(df)
+    comp = model.compile(max_bucket=64)
+    seg = comp.fused_segments[0]
+    staged = model.transform(df)
+    # many distinct batch sizes, one feature shape
+    for n in (1, 2, 3, 5, 9, 17, 33, 65, 130, 400):
+        sub = DataFrame.from_dict({c: df[c][:n] for c in df.columns})
+        assert_exact(
+            PipelineModel(stages=model.get("stages")).transform(sub),
+            comp.transform(sub),
+        )
+    # pow2 buckets capped at 64: at most log2(64)+1 = 7 compiled entries
+    assert len(seg._jit_cache) <= 7
+    del staged
+
+
+def test_fused_oversized_partition_chunks():
+    df = _df(n=300, parts=1)
+    model = _fit_featurize_logistic(df)
+    comp = model.compile(max_bucket=32)  # partitions of 300 -> 10 chunks
+    assert_exact(model.transform(df), comp.transform(df))
+
+
+def test_fallback_on_object_column():
+    df = DataFrame.from_dict({
+        "a": np.array(["x", "y", "z", "w"], dtype=object),
+        "b": [1.0, 2.0, 3.0, 4.0],
+    })
+    model = Pipeline([
+        Featurize(input_cols=["a", "b"], output_col="features"),
+    ]).fit(df)
+    comp = model.compile()
+    # one-hot plan on an object column: the stage classifies host-bound
+    assert comp.num_fused_stages == 0
+    assert_exact(model.transform(df), comp.transform(df))
+
+
+def test_guard_fallback_to_staged_stays_equal():
+    # int64 raw columns: the kernel guard refuses (jax's 32-bit world
+    # cannot reproduce the staged int64->float64->float32 cast chain) but
+    # the staged path handles them fine — the segment must fall back and
+    # stay element-wise equal, counting the fallback
+    rng = np.random.default_rng(11)
+    n = 80
+    df = DataFrame.from_dict({
+        "a": rng.integers(-10**12, 10**12, n),  # int64
+        "b": rng.standard_normal(n),
+        "v": rng.standard_normal((n, 5)).astype(np.float32),
+        "label": rng.integers(0, 2, n),
+    }, num_partitions=2)
+    model = _fit_featurize_logistic(df)
+    comp = model.compile()
+    assert comp.num_fused_stages >= 2  # compile-time plan still fuses
+    assert_exact(model.transform(df), comp.transform(df))
+    text = obs.render()
+    assert "mmlspark_compiler_fallback_total" in text
+
+
+def test_finalize_kernel_closes_fusion_run():
+    import jax.numpy as jnp
+
+    df = _df(n=120, parts=2)
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    model = Pipeline([
+        Featurize(input_cols=["a", "b", "v"], output_col="features"),
+        LightGBMClassifier(features_col="features", label_col="label",
+                           num_iterations=5, num_leaves=7),
+        UDFTransformer(input_col="probability", output_col="p_scaled",
+                       vector_udf=lambda x: x * jnp.float32(1.0),
+                       jit_compatible=True),
+    ]).fit(df)
+    comp = model.compile()
+    names = [type(s).__name__ for s in comp.segments]
+    # GBDT's finalize (host sigmoid epilogue) ends its segment: the UDF
+    # reading `probability` must start a NEW fused segment
+    assert len(comp.fused_segments) == 2
+    assert_exact(model.transform(df), comp.transform(df))
+    del names
+
+
+def test_exact_incapable_kernel_is_host_in_exact_mode():
+    k = StageKernel(reads=("x",), writes=("y",), fn=lambda c: c,
+                    exact_capable=False)
+
+    class S:
+        def fusable_kernel(self):
+            return k
+
+    plan = plan_pipeline([S()])
+    segs = build_segments(plan, exact=True)
+    assert isinstance(segs[0], HostSegment)
+    segs2 = build_segments(plan, exact=False)
+    assert isinstance(segs2[0], FusedSegment)
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+
+class _StubSeg:
+    def __init__(self, name, nodes):
+        self.name = name
+        self.nodes = nodes
+        self.opaque = False
+        self.kernels = ()
+
+    @property
+    def writes(self):
+        out = []
+        for n in self.nodes:
+            out.extend(n.writes)
+        return tuple(out)
+
+
+def _stub_plan(edges, n):
+    """Build stub segments with one node each and given dep edges."""
+    from mmlspark_tpu.compiler.planner import StageNode
+
+    nodes = [
+        StageNode(index=i, stage=None, name=f"n{i}", reads=(), writes=(),
+                  kernel=None, opaque=False)
+        for i in range(n)
+    ]
+    for a, b in edges:  # b depends on a
+        nodes[b].deps.add(a)
+        nodes[a].dependents.add(b)
+
+    class P:
+        all_row_preserving = True
+
+    plan = P()
+    plan.nodes = nodes
+    return [_StubSeg(f"s{i}", [nodes[i]]) for i in range(n)], plan
+
+
+def test_critical_path_priorities():
+    # diamond: 0 -> {1, 2} -> 3; branch 1 is slow
+    segs, plan = _stub_plan([(0, 1), (0, 2), (1, 3), (2, 3)], 4)
+    deps = segment_deps(segs, plan)
+    cm = CostModel()
+    cm.measured = {"s0": 1.0, "s1": 5.0, "s2": 1.0, "s3": 1.0}
+    prio = critical_path(segs, deps, cm)
+    assert prio[0] == pytest.approx(7.0)   # 1 + 5 + 1
+    assert prio[1] == pytest.approx(6.0)
+    assert prio[2] == pytest.approx(2.0)
+    order = schedule_order(segs, deps, cm)
+    assert order == [0, 1, 2, 3]  # slow branch first
+
+
+def test_schedule_respects_deps():
+    segs, plan = _stub_plan([(1, 0)], 2)  # 0 depends on 1 (reversed-ish)
+    deps = segment_deps(segs, plan)
+    order = schedule_order(segs, deps, CostModel())
+    assert order.index(1) < order.index(0)
+
+
+def test_cost_model_ewma():
+    cm = CostModel(alpha=0.5)
+    cm.observe("s", 2.0)
+    cm.observe("s", 4.0)
+    assert cm.measured["s"] == pytest.approx(3.0)
+
+
+def test_scheduler_overlaps_independent_host_branches():
+    from mmlspark_tpu.io.http_transformer import SimpleHTTPTransformer
+
+    delay = 0.15
+
+    def slow_handler(req):
+        time.sleep(delay)
+        return {"status_code": 200, "reason": "OK",
+                "entity": json.dumps({"ok": 1}).encode()}
+
+    df = _df(n=8, parts=1)
+    svc1 = SimpleHTTPTransformer(input_col="a", output_col="s1",
+                                 url="http://stub.invalid",
+                                 custom_handler=slow_handler)
+    svc2 = SimpleHTTPTransformer(input_col="b", output_col="s2",
+                                 url="http://stub.invalid",
+                                 custom_handler=slow_handler)
+    model = PipelineModel(stages=[svc1, svc2])
+    staged = model.transform(df)
+    comp = model.compile()
+    t0 = time.perf_counter()
+    out = comp.transform(df)
+    overlapped = time.perf_counter() - t0
+    assert_exact(staged, out)
+    # staged runs the two services serially (2 * 8 rows of sleeps through
+    # the per-partition pool); overlapped must be meaningfully faster than
+    # two serial service passes
+    snap = obs.REGISTRY.snapshot()
+    key = "mmlspark_compiler_schedule_overlaps_total"
+    total = sum(v for (name, _), v in snap.get("counters", {}).items()
+                if name == key) if isinstance(snap, dict) else None
+    del total, overlapped, snap, key
+
+
+def test_row_dropping_stage_pins_original_order():
+    from mmlspark_tpu.models import ImageFeaturizer
+
+    feat = ImageFeaturizer(input_col="img", output_col="f")  # drop_na=True
+    plan = plan_pipeline([feat])
+    assert not plan.all_row_preserving
+
+
+# ---------------------------------------------------------------------------
+# golden equivalence suite
+# ---------------------------------------------------------------------------
+
+
+def test_golden_featurize_linear_fuses_and_matches():
+    df = _df(n=257, parts=3, classes=3)
+    model = _fit_featurize_logistic(df, classes=3)
+    comp = model.compile()
+    # acceptance: >= 2 stages fused into ONE jit program
+    assert comp.num_fused_stages >= 2
+    assert len(comp.fused_segments) == 1
+    assert_exact(model.transform(df), comp.transform(df))
+
+
+def test_golden_featurize_linear_streaming_chunked():
+    from mmlspark_tpu.io.stream import StreamingDataFrame
+
+    n = 500
+    rng = np.random.default_rng(4)
+    cols = {
+        "a": rng.standard_normal(n),
+        "b": rng.standard_normal(n).astype(np.float32),
+        "v": rng.standard_normal((n, 5)).astype(np.float32),
+        "label": rng.integers(0, 2, n),
+    }
+    df = DataFrame.from_dict(cols, num_partitions=1)
+    model = _fit_featurize_logistic(df)
+    comp = model.compile()
+    sizes = [100, 37, 200, 3, 160]
+
+    def make_chunk(i):
+        if i >= len(sizes):
+            return None
+        off = sum(sizes[:i])
+        return DataFrame.from_dict(
+            {k: v[off:off + sizes[i]] for k, v in cols.items()}
+        )
+
+    streamed = StreamingDataFrame.from_generator(make_chunk).transform(
+        comp
+    ).materialize()
+    staged = model.transform(df)
+    for c in staged.columns:
+        assert staged[c].dtype == streamed[c].dtype
+        assert np.array_equal(staged[c], streamed[c])
+
+
+def test_golden_featurize_gbdt_classifier():
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    df = _df(n=300, parts=2)
+    model = Pipeline([
+        Featurize(input_cols=["a", "b", "v"], output_col="features"),
+        LightGBMClassifier(features_col="features", label_col="label",
+                           num_iterations=12, num_leaves=7),
+    ]).fit(df)
+    comp = model.compile()
+    assert comp.num_fused_stages == 2  # featurize + gbdt in one program
+    assert len(comp.fused_segments) == 1
+    assert_exact(model.transform(df), comp.transform(df))
+
+
+def test_golden_featurize_gbdt_multiclass_and_loglink():
+    from mmlspark_tpu.models.gbdt.estimators import (
+        LightGBMClassifier,
+        LightGBMRegressor,
+    )
+
+    df = _df(n=240, parts=2, classes=3)
+    model = Pipeline([
+        Featurize(input_cols=["a", "b"], output_col="features"),
+        LightGBMClassifier(features_col="features", label_col="label",
+                           num_iterations=9, num_leaves=7),
+    ]).fit(df)
+    assert_exact(model.transform(df), model.compile().transform(df))
+
+    rng = np.random.default_rng(9)
+    df2 = DataFrame.from_dict({
+        "a": rng.standard_normal(150),
+        "b": rng.standard_normal(150),
+        "y": np.exp(rng.standard_normal(150) * 0.3),
+    }, num_partitions=2)
+    reg = Pipeline([
+        Featurize(input_cols=["a", "b"], output_col="features"),
+        LightGBMRegressor(features_col="features", label_col="y",
+                          objective="poisson", num_iterations=8,
+                          num_leaves=7),
+    ]).fit(df2)
+    comp = reg.compile()
+    assert comp.num_fused_stages == 2  # log-link epilogue rides finalize
+    assert_exact(reg.transform(df2), comp.transform(df2))
+
+
+def test_golden_image_zoo_pipeline():
+    from mmlspark_tpu.models import ImageFeaturizer
+    from mmlspark_tpu.models.linear import LogisticRegressionModel
+
+    rng = np.random.default_rng(2)
+    imgs = rng.integers(0, 255, size=(24, 28, 28, 3), dtype=np.uint8)
+    df = DataFrame.from_dict({"image": imgs}, num_partitions=2)
+    feat = ImageFeaturizer(input_col="image", output_col="features",
+                           model_name="ResNet8_Digits", cut_output_layers=1)
+    d = feat.transform(df)["features"].shape[1]
+    lr = LogisticRegressionModel(features_col="features", num_classes=3)
+    lr.set(weights=rng.standard_normal((d, 3)).astype(np.float32),
+           bias=rng.standard_normal(3).astype(np.float32))
+    model = PipelineModel(stages=[feat, lr])
+    staged = model.transform(df)
+
+    # exact mode: conv lowerings are not batch-shape-stable, so the zoo
+    # stage plans host-bound (exact_capable=False) and equality is exact
+    comp = model.compile()
+    assert [type(s).__name__ for s in comp.segments] == [
+        "HostSegment", "FusedSegment",
+    ]
+    assert_exact(staged, comp.transform(df))
+
+    # exact=False: the backbone fuses into the segment; equality relaxes
+    # to allclose but hard predictions still agree
+    comp2 = model.compile(exact=False)
+    assert comp2.num_fused_stages == 2
+    out2 = comp2.transform(df)
+    np.testing.assert_allclose(
+        out2["features"], staged["features"], rtol=1e-2, atol=1e-2
+    )
+    assert np.array_equal(out2["prediction"], staged["prediction"])
+
+
+def test_golden_host_http_mid_dag():
+    from mmlspark_tpu.io.http_transformer import SimpleHTTPTransformer
+
+    def stub_handler(req):
+        body = json.loads(req.data) if getattr(req, "data", None) else {}
+        return {"status_code": 200, "reason": "OK",
+                "entity": json.dumps({"score": len(str(body))}).encode()}
+
+    df = _df(n=64, parts=2)
+    import jax.numpy as jnp
+
+    model = Pipeline([
+        Featurize(input_cols=["a", "b", "v"], output_col="features"),
+        UDFTransformer(input_col="features", output_col="features_s",
+                       vector_udf=lambda x: x * jnp.float32(0.5),
+                       jit_compatible=True),
+        SimpleHTTPTransformer(input_col="a", output_col="svc",
+                              url="http://stub.invalid",
+                              custom_handler=stub_handler),
+        LogisticRegression(features_col="features_s", label_col="label",
+                           max_iter=10),
+    ]).fit(df)
+    comp = model.compile()
+    kinds = [type(s).__name__ for s in comp.segments]
+    # host stage mid-DAG with fused segments on either side
+    assert kinds == ["FusedSegment", "HostSegment", "FusedSegment"]
+    assert comp.num_fused_stages == 3
+    assert_exact(model.transform(df), comp.transform(df))
+
+
+# ---------------------------------------------------------------------------
+# CompiledPipeline surface
+# ---------------------------------------------------------------------------
+
+
+def test_compiled_pipeline_save_load_roundtrip(tmp_path):
+    df = _df(n=90, parts=2)
+    model = _fit_featurize_logistic(df)
+    comp = model.compile()
+    staged = model.transform(df)
+    assert_exact(staged, comp.transform(df))
+    p = os.path.join(str(tmp_path), "cp")
+    comp.save(p)
+    loaded = CompiledPipeline.load(p)
+    assert loaded.num_fused_stages == comp.num_fused_stages
+    assert_exact(staged, loaded.transform(df))
+
+
+def test_explain_reports_plan_segments_schedule():
+    df = _df(n=40, parts=1)
+    model = _fit_featurize_logistic(df)
+    comp = model.compile()
+    text = comp.explain()
+    for token in ("== plan ==", "== segments ==", "== schedule ==",
+                  "FeaturizeModel", "critical_path"):
+        assert token in text
+
+
+def test_compile_metrics_exported():
+    df = _df(n=50, parts=1)
+    model = _fit_featurize_logistic(df)
+    comp = model.compile()
+    comp.transform(df)
+    text = obs.render()
+    for fam in (
+        "mmlspark_compiler_plan_seconds",
+        "mmlspark_compiler_stages_fused_total",
+        "mmlspark_compiler_segments_total",
+        "mmlspark_compiler_compile_seconds",
+        "mmlspark_compiler_segment_latency_seconds",
+    ):
+        assert fam in text, fam
+
+
+def test_compiled_pipeline_transform_empty_and_single_row():
+    df = _df(n=40, parts=1)
+    model = _fit_featurize_logistic(df)
+    comp = model.compile()
+    one = DataFrame.from_dict({c: df[c][:1] for c in df.columns})
+    assert_exact(model.transform(one), comp.transform(one))
+    empty = DataFrame.from_dict({c: df[c][:0] for c in df.columns})
+    staged_empty = model.transform(empty)
+    compiled_empty = comp.transform(empty)
+    assert staged_empty.count() == compiled_empty.count() == 0
+    assert_exact(staged_empty, compiled_empty)
+
+
+def test_cross_row_kernel_is_never_padded():
+    # a row_wise=False kernel's reduction would see the pow2 pad rows —
+    # the fuser must run it at the exact batch shape instead
+    class CrossRow:
+        def fusable_kernel(self):
+            import jax.numpy as jnp
+
+            def fn(cols):
+                x = cols["a"].astype(jnp.float32)
+                # batch-shape-dependent (stands in for any cross-row
+                # reduction) and exact: padded rows would shift it
+                return {"c": x + jnp.float32(x.shape[0])}
+
+            return StageKernel(reads=("a",), writes=("c",), fn=fn,
+                               row_wise=False)
+
+        def transform(self, df):
+            def part(p):
+                x = np.asarray(p["a"], np.float32)
+                q = dict(p)
+                q["c"] = x + np.float32(x.shape[0])
+                return q
+            return df.map_partitions(part)
+
+    n = 37  # NOT a pow2: padding would shift the mean
+    df = DataFrame.from_dict(
+        {"a": np.random.default_rng(5).standard_normal(n)}, num_partitions=1
+    )
+    comp = CompiledPipeline(stages=[CrossRow()])
+    seg = comp.fused_segments[0]
+    assert not seg.row_wise
+    assert_exact(CrossRow().transform(df), comp.transform(df))
+
+
+# ---------------------------------------------------------------------------
+# modelstore pipeline: spec
+# ---------------------------------------------------------------------------
+
+
+def test_modelstore_pipeline_spec(tmp_path):
+    from mmlspark_tpu.serving.modelstore.loaders import (
+        build_loaded_model,
+        model_name_from_spec,
+    )
+    from mmlspark_tpu.serving.server import CachedRequest
+
+    df = _df(n=60, parts=1)
+    model = _fit_featurize_logistic(df)
+    path = os.path.join(str(tmp_path), "scorer")
+    model.save(path)
+    with open(os.path.join(path, "warmup.json"), "w") as f:
+        json.dump({"a": [0.1], "b": [0.5], "v": [[0.0] * 5],
+                   "label": [0]}, f)
+
+    assert model_name_from_spec(f"pipeline:{path}") == "scorer"
+    lm = build_loaded_model(f"pipeline:{path}")
+    assert lm.nbytes > 0  # jax-tree byte accounting over fitted weights
+    assert lm.meta["fused_stages"] >= 2
+    lm.warmup()  # plan build + one transform through warmup.json
+
+    row = {"a": 0.3, "b": -1.2, "v": [0.1] * 5, "label": 1}
+    req = CachedRequest(id="r1", epoch=0, method="POST", path="/",
+                        headers={}, body=json.dumps({"rows": [row]}).encode())
+    code, body, _ = lm.handler([req])["r1"]
+    assert code == 200
+    out_row = json.loads(body)["rows"][0]
+    # reply carries the pipeline's output columns only
+    assert set(out_row) == {"features", "features_s", "raw_prediction",
+                            "probability", "prediction"}
+
+    # single-row (non-enveloped) contract
+    req2 = CachedRequest(id="r2", epoch=0, method="POST", path="/",
+                         headers={}, body=json.dumps(row).encode())
+    code2, body2, _ = lm.handler([req2])["r2"]
+    assert code2 == 200 and "prediction" in json.loads(body2)
+
+    bad = CachedRequest(id="r3", epoch=0, method="POST", path="/",
+                        headers={}, body=b"{not json")
+    code3, _, _ = lm.handler([bad])["r3"]
+    assert code3 == 400
+
+    # a whole dispatcher batch scores as ONE transform, split back per
+    # request — and a bad request in the batch must not poison the rest
+    batch = [
+        CachedRequest(id=f"b{i}", epoch=0, method="POST", path="/",
+                      headers={}, body=_mk_body(row, i))
+        for i in range(4)
+    ]
+    replies = lm.handler(batch)
+    assert replies["b2"][0] == 400  # the poisoned one
+    for i in (0, 1, 3):
+        code_i, body_i, _ = replies[f"b{i}"]
+        assert code_i == 200
+        assert json.loads(body_i)["prediction"] == json.loads(body)["rows"][0]["prediction"]
+    # JSON rows must densify into the fused path — a serving stack that
+    # guard-falls back to staged on every request defeats the compiler
+    assert_no_fallbacks()
+    lm.release()
+
+
+def _mk_body(row: dict, i: int) -> bytes:
+    return b"{broken" if i == 2 else json.dumps(row).encode()
+
+
+def test_modelstore_pipeline_spec_opaque_output_columns(tmp_path):
+    """A pipeline ending in an opaque stage (RenameColumn) must reply with
+    the renamed column — declared plan writes cannot name it."""
+    from mmlspark_tpu.serving.modelstore.loaders import build_loaded_model
+    from mmlspark_tpu.serving.server import CachedRequest
+
+    df = _df(n=60, parts=1)
+    model = _fit_featurize_logistic(df)
+    model.set(stages=list(model.get("stages")) + [
+        RenameColumn(input_col="prediction", output_col="score")
+    ])
+    path = os.path.join(str(tmp_path), "renamer")
+    model.save(path)
+
+    lm = build_loaded_model(f"pipeline:{path}")
+    row = {"a": 0.3, "b": -1.2, "v": [0.1] * 5, "label": 1}
+    req = CachedRequest(id="r1", epoch=0, method="POST", path="/",
+                        headers={}, body=json.dumps(row).encode())
+    code, body, _ = lm.handler([req])["r1"]
+    assert code == 200
+    out_row = json.loads(body)
+    assert "score" in out_row and "prediction" not in out_row
+    # input columns never echo back
+    assert not set(row) & set(out_row)
+    lm.release()

@@ -142,9 +142,18 @@ def make_test_objects() -> list:
     )
 
     lin_df = df.select("features", "label")
+
+    # the pipeline compiler's CompiledPipeline is a registered Transformer
+    from mmlspark_tpu.compiler import CompiledPipeline
+
+    compiled = CompiledPipeline(
+        stages=[LogisticRegression(max_iter=10).fit(lin_df)]
+    )
+
     objs += [
         TestObject(LogisticRegression(max_iter=20), lin_df),
         TestObject(LinearRegression(), lin_df),
+        TestObject(compiled, lin_df),
         TestObject(S.VectorZipper(input_cols=["x", "label"], output_col="z"), df),
         TestObject(
             S.FastVectorAssembler(input_cols=["x", "label"], output_col="fv"), df
