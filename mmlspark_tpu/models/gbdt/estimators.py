@@ -133,6 +133,12 @@ class _LightGBMParams(
     )
     seed = Param("rng seed", default=0, type_=int)
     verbosity = Param("log level", default=-1, type_=int)
+    fused_rounds = Param(
+        "scan-fused chunk size: 0 = auto (one dispatch per run, bounded "
+        "chunks under early stopping), 1 = legacy per-round dispatch "
+        "loop (fallback; identical model), N > 1 = cap chunks at N rounds",
+        default=0, type_=int,
+    )
 
     def _config(self, objective: str, num_class: int = 1) -> TrainConfig:
         return TrainConfig(
@@ -214,6 +220,7 @@ class _LightGBMParams(
                 "num_batches > 1 (per-segment round indices would collide "
                 "in one checkpoint directory)"
             )
+        kw.setdefault("fused_rounds", self.get("fused_rounds"))
         if nb and nb > 1:
             n = len(data["y"])
             bounds = np.linspace(0, n, nb + 1).astype(int)

@@ -347,3 +347,36 @@ def sigmoid(x: np.ndarray) -> np.ndarray:
 def softmax(x: np.ndarray) -> np.ndarray:
     e = np.exp(x - x.max(axis=-1, keepdims=True))
     return e / e.sum(axis=-1, keepdims=True)
+
+def binary_auc_device(
+    s: "jnp.ndarray", y: "jnp.ndarray", vw: "jnp.ndarray"
+) -> "jnp.ndarray":
+    """ROC AUC on device over the masked validation rows — the rank
+    statistic with ties averaged, formula-matched to
+    :func:`mmlspark_tpu.core.metrics.binary_auc` (searchsorted average
+    ranks instead of the host's tie-run walk; identical value). Lets
+    ``metric="auc"`` early stopping train scan-fused with zero per-round
+    host syncs. Raw scores are fine: sigmoid is strictly increasing, so
+    ranks (and ties) match probability-space AUC exactly. Degenerate
+    all-one-class validation sets return 0.5 (the host path returns NaN
+    and disables improvement tracking; inside a fused chunk a constant
+    metric achieves the same — no improvement is ever recorded)."""
+    import jax.numpy as jnp
+
+    valid = vw > 0
+    # invalid rows sort to +inf: counts of (< s_i) and (<= s_i) over the
+    # valid set are unaffected for finite s_i. Rank sums accumulate in
+    # f32 (x64 is globally off) — exact up to ~2^24 validation rows,
+    # far beyond any early-stopping eval set here
+    srt = jnp.sort(jnp.where(valid, s, jnp.inf))
+    lo = jnp.searchsorted(srt, s, side="left")
+    hi = jnp.searchsorted(srt, s, side="right")
+    avg_rank = (lo + hi + 1).astype(jnp.float32) / 2.0
+    pos = jnp.where(valid, y, 0.0)
+    n_pos = pos.sum()
+    n_val = valid.sum().astype(jnp.float32)
+    n_neg = n_val - n_pos
+    rank_sum = (avg_rank * pos).sum()
+    denom = jnp.maximum(n_pos * n_neg, 1.0)
+    auc = (rank_sum - n_pos * (n_pos + 1) / 2.0) / denom
+    return jnp.where((n_pos > 0) & (n_neg > 0), auc, 0.5)

@@ -55,7 +55,7 @@ from mmlspark_tpu.core import faults
 from mmlspark_tpu.models.gbdt import objectives
 from mmlspark_tpu.parallel.mesh import DATA_AXIS as _DATA_AXIS
 from mmlspark_tpu.models.gbdt.binning import BinMapper
-from mmlspark_tpu.ops.histogram import NUM_BINS
+from mmlspark_tpu.ops.histogram import NUM_BINS, hist_lowering as _hist_lowering
 from mmlspark_tpu.models.gbdt.booster import Booster, Tree, per_tree_raw
 from mmlspark_tpu.models.gbdt.treegrow import grow_tree
 
@@ -76,6 +76,16 @@ _M_ROUND_SECONDS = obs.histogram(
 _M_CHUNK_SECONDS = obs.histogram(
     "mmlspark_gbdt_chunk_seconds",
     "Scan-fused chunk wall time: dispatch + eval read + record unpack",
+)
+_M_FUSED_CHUNKS = obs.counter(
+    "mmlspark_gbdt_fused_chunks_total",
+    "Scan-fused chunk dispatches: a training run costs O(rounds / chunk) "
+    "of these instead of O(rounds) per-round dispatches",
+)
+_M_DEVICE_EVAL_ROUNDS = obs.counter(
+    "mmlspark_gbdt_device_eval_rounds_total",
+    "Boosting rounds whose eval metric was computed on device inside the "
+    "fused chunk (no per-round host sync)",
 )
 
 
@@ -477,7 +487,7 @@ def _iteration_core(
     static_argnames=(
         "objective", "k", "grad_pre", "is_goss", "use_voting", "has_cat",
         "num_leaves", "max_depth", "min_data_in_leaf", "top_k", "mesh",
-        "depthwise", "partitioned", "num_bins",
+        "depthwise", "partitioned", "num_bins", "hist_mode",
     ),
 )
 def _fused_iteration(
@@ -515,6 +525,7 @@ def _fused_iteration(
     depthwise: bool = False,
     partitioned: bool = False,
     num_bins: int = NUM_BINS,
+    hist_mode: str = "",
 ) -> tuple:
     """One whole boosting iteration as ONE XLA program — the dispatch-per-
     iteration path kept for the modes whose loop does host work between
@@ -536,11 +547,13 @@ def _fused_iteration(
     return new_scores, tuple(grown_list)
 
 
-# all lower-is-better; computed on device inside the scan so eval costs no
-# extra host round trip (the host only reads the (C,) metric vector)
+# computed on device inside the scan so eval costs no extra host round
+# trip (the host only reads the (C,) metric vector); all lower-is-better
+# except auc/ndcg (see _HIGHER_METRICS)
 _DEVICE_METRICS = (
-    "binary_logloss", "binary_error", "multi_logloss",
+    "binary_logloss", "binary_error", "multi_logloss", "auc",
 ) + objectives.REGRESSION_KINDS
+_HIGHER_METRICS = ("ndcg", "auc")
 
 
 def _device_metric(
@@ -550,6 +563,8 @@ def _device_metric(
     """Masked-mean validation metric, formula-matched to :func:`_eval_metric`
     (same clips/logs so early-stopping decisions agree across paths)."""
     wsum = jnp.maximum(vw.sum(), 1.0)
+    if eval_kind == "auc":
+        return objectives.binary_auc_device(s, y, vw)
     if eval_kind == "binary_logloss":
         p = jnp.clip(jax.nn.sigmoid(s), 1e-15, 1 - 1e-15)
         loss = -(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p))
@@ -579,7 +594,7 @@ _PACK_FIELDS = (
         "objective", "k", "grad_pre", "is_goss", "use_voting", "has_cat",
         "num_leaves", "max_depth", "min_data_in_leaf", "top_k", "mesh",
         "depthwise", "partitioned", "bagging_freq", "eval_kind", "is_rf",
-        "num_bins", "eval_k",
+        "num_bins", "eval_k", "hist_mode",
     ),
 )
 def _scan_chunk(
@@ -629,6 +644,7 @@ def _scan_chunk(
     is_rf: bool,
     num_bins: int = NUM_BINS,
     eval_k: int = 5,
+    hist_mode: str = "",
 ) -> tuple:
     """C whole boosting iterations as ONE XLA program (``lax.scan`` over
     iterations). On a relay-attached TPU every dispatch costs ~35 ms and
@@ -800,6 +816,7 @@ def train(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 10,
     resume_from: Optional[str] = None,
+    fused_rounds: int = 0,
 ) -> Booster:
     """Fit a booster on dense (n, d) features or a CSR triple.
 
@@ -811,6 +828,13 @@ def train(
     ``base_score``: boost_from_average baseline (scalar, or (k,) for
     multiclass) — added to the initial scores AND stored on the booster so
     prediction replays it.
+
+    ``fused_rounds``: scan-fused chunk control — 0 (default) sizes chunks
+    automatically (the whole run without early stopping, bounded chunks
+    with it), 1 forces the legacy one-dispatch-per-round loop (kept as
+    the debugging/fallback path; bit-identical results), N > 1 caps the
+    chunk at N rounds. Chunk size never changes the trained model — only
+    how many XLA dispatches the loop costs (O(rounds / N) vs O(rounds)).
 
     Preemption safety (models/gbdt/checkpoint.py): ``checkpoint_dir``
     serializes trees + device score/bag state + host RNG every
@@ -1210,9 +1234,10 @@ def train(
     # chunked lax.scan programs: ONE dispatch (and one packed record fetch)
     # per chunk instead of one per iteration. Excluded: dart (mutates past
     # trees on host), delegates (host callbacks), multihost (replicated
-    # small-read choreography), host-only eval metrics (auc needs sorts we
-    # keep on host), and lambdarank only when its groups are non-contiguous
-    # or too large for the padded device kernel (rank_fast above).
+    # small-read choreography), and lambdarank only when its groups are
+    # non-contiguous or too large for the padded device kernel (rank_fast
+    # above). Eval metrics all run on device now (incl. the searchsorted
+    # rank-statistic AUC), so no metric forces the host loop.
     rank_fast = False
     rank_pads = None
     if cfg.objective == "lambdarank" and not multihost and group_ids is not None:
@@ -1228,7 +1253,8 @@ def train(
                 rank_fast = True
                 rank_pads = (pi, va)
     fast = (
-        delegate is None and not multihost and not is_dart
+        int(fused_rounds) != 1
+        and delegate is None and not multihost and not is_dart
         and (cfg.objective != "lambdarank" or rank_fast)
     )
     eval_needed = valid_mask is not None and bool(np.any(valid_mask))
@@ -1264,6 +1290,8 @@ def train(
             cfg.num_iterations if early_stopping_round == 0
             else min(cfg.num_iterations, max(16, early_stopping_round))
         )
+        if int(fused_rounds) > 1:
+            C_full = max(1, min(C_full, int(fused_rounds)))
         if checkpoint_dir:
             # chunk boundaries ARE the checkpoint (and fault-injection)
             # boundaries; align them so every checkpoint lands exactly
@@ -1338,11 +1366,11 @@ def train(
                 partitioned=partitioned,
                 bagging_freq=int(bagging_freq) if use_bag else 0,
                 eval_kind=eval_kind, is_rf=is_rf, num_bins=hist_bins,
-                eval_k=int(eval_k),
+                eval_k=int(eval_k), hist_mode=_hist_lowering(),
             )
             keep = C
             if eval_on:
-                higher = eval_kind == "ndcg"
+                higher = eval_kind in _HIGHER_METRICS
                 mvals = np.asarray(metrics)
                 for i in range(C):
                     val = float(mvals[i])
@@ -1376,6 +1404,9 @@ def train(
             done_ns = _time.perf_counter_ns()
             obs.record_span("gbdt.chunk", t_chunk_ns, done_ns)
             _M_CHUNK_SECONDS.observe((done_ns - t_chunk_ns) / 1e9)
+            _M_FUSED_CHUNKS.inc()
+            if eval_on:
+                _M_DEVICE_EVAL_ROUNDS.inc(keep)
             _M_ROUNDS.inc(keep)
             # one observation per completed round at the amortized cost —
             # sum and count stay exact for scrape-side mean/rate math
@@ -1383,7 +1414,15 @@ def train(
             for _ in range(keep):
                 _M_ROUND_SECONDS.observe(per_round)
             it0 += C
-            if checkpoint_dir and not stopped:
+            # checkpoint at the configured cadence: snapshot whenever this
+            # chunk crossed a checkpoint_every boundary (chunk sizes that
+            # do not divide the cadence still checkpoint at the first
+            # boundary after each cadence point, never skip one)
+            if (
+                checkpoint_dir and not stopped
+                and ((it0 - C) // checkpoint_every < it0 // checkpoint_every
+                     or it0 >= cfg.num_iterations)
+            ):
                 _save_ckpt(it0, bag_dev if use_bag else None)
 
     # dispatch-per-iteration path (dart / lambdarank / multihost /
@@ -1471,6 +1510,7 @@ def train(
             mesh=mesh if (use_voting or hist_sharded) else None,
             depthwise=cfg.growth_policy == "depthwise",
             partitioned=partitioned, num_bins=hist_bins,
+            hist_mode=_hist_lowering(),
         )
         # the fused step fit against eff_scores (dart: scores minus dropped
         # trees); the running total keeps the dropped contribution

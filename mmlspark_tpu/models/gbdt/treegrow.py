@@ -180,6 +180,33 @@ def grow_tree(
     has_categorical = categorical_mask is not None
     if not has_categorical:
         categorical_mask = jnp.zeros((bins.shape[1],), bool)
+    # the lowering choice is env/backend-dependent and invisible to jit's
+    # cache key — thread it as a static arg so flipping
+    # MMLSPARK_TPU_HIST_HOST / MMLSPARK_TPU_PALLAS between calls with
+    # identical shapes can never reuse a stale-lowering program
+    from mmlspark_tpu.ops.histogram import (
+        _rows_sharded,
+        hist_lowering,
+        use_host_hist,
+    )
+
+    hm = hist_lowering()
+    if (
+        use_host_hist()
+        and not partitioned
+        and not _rows_sharded(mesh, shard_axis)
+    ):
+        # CPU lowering: the whole leaf-wise tree behind ONE host callback
+        # (see _grow_tree_depthwise_hostcall for the cost argument)
+        return _grow_tree_lossguide_hostcall(
+            bins, grad, hess, row_weight,
+            num_leaves=num_leaves, max_depth=max_depth, num_bins=num_bins,
+            min_data_in_leaf=min_data_in_leaf, min_gain=min_gain,
+            lambda_l2=lambda_l2, lambda_l1=lambda_l1,
+            min_sum_hessian=min_sum_hessian, learning_rate=learning_rate,
+            feature_mask=feature_mask, categorical_mask=categorical_mask,
+            has_categorical=has_categorical,
+        )
     if partitioned:
         return _grow_tree_partitioned(
             bins, grad, hess, row_weight,
@@ -188,7 +215,7 @@ def grow_tree(
             max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
             categorical_mask=categorical_mask, has_categorical=has_categorical,
             lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
-            num_bins=num_bins,
+            num_bins=num_bins, hist_mode=hm,
         )
     return _grow_tree(
         bins, grad, hess, row_weight,
@@ -197,7 +224,7 @@ def grow_tree(
         max_depth=max_depth, min_data_in_leaf=min_data_in_leaf,
         categorical_mask=categorical_mask, has_categorical=has_categorical,
         lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
-        num_bins=num_bins, mesh=mesh, shard_axis=shard_axis,
+        num_bins=num_bins, mesh=mesh, shard_axis=shard_axis, hist_mode=hm,
     )
 
 
@@ -205,7 +232,7 @@ def grow_tree(
     jax.jit,
     static_argnames=(
         "num_leaves", "max_depth", "min_data_in_leaf", "has_categorical",
-        "num_bins", "mesh", "shard_axis",
+        "num_bins", "mesh", "shard_axis", "hist_mode",
     ),
 )
 def _grow_tree(
@@ -227,7 +254,9 @@ def _grow_tree(
     num_bins: int = NUM_BINS,
     mesh: Any = None,
     shard_axis: Optional[str] = None,
+    hist_mode: str = "",
 ) -> GrownTree:
+    del hist_mode  # jit cache key only (see grow_tree)
     n, d = bins.shape
     L = num_leaves
     B = num_bins
@@ -253,7 +282,8 @@ def _grow_tree(
     def plane_hist(mask: jnp.ndarray) -> jnp.ndarray:
         """Histogram of the rows selected by ``mask`` -> (d*B, 3)."""
         return plane_histogram(
-            bins, row_stats, mask, num_bins=B, mesh=mesh, shard_axis=shard_axis
+            bins, row_stats, mask, num_bins=B, mesh=mesh,
+            shard_axis=shard_axis, bins_in_range=True,
         )
 
     # best split of ONE leaf from its plane. Only state-free validity
@@ -369,9 +399,12 @@ def _grow_tree(
     )
 
     # leaf values: -ThresholdL1(G)/(H+lambda) * lr per final leaf
-    Gl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(g)
-    Hl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(h)
-    Cl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(cnt_w)
+    from mmlspark_tpu.ops.histogram import _rows_sharded, leaf_stat_sums
+
+    sums = leaf_stat_sums(
+        row_leaf, row_stats, L, sharded=_rows_sharded(mesh, shard_axis)
+    )
+    Gl, Hl, Cl = sums[:, 0], sums[:, 1], sums[:, 2]
     leaf_values = -soft(Gl) / (Hl + lambda_l2) * learning_rate
     leaf_values = jnp.where(Cl > 0, leaf_values, 0.0)
     return GrownTree(
@@ -397,7 +430,7 @@ def _range_sizes(n: int, min_size: int = 512) -> tuple:
     jax.jit,
     static_argnames=(
         "num_leaves", "max_depth", "min_data_in_leaf", "has_categorical",
-        "num_bins",
+        "num_bins", "hist_mode",
     ),
 )
 def _grow_tree_partitioned(
@@ -417,6 +450,7 @@ def _grow_tree_partitioned(
     lambda_l1: float = 0.0,
     min_sum_hessian: float = 1e-3,
     num_bins: int = NUM_BINS,
+    hist_mode: str = "",
 ) -> GrownTree:
     """Leaf-wise growth over data kept PARTITIONED by leaf — the TPU
     expression of LightGBM's DataPartition + histogram-subtraction core
@@ -532,7 +566,7 @@ def _grow_tree_partitioned(
                 m = ((p >= s_small) & (p < s_small + c_small)).astype(
                     jnp.float32
                 )
-                return plane_histogram(bsl, ssl, m, num_bins=B)
+                return plane_histogram(bsl, ssl, m, num_bins=B, bins_in_range=True)
             return f
 
         idx = jnp.sum(c_small > sizes_arr).astype(jnp.int32)
@@ -581,7 +615,7 @@ def _grow_tree_partitioned(
     hist0 = (
         jnp.zeros((L, d * B, 3), jnp.float32)
         .at[0]
-        .set(plane_histogram(bins, row_stats, num_bins=B))
+        .set(plane_histogram(bins, row_stats, num_bins=B, bins_in_range=True))
     )
     init = (
         hist0,
@@ -620,9 +654,10 @@ def _grow_tree_partitioned(
     row_leaf_ord = jnp.argmax(in_leaf, axis=1).astype(jnp.int32)
     row_leaf = jnp.zeros((n,), jnp.int32).at[order].set(row_leaf_ord)
 
-    Gl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(g)
-    Hl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(h)
-    Cl = jnp.zeros((L,), jnp.float32).at[row_leaf].add(cnt_w)
+    from mmlspark_tpu.ops.histogram import leaf_stat_sums
+
+    sums = leaf_stat_sums(row_leaf, row_stats, L)
+    Gl, Hl, Cl = sums[:, 0], sums[:, 1], sums[:, 2]
     leaf_values = -threshold_l1(Gl, lambda_l1) / (Hl + lambda_l2) * learning_rate
     leaf_values = jnp.where(Cl > 0, leaf_values, 0.0)
     return GrownTree(
@@ -694,6 +729,26 @@ def grow_tree_depthwise(
             vector = jax.default_backend() == "tpu"
         except Exception:
             vector = False
+    # CPU lowering: the whole tree grows behind ONE host callback (numpy
+    # split scan + pooled bincount histograms) — a per-level histogram
+    # callback alone leaves ~9 ms/tree of XLA:CPU glue plus ~1 ms of
+    # bridge cost per crossing, which is the difference between losing
+    # and beating sklearn's OpenMP grower at bench shapes. TPU and
+    # sharded meshes keep the XLA grower below.
+    from mmlspark_tpu.ops.histogram import _rows_sharded, use_host_hist
+
+    if use_host_hist() and not _rows_sharded(mesh, shard_axis):
+        return _grow_tree_depthwise_hostcall(
+            bins, grad, hess, row_weight,
+            num_leaves=L, n_levels=n_levels, num_bins=num_bins,
+            min_data_in_leaf=min_data_in_leaf, min_gain=min_gain,
+            lambda_l2=lambda_l2, lambda_l1=lambda_l1,
+            min_sum_hessian=min_sum_hessian, learning_rate=learning_rate,
+            feature_mask=feature_mask, categorical_mask=categorical_mask,
+            has_categorical=has_categorical, sibling_subtract=sibling,
+        )
+    from mmlspark_tpu.ops.histogram import hist_lowering
+
     return _grow_tree_depthwise(
         bins, grad, hess, row_weight,
         num_leaves=L, lambda_l2=lambda_l2, min_gain=min_gain,
@@ -703,7 +758,108 @@ def grow_tree_depthwise(
         lambda_l1=lambda_l1, min_sum_hessian=min_sum_hessian,
         num_bins=num_bins, mesh=mesh, shard_axis=shard_axis,
         sibling_subtract=sibling, vector_split=vector,
+        hist_mode=hist_lowering(),
     )
+
+
+def _grown_tree_shapes(n: int, L: int, B: int) -> tuple:
+    return (
+        jax.ShapeDtypeStruct((L - 1,), jnp.int32),    # rec_leaf
+        jax.ShapeDtypeStruct((L - 1,), jnp.int32),    # rec_feature
+        jax.ShapeDtypeStruct((L - 1,), jnp.int32),    # rec_bin
+        jax.ShapeDtypeStruct((L - 1,), jnp.bool_),    # rec_active
+        jax.ShapeDtypeStruct((L - 1,), jnp.float32),  # rec_gain
+        jax.ShapeDtypeStruct((L,), jnp.float32),      # leaf_values
+        jax.ShapeDtypeStruct((L,), jnp.int32),        # leaf_counts
+        jax.ShapeDtypeStruct((n,), jnp.int32),        # row_leaf
+        jax.ShapeDtypeStruct((L - 1,), jnp.bool_),    # rec_is_cat
+        jax.ShapeDtypeStruct((L - 1, B), jnp.bool_),  # rec_catmask
+    )
+
+
+def _grow_tree_lossguide_hostcall(
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    row_weight: jnp.ndarray,
+    *,
+    num_leaves: int,
+    max_depth: int,
+    num_bins: int,
+    min_data_in_leaf: int,
+    min_gain: float,
+    lambda_l2: float,
+    lambda_l1: float,
+    min_sum_hessian: float,
+    learning_rate: float,
+    feature_mask: jnp.ndarray,
+    categorical_mask: jnp.ndarray,
+    has_categorical: bool,
+) -> GrownTree:
+    """The host leaf-wise grower (models/gbdt/hostgrow.py) behind one
+    pure_callback; traceable inside jit / the scan-fused round loop."""
+    from mmlspark_tpu.models.gbdt.hostgrow import grow_tree_lossguide_host
+
+    n, d = bins.shape
+    L, B = num_leaves, num_bins
+    kern = functools.partial(
+        grow_tree_lossguide_host,
+        L, int(max_depth), B, min_data_in_leaf, has_categorical,
+    )
+    args = (
+        jnp.float32(min_gain), jnp.float32(lambda_l2),
+        jnp.float32(lambda_l1), jnp.float32(min_sum_hessian),
+        jnp.float32(learning_rate),
+        bins, grad, hess, row_weight, feature_mask, categorical_mask,
+    )
+    out_shapes = _grown_tree_shapes(n, L, B)
+    from mmlspark_tpu.ops.histogram import _callback
+
+    return GrownTree(*_callback(kern, out_shapes, *args))
+
+
+def _grow_tree_depthwise_hostcall(
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    row_weight: jnp.ndarray,
+    *,
+    num_leaves: int,
+    n_levels: int,
+    num_bins: int,
+    min_data_in_leaf: int,
+    min_gain: float,
+    lambda_l2: float,
+    lambda_l1: float,
+    min_sum_hessian: float,
+    learning_rate: float,
+    feature_mask: jnp.ndarray,
+    categorical_mask: jnp.ndarray,
+    has_categorical: bool,
+    sibling_subtract: bool,
+) -> GrownTree:
+    """The host grower (models/gbdt/hostgrow.py) behind one
+    pure_callback; traceable inside jit / the scan-fused round loop."""
+    from mmlspark_tpu.models.gbdt.hostgrow import grow_tree_depthwise_host
+
+    n, d = bins.shape
+    L, B = num_leaves, num_bins
+    # static structure in the partial; regularization/lr knobs ride as
+    # operands — inside the scan-fused loop they are traced scalars
+    kern = functools.partial(
+        grow_tree_depthwise_host,
+        L, n_levels, B, min_data_in_leaf, sibling_subtract, has_categorical,
+    )
+    out_shapes = _grown_tree_shapes(n, L, B)
+    args = (
+        jnp.float32(min_gain), jnp.float32(lambda_l2),
+        jnp.float32(lambda_l1), jnp.float32(min_sum_hessian),
+        jnp.float32(learning_rate),
+        bins, grad, hess, row_weight, feature_mask, categorical_mask,
+    )
+    from mmlspark_tpu.ops.histogram import _callback
+
+    return GrownTree(*_callback(kern, out_shapes, *args))
 
 
 @functools.partial(
@@ -711,7 +867,7 @@ def grow_tree_depthwise(
     static_argnames=(
         "num_leaves", "n_levels", "min_data_in_leaf", "has_categorical",
         "num_bins", "mesh", "shard_axis", "sibling_subtract",
-        "vector_split",
+        "vector_split", "hist_mode",
     ),
 )
 def _grow_tree_depthwise(
@@ -735,7 +891,9 @@ def _grow_tree_depthwise(
     shard_axis: Optional[str] = None,
     sibling_subtract: bool = True,
     vector_split: bool = True,
+    hist_mode: str = "",
 ) -> GrownTree:
+    del hist_mode  # jit cache key only (see grow_tree_depthwise)
     from mmlspark_tpu.ops.histogram import multi_plane_histogram
 
     n, d = bins.shape
@@ -782,7 +940,7 @@ def _grow_tree_depthwise(
             slot_pair = jnp.where(is_right, local // 2, P)  # P = no plane
             half = multi_plane_histogram(
                 bins, row_stats, slot_pair, P, num_bins=B,
-                mesh=mesh, shard_axis=shard_axis,
+                mesh=mesh, shard_axis=shard_axis, bins_in_range=True,
             )
             ok = (parent_local >= 0)[:, None, None]
             parents = cube_prev[
@@ -800,7 +958,7 @@ def _grow_tree_depthwise(
         else:
             cube = multi_plane_histogram(
                 bins, row_stats, local, S, num_bins=B,
-                mesh=mesh, shard_axis=shard_axis,
+                mesh=mesh, shard_axis=shard_axis, bins_in_range=True,
             )
         cube_prev = cube
         gains, feats, bbs, catms = jax.vmap(leaf_best)(cube)
@@ -979,9 +1137,12 @@ def _grow_tree_depthwise(
              rec_is_cat, rec_catmask),
         )
 
-    Gl = jnp.zeros((L,), jnp.float32).at[row_slot].add(g)
-    Hl = jnp.zeros((L,), jnp.float32).at[row_slot].add(h)
-    Cl = jnp.zeros((L,), jnp.float32).at[row_slot].add(cnt_w)
+    from mmlspark_tpu.ops.histogram import _rows_sharded, leaf_stat_sums
+
+    sums = leaf_stat_sums(
+        row_slot, row_stats, L, sharded=_rows_sharded(mesh, shard_axis)
+    )
+    Gl, Hl, Cl = sums[:, 0], sums[:, 1], sums[:, 2]
     leaf_values = (
         -threshold_l1(Gl, lambda_l1) / (Hl + lambda_l2) * learning_rate
     )

@@ -118,8 +118,12 @@ def _voting_program(
 
         def plane_hist(mask):
             # LOCAL histogram plane — stays on the shard (scatter lowering;
-            # single-shard shapes, no GSPMD collectives inside shard_map)
-            return plane_histogram(bins, row_stats, mask, num_bins=B)
+            # single-shard shapes, no GSPMD collectives inside shard_map;
+            # allow_host=False: a host callback per shard would serialize
+            # the shards on the GIL)
+            return plane_histogram(
+                bins, row_stats, mask, num_bins=B, allow_host=False
+            )
 
         cat_f = categorical_mask.astype(bool)
 

@@ -19,12 +19,29 @@ Two lowerings:
   ``psum`` riding ICI — exactly LightGBM's data_parallel per-iteration
   histogram allreduce (lightgbm/TrainUtils.scala:496-512 NetworkInit +
   socket rings), with the MXU kernel intact on every chip.
-- **XLA scatter-add (CPU, or sharded meshes without a mesh handle)**:
-  GSPMD partitions the scatter across the mesh and inserts the ICI
-  allreduce automatically.
+- **XLA scatter-add (sharded meshes without a mesh handle)**: GSPMD
+  partitions the scatter across the mesh and inserts the ICI allreduce
+  automatically. When the caller passes the mesh and Pallas is off, the
+  same scatter runs PER SHARD under ``shard_map`` with an explicit
+  ``psum`` instead — the allreduce stays visible (and measurable) in the
+  program rather than implied by the partitioner.
+- **Host bincount (CPU)**: XLA:CPU lowers scatter-add to an
+  element-by-element update loop (~70 ns/update measured — the reason
+  BENCH r06 *lost* to single-core sklearn by 4-35x); ``np.bincount``
+  does the identical accumulation at ~2 ns/update and, because the
+  kernel sees the row mask/slot vector instead of pre-zeroed stats, it
+  compacts to the selected rows first — per-split cost becomes
+  proportional to the CHILD size, LightGBM's DataPartition cost model
+  without the permutation. Runs as a ``pure_callback`` inside the jitted
+  (and scan-fused) growers; on CPU the "device" is the host, so
+  residency is preserved. Trade-off: callback programs are excluded
+  from jax's persistent compilation cache, so CPU training programs
+  recompile once per process (the ~10x runtime win repays one compile
+  within a single 20-iteration fit).
 
-Selection is automatic (see :func:`use_pallas`) and overridable with
-``MMLSPARK_TPU_PALLAS=0|1``.
+Selection is automatic (see :func:`use_pallas` / :func:`use_host_hist` /
+:func:`hist_lowering`) and overridable with ``MMLSPARK_TPU_PALLAS=0|1``
+and ``MMLSPARK_TPU_HIST_HOST=0|1``.
 """
 
 from __future__ import annotations
@@ -97,6 +114,33 @@ def use_pallas() -> bool:
         return False
 
 
+def use_host_hist() -> bool:
+    """Host-bincount lowering choice (CPU backend; or env-forced).
+
+    ``MMLSPARK_TPU_HIST_HOST=0`` restores the XLA scatter lowering (the
+    only pre-host-kernel CPU path — kept for A/B measurement and for the
+    GSPMD-partitioned sharded case, which never takes the host path)."""
+    env = os.environ.get("MMLSPARK_TPU_HIST_HOST")
+    if env is not None:
+        return env not in ("0", "false", "")
+    try:
+        return jax.default_backend() == "cpu" and not use_pallas()
+    except Exception:
+        return False
+
+
+def hist_lowering() -> str:
+    """Name of the unsharded-trace lowering that :func:`plane_histogram`
+    would pick right now: ``pallas`` | ``cpu`` (host bincount) |
+    ``scatter``. Recorded by the bench so the CPU-vs-TPU numbers say
+    which kernel produced them."""
+    if use_pallas():
+        return "pallas"
+    if use_host_hist():
+        return "cpu"
+    return "scatter"
+
+
 def _rows_sharded(mesh, shard_axis) -> bool:
     try:
         return (
@@ -106,6 +150,198 @@ def _rows_sharded(mesh, shard_axis) -> bool:
         )
     except Exception:
         return False
+
+
+# -- host (numpy bincount) lowering -----------------------------------------
+#
+# One module-level kernel per op so the traced callback target is a stable
+# object: jit caches of the enclosing programs stay valid across train()
+# calls (a fresh closure per call would retrace every fit).
+
+
+def _host_bincounts(
+    out: np.ndarray, b: np.ndarray, base, s: np.ndarray, ns: int, nb: int,
+    in_range: bool = False,
+) -> None:
+    """Shared accumulation loop: per feature, one weighted bincount per
+    stat column into ``out[:, f]``. ``base`` is the per-row plane offset
+    (slot * nb, or 0) with a trash value of ns*nb for dropped rows;
+    out-of-range bin codes also land in the trash slot (scatter's
+    mode='drop' semantics). np.bincount accumulates in f64 and the result
+    is cast once — slightly MORE accurate than the f32 scatter it
+    replaces."""
+    g, h, c = s[:, 0], s[:, 1], s[:, 2]
+    trash = ns * nb
+    width = trash + 1
+    # one contiguous transpose up front: per-feature rows become
+    # sequential reads, and the per-feature astype goes away (~30% of the
+    # kernel at bench shapes)
+    bT = np.ascontiguousarray(b.T, np.int32)
+    in_range = in_range or (
+        bool((bT.min() >= 0) and (bT.max() < nb)) if bT.size else True
+    )
+    for f in range(bT.shape[0]):
+        col = bT[f]
+        if in_range:
+            idx = base + col
+        else:
+            idx = np.where((col >= 0) & (col < nb), base + col, trash)
+        for j, w in enumerate((g, h, c)):
+            out[:, f, :, j] = np.bincount(
+                idx, weights=w, minlength=width
+            )[:trash].reshape(ns, nb)
+
+
+def _pool_worthwhile(kept_rows: int, d: int) -> bool:
+    from mmlspark_tpu.ops.histpool import MIN_POOL_ITEMS
+
+    return kept_rows * d >= MIN_POOL_ITEMS
+
+
+def _try_pool(
+    b: np.ndarray, base: np.ndarray, s3: np.ndarray, ns: int, nb: int
+) -> "np.ndarray | None":
+    """Feature-parallel process pool (histpool.py). None = run serial.
+    Bit-identical to the serial loop either way (same per-feature
+    bincounts, same row order)."""
+    from mmlspark_tpu.ops.histpool import pooled_bincounts
+
+    res = pooled_bincounts(b, base, s3, ns, nb)
+    if res is None:
+        return None
+    # the pool result aliases its shared arena (valid until the next
+    # call) — copy before handing it to the callback bridge
+    return res.reshape(ns, b.shape[1] * nb, 3).copy()
+
+
+def _host_plane_kernel(
+    num_bins: int, in_range: bool, bins, stats, mask=None
+) -> np.ndarray:
+    """(n, d) bins + (n, 3) stats [+ (n,) weight mask] -> (d*B, 3) f32.
+
+    The mask arrives as the raw row selector, not pre-zeroed stats, so
+    sparse selections (a leaf-wise split's moved rows) compact to the
+    selected rows first: per-split cost is proportional to the CHILD
+    size. At >= half the rows kept, scanning everything with zeroed
+    weights beats the gather; full-width builds go to the worker pool."""
+    nb = num_bins
+    b = np.asarray(bins)
+    n = b.shape[0]
+    m = None if mask is None else np.asarray(mask, np.float32)
+    n_kept = n if m is None else int(np.count_nonzero(m))
+    if (
+        in_range
+        and b.dtype in (np.int32, np.uint8)
+        and n_kept == n
+        and _pool_worthwhile(n, b.shape[1])
+        # fractional masks stay serial: the pool transports f32 stats, so
+        # an f32 mask multiply would differ from the serial kernel's f64
+        # product in the last ulp — only exact 0/1 selectors preserve the
+        # pooled == serial bit-identity invariant
+        and (m is None or bool(np.all((m == 0.0) | (m == 1.0))))
+    ):
+        s32 = np.asarray(stats, np.float32)
+        s3 = np.ascontiguousarray((s32 if m is None else s32 * m[:, None]).T)
+        res = _try_pool(b, np.zeros(n, np.int64), s3, 1, nb)
+        if res is not None:
+            return res.reshape(b.shape[1] * nb, 3)
+    s = np.asarray(stats, np.float64)
+    base: "np.ndarray | int" = 0
+    if m is not None:
+        m64 = m.astype(np.float64)
+        if n_kept < (n >> 1):
+            keep = np.flatnonzero(m64)
+            b, s = b[keep], s[keep] * m64[keep, None]
+        else:
+            s = s * m64[:, None]
+    out = np.empty((1, b.shape[1], nb, 3), np.float32)
+    _host_bincounts(out, b, base, s, 1, nb, in_range)
+    return out.reshape(b.shape[1] * nb, 3)
+
+
+def _host_multi_kernel(
+    num_slots: int, num_bins: int, in_range: bool, bins, stats, slot
+) -> np.ndarray:
+    """Multi-leaf planes: (n,) slot selects the plane; out-of-range slots
+    drop, so the sibling-subtraction caller's cost is proportional to the
+    rows it actually histograms, not the dataset. Large builds go to the
+    worker pool (dropped rows ride along as trash offsets — cheaper than
+    a main-thread compaction gather)."""
+    ns, nb = num_slots, num_bins
+    b = np.asarray(bins)
+    sl = np.asarray(slot).astype(np.int64)
+    ok = (sl >= 0) & (sl < ns)
+    all_ok = bool(ok.all())
+    kept = b.shape[0] if all_ok else int(ok.sum())
+    # pool only when the SELECTED work is large: the pool scans dropped
+    # rows too (trash offsets), so a small child inside a big dataset is
+    # cheaper through the compacting serial path
+    if (
+        in_range
+        and b.dtype in (np.int32, np.uint8)
+        and _pool_worthwhile(kept, b.shape[1])
+    ):
+        base = sl * nb if all_ok else np.where(ok, sl * nb, ns * nb)
+        res = _try_pool(
+            b, base, np.ascontiguousarray(np.asarray(stats, np.float32).T),
+            ns, nb,
+        )
+        if res is not None:
+            return res
+    s = np.asarray(stats, np.float64)
+    if not all_ok:
+        keep = np.flatnonzero(ok)
+        if keep.size < (b.shape[0] >> 1):
+            b, s, sl = b[keep], s[keep], sl[keep]
+            base = sl * nb
+        else:
+            base = np.where(ok, sl * nb, ns * nb)
+    else:
+        base = sl * nb
+    out = np.empty((ns, b.shape[1], nb, 3), np.float32)
+    _host_bincounts(out, b, base, s, ns, nb, in_range)
+    return out.reshape(ns, b.shape[1] * nb, 3)
+
+
+def _callback(kernel, out_shape, *args) -> jnp.ndarray:
+    """pure_callback with version-portable vmap handling."""
+    try:
+        return jax.pure_callback(
+            kernel, out_shape, *args, vmap_method="sequential"
+        )
+    except TypeError:  # older jax: no vmap_method kwarg
+        return jax.pure_callback(kernel, out_shape, *args, vectorized=False)
+
+
+def _plane_histogram_host(
+    bins: jnp.ndarray,
+    stats: jnp.ndarray,
+    mask: "jnp.ndarray | None",
+    num_bins: int = NUM_BINS,
+    assume_in_range: bool = False,
+) -> jnp.ndarray:
+    d = bins.shape[1]
+    out = jax.ShapeDtypeStruct((d * num_bins, 3), jnp.float32)
+    kern = functools.partial(_host_plane_kernel, num_bins, assume_in_range)
+    if mask is None:
+        return _callback(kern, out, bins, stats)
+    return _callback(kern, out, bins, stats, mask)
+
+
+def _multi_plane_host(
+    bins: jnp.ndarray,
+    stats: jnp.ndarray,
+    slot: jnp.ndarray,
+    num_slots: int,
+    num_bins: int = NUM_BINS,
+    assume_in_range: bool = False,
+) -> jnp.ndarray:
+    d = bins.shape[1]
+    out = jax.ShapeDtypeStruct((num_slots, d * num_bins, 3), jnp.float32)
+    kern = functools.partial(
+        _host_multi_kernel, num_slots, num_bins, assume_in_range
+    )
+    return _callback(kern, out, bins, stats, slot)
 
 
 def _hist_kernel(bins_ref, stats_ref, out_ref, *, num_bins: int):
@@ -413,6 +649,7 @@ def multi_plane_histogram(
     num_bins: int = NUM_BINS,
     mesh=None,
     shard_axis: str | None = None,
+    bins_in_range: bool = False,
 ) -> jnp.ndarray:
     """Histogram planes for MANY leaves in one pass over the rows.
 
@@ -429,14 +666,23 @@ def multi_plane_histogram(
     backend: slower, but it compiles instead of tripping Mosaic's
     scoped-VMEM ceiling."""
     df_fit = _multi_df(num_slots, num_bins, bins.shape[1])
-    if df_fit is not None and _rows_sharded(mesh, shard_axis) and _pallas_enabled():
+    use_pl = df_fit is not None and _pallas_enabled()
+    if _rows_sharded(mesh, shard_axis):
         from jax.sharding import PartitionSpec as P
 
         def local(b, s, sl):
-            cube = _multi_plane_pallas(
-                b.astype(jnp.int32), s, sl.astype(jnp.int32), num_slots,
-                num_bins, df=df_fit,
-            )
+            if use_pl:
+                cube = _multi_plane_pallas(
+                    b.astype(jnp.int32), s, sl.astype(jnp.int32), num_slots,
+                    num_bins, df=df_fit,
+                )
+            else:
+                # per-shard scatter partials + the same explicit allreduce
+                # (LightGBM data_parallel with the MXU kernel swapped out)
+                cube = _multi_plane_scatter(
+                    b.astype(jnp.int32), s, sl.astype(jnp.int32), num_slots,
+                    num_bins,
+                )
             return jax.lax.psum(cube, shard_axis)
 
         return shard_map(
@@ -451,12 +697,38 @@ def multi_plane_histogram(
             bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots,
             num_bins, df=df_fit,
         )
+    if use_host_hist():
+        return _multi_plane_host(
+            bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots,
+            num_bins, assume_in_range=bins_in_range,
+        )
     # scatter path; under a sharded trace GSPMD partitions the scatter
     # and inserts the allreduce automatically
     return _multi_plane_scatter(
         bins.astype(jnp.int32), stats, slot.astype(jnp.int32), num_slots,
         num_bins,
     )
+
+
+def leaf_stat_sums(
+    leaf: jnp.ndarray, stats: jnp.ndarray, num_leaves: int,
+    sharded: bool = False,
+) -> jnp.ndarray:
+    """Per-leaf (g, h, count) totals: (n,) leaf ids + (n, 3) stats ->
+    (num_leaves, 3). The growers' end-of-tree reduction — a (n,)
+    scatter-add on the XLA path, one bincount pass on the host path (the
+    scatters cost ~3 ms/tree at bench shapes on XLA:CPU, ~25x the host
+    kernel). ``sharded``: the caller's rows are sharded over a mesh —
+    keep the scatter (GSPMD partitions it; a host callback would force a
+    gather)."""
+    if not sharded and use_host_hist():
+        # leaf ids are grower outputs, always in [0, num_leaves)
+        return _plane_histogram_host(
+            leaf[:, None].astype(jnp.int32), stats, None, num_leaves,
+            assume_in_range=True,
+        )
+    z = jnp.zeros((num_leaves, 3), jnp.float32)
+    return z.at[leaf].add(stats)
 
 
 def _plane_histogram_scatter(
@@ -478,13 +750,21 @@ def _plane_histogram_shard_map(
     bins: jnp.ndarray, stats: jnp.ndarray, mesh, shard_axis: str,
     num_bins: int,
 ) -> jnp.ndarray:
-    """Per-shard Pallas kernel + explicit psum of the planes — LightGBM
+    """Per-shard kernel + explicit psum of the planes — LightGBM
     data_parallel's per-iteration histogram allreduce over ICI
-    (TrainUtils.scala:496-512), MXU kernel intact on every chip."""
+    (TrainUtils.scala:496-512). On TPU the local kernel is the Pallas MXU
+    one-hot; with Pallas off (CPU meshes, forced-device scaling runs) the
+    local kernel is the XLA scatter — either way the allreduce is an
+    explicit ``psum`` in the program, not a GSPMD inference."""
     from jax.sharding import PartitionSpec as P
 
+    use_pl = _pallas_enabled()
+
     def local(b: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
-        h = _plane_histogram_pallas(b.astype(jnp.int32), s, num_bins)
+        if use_pl:
+            h = _plane_histogram_pallas(b.astype(jnp.int32), s, num_bins)
+        else:
+            h = _plane_histogram_scatter(b.astype(jnp.int32), s, num_bins)
         return jax.lax.psum(h, shard_axis)
 
     return shard_map(
@@ -496,24 +776,78 @@ def _plane_histogram_shard_map(
     )(bins, stats)
 
 
+# wall time of one EAGER sharded histogram build including the explicit
+# psum allreduce — the bench's hist scaling rows observe this so the
+# ICI-allreduce claim is a recorded number (in-jit builds fuse into the
+# surrounding program and cannot be timed individually)
+_M_ALLREDUCE_SECONDS = None
+_SHARDED_BUILD_CACHE: dict = {}
+
+
+def sharded_build_timed(
+    bins: jnp.ndarray, stats: jnp.ndarray, mesh, shard_axis: str,
+    num_bins: int = NUM_BINS,
+) -> jnp.ndarray:
+    """Eagerly run one per-shard histogram + explicit psum and record the
+    wall time into ``mmlspark_gbdt_hist_allreduce_seconds``."""
+    global _M_ALLREDUCE_SECONDS
+    if _M_ALLREDUCE_SECONDS is None:
+        from mmlspark_tpu import obs
+
+        _M_ALLREDUCE_SECONDS = obs.histogram(
+            "mmlspark_gbdt_hist_allreduce_seconds",
+            "Wall time of one sharded histogram build including the "
+            "explicit psum allreduce (observed by eager/bench builds)",
+        )
+    import time as _t
+
+    key = (mesh, shard_axis, num_bins)
+    fn = _SHARDED_BUILD_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(
+            functools.partial(
+                _plane_histogram_shard_map, mesh=mesh,
+                shard_axis=shard_axis, num_bins=num_bins,
+            )
+        )
+        _SHARDED_BUILD_CACHE[key] = fn
+    t0 = _t.perf_counter()
+    out = fn(bins, stats)
+    jax.block_until_ready(out)
+    _M_ALLREDUCE_SECONDS.observe(_t.perf_counter() - t0)
+    return out
+
+
 def plane_histogram(
     bins: jnp.ndarray, stats: jnp.ndarray, mask: jnp.ndarray | None = None,
     num_bins: int = NUM_BINS, mesh=None, shard_axis: str | None = None,
+    allow_host: bool = True, bins_in_range: bool = False,
 ) -> jnp.ndarray:
     """(d * NUM_BINS, 3) gradient-histogram plane of the masked rows.
 
     ``bins``: (n, d) int bin codes; ``stats``: (n, 3) per-row (g, h, count);
     ``mask``: optional (n,) row selector (0 rows contribute nothing).
     ``mesh``/``shard_axis``: when the rows are sharded over that mesh axis,
-    run the Pallas kernel per shard under shard_map and psum the planes
-    (falls back to the GSPMD-partitioned scatter when Pallas is off).
+    run the local kernel (Pallas on TPU, scatter otherwise) per shard
+    under shard_map and psum the planes.
     """
-    if mask is not None:
-        stats = stats * mask[:, None]
-    if _rows_sharded(mesh, shard_axis) and _pallas_enabled():
+    if _rows_sharded(mesh, shard_axis):
+        if mask is not None:
+            stats = stats * mask[:, None]
         return _plane_histogram_shard_map(
             bins, stats, mesh, shard_axis, num_bins
         )
     if use_pallas():
+        if mask is not None:
+            stats = stats * mask[:, None]
         return _plane_histogram_pallas(bins.astype(jnp.int32), stats, num_bins)
+    if allow_host and use_host_hist():
+        # the host kernel takes the RAW mask: sparse selections compact
+        # to the selected rows instead of scanning zeroed stats
+        return _plane_histogram_host(
+            bins.astype(jnp.int32), stats, mask, num_bins,
+            assume_in_range=bins_in_range,
+        )
+    if mask is not None:
+        stats = stats * mask[:, None]
     return _plane_histogram_scatter(bins.astype(jnp.int32), stats, num_bins)
