@@ -44,6 +44,17 @@ point                     fires inside
 ``supervisor.restart``    serving/supervisor.py before a worker respawn —
                           an error is "the scheduler refused", retried next
                           tick; delay simulates slow node allocation
+``online.ingest``         online/feedback.py per accepted micro-batch — an
+                          error refuses the chunk (HTTP ingest answers 503,
+                          nothing buffered), delay stalls intake
+``online.publish``        online/publisher.py before the snapshot is written
+                          — an error aborts the whole publication (alias
+                          untouched: the rollback path), delay stalls only
+                          the control path while serving continues
+``autoscaler.scale``      serving/supervisor.py as an autoscale decision is
+                          about to be applied — an error suppresses that
+                          scale event ("the scheduler refused", retried
+                          next tick), delay stalls it
 ========================  ====================================================
 
 Schedules are **seeded and step-indexed**: a rule fires by absolute step

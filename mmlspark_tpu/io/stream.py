@@ -166,14 +166,30 @@ class StreamingDataFrame:
         return None
 
     def materialize(self, max_rows: Optional[int] = None) -> DataFrame:
-        """Concatenate chunks into an eager DataFrame; stops reading the
-        source as soon as ``max_rows`` is reached."""
+        """Concatenate chunks into an eager DataFrame; stops PULLING the
+        source as soon as ``max_rows`` rows are buffered — on an
+        unbounded source (an infinite feedback generator, a live ingest
+        stream) the iterator is never drained past the cap. The chunk
+        that crosses the cap is truncated to exactly ``max_rows`` rows.
+        ``max_rows <= 0`` returns an empty frame without touching the
+        source at all (no chunk is ever pulled just to be discarded).
+
+        The online suite (tests/test_online.py) pins this contract:
+        FeedbackStream's pull sources are unbounded by design, and a
+        ``materialize`` that drained them would hang forever."""
+        if max_rows is not None and max_rows <= 0:
+            return DataFrame.from_dict({})
         chunks: list = []
         rows = 0
-        for chunk in self._source():
+        src = self._source()
+        for chunk in src:
             chunks.append(chunk)
             rows += len(chunk)
             if max_rows is not None and rows >= max_rows:
+                # release the generator's resources eagerly (an open CSV
+                # file handle, a live socket) instead of waiting for GC
+                if hasattr(src, "close"):
+                    src.close()
                 break
         if not chunks:
             return DataFrame.from_dict({})

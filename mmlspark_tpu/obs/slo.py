@@ -199,6 +199,29 @@ def default_targets(
     )]
 
 
+def freshness_target(
+    name: str = "online-freshness",
+    budget_ms: float = 5000.0,
+    availability: float = 0.99,
+) -> SLOTarget:
+    """The continuous-learning freshness objective as a first-class SLO
+    target: a publication is *bad* when it failed outright OR its
+    example-ingested -> model-servable time exceeded ``budget_ms`` (read
+    from the ``mmlspark_online_freshness_seconds`` buckets, so no extra
+    instrumentation rides the training loop). Burn rates, windows and
+    red/yellow thresholds are the standard engine semantics — a
+    feedback stream outrunning the publish path pages exactly like a
+    latency SLO would (docs/online-learning.md)."""
+    return SLOTarget(
+        name=name,
+        availability=availability,
+        p99_ms=budget_ms,
+        total_metric="mmlspark_online_publish_attempts_total",
+        error_metric="mmlspark_online_publish_failures_total",
+        latency_metric="mmlspark_online_freshness_seconds",
+    )
+
+
 def _buckets_of(parsed: dict, name: str, match: dict) -> dict:
     """{le_bound: cumulative_count} summed across matching series."""
     want = set(match.items())
@@ -418,6 +441,6 @@ def status_from_scrape(parsed: dict) -> Optional[int]:
 
 __all__ = [
     "GREEN", "RED", "RED_BURN", "SLOEngine", "SLOTarget", "STATUS_NAMES",
-    "WINDOWS", "YELLOW", "YELLOW_BURN", "default_targets", "load_targets",
-    "status_from_scrape",
+    "WINDOWS", "YELLOW", "YELLOW_BURN", "default_targets",
+    "freshness_target", "load_targets", "status_from_scrape",
 ]

@@ -1,0 +1,37 @@
+"""Continuous learning: streaming feedback -> online training -> zero-drop
+publication.
+
+The subsystem that closes the train/serve loop (ROADMAP item 5, the
+one-system argument of the TensorFlow paper, arXiv 1605.08695): a
+:class:`FeedbackStream` source feeds labeled micro-batches into an
+:class:`OnlineTrainer` that incrementally updates the device-resident VW
+learner (``vw/learner.py`` stateful SGD — weights AND AdaGrad state stay
+on device between micro-batches), and a :class:`Publisher` snapshots the
+weights into a versioned ``vw:`` ModelStore spec and drives the existing
+load -> warm -> swap path, so a fresh version becomes servable with zero
+dropped requests. :class:`OnlineLearningLoop` is the control loop tying
+the three together, exporting the **freshness SLO** — the time from an
+example entering the system to its model being servable — as burn rates
+through ``obs/slo.py``. :class:`Autoscaler` is the SLO-driven scaling
+policy the fleet supervisor consults in ``supervise --autoscale`` mode.
+
+See docs/online-learning.md for the architecture walkthrough, freshness
+semantics, the autoscaler policy, and the fault-point/metric tables.
+"""
+
+from mmlspark_tpu.online.autoscaler import Autoscaler, FleetSignals, ScaleSignals
+from mmlspark_tpu.online.feedback import FeedbackStream
+from mmlspark_tpu.online.loop import OnlineLearningLoop
+from mmlspark_tpu.online.publisher import PublishError, Publisher
+from mmlspark_tpu.online.trainer import OnlineTrainer
+
+__all__ = [
+    "Autoscaler",
+    "FeedbackStream",
+    "FleetSignals",
+    "OnlineLearningLoop",
+    "OnlineTrainer",
+    "PublishError",
+    "Publisher",
+    "ScaleSignals",
+]

@@ -1,0 +1,258 @@
+"""SLO-driven autoscaling policy for the fleet supervisor.
+
+The supervisor (serving/supervisor.py) keeps N worker processes alive;
+``supervise --autoscale`` lets it also decide what N should BE, from the
+signals the earlier layers already export:
+
+- **admission sheds** (PR 5): workers answering 429 mean the AIMD limit
+  is full — spawn a replica *before* the breaker trips, while the fleet
+  is still shedding rather than failing;
+- **in-flight utilization**: summed ``inflight/limit`` across workers
+  approaching 1.0 is the same overload, seen earlier;
+- **SLO burn** (PR 4): a red burn-rate status is the page-now signal —
+  scale out even if sheds haven't started;
+- **sustained idle**: no accepted traffic, nothing in flight and no
+  sheds for ``idle_after_s`` — reap one replica (never below
+  ``min_replicas``).
+
+Hysteresis, so the fleet never flaps: scale-out is rate-limited by
+``scale_out_cooldown_s``, scale-in by ``scale_in_cooldown_s`` AND the
+idle clock (which resets on any activity and on every scale event — a
+fresh replica gets a full idle window before it can be judged useless).
+One step per decision, clamped to ``[min_replicas, max_replicas]``.
+
+:class:`FleetSignals` turns live ``/metrics`` scrapes (gateway +
+rostered workers) into one :class:`ScaleSignals` sample per tick, with
+counter deltas computed against the previous scrape. Tests inject
+scripted signals instead — the policy is pure.
+
+Fault point ``autoscaler.scale`` fires as the supervisor is about to
+act on a decision: an injected error suppresses that scale event
+(retried next tick — "the scheduler refused"), ``delay_s`` stalls it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from mmlspark_tpu import obs
+
+_M_REPLICAS = obs.gauge(
+    "mmlspark_autoscaler_replicas_count",
+    "Worker replicas the autoscaling supervisor currently maintains",
+)
+_M_EVENTS = obs.counter(
+    "mmlspark_autoscaler_scale_events_total",
+    "Autoscaler actions taken", labels=("direction",),
+)
+_M_DESIRED = obs.gauge(
+    "mmlspark_autoscaler_desired_replicas_count",
+    "Replica count the last policy decision asked for",
+)
+
+
+@dataclass
+class ScaleSignals:
+    """One tick's worth of fleet-health evidence."""
+
+    shed_delta: float = 0.0        # admission/backpressure 429s since last tick
+    inflight: float = 0.0          # summed in-flight requests across workers
+    limit: float = 0.0             # summed AIMD limits across workers
+    accepted_delta: float = 0.0    # requests accepted since last tick
+    slo_status: Optional[int] = None  # obs.slo GREEN/YELLOW/RED (None=unknown)
+    breakers_open: int = 0         # open breakers at the gateway
+
+    @property
+    def utilization(self) -> float:
+        return (self.inflight / self.limit) if self.limit > 0 else 0.0
+
+    @property
+    def busy(self) -> bool:
+        return (
+            self.accepted_delta > 0 or self.inflight > 0
+            or self.shed_delta > 0
+        )
+
+
+class Autoscaler:
+    """The pure scaling policy: ``decide(current, signals) -> (desired,
+    reason)``. Stateful only for hysteresis clocks."""
+
+    def __init__(
+        self,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        util_threshold: float = 0.85,
+        scale_out_cooldown_s: float = 10.0,
+        scale_in_cooldown_s: float = 30.0,
+        idle_after_s: float = 30.0,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        if min_replicas < 0 or max_replicas < max(1, min_replicas):
+            raise ValueError(
+                f"need 0 <= min_replicas <= max_replicas, got "
+                f"{min_replicas}/{max_replicas}"
+            )
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.util_threshold = util_threshold
+        self.scale_out_cooldown_s = scale_out_cooldown_s
+        self.scale_in_cooldown_s = scale_in_cooldown_s
+        self.idle_after_s = idle_after_s
+        self._now = time_fn
+        now = self._now()
+        self._last_out = now - scale_out_cooldown_s  # first overload may act
+        self._last_in = now
+        self._idle_since = now
+        self.events: list = []  # (direction, reason) history
+
+    def _overloaded(self, s: ScaleSignals) -> Optional[str]:
+        from mmlspark_tpu.obs import slo
+
+        if s.shed_delta > 0:
+            return f"admission shed x{s.shed_delta:.0f}"
+        if s.limit > 0 and s.utilization >= self.util_threshold:
+            return f"utilization {s.utilization:.2f}"
+        if s.slo_status is not None and s.slo_status >= slo.RED:
+            return "slo red"
+        return None
+
+    def decide(self, current: int, s: ScaleSignals) -> tuple:
+        """Returns ``(desired_replicas, reason)``; ``reason`` is ''
+        when desired == current. At most one step per call."""
+        now = self._now()
+        if s.busy:
+            self._idle_since = now
+        if current < self.min_replicas:
+            return self.min_replicas, "below min_replicas"
+        if current > self.max_replicas:
+            return self.max_replicas, "above max_replicas"
+        why = self._overloaded(s)
+        if (
+            why is not None
+            and current < self.max_replicas
+            and now - self._last_out >= self.scale_out_cooldown_s
+        ):
+            self._last_out = now
+            self._idle_since = now  # a fresh replica gets a full idle window
+            self.events.append(("out", why))
+            _M_DESIRED.set(current + 1)
+            return current + 1, why
+        if (
+            why is None
+            and not s.busy
+            and current > self.min_replicas
+            and now - self._idle_since >= self.idle_after_s
+            and now - self._last_in >= self.scale_in_cooldown_s
+        ):
+            self._last_in = now
+            self._idle_since = now  # one reap per idle window
+            self.events.append(("in", "sustained idle"))
+            _M_DESIRED.set(current - 1)
+            return current - 1, "sustained idle"
+        _M_DESIRED.set(current)
+        return current, ""
+
+    @staticmethod
+    def note_applied(direction: str) -> None:
+        """The supervisor actually acted on a decision (post fault-point)."""
+        _M_EVENTS.labels(direction=direction).inc()
+
+    @staticmethod
+    def export_replicas(n: int) -> None:
+        _M_REPLICAS.set(n)
+
+
+class FleetSignals:
+    """Live signal source: scrape the gateway's and the rostered
+    workers' ``/metrics`` into one :class:`ScaleSignals` per call, with
+    counter deltas against the previous call. Every scrape failure
+    degrades to zeros — a blind autoscaler must hold, not flap."""
+
+    def __init__(
+        self,
+        registry_url: Optional[str] = None,
+        gateway_url: Optional[str] = None,
+        service_name: str = "serving",
+    ):
+        self.registry_url = registry_url
+        self.gateway_url = gateway_url
+        self.service_name = service_name
+        self._prev_shed = None
+        self._prev_accepted = None
+
+    def __call__(self) -> ScaleSignals:
+        from mmlspark_tpu.obs import slo as slo_mod
+        from mmlspark_tpu.serving.fleet import (
+            scrape_metrics,
+            worker_urls_from_registry,
+        )
+
+        shed = accepted = inflight = limit = 0.0
+        slo_status = None
+        breakers_open = 0
+        worker_urls: list = []
+        if self.registry_url:
+            try:
+                worker_urls = worker_urls_from_registry(
+                    self.registry_url, self.service_name
+                )
+            except Exception:  # noqa: BLE001 — registry down: gateway-only view
+                pass
+        for u in worker_urls:
+            parsed = scrape_metrics(u)
+            if parsed is None:
+                continue
+            m = {"server": self.service_name}
+            shed += obs.sum_samples(parsed, "mmlspark_admission_shed_total", m)
+            accepted += obs.sum_samples(
+                parsed, "mmlspark_serving_requests_total", m
+            )
+            inflight += obs.sum_samples(
+                parsed, "mmlspark_admission_inflight_requests", m
+            )
+            limit += obs.sum_samples(
+                parsed, "mmlspark_admission_limit_requests", m
+            )
+            status = slo_mod.status_from_scrape(parsed)
+            if status is not None:
+                slo_status = max(slo_status or 0, status)
+        if self.gateway_url:
+            parsed = scrape_metrics(self.gateway_url)
+            if parsed is not None:
+                # the gateway's view of worker sheds (429 relays) covers
+                # workers the roster scrape missed
+                shed += obs.sum_samples(
+                    parsed, "mmlspark_gateway_backend_backpressure_total"
+                )
+                accepted += obs.sum_samples(
+                    parsed, "mmlspark_serving_requests_total",
+                    {"server": f"{self.service_name}-gateway"},
+                )
+                status = slo_mod.status_from_scrape(parsed)
+                if status is not None:
+                    slo_status = max(slo_status or 0, status)
+                for (name, _labels), v in parsed.items():
+                    if name == "mmlspark_gateway_breaker_state" and v == 1.0:
+                        breakers_open += 1
+        shed_delta = 0.0 if self._prev_shed is None else max(
+            0.0, shed - self._prev_shed
+        )
+        accepted_delta = 0.0 if self._prev_accepted is None else max(
+            0.0, accepted - self._prev_accepted
+        )
+        self._prev_shed = shed
+        self._prev_accepted = accepted
+        return ScaleSignals(
+            shed_delta=shed_delta,
+            inflight=inflight,
+            limit=limit,
+            accepted_delta=accepted_delta,
+            slo_status=slo_status,
+            breakers_open=breakers_open,
+        )
+
+
+__all__ = ["Autoscaler", "FleetSignals", "ScaleSignals"]

@@ -1,0 +1,290 @@
+"""FeedbackStream: the labeled-example source of the continuous-training
+loop.
+
+Two intake modes behind one ``poll()`` surface:
+
+- **push** — ``push(chunk)`` from any producer thread; the HTTP ingest
+  endpoint (``serve()``: ``POST /ingest`` on a WorkerServer, so ``GET
+  /metrics`` comes for free) is a push producer. The buffer is bounded:
+  past ``max_chunks`` the OLDEST chunk is dropped and counted — under
+  sustained overload a freshness-driven trainer wants the newest
+  feedback, not a queue of stale examples.
+- **pull** — ``from_generator`` / ``from_streaming_dataframe`` /
+  ``from_csv`` wrap a re-iterable chunk source; ``poll()`` draws the
+  next chunk on demand. This is the test/backfill shape, and keeps the
+  source :class:`~mmlspark_tpu.io.stream.StreamingDataFrame`-compatible
+  (``materialize(max_rows=...)`` on an unbounded feedback source stops
+  at the cap — the io/stream contract the online tests pin).
+
+Every chunk carries its **ingest timestamp** (``time_fn`` at push/pull),
+the left edge of the freshness SLO: example ingested -> model servable.
+
+Fault point ``online.ingest`` fires per accepted chunk: an injected
+error refuses the chunk (the HTTP endpoint answers 503 and buffers
+nothing — chaos for the producer's retry handling), ``delay_s`` stalls
+intake.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterator, Optional
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.dataframe import DataFrame
+
+_M_INGESTED = obs.counter(
+    "mmlspark_online_ingested_total",
+    "Feedback examples accepted into the stream buffer",
+)
+_M_CHUNKS = obs.counter(
+    "mmlspark_online_ingest_chunks_total",
+    "Feedback micro-batches accepted into the stream buffer",
+)
+_M_DROPPED = obs.counter(
+    "mmlspark_online_dropped_chunks_total",
+    "Oldest chunks dropped by the bounded buffer under overload",
+)
+_M_DEPTH = obs.gauge(
+    "mmlspark_online_buffer_depth_count",
+    "Feedback micro-batches buffered awaiting training",
+)
+_M_REFUSED = obs.counter(
+    "mmlspark_online_ingest_refused_total",
+    "Ingest requests refused (injected fault or malformed rows)",
+)
+
+_JSON = {"Content-Type": "application/json"}
+
+
+class FeedbackStream:
+    """Bounded, timestamped micro-batch buffer with optional pull source.
+
+    ``max_chunks`` bounds memory; overflow drops the OLDEST buffered
+    chunk (counted in ``mmlspark_online_dropped_chunks_total``).
+    ``time_fn`` stamps ingest times (monotonic by default — freshness is
+    an interval, not a wall-clock date)."""
+
+    def __init__(
+        self,
+        source: Optional[Callable[[], Iterator[DataFrame]]] = None,
+        max_chunks: int = 1024,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        self._buf: deque = deque()  # (ingest_ts, DataFrame)
+        self._cond = threading.Condition()
+        self._max_chunks = max(1, int(max_chunks))
+        self._now = time_fn
+        self._source = source
+        self._iter: Optional[Iterator[DataFrame]] = None
+        self._exhausted = False
+        self._closed = False
+        self.ingested = 0   # examples accepted
+        self.dropped = 0    # chunks dropped by the bound
+        self._ingress: Any = None
+        self._router: Optional[threading.Thread] = None
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def from_generator(
+        make_chunk: Callable[[int], Optional[DataFrame]],
+        num_chunks: Optional[int] = None,
+        **kw: Any,
+    ) -> "FeedbackStream":
+        """``make_chunk(i)`` -> DataFrame or None (None = end of stream);
+        ``num_chunks=None`` = unbounded (the live-feedback shape)."""
+
+        def source() -> Iterator[DataFrame]:
+            i = 0
+            while num_chunks is None or i < num_chunks:
+                chunk = make_chunk(i)
+                if chunk is None:
+                    return
+                yield chunk
+                i += 1
+
+        return FeedbackStream(source=source, **kw)
+
+    @staticmethod
+    def from_streaming_dataframe(sdf: Any, **kw: Any) -> "FeedbackStream":
+        """Wrap a :class:`StreamingDataFrame` (file/CSV-backed feedback
+        logs replay through the same loop as live traffic)."""
+        return FeedbackStream(source=sdf.iter_chunks, **kw)
+
+    @staticmethod
+    def from_csv(path: str, chunk_rows: int = 4096, **kw: Any) -> "FeedbackStream":
+        from mmlspark_tpu.io.stream import StreamingDataFrame
+
+        return FeedbackStream.from_streaming_dataframe(
+            StreamingDataFrame.from_csv(path, chunk_rows=chunk_rows), **kw
+        )
+
+    # -- push intake ---------------------------------------------------------
+
+    def push(self, chunk: DataFrame, ts: Optional[float] = None) -> int:
+        """Buffer one micro-batch; returns rows accepted. Raises when the
+        ``online.ingest`` fault point injects an error (the chunk is NOT
+        buffered) or the stream is closed."""
+        if self._closed:
+            raise RuntimeError("feedback stream is closed")
+        # fault point online.ingest: an injected error refuses this chunk
+        # (producer-visible), delay_s stalls intake
+        faults.inject("online.ingest", context={"rows": len(chunk)})
+        ts = self._now() if ts is None else ts
+        with self._cond:
+            self._buf.append((ts, chunk))
+            if len(self._buf) > self._max_chunks:
+                self._buf.popleft()  # freshest-wins: shed the oldest
+                self.dropped += 1
+                _M_DROPPED.inc()
+            self.ingested += len(chunk)
+            _M_DEPTH.set(len(self._buf))
+            self._cond.notify()
+        _M_INGESTED.inc(len(chunk))
+        _M_CHUNKS.inc()
+        return len(chunk)
+
+    # -- consumption ---------------------------------------------------------
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._buf)
+
+    def poll(self, timeout_s: float = 0.25) -> Optional[tuple]:
+        """Next ``(ingest_ts, DataFrame)`` micro-batch, or None.
+
+        Buffered (pushed) chunks win; otherwise a pull source is drawn
+        from (stamped at draw time — that IS its ingest into the
+        system); otherwise block up to ``timeout_s`` for a push."""
+        with self._cond:
+            if self._buf:
+                item = self._buf.popleft()
+                _M_DEPTH.set(len(self._buf))
+                return item
+        if self._source is not None and not self._exhausted:
+            if self._iter is None:
+                self._iter = self._source()
+            # fault point BEFORE the draw: an injected refusal leaves the
+            # chunk in the iterator (retried next poll), matching the
+            # push path where the producer keeps the refused chunk —
+            # firing after next() would silently lose examples
+            faults.inject("online.ingest", context={"mode": "pull"})
+            try:
+                chunk = next(self._iter)
+            except StopIteration:
+                self._exhausted = True
+                return None
+            self.ingested += len(chunk)
+            _M_INGESTED.inc(len(chunk))
+            _M_CHUNKS.inc()
+            return (self._now(), chunk)
+        with self._cond:
+            if not self._buf and timeout_s > 0:
+                self._cond.wait(timeout_s)
+            if self._buf:
+                item = self._buf.popleft()
+                _M_DEPTH.set(len(self._buf))
+                return item
+        return None
+
+    @property
+    def exhausted(self) -> bool:
+        """A pull source ran dry (push streams never exhaust)."""
+        return self._exhausted and self.depth() == 0
+
+    # -- HTTP ingest endpoint ------------------------------------------------
+
+    def serve(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        name: str = "serving-online",
+    ) -> Any:
+        """Start the HTTP ingest ingress: ``POST /ingest`` with
+        ``{"rows": [{...}, ...]}`` (or one bare row object) buffers a
+        micro-batch; ``GET /health`` answers liveness; ``GET /metrics``
+        is served inline by the WorkerServer machinery. Returns the
+        :class:`ServiceInfo` (registered under ``name`` by the fleet
+        wiring so ``fleet top`` and the deploy smoke can find the loop).
+        """
+        from mmlspark_tpu.serving.server import WorkerServer
+
+        srv = WorkerServer(host=host, port=port, name=name)
+        info = srv.start()
+        self._ingress = srv
+        self._router = threading.Thread(
+            target=self._ingest_loop, name="online-ingest", daemon=True
+        )
+        self._router.start()
+        return info
+
+    def _ingest_loop(self) -> None:
+        srv = self._ingress
+        while not self._closed:
+            reqs = srv.get_next_batch(max_n=64, timeout_s=0.25)
+            for r in reqs:
+                try:
+                    self._ingest_one(r)
+                except Exception as e:  # noqa: BLE001 — ingress must survive
+                    _M_REFUSED.inc()
+                    srv.reply_to(
+                        r.id,
+                        json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}
+                        ).encode(),
+                        503, _JSON,
+                    )
+            if reqs:
+                srv.auto_commit()
+        for r in srv.get_next_batch(max_n=1_000_000, timeout_s=0.0):
+            srv.reply_to(r.id, b"ingest stopping", 503)
+
+    def _ingest_one(self, r: Any) -> None:
+        path = r.path.split("?", 1)[0]
+        if path in ("/health", "/healthz") and r.method == "GET":
+            self._ingress.reply_to(
+                r.id,
+                json.dumps(
+                    {"status": "ok", "buffered_chunks": self.depth()}
+                ).encode(),
+                200, _JSON,
+            )
+            return
+        if path != "/ingest" or r.method != "POST":
+            self._ingress.reply_to(
+                r.id, b'{"error": "POST /ingest"}', 404, _JSON
+            )
+            return
+        body = json.loads(r.body) if r.body else {}
+        rows = body["rows"] if isinstance(body, dict) and "rows" in body \
+            else [body]
+        if (
+            not isinstance(rows, list) or not rows
+            or not all(isinstance(x, dict) for x in rows)
+        ):
+            raise ValueError("rows must be a non-empty list of objects")
+        n = self.push(DataFrame.from_rows(rows))
+        self._ingress.reply_to(
+            r.id,
+            json.dumps(
+                {"accepted": n, "buffered_chunks": self.depth()}
+            ).encode(),
+            200, _JSON,
+        )
+
+    def close(self) -> None:
+        self._closed = True
+        if self._router is not None:
+            self._router.join(5.0)
+        if self._ingress is not None:
+            self._ingress.stop()
+        with self._cond:
+            self._cond.notify_all()
+
+
+__all__ = ["FeedbackStream"]

@@ -1,0 +1,246 @@
+"""Publisher: snapshot online-learner weights into a versioned ``vw:``
+artifact and drive the ModelStore load -> warm -> swap path.
+
+Zero-drop by construction: publication rides the SAME machinery the
+chaos suite already gates — a new version loads and warms in the
+background while the old one keeps serving, the alias flip is atomic,
+and in-flight batches drain on the old weights (serving/modelstore).
+The publisher never touches the dispatch path; a failed publish leaves
+the serving alias exactly where it was (the rollback property pinned in
+tests/test_online.py).
+
+Targets:
+
+- **in-process store** (``store=``) — the loop runs inside a serving
+  worker (tests, bench, single-process deployments);
+- **remote workers** (``worker_urls=`` and/or ``registry_url=``) — each
+  publish re-resolves the roster and drives every worker's model
+  control plane (``POST /models/<m>/load`` with ``activate=never``,
+  then ``POST /models/<m>/swap``), so a worker the supervisor just
+  restarted picks the fresh version up on the next publish.
+
+Fault point ``online.publish`` fires before the snapshot is written: an
+injected error aborts the whole publication (nothing written, nothing
+loaded, alias untouched — retried at the next due time), ``delay_s``
+stalls only the control path while serving continues.
+
+Freshness: ``publish(trainer, oldest_ts)`` returns — and observes into
+``mmlspark_online_freshness_seconds`` — the time from the OLDEST example
+folded in since the last successful publish to the moment the new
+version was servable everywhere it was pushed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core import faults
+
+# freshness is seconds-scale (publish cadence + load/warm/swap), not the
+# request-latency scale of DEFAULT_BUCKETS — widen to 50 ms .. 2 min
+FRESHNESS_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+_M_ATTEMPTS = obs.counter(
+    "mmlspark_online_publish_attempts_total",
+    "Publication attempts (the freshness SLO's total-events metric)",
+)
+_M_PUBLISHES = obs.counter(
+    "mmlspark_online_publishes_total",
+    "Successful online-model publications (servable version flips)",
+)
+_M_FAILURES = obs.counter(
+    "mmlspark_online_publish_failures_total",
+    "Publications that failed (fault, store error, no worker flipped)",
+)
+_M_PUBLISH_S = obs.histogram(
+    "mmlspark_online_publish_seconds",
+    "Wall time of one publication (snapshot + load + warm + swap)",
+)
+_M_FRESHNESS = obs.histogram(
+    "mmlspark_online_freshness_seconds",
+    "Oldest-example-ingested to new-version-servable, per publication",
+    buckets=FRESHNESS_BUCKETS,
+)
+_M_VERSION = obs.gauge(
+    "mmlspark_online_published_version_count",
+    "Monotonic publication sequence number of the serving online model",
+)
+
+
+class PublishError(Exception):
+    """A publication failed end-to-end (the serving alias is unchanged)."""
+
+
+class Publisher:
+    def __init__(
+        self,
+        model: str = "vw-online",
+        snapshot_dir: Optional[str] = None,
+        store: Any = None,
+        worker_urls: Optional[list] = None,
+        registry_url: Optional[str] = None,
+        service_name: str = "serving",
+        keep_snapshots: int = 4,
+        request_timeout_s: float = 60.0,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        if store is None and not worker_urls and not registry_url:
+            raise ValueError(
+                "Publisher needs a target: store=, worker_urls= or "
+                "registry_url="
+            )
+        self.model = model
+        self.snapshot_dir = snapshot_dir or os.path.join(
+            os.getcwd(), ".online_snapshots"
+        )
+        self.store = store
+        self.worker_urls = list(worker_urls or ())
+        self.registry_url = registry_url
+        self.service_name = service_name
+        self.keep_snapshots = max(1, int(keep_snapshots))
+        self.request_timeout_s = request_timeout_s
+        self._now = time_fn
+        self.seq = 0
+        self.publishes = 0
+        self.failures = 0
+        self.last_freshness_s: Optional[float] = None
+        self.freshness_history: list = []  # seconds, per successful publish
+
+    # -- snapshot artifact ---------------------------------------------------
+
+    def _write_snapshot(self, trainer: Any) -> str:
+        os.makedirs(self.snapshot_dir, exist_ok=True)
+        path = os.path.join(
+            self.snapshot_dir, f"{self.model}-v{self.seq:06d}.npz"
+        )
+        tmp = path + ".tmp"
+        meta = trainer.snapshot_meta()
+        # atomic: a concurrently-restarting worker re-loading its --load
+        # spec must never see a torn file
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                weights=trainer.weights_host(),
+                meta=json.dumps(meta).encode(),
+            )
+        os.replace(tmp, path)
+        return path
+
+    def _prune_snapshots(self) -> None:
+        try:
+            snaps = sorted(
+                f for f in os.listdir(self.snapshot_dir)
+                if f.startswith(f"{self.model}-v") and f.endswith(".npz")
+            )
+            for f in snaps[: -self.keep_snapshots]:
+                os.remove(os.path.join(self.snapshot_dir, f))
+        except OSError:
+            pass  # pruning is hygiene, not correctness
+
+    # -- targets -------------------------------------------------------------
+
+    def _publish_store(self, spec: str) -> int:
+        v = self.store.load(self.model, spec, wait=True, activate="never")
+        self.store.swap(self.model, v)
+        return 1
+
+    def _resolve_workers(self) -> list:
+        urls = list(self.worker_urls)
+        if self.registry_url:
+            from mmlspark_tpu.serving.fleet import worker_urls_from_registry
+
+            try:
+                for u in worker_urls_from_registry(
+                    self.registry_url, self.service_name
+                ):
+                    if u not in urls:
+                        urls.append(u)
+            except Exception:  # noqa: BLE001 — explicit urls still publish
+                pass
+        return urls
+
+    def _publish_workers(self, spec: str) -> int:
+        from mmlspark_tpu.io.clients import send_request
+        from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+        flipped = 0
+        for base in self._resolve_workers():
+            base = base.rstrip("/")
+            try:
+                loaded = send_request(HTTPRequestData(
+                    f"{base}/models/{self.model}/load", "POST",
+                    {"Content-Type": "application/json"},
+                    json.dumps({"spec": spec, "activate": "never"}),
+                ), timeout=self.request_timeout_s)
+                if loaded["status_code"] not in (200, 202):
+                    continue
+                swapped = send_request(HTTPRequestData(
+                    f"{base}/models/{self.model}/swap", "POST",
+                    {"Content-Type": "application/json"}, "{}",
+                ), timeout=self.request_timeout_s)
+                if swapped["status_code"] == 200:
+                    flipped += 1
+            except Exception:  # noqa: BLE001 — a dead worker skips, not aborts
+                continue
+        return flipped
+
+    # -- the publication -----------------------------------------------------
+
+    def publish(self, trainer: Any, oldest_ts: Optional[float] = None) -> dict:
+        """Snapshot + load + warm + swap. Returns ``{"version", "path",
+        "targets", "freshness_s"}``; raises :class:`PublishError` (after
+        counting the failure) when no target flipped — the serving alias
+        is unchanged and the caller retries with the same watermark."""
+        t0 = self._now()
+        _M_ATTEMPTS.inc()
+        try:
+            # fault point online.publish: an injected error aborts the
+            # publication before anything is written or loaded
+            faults.inject("online.publish", context={"model": self.model})
+            self.seq += 1
+            path = self._write_snapshot(trainer)
+            spec = f"vw:{path}"
+            targets = 0
+            if self.store is not None:
+                targets += self._publish_store(spec)
+            if self.worker_urls or self.registry_url:
+                targets += self._publish_workers(spec)
+            if targets == 0:
+                raise PublishError(
+                    f"no target made {self.model} v{self.seq} servable"
+                )
+        except Exception as e:
+            self.failures += 1
+            _M_FAILURES.inc()
+            if isinstance(e, PublishError):
+                raise
+            raise PublishError(f"{type(e).__name__}: {e}") from e
+        ready = self._now()
+        _M_PUBLISH_S.observe(ready - t0)
+        freshness = None
+        if oldest_ts is not None:
+            freshness = max(0.0, ready - oldest_ts)
+            self.last_freshness_s = freshness
+            self.freshness_history.append(freshness)
+            _M_FRESHNESS.observe(freshness)
+        self.publishes += 1
+        _M_PUBLISHES.inc()
+        _M_VERSION.set(self.seq)
+        self._prune_snapshots()
+        return {
+            "version": self.seq,
+            "path": path,
+            "targets": targets,
+            "freshness_s": freshness,
+        }
+
+
+__all__ = ["FRESHNESS_BUCKETS", "PublishError", "Publisher"]

@@ -687,7 +687,14 @@ class ServingGateway:
             evict_after=evict_after,
             models=static_models,
         )
-        self._registry_url = registry_url
+        # registry HA (ROADMAP 5c): accept one URL, a comma-separated
+        # list, or a sequence — roster refreshes fail over to the next
+        # live registry, so the control plane survives a registry death
+        # the way the data plane already survives a worker's
+        from mmlspark_tpu.serving.fleet import split_registry_urls
+
+        self._registry_urls = split_registry_urls(registry_url)
+        self._reg_idx = 0  # last-known-good registry, tried first
         self._refresh_s = refresh_s
         self._timeout = request_timeout_s
         self._num_dispatchers = num_dispatchers
@@ -741,7 +748,7 @@ class ServingGateway:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> ServiceInfo:
-        if self._registry_url:
+        if self._registry_urls:
             self._refresh_once()
             t = threading.Thread(
                 target=self._refresh_loop, name="gateway-refresh", daemon=True
@@ -800,13 +807,30 @@ class ServingGateway:
         from mmlspark_tpu.io.clients import send_request
         from mmlspark_tpu.io.http_schema import HTTPRequestData
 
-        try:
-            resp = send_request(
-                HTTPRequestData(self._registry_url, "GET"), timeout=5.0
-            )
-            roster = json.loads(resp["entity"])
-        except Exception as e:  # noqa: BLE001 — discovery must never crash
-            log.warning("gateway: registry refresh failed: %s", e)
+        roster = None
+        n = len(self._registry_urls)
+        # start at the last-known-good registry, fail over to the next
+        # live one (workers heartbeat to ALL registries, so any live
+        # roster is authoritative)
+        for i in range(n):
+            k = (self._reg_idx + i) % n
+            url = self._registry_urls[k]
+            try:
+                resp = send_request(HTTPRequestData(url, "GET"), timeout=5.0)
+                if resp["status_code"] != 200:
+                    raise ConnectionError(f"status {resp['status_code']}")
+                roster = json.loads(resp["entity"])
+                if k != self._reg_idx:
+                    log.warning(
+                        "gateway: registry failed over to %s", url
+                    )
+                    self._reg_idx = k
+                break
+            except Exception as e:  # noqa: BLE001 — discovery must never crash
+                log.warning(
+                    "gateway: registry refresh via %s failed: %s", url, e
+                )
+        if roster is None:
             return
         infos = roster.get(self.service_name, [])
         if infos:

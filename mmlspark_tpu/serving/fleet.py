@@ -62,16 +62,27 @@ def run_registry(
     return reg
 
 
+def split_registry_urls(registry_url: Any) -> list:
+    """Registry HA: one URL, a comma-separated string, or a sequence ->
+    the list of registries a role talks to (workers heartbeat to ALL,
+    the gateway fails roster refreshes over to the next live one)."""
+    if not registry_url:
+        return []
+    if isinstance(registry_url, str):
+        return [u.strip() for u in registry_url.split(",") if u.strip()]
+    return list(registry_url)
+
+
 class _WorkerStopper:
     """Shutdown handle for a fleet worker: stops the heartbeat AND
-    deregisters from the registry, so a clean SIGTERM removes the roster
-    entry immediately instead of leaving it stale until TTL expiry or
-    gateway-failure eviction. Keeps the Event surface (``set``/``is_set``/
-    ``wait``) callers and tests already use."""
+    deregisters from every registry, so a clean SIGTERM removes the
+    roster entries immediately instead of leaving them stale until TTL
+    expiry or gateway-failure eviction. Keeps the Event surface (``set``/
+    ``is_set``/``wait``) callers and tests already use."""
 
     def __init__(self, ev: threading.Event, registry_url: str, info: Any):
         self._ev = ev
-        self._registry_url = registry_url
+        self._registry_urls = split_registry_urls(registry_url)
         self._info = info
         self._beat: Optional[threading.Thread] = None
         self.slo_engine: Any = None
@@ -89,10 +100,14 @@ class _WorkerStopper:
             # resurrect until the next expiry — so outwait even a register
             # POST stuck at its full 10 s send_request timeout
             self._beat.join(12.0)
-        try:
-            DriverRegistry.deregister(self._registry_url, self._info)
-        except Exception as e:  # noqa: BLE001 — registry may already be gone
-            print(f"worker: deregister failed: {e}", file=sys.stderr, flush=True)
+        for url in self._registry_urls:
+            try:
+                DriverRegistry.deregister(url, self._info)
+            except Exception as e:  # noqa: BLE001 — registry may already be gone
+                print(
+                    f"worker: deregister from {url} failed: {e}",
+                    file=sys.stderr, flush=True,
+                )
 
     stop = set
 
@@ -215,23 +230,30 @@ def run_worker(
         slo_interval_s,
     )
 
+    registry_urls = split_registry_urls(registry_url)
+
     def beat() -> None:
         while not stop.is_set():
-            try:
-                # checked INSIDE the try so a shutdown signaled between the
-                # loop test and the POST still skips the re-register
-                if not stop.is_set():
-                    # re-advertise the store's CURRENT models each beat:
-                    # a model loaded at runtime through the control plane
-                    # becomes gateway-routable within one heartbeat
-                    DriverRegistry.register(
-                        registry_url,
-                        dataclasses.replace(
-                            info, models=tuple(store.model_names())
-                        ),
+            # registry HA: every live registry learns this worker each
+            # beat, so the gateway can fail roster refreshes over to any
+            # of them; a dead registry is skipped, not fatal
+            fresh = dataclasses.replace(
+                info, models=tuple(store.model_names())
+            )
+            for url in registry_urls:
+                try:
+                    # checked INSIDE the try so a shutdown signaled between
+                    # the loop test and the POST still skips the re-register
+                    if not stop.is_set():
+                        # re-advertise the store's CURRENT models each beat:
+                        # a model loaded at runtime through the control plane
+                        # becomes gateway-routable within one heartbeat
+                        DriverRegistry.register(url, fresh)
+                except Exception as e:  # noqa: BLE001 — may be restarting
+                    print(
+                        f"worker: register to {url} failed: {e}",
+                        file=sys.stderr, flush=True,
                     )
-            except Exception as e:  # noqa: BLE001 — registry may be restarting
-                print(f"worker: register failed: {e}", file=sys.stderr, flush=True)
             stop.wait(heartbeat_s)
 
     stopper._beat = threading.Thread(target=beat, name="worker-heartbeat", daemon=True)
@@ -316,24 +338,34 @@ def worker_urls_from_registry(
     registry_url: str, service_name: str = "serving", timeout: float = 5.0
 ) -> list:
     """Roster -> worker base URLs (preferring forwarded endpoints).
-    Raises on an unreachable registry — callers decide how to degrade."""
+    ``registry_url`` may be comma-separated (registry HA): the first
+    live registry answers. Raises when EVERY registry is unreachable —
+    callers decide how to degrade."""
     from mmlspark_tpu.io.clients import send_request
     from mmlspark_tpu.io.http_schema import HTTPRequestData
 
-    resp = send_request(
-        HTTPRequestData(registry_url.rstrip("/") + "/", "GET"),
-        timeout=timeout,
+    last_err: Optional[Exception] = None
+    for url in split_registry_urls(registry_url):
+        try:
+            resp = send_request(
+                HTTPRequestData(url.rstrip("/") + "/", "GET"),
+                timeout=timeout,
+            )
+            if resp["status_code"] != 200:
+                raise ConnectionError(
+                    f"registry {url} answered {resp['status_code']}"
+                )
+            roster = json.loads(resp["entity"])
+            return [
+                f"http://{i.get('forwarded_host') or i['host']}"
+                f":{i.get('forwarded_port') or i['port']}"
+                for i in roster.get(service_name, [])
+            ]
+        except Exception as e:  # noqa: BLE001 — try the next registry
+            last_err = e
+    raise ConnectionError(
+        f"no live registry among {registry_url!r}: {last_err}"
     )
-    if resp["status_code"] != 200:
-        raise ConnectionError(
-            f"registry {registry_url} answered {resp['status_code']}"
-        )
-    roster = json.loads(resp["entity"])
-    return [
-        f"http://{i.get('forwarded_host') or i['host']}"
-        f":{i.get('forwarded_port') or i['port']}"
-        for i in roster.get(service_name, [])
-    ]
 
 
 def _hist_stats(parsed: dict, name: str, match: Optional[dict] = None) -> tuple:
@@ -663,13 +695,30 @@ def run_supervise(
     backoff_max_s: float = 30.0,
     host: str = "127.0.0.1",
     port: int = 0,
+    autoscale: bool = False,
+    min_replicas: int = 1,
+    max_replicas: int = 4,
+    worker_template: Optional[str] = None,
+    scale_out_cooldown_s: float = 10.0,
+    scale_in_cooldown_s: float = 30.0,
+    idle_after_s: float = 30.0,
+    util_threshold: float = 0.85,
+    gateway_url: Optional[str] = None,
 ) -> Any:
     """``fleet supervise``: spawn each ``--worker`` charge as a ``fleet
     worker`` process and keep it alive — restart on crash, kill+restart
     on a wedged ``/health``, capped exponential backoff between restarts
     (serving/supervisor.py). The supervisor registers its own status
     endpoint under ``<service-name>-supervisor`` so ``fleet top`` shows
-    it in the header."""
+    it in the header.
+
+    ``--autoscale`` (docs/online-learning.md): the supervisor also
+    DECIDES the replica count — the SLO-burn/admission-signal policy in
+    ``mmlspark_tpu/online/autoscaler.py`` scrapes the gateway and the
+    rostered workers each tick, spawns a ``--worker-template`` replica
+    before the breaker trips (sheds/utilization/red burn) and reaps
+    autoscaled replicas on sustained idle, clamped to
+    ``[--min-replicas, --max-replicas]``."""
     from mmlspark_tpu import obs
     from mmlspark_tpu.serving.supervisor import (
         FleetSupervisor,
@@ -680,19 +729,174 @@ def run_supervise(
         charge_from_worker_args(w, registry_url, i)
         for i, w in enumerate(workers)
     ]
+    autoscaler = signals_fn = None
+    template = worker_template
+    if autoscale:
+        from mmlspark_tpu.online.autoscaler import Autoscaler, FleetSignals
+
+        autoscaler = Autoscaler(
+            min_replicas=min_replicas, max_replicas=max_replicas,
+            util_threshold=util_threshold,
+            scale_out_cooldown_s=scale_out_cooldown_s,
+            scale_in_cooldown_s=scale_in_cooldown_s,
+            idle_after_s=idle_after_s,
+        )
+        signals_fn = FleetSignals(
+            registry_url=registry_url, gateway_url=gateway_url,
+            service_name=service_name,
+        )
+        if template is None and workers:
+            # autoscaled replicas default to the first charge's shape,
+            # minus any fixed --port (replicas need ephemeral ports)
+            template = _strip_port(workers[0])
     sup = FleetSupervisor(
         charges, registry_url=registry_url, service_name=service_name,
         probe_s=probe_s, wedge_after=wedge_after, backoff_s=backoff_s,
         backoff_max_s=backoff_max_s, host=host, port=port,
+        autoscaler=autoscaler, worker_template=template,
+        signals_fn=signals_fn,
     ).start()
     obs.set_process_label(
         f"{service_name}-supervisor@{sup._info.host}:{sup._info.port}"
     )
     print(
-        f"supervisor: {sup.url} watching {len(charges)} worker(s)",
+        f"supervisor: {sup.url} watching {len(charges)} worker(s)"
+        + (
+            f", autoscaling {min_replicas}..{max_replicas}"
+            if autoscale else ""
+        ),
         flush=True,
     )
     return sup
+
+
+def _strip_port(worker_args: str) -> str:
+    """Remove ``--port N`` / ``--port=N`` from a worker arg string
+    (autoscaled replicas must bind ephemeral ports — two replicas
+    cannot share the operator's fixed one)."""
+    import shlex
+
+    toks = shlex.split(worker_args)
+    out = []
+    i = 0
+    while i < len(toks):
+        if toks[i] == "--port" and i + 1 < len(toks):
+            i += 2
+            continue
+        if toks[i].startswith("--port="):
+            i += 1
+            continue
+        out.append(toks[i])
+        i += 1
+    return " ".join(out)
+
+
+def run_online(
+    registry_url: Optional[str] = None,
+    model: str = "vw-online",
+    host: str = "0.0.0.0",
+    port: int = 0,
+    service_name: str = "serving",
+    worker_urls: Optional[list] = None,
+    snapshot_dir: Optional[str] = None,
+    publish_every_s: float = 2.0,
+    freshness_slo_ms: float = 5000.0,
+    heartbeat_s: float = 5.0,
+    advertise_host: Optional[str] = None,
+    num_bits: int = 18,
+    loss: str = "logistic",
+    lr: float = 0.5,
+    batch: int = 64,
+    label_col: str = "label",
+    features_col: str = "features",
+    text_col: Optional[str] = None,
+    distributed: bool = False,
+) -> tuple:
+    """``fleet online``: run the continuous-learning loop as a fleet
+    role. Starts the HTTP ingest ingress (``POST /ingest``; ``GET
+    /metrics`` inline), trains the device-resident VW learner on every
+    ingested micro-batch, and every ``publish_every_s`` publishes a
+    versioned ``vw:`` snapshot through the zero-drop load -> warm ->
+    swap path on every rostered worker (and/or explicit
+    ``--worker-url``\\ s). Registers under ``<service>-online`` so
+    ``fleet top`` and the deploy smoke's freshness gate find it; the
+    freshness SLO engine runs in-process and exports burn-rate gauges.
+
+    Returns ``(stream, loop, stopper)``."""
+    import dataclasses
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.online import (
+        FeedbackStream,
+        OnlineLearningLoop,
+        OnlineTrainer,
+        Publisher,
+    )
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    if not registry_url and not worker_urls:
+        raise ValueError("fleet online needs --registry and/or --worker-url")
+    stream = FeedbackStream()
+    info = stream.serve(host=host, port=port, name=f"{service_name}-online")
+    obs.set_process_label(
+        f"{service_name}-online@{advertise_host or info.host}:{info.port}"
+    )
+    trainer = OnlineTrainer(
+        num_bits=num_bits, loss=loss, lr=lr, batch=batch,
+        label_col=label_col, features_col=features_col, text_col=text_col,
+        distributed=distributed,
+    )
+    publisher = Publisher(
+        model=model, snapshot_dir=snapshot_dir,
+        worker_urls=worker_urls, registry_url=registry_url,
+        service_name=service_name,
+    )
+    loop = OnlineLearningLoop(
+        stream, trainer, publisher, publish_every_s=publish_every_s,
+        freshness_budget_ms=freshness_slo_ms or None,
+    ).start()
+    if advertise_host:
+        info = dataclasses.replace(info, host=advertise_host)
+    stop = threading.Event()
+    registry_urls = split_registry_urls(registry_url)
+
+    def beat() -> None:
+        while not stop.is_set():
+            for url in registry_urls:
+                try:
+                    if not stop.is_set():
+                        DriverRegistry.register(url, info)
+                except Exception as e:  # noqa: BLE001 — may be restarting
+                    print(
+                        f"online: register to {url} failed: {e}",
+                        file=sys.stderr, flush=True,
+                    )
+            stop.wait(heartbeat_s)
+
+    beat_t = threading.Thread(target=beat, name="online-heartbeat", daemon=True)
+    beat_t.start()
+
+    class _OnlineStopper:
+        def stop(self) -> None:
+            if stop.is_set():
+                return
+            stop.set()
+            beat_t.join(12.0)
+            loop.stop(final_publish=True)
+            stream.close()
+            for url in registry_urls:
+                try:
+                    DriverRegistry.deregister(url, info)
+                except Exception:  # noqa: BLE001 — registry may be gone
+                    pass
+
+        set = stop
+
+    print(
+        f"online: ingest http://{info.host}:{info.port}/ingest -> model "
+        f"{model!r}, publish every {publish_every_s}s", flush=True,
+    )
+    return stream, loop, _OnlineStopper()
 
 
 def supervisor_status_from_registry(
@@ -877,6 +1081,73 @@ def main(argv: Optional[list] = None) -> None:
                     help="base restart backoff (doubles per fast death)")
     sv.add_argument("--backoff-max-s", type=float, default=30.0,
                     help="restart backoff cap")
+    sv.add_argument(
+        "--autoscale", action="store_true",
+        help="SLO-driven autoscaling: spawn a replica on admission "
+        "sheds / high utilization / red SLO burn, reap on sustained "
+        "idle (mmlspark_tpu/online/autoscaler.py)",
+    )
+    sv.add_argument("--min-replicas", type=int, default=1)
+    sv.add_argument("--max-replicas", type=int, default=4)
+    sv.add_argument(
+        "--worker-template", default=None,
+        help="fleet-worker args for autoscaled replicas (default: the "
+        "first --worker, with any fixed --port stripped)",
+    )
+    sv.add_argument("--scale-out-cooldown-s", type=float, default=10.0)
+    sv.add_argument("--scale-in-cooldown-s", type=float, default=30.0)
+    sv.add_argument(
+        "--idle-after-s", type=float, default=30.0,
+        help="sustained-idle window before an autoscaled replica is reaped",
+    )
+    sv.add_argument("--util-threshold", type=float, default=0.85)
+    sv.add_argument(
+        "--gateway", default=None,
+        help="gateway base URL scraped for scale signals (backpressure, "
+        "breakers, SLO status)",
+    )
+    on = sub.add_parser(
+        "online",
+        help="continuous-learning loop: HTTP feedback ingest -> online "
+        "VW training -> zero-drop publication to the fleet's workers "
+        "(docs/online-learning.md)",
+    )
+    on.add_argument("--registry", default=None)
+    on.add_argument(
+        "--worker-url", action="append", default=[],
+        help="explicit worker base URL to publish to (repeatable; "
+        "adds to the registry roster)",
+    )
+    on.add_argument("--model", default="vw-online")
+    on.add_argument("--host", default="0.0.0.0")
+    on.add_argument("--port", type=int, default=0,
+                    help="HTTP ingest port (POST /ingest; GET /metrics)")
+    on.add_argument("--service-name", default="serving")
+    on.add_argument("--snapshot-dir", default=None)
+    on.add_argument("--publish-every-s", type=float, default=2.0)
+    on.add_argument(
+        "--freshness-slo-ms", type=float, default=5000.0,
+        help="freshness budget: example-ingested -> model-servable over "
+        "this burns the SLO error budget (0 disables the engine)",
+    )
+    on.add_argument("--heartbeat-s", type=float, default=5.0)
+    on.add_argument("--advertise-host", default=None)
+    on.add_argument("--num-bits", type=int, default=18)
+    on.add_argument("--loss", default="logistic")
+    on.add_argument("--lr", type=float, default=0.5)
+    on.add_argument("--batch", type=int, default=64)
+    on.add_argument("--label-col", default="label")
+    on.add_argument("--features-col", default="features")
+    on.add_argument(
+        "--text-col", default=None,
+        help="hash this text column through the VW featurizer instead "
+        "of reading pre-hashed sparse rows",
+    )
+    on.add_argument(
+        "--distributed", action="store_true",
+        help="shard micro-batches over the device mesh with a pmean "
+        "allreduce per pass (multi-chip training)",
+    )
     t = sub.add_parser(
         "top", help="scrape /metrics across the fleet, print a summary"
     )
@@ -1014,8 +1285,34 @@ def main(argv: Optional[list] = None) -> None:
             probe_s=args.probe_s, wedge_after=args.wedge_after,
             backoff_s=args.backoff_s, backoff_max_s=args.backoff_max_s,
             host=args.host, port=args.port,
+            autoscale=args.autoscale, min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            worker_template=args.worker_template,
+            scale_out_cooldown_s=args.scale_out_cooldown_s,
+            scale_in_cooldown_s=args.scale_in_cooldown_s,
+            idle_after_s=args.idle_after_s,
+            util_threshold=args.util_threshold,
+            gateway_url=args.gateway,
         )
         _serve_forever([sup])
+    elif args.role == "online":
+        from mmlspark_tpu.obs.flightrec import install_sigusr1
+
+        install_sigusr1()
+        _stream, _loop, stopper = run_online(
+            registry_url=args.registry, model=args.model, host=args.host,
+            port=args.port, service_name=args.service_name,
+            worker_urls=args.worker_url or None,
+            snapshot_dir=args.snapshot_dir,
+            publish_every_s=args.publish_every_s,
+            freshness_slo_ms=args.freshness_slo_ms,
+            heartbeat_s=args.heartbeat_s,
+            advertise_host=args.advertise_host, num_bits=args.num_bits,
+            loss=args.loss, lr=args.lr, batch=args.batch,
+            label_col=args.label_col, features_col=args.features_col,
+            text_col=args.text_col, distributed=args.distributed,
+        )
+        _serve_forever([stopper])
     else:
         from mmlspark_tpu.obs.flightrec import install_sigusr1
 

@@ -26,7 +26,10 @@ def model_name_from_spec(spec: str) -> str:
     """The model name a spec serves under (fleet worker registration and
     per-model routing): ``echo`` -> ``echo``, ``zoo:ResNet8`` ->
     ``ResNet8``, ``module:pkg.make`` -> ``make``, ``pipeline:/m/churn``
-    -> ``churn``."""
+    -> ``churn``, ``vw:/s/vw-online-v000007.npz`` -> ``vw-online``
+    (exactly the Publisher's ``-v%06d`` suffix strips so every snapshot
+    of one online model registers under one stable name; a hand-named
+    ``vw:/s/fraud-v2.npz`` keeps its full ``fraud-v2`` name)."""
     if spec.startswith("zoo:"):
         return spec[len("zoo:"):]
     if spec.startswith("module:"):
@@ -35,6 +38,15 @@ def model_name_from_spec(spec: str) -> str:
         import os
 
         return os.path.basename(spec[len("pipeline:"):].rstrip("/")) or "pipeline"
+    if spec.startswith("vw:"):
+        import os
+        import re
+
+        stem = os.path.basename(spec[len("vw:"):])
+        stem = stem[: -len(".npz")] if stem.endswith(".npz") else stem
+        # exactly the Publisher's -v%06d suffix: a looser \d+ would
+        # mangle user-named snapshots like fraud-v2.npz -> "fraud"
+        return re.sub(r"-v\d{6}$", "", stem) or "vw"
     return spec
 
 
@@ -292,6 +304,110 @@ def _pipeline_loaded(path: str) -> LoadedModel:
     )
 
 
+def _vw_loaded(path: str) -> LoadedModel:
+    """``vw:<snapshot.npz>`` — serve an online-published VW linear model
+    from device memory (mmlspark_tpu/online/ Publisher artifacts; also
+    loadable standalone for warm worker restarts via ``--load``).
+
+    The npz carries ``weights`` (2^num_bits f32) and ``meta`` (JSON:
+    num_bits, loss, no_constant, quantile_tau). Wire contract
+    (docs/online-learning.md): POST body is one sparse row
+    ``{"i": [...], "v": [...]}`` or ``{"rows": [...]}`` of them; the
+    reply carries ``margin`` plus ``prediction`` (and ``probability``
+    for logistic). Batches pad to 8-row/8-nnz buckets so the compile
+    set stays bounded; warmup runs one dummy bucket through the real
+    scoring kernel before the version turns ready."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.vw.learner import LOSS_HINGE, LOSS_LOGISTIC, LOSS_POISSON
+    from mmlspark_tpu.vw.sparse import pad_sparse_batch
+
+    with np.load(path, allow_pickle=False) as z:
+        weights = np.asarray(z["weights"], np.float32)
+        meta = json.loads(bytes(z["meta"]))
+    num_bits = int(meta["num_bits"])
+    loss = meta.get("loss", "logistic")
+    no_constant = bool(meta.get("no_constant", False))
+    if weights.shape != (1 << num_bits,):
+        raise ValueError(
+            f"vw snapshot {path}: weights shape {weights.shape} != "
+            f"({1 << num_bits},)"
+        )
+    state = {"w": jnp.asarray(weights)}
+
+    def _score(rows: list) -> list:
+        from mmlspark_tpu.vw.estimators import _append_constant
+        from mmlspark_tpu.vw.learner import _predict_margin
+
+        norm = np.empty(len(rows), dtype=object)
+        for r, cell in enumerate(rows):
+            norm[r] = {"i": cell["i"], "v": cell["v"]}
+        idx, val = pad_sparse_batch(norm)
+        if not no_constant:
+            idx, val = _append_constant(idx, val, num_bits)
+        pad = -len(idx) % 8  # 8-row bucket: bounded compile set
+        if pad:
+            idx = np.pad(idx, ((0, pad), (0, 0)))
+            val = np.pad(val, ((0, pad), (0, 0)))
+        margins = np.asarray(_predict_margin(
+            jnp.asarray(idx, jnp.int32), jnp.asarray(val), state["w"]
+        ))[: len(rows)].astype(np.float64)
+        out = []
+        for m in margins:
+            row = {"margin": float(m)}
+            if loss in (LOSS_LOGISTIC, LOSS_HINGE):
+                row["prediction"] = float(m > 0)
+                if loss == LOSS_LOGISTIC:
+                    row["probability"] = float(1.0 / (1.0 + np.exp(-m)))
+            elif loss == LOSS_POISSON:
+                row["prediction"] = float(np.exp(np.clip(m, -30.0, 30.0)))
+            else:
+                row["prediction"] = float(m)
+            out.append(row)
+        return out
+
+    def handler(reqs: list) -> dict:
+        out = {}
+        for r in reqs:
+            try:
+                body = json.loads(r.body) if r.body else {}
+                rows = (
+                    body["rows"]
+                    if isinstance(body, dict) and "rows" in body else [body]
+                )
+                if not rows or not all(
+                    isinstance(x, dict) and "i" in x and "v" in x
+                    for x in rows
+                ):
+                    raise ValueError(
+                        'rows must be sparse objects {"i": [...], "v": [...]}'
+                    )
+                scored = _score(rows)
+                payload = (
+                    {"rows": scored}
+                    if isinstance(body, dict) and "rows" in body
+                    else scored[0]
+                )
+                out[r.id] = (200, json.dumps(payload).encode(), {})
+            except Exception as e:  # noqa: BLE001 — a bad row 400s alone
+                out[r.id] = (
+                    400, json.dumps({"error": str(e)[:300]}).encode(), {}
+                )
+        return out
+
+    def warmup() -> None:
+        _score([{"i": [0], "v": [0.0]}])
+
+    def release() -> None:
+        state["w"] = None
+
+    return LoadedModel(
+        handler=handler, nbytes=int(weights.nbytes), warmup=warmup,
+        release=release,
+        meta={"spec": f"vw:{path}", **meta},
+    )
+
+
 def build_loaded_model(spec: Any) -> LoadedModel:
     """Resolve a model spec:
 
@@ -304,7 +420,9 @@ def build_loaded_model(spec: Any) -> LoadedModel:
       :class:`LoadedModel`;
     - ``"pipeline:<dir>"`` — a saved PipelineModel/CompiledPipeline dir,
       compiled (plan+fuse+partition) before ready, with jax-tree byte
-      accounting over the fitted stages.
+      accounting over the fitted stages;
+    - ``"vw:<snapshot.npz>"`` — an online-published VW linear model
+      (mmlspark_tpu/online/ Publisher artifact), scored on device.
     """
     if isinstance(spec, LoadedModel):
         return spec
@@ -318,6 +436,8 @@ def build_loaded_model(spec: Any) -> LoadedModel:
         return _zoo_loaded(spec[len("zoo:"):])
     if spec.startswith("pipeline:"):
         return _pipeline_loaded(spec[len("pipeline:"):])
+    if spec.startswith("vw:"):
+        return _vw_loaded(spec[len("vw:"):])
     if spec.startswith("module:"):
         import importlib
 

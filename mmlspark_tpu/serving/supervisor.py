@@ -148,7 +148,18 @@ class FleetSupervisor:
         host: str = "127.0.0.1",
         port: int = 0,
         spawn: Any = None,
+        autoscaler: Any = None,
+        worker_template: Optional[str] = None,
+        signals_fn: Any = None,
     ):
+        """``autoscaler`` (an :class:`~mmlspark_tpu.online.autoscaler.
+        Autoscaler`) turns supervision into autoscaling: each tick the
+        policy decides a desired replica count from ``signals_fn()``
+        (a :class:`ScaleSignals` source, e.g. ``FleetSignals``) and the
+        supervisor spawns a ``worker_template`` charge or reaps an
+        autoscaled one — only charges IT created are ever reaped, the
+        operator's original ``--worker`` charges are a floor. The
+        ``autoscaler.scale`` fault point gates every action."""
         self.charges: list = list(charges)
         self.registry_url = registry_url
         self.service_name = service_name
@@ -162,6 +173,17 @@ class FleetSupervisor:
         self._host = host
         self._port = port
         self._spawn = spawn or (lambda argv: subprocess.Popen(argv))
+        self._autoscaler = autoscaler
+        self._worker_template = worker_template
+        self._signals_fn = signals_fn
+        # latest sample from the signals thread: signal sources scrape
+        # /metrics over the network with multi-second timeouts, and that
+        # must never stall the supervision tick — crash/wedge handling
+        # has to stay responsive exactly when nodes are dying
+        self._last_signals: Any = None
+        self._signals_thread: Optional[threading.Thread] = None
+        self._autoscaled: list = []  # charges the autoscaler created
+        self._scale_index = len(self.charges)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._ingress: Any = None
@@ -183,16 +205,35 @@ class FleetSupervisor:
         self._info = self._ingress.start()
         for c in self.charges:
             self._spawn_charge(c, first=True)
+        if self._autoscaler is not None and self._signals_fn is not None:
+            self._signals_thread = threading.Thread(
+                target=self._signals_loop, name="fleet-autoscale-signals",
+                daemon=True,
+            )
+            self._signals_thread.start()
         self._thread = threading.Thread(
             target=self._loop, name="fleet-supervisor", daemon=True
         )
         self._thread.start()
         return self
 
+    def _signals_loop(self) -> None:
+        """Sample the scale-signal source off the supervision path: a
+        blackholed scrape eats its own thread's time, not a tick's."""
+        while not self._stop.is_set():
+            try:
+                self._last_signals = self._signals_fn()
+            except Exception as e:  # noqa: BLE001 — a blind sample = hold
+                print(f"supervisor: signal sample failed: {e}",
+                      file=sys.stderr, flush=True)
+            self._stop.wait(self.probe_s)
+
     def stop(self, kill_charges: bool = True) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(5.0)
+        if self._signals_thread is not None:
+            self._signals_thread.join(5.0)
         if kill_charges:
             for c in self.charges:
                 if c.alive():
@@ -203,15 +244,23 @@ class FleetSupervisor:
                         c.proc.wait(5.0)
                     except Exception:  # noqa: BLE001 — escalate to SIGKILL
                         c.proc.kill()
-        if self.registry_url and self._info is not None:
+        if self._info is not None:
             from mmlspark_tpu.serving.registry import DriverRegistry
 
-            try:
-                DriverRegistry.deregister(self.registry_url, self._info)
-            except Exception:  # noqa: BLE001 — registry may be gone
-                pass
+            for url in self._registry_urls():
+                try:
+                    DriverRegistry.deregister(url, self._info)
+                except Exception:  # noqa: BLE001 — registry may be gone
+                    pass
         if self._ingress is not None:
             self._ingress.stop()
+
+    def _registry_urls(self) -> list:
+        """Registry HA: ``registry_url`` may be one URL, a comma-
+        separated list, or a sequence — heartbeats go to ALL of them."""
+        from mmlspark_tpu.serving.fleet import split_registry_urls
+
+        return split_registry_urls(self.registry_url)
 
     @property
     def url(self) -> str:
@@ -323,13 +372,110 @@ class FleetSupervisor:
                 up += 1
             _M_UP.set(up)
             _M_CHARGES.set(len(self.charges))
-        if self.registry_url and self._info is not None:
+        self._autoscale()
+        if self._info is not None:
             from mmlspark_tpu.serving.registry import DriverRegistry
 
+            for url in self._registry_urls():
+                try:
+                    DriverRegistry.register(url, self._info)
+                except Exception:  # noqa: BLE001 — registry may be restarting
+                    pass
+
+    # -- autoscaling ---------------------------------------------------------
+
+    def _autoscale(self) -> None:
+        if self._autoscaler is None:
+            return
+        from mmlspark_tpu.online.autoscaler import Autoscaler, ScaleSignals
+
+        # the signals thread feeds _last_signals; until the first sample
+        # lands (or with no source at all) the policy sees a quiet fleet
+        # and holds — an autoscaler without evidence must not act
+        signals = self._last_signals
+        if signals is None:
+            signals = ScaleSignals()
+        with self._lock:
+            current = len(self.charges)
+        Autoscaler.export_replicas(current)
+        desired, reason = self._autoscaler.decide(current, signals)
+        if desired == current:
+            return
+        direction = "out" if desired > current else "in"
+        try:
+            # fault point autoscaler.scale: an injected error is "the
+            # scheduler refused this scale event" — retried next tick
+            faults.inject(
+                "autoscaler.scale",
+                context={"direction": direction, "reason": reason},
+            )
+        except Exception as e:  # noqa: BLE001 — injected refusal
+            print(
+                f"supervisor: autoscale {direction} suppressed: {e}",
+                file=sys.stderr, flush=True,
+            )
+            return
+        if direction == "out":
+            self._scale_out(reason)
+        else:
+            self._scale_in(reason)
+        with self._lock:
+            Autoscaler.export_replicas(len(self.charges))
+
+    def _scale_out(self, reason: str) -> None:
+        from mmlspark_tpu.online.autoscaler import Autoscaler
+
+        if not self._worker_template:
+            print(
+                "supervisor: autoscale wants a replica but no "
+                "--worker-template is set", file=sys.stderr, flush=True,
+            )
+            return
+        c = charge_from_worker_args(
+            self._worker_template, self.registry_url or "",
+            self._scale_index,
+        )
+        self._scale_index += 1
+        c.name = f"autoscaled-{c.name}"
+        if not self._spawn_charge(c, first=True):
+            return
+        with self._lock:
+            self.charges.append(c)
+            self._autoscaled.append(c)
+            _M_CHARGES.set(len(self.charges))
+        Autoscaler.note_applied("out")
+        print(
+            f"supervisor: scaled OUT to {len(self.charges)} ({reason}): "
+            f"{c.name}", file=sys.stderr, flush=True,
+        )
+
+    def _scale_in(self, reason: str) -> None:
+        from mmlspark_tpu.online.autoscaler import Autoscaler
+
+        with self._lock:
+            # only reap replicas the autoscaler created — the operator's
+            # own charges are a floor, not scaling headroom
+            victim = None
+            while self._autoscaled:
+                cand = self._autoscaled.pop()
+                if cand in self.charges:
+                    victim = cand
+                    break
+            if victim is None:
+                return
+            self.charges.remove(victim)
+            _M_CHARGES.set(len(self.charges))
+        if victim.alive():
+            victim.proc.terminate()  # SIGTERM: the worker deregisters clean
             try:
-                DriverRegistry.register(self.registry_url, self._info)
-            except Exception:  # noqa: BLE001 — registry may be restarting
-                pass
+                victim.proc.wait(5.0)
+            except Exception:  # noqa: BLE001 — escalate
+                victim.proc.kill()
+        Autoscaler.note_applied("in")
+        print(
+            f"supervisor: scaled IN to {len(self.charges)} ({reason}): "
+            f"reaped {victim.name}", file=sys.stderr, flush=True,
+        )
 
     def _loop(self) -> None:
         while not self._stop.is_set():

@@ -15,7 +15,7 @@ non-adaptive path.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +32,36 @@ LOSS_QUANTILE = "quantile"
 LOSS_HINGE = "hinge"
 LOSS_POISSON = "poisson"
 LOSSES = (LOSS_LOGISTIC, LOSS_SQUARED, LOSS_QUANTILE, LOSS_HINGE, LOSS_POISSON)
+
+
+class SGDState(NamedTuple):
+    """Full optimizer state of the VW online learner.
+
+    Carrying ``g2`` (the AdaGrad accumulator) and ``t`` (the minibatch
+    counter for the non-adaptive schedule) across calls is what makes
+    incremental training *bit-identical* to one batch run over the
+    concatenated rows (asserted in tests/test_online.py): warm-starting
+    on weights alone would reset the per-coordinate step sizes every
+    micro-batch. Fields may be numpy or jax arrays — the continuous-
+    training loop keeps them device-resident between micro-batches and
+    only pulls ``w`` to host at publish time."""
+
+    w: Any    # (2^num_bits,) f32 weights
+    g2: Any   # (2^num_bits,) f32 AdaGrad sum of squared gradients
+    t: Any    # scalar f32: minibatches seen (power_t schedule input)
+
+
+def sgd_init(num_bits: int,
+             initial_weights: Optional[np.ndarray] = None) -> SGDState:
+    """Fresh optimizer state for :func:`train_sparse_sgd_state`."""
+    d = 1 << num_bits
+    w = (
+        np.zeros(d, np.float32) if initial_weights is None
+        else np.asarray(initial_weights, np.float32)
+    )
+    if w.shape != (d,):
+        raise ValueError(f"initial weights shape {w.shape} != ({d},)")
+    return SGDState(w=w, g2=np.zeros(d, np.float32), t=np.float32(0.0))
 
 
 def _dloss(loss: str, margin: jnp.ndarray, y: jnp.ndarray, tau: float) -> jnp.ndarray:
@@ -64,6 +94,8 @@ def _shard_train(
     y: jnp.ndarray,  # (n,) f32
     wt: jnp.ndarray,  # (n,) f32 example weights, 0 for padding rows
     w0: jnp.ndarray,  # (D,) f32 initial weights
+    g20: jnp.ndarray,  # (D,) f32 initial AdaGrad accumulator
+    t0: jnp.ndarray,  # scalar f32: minibatches already seen
     tau: jnp.ndarray,  # pinball level (quantile loss only)
     *,
     loss: str,
@@ -74,7 +106,7 @@ def _shard_train(
     l2: float,
     adaptive: bool,
     axis: Optional[str],
-) -> jnp.ndarray:
+) -> tuple:
     n = idx.shape[0]
     nb = n // batch
     idx_b = idx[: nb * batch].reshape(nb, batch, -1)
@@ -115,26 +147,29 @@ def _shard_train(
             g2 = pcast(g2, axis, to="varying")
         return (w, g2, t), None
 
-    g20 = jnp.zeros_like(w0)
     if axis is not None:
         # carry becomes device-varying after the first shard-local update;
         # mark it so from the start (shard_map varying-axis typing)
         w0 = pcast(w0, axis, to="varying")
         g20 = pcast(g20, axis, to="varying")
-    (w, _, _), _ = jax.lax.scan(one_pass, (w0, g20, 0.0), None, length=num_passes)
+    (w, g2, t), _ = jax.lax.scan(
+        one_pass, (w0, g20, jnp.float32(t0)), None, length=num_passes
+    )
     if axis is not None:
         # shards already hold identical pmean-ed weights; this extra pmean is
         # a no-op numerically but types the output as axis-invariant
         w = jax.lax.pmean(w, axis)
-    return w
+        g2 = jax.lax.pmean(g2, axis)
+    return w, g2, t
 
 
-def train_sparse_sgd(
+def train_sparse_sgd_state(
     idx: np.ndarray,
     val: np.ndarray,
     y: np.ndarray,
     wt: Optional[np.ndarray],
     num_bits: int,
+    state: Optional[SGDState] = None,
     *,
     loss: str = LOSS_LOGISTIC,
     num_passes: int = 1,
@@ -143,18 +178,22 @@ def train_sparse_sgd(
     power_t: float = 0.5,
     l2: float = 0.0,
     adaptive: bool = True,
-    initial_weights: Optional[np.ndarray] = None,
     distributed: bool = True,
     quantile_tau: float = 0.5,
-) -> np.ndarray:
-    """Train on the (padded) sparse batch; returns the (2^num_bits,) weights.
+) -> SGDState:
+    """One incremental training step: continue from ``state`` (or fresh
+    zeros) over this (padded) sparse micro-batch, returning the FULL
+    updated optimizer state with **device-resident** arrays.
 
-    ``distributed=True`` shards rows over the mesh ``data`` axis via
-    ``shard_map`` so every pass ends in an ICI ``pmean``.
-
-    ``batch <= 0`` = auto: 1024 on TPU (the gather/scatter SGD step is
-    latency-bound there — bigger minibatches keep the chip busy), 64
-    elsewhere (closer to VW's per-example updates)."""
+    This is the continuous-training entry point (mmlspark_tpu/online/):
+    state fields stay on device between calls — no host round-trip per
+    micro-batch — and because the AdaGrad accumulator and schedule
+    counter ride along, feeding rows chunk-by-chunk is bit-identical to
+    one :func:`train_sparse_sgd` call over the concatenation whenever
+    chunk sizes are multiples of the minibatch size (unsharded path;
+    asserted in tests/test_online.py). Batch semantics, sharding and the
+    per-pass ``pmean`` allreduce are exactly :func:`train_sparse_sgd`'s.
+    """
     d = 1 << num_bits
     n = len(y)
     if batch <= 0:
@@ -192,13 +231,13 @@ def train_sparse_sgd(
         val = np.concatenate([val, np.zeros((pad, val.shape[1]), val.dtype)])
         y = np.concatenate([np.asarray(y, np.float32), np.zeros(pad, np.float32)])
         wt = np.concatenate([wt, np.zeros(pad, np.float32)])  # padding = no-op
-    w0 = (
-        np.zeros(d, np.float32)
-        if initial_weights is None
-        else np.asarray(initial_weights, np.float32)
-    )
-    if w0.shape != (d,):
-        raise ValueError(f"initial weights shape {w0.shape} != ({d},)")
+    if state is None:
+        state = sgd_init(num_bits)
+    w0, g20, t0 = state
+    if getattr(w0, "shape", None) != (d,):
+        raise ValueError(
+            f"state weights shape {getattr(w0, 'shape', None)} != ({d},)"
+        )
     kwargs = dict(
         loss=loss,
         num_passes=num_passes,
@@ -210,23 +249,26 @@ def train_sparse_sgd(
     )
     tau = np.float32(quantile_tau)
     if not distributed or n_shards == 1:
-        w = _shard_train(
+        w, g2, t = _shard_train(
             jnp.asarray(idx, jnp.int32),
             jnp.asarray(val),
             jnp.asarray(y, jnp.float32),
             jnp.asarray(wt),
-            jnp.asarray(w0),
+            jnp.asarray(w0, jnp.float32),  # no-op on a device array
+            jnp.asarray(g20, jnp.float32),
+            jnp.asarray(t0, jnp.float32),
             tau,
             axis=None,
             **kwargs,
         )
-        return np.asarray(w)
+        return SGDState(w=w, g2=g2, t=t)
 
     fn = shard_apply(
         functools.partial(_shard_train, axis=DATA_AXIS, **kwargs),
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
-        out_specs=P(),
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(), P(), P(), P()),
+        out_specs=(P(), P(), P()),
     )
     if multihost:
         from mmlspark_tpu.parallel.sharding import shard_batch_multihost
@@ -236,17 +278,60 @@ def train_sparse_sgd(
              np.asarray(y, np.float32), wt.astype(np.float32)),
             mesh,
         )
-        w = jax.jit(fn)(*rows, w0, tau)  # w0: identical host array == replicated
-        return np.asarray(w)
-    w = jax.jit(fn)(
+        # state: identical host arrays (or replicated device arrays from a
+        # previous step) == replicated
+        w, g2, t = jax.jit(fn)(
+            *rows, np.asarray(w0, np.float32), np.asarray(g20, np.float32),
+            np.float32(t0), tau,
+        )
+        return SGDState(w=w, g2=g2, t=t)
+    w, g2, t = jax.jit(fn)(
         jnp.asarray(idx, jnp.int32),
         jnp.asarray(val),
         jnp.asarray(y, jnp.float32),
         jnp.asarray(wt),
-        jnp.asarray(w0),
+        jnp.asarray(w0, jnp.float32),
+        jnp.asarray(g20, jnp.float32),
+        jnp.asarray(t0, jnp.float32),
         tau,
     )
-    return np.asarray(w)
+    return SGDState(w=w, g2=g2, t=t)
+
+
+def train_sparse_sgd(
+    idx: np.ndarray,
+    val: np.ndarray,
+    y: np.ndarray,
+    wt: Optional[np.ndarray],
+    num_bits: int,
+    *,
+    loss: str = LOSS_LOGISTIC,
+    num_passes: int = 1,
+    batch: int = 0,
+    lr: float = 0.5,
+    power_t: float = 0.5,
+    l2: float = 0.0,
+    adaptive: bool = True,
+    initial_weights: Optional[np.ndarray] = None,
+    distributed: bool = True,
+    quantile_tau: float = 0.5,
+) -> np.ndarray:
+    """Train on the (padded) sparse batch; returns the (2^num_bits,) weights.
+
+    ``distributed=True`` shards rows over the mesh ``data`` axis via
+    ``shard_map`` so every pass ends in an ICI ``pmean``.
+
+    ``batch <= 0`` = auto: 1024 on TPU (the gather/scatter SGD step is
+    latency-bound there — bigger minibatches keep the chip busy), 64
+    elsewhere (closer to VW's per-example updates)."""
+    state = train_sparse_sgd_state(
+        idx, val, y, wt, num_bits,
+        sgd_init(num_bits, initial_weights),
+        loss=loss, num_passes=num_passes, batch=batch, lr=lr,
+        power_t=power_t, l2=l2, adaptive=adaptive, distributed=distributed,
+        quantile_tau=quantile_tau,
+    )
+    return np.asarray(state.w)
 
 
 @functools.partial(jax.jit, static_argnames=())

@@ -822,6 +822,213 @@ def test_chaos_soak_supervisor_restores_fleet_and_breakers_cycle():
         obs.reset()
 
 
+# -- continuous learning under chaos: kill the worker mid-training -----------
+
+
+@pytest.mark.xdist_group("latency")
+def test_chaos_online_worker_kill_mid_training_zero_drop(tmp_path):
+    """The continuous-learning acceptance soak (docs/online-learning.md):
+    sustained serving traffic for the online model through the gateway
+    while the OnlineLearningLoop trains on a live feedback stream and
+    publishes every ~0.5 s — and one serving worker is SIGKILLed
+    mid-soak, with the supervisor in AUTOSCALE mode. Gates: the
+    supervisor restarts the victim warm (its ``--load vw:`` seed spec
+    brings the model back before re-registering), publication resumes
+    (>= 3 successful publications AFTER the kill), ZERO dropped or
+    failed requests across every version flip, the freshness burn rate
+    ends green, and the autoscaler never shrank the fleet below its
+    floor."""
+    import os
+    import socket
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.online import (
+        Autoscaler,
+        FeedbackStream,
+        FleetSignals,
+        OnlineLearningLoop,
+        OnlineTrainer,
+        Publisher,
+    )
+    from mmlspark_tpu.serving import fleet
+    from mmlspark_tpu.serving.distributed import ServingGateway
+    from mmlspark_tpu.serving.supervisor import (
+        FleetSupervisor,
+        charge_from_worker_args,
+    )
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    bits = 10
+    rng = np.random.default_rng(17)
+
+    def feedback_chunk(n=64):
+        rows = np.empty(n, dtype=object)
+        for r in range(n):
+            k = int(rng.integers(2, 7))
+            rows[r] = {
+                "i": rng.integers(0, 1 << bits, size=k).astype(np.int64),
+                "v": rng.normal(size=k).astype(np.float32),
+            }
+        return DataFrame.from_dict({
+            "features": rows,
+            "label": rng.integers(0, 2, size=n).astype(np.float64),
+        })
+
+    soak_s = float(os.environ.get("MMLSPARK_CHAOS_ONLINE_SOAK_S", "14"))
+    reg = fleet.run_registry(host="127.0.0.1", port=0)
+    # seed snapshot in its OWN dir (the live publisher prunes its
+    # snapshot dir; the restart --load spec must survive all soak long)
+    trainer = OnlineTrainer(num_bits=bits, batch=32)
+    trainer.step(feedback_chunk())
+    seed_dir = tmp_path / "seed"
+    seed_pub = Publisher(
+        model="vw-online", snapshot_dir=str(seed_dir),
+        worker_urls=["http://127.0.0.1:1/"],  # snapshot only, never reached
+    )
+    seed_path = seed_pub._write_snapshot(trainer)
+    worker_args = [
+        f"--model echo --host 127.0.0.1 --port {p} --heartbeat-s 0.5 "
+        f"--load vw-online=vw:{seed_path}"
+        for p in (free_port(), free_port())
+    ]
+    autoscaler = Autoscaler(
+        min_replicas=2, max_replicas=3, scale_out_cooldown_s=5.0,
+        scale_in_cooldown_s=10.0, idle_after_s=3600.0,
+    )
+    gw = ServingGateway(
+        registry_url=reg.url, refresh_s=0.2, cooldown_s=0.4,
+        evict_after=3, request_timeout_s=5.0,
+    )
+    ginfo = gw.start()
+    charges = [
+        charge_from_worker_args(w, reg.url, i)
+        for i, w in enumerate(worker_args)
+    ]
+    sup = FleetSupervisor(
+        charges, registry_url=reg.url, probe_s=0.3, backoff_s=0.3,
+        stable_s=20.0, autoscaler=autoscaler,
+        worker_template=fleet._strip_port(worker_args[0]),
+        signals_fn=FleetSignals(
+            registry_url=reg.url,
+            gateway_url=f"http://127.0.0.1:{ginfo.port}",
+        ),
+    ).start()
+    stream = FeedbackStream(max_chunks=64)
+    publisher = Publisher(
+        model="vw-online", snapshot_dir=str(tmp_path / "snaps"),
+        registry_url=reg.url,
+    )
+    loop = OnlineLearningLoop(
+        stream, trainer, publisher, publish_every_s=0.5, poll_s=0.05,
+        freshness_budget_ms=15_000.0,
+    )
+    counters = {"ok": 0, "other": 0, "dropped": 0, "n": 0}
+    stop_traffic = threading.Event()
+    lock = threading.Lock()
+    payload = {"i": [1, 2, 3], "v": [1.0, -0.5, 0.25]}
+
+    def client_loop():
+        while not stop_traffic.is_set():
+            try:
+                status, _ = _post(ginfo.port, "/models/vw-online", payload)
+            except Exception:  # noqa: BLE001 — a DROP, the thing we gate on
+                status = None
+            with lock:
+                counters["n"] += 1
+                if status == 200:
+                    counters["ok"] += 1
+                elif status is None:
+                    counters["dropped"] += 1
+                else:
+                    counters["other"] += 1
+            time.sleep(0.003)
+
+    def producer_loop():
+        while not stop_traffic.is_set():
+            try:
+                stream.push(feedback_chunk())
+            except Exception:  # noqa: BLE001 — bounded buffer shed is fine
+                pass
+            stop_traffic.wait(0.06)
+
+    try:
+        # both workers warm (seed vw-online loaded pre-registration) and
+        # routable before traffic starts
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            infos = reg.services("serving")
+            if len(infos) >= 2 and all(
+                "vw-online" in (i.get("models") or ()) for i in infos
+            ) and gw.pool.size() >= 2:
+                break
+            time.sleep(0.2)
+        assert gw.pool.size() >= 2, "workers never became routable"
+        loop.start()
+        threads = [
+            threading.Thread(target=client_loop) for _ in range(2)
+        ] + [threading.Thread(target=producer_loop)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        victim = charges[0]
+        time.sleep(soak_s * 0.3)
+        with lock:
+            pre_kill_n = counters["n"]
+        publishes_at_kill = publisher.publishes
+        victim.proc.kill()  # SIGKILL mid-continuous-training, for real
+        while time.monotonic() - t0 < soak_s:
+            time.sleep(0.25)
+        stop_traffic.set()
+        for t in threads:
+            t.join(10.0)
+        # -- the supervisor restarted the victim WARM -----------------------
+        assert victim.restarts >= 1, "supervisor never restarted the victim"
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and not victim.alive():
+            time.sleep(0.2)
+        assert victim.alive()
+        # -- publication resumed: >= 3 successful publishes post-kill -------
+        assert publisher.publishes - publishes_at_kill >= 3, (
+            f"only {publisher.publishes - publishes_at_kill} publications "
+            f"after the kill (total {publisher.publishes})"
+        )
+        # -- zero drops across every flip -----------------------------------
+        assert counters["n"] > 100 and pre_kill_n > 10
+        assert counters["dropped"] == 0, (
+            f"{counters['dropped']}/{counters['n']} requests got no reply"
+        )
+        assert counters["other"] == 0, (
+            f"{counters['other']}/{counters['n']} requests failed across "
+            f"{publisher.publishes} publications"
+        )
+        # -- freshness burn is green at the end -----------------------------
+        rep = loop.slo_engine.tick()
+        assert rep["online-freshness"]["status"] == "green", rep
+        assert publisher.failures == 0 or (
+            publisher.publishes >= 3 * publisher.failures
+        )
+        # -- the autoscaler held the floor ----------------------------------
+        assert len(sup.charges) >= 2, "autoscaler shrank below min_replicas"
+    finally:
+        stop_traffic.set()
+        loop.stop()
+        stream.close()
+        sup.stop()
+        gw.stop()
+        reg.stop()
+        # same hygiene as the PR-5 soak: this floods process-global obs
+        # state (freshness histograms, online counters, exemplars) that
+        # later in-process smoke gates must not inherit
+        obs.reset()
+
+
 # -- chaos smoke through the deployed-fleet client ---------------------------
 
 

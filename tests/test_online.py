@@ -1,0 +1,777 @@
+"""Continuous-learning subsystem (mmlspark_tpu/online/): feedback stream,
+incremental trainer, zero-drop publication, freshness SLO, autoscaler,
+registry HA, and the smoke freshness gate.
+
+The load-bearing guarantees pinned here:
+
+- **warm-start bit-identity** — chunked online training carries the FULL
+  optimizer state, so it equals one batch retrain over the same rows
+  bit-for-bit (unsharded, chunk sizes multiple of the minibatch);
+- **zero-drop publication** — publishing rides the ModelStore hot-swap
+  path, so sustained serving traffic sees no failed request across
+  consecutive version flips;
+- **publish-under-fault rollback** — a failed publication leaves the
+  serving alias untouched and the freshness watermark pending, so the
+  next success honestly reports the outage in its freshness;
+- **autoscaler hysteresis** — scale-out on shed/utilization/red-burn
+  with a cooldown, scale-in only on sustained idle, floors/caps hold.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.faults import FaultPlan
+
+
+def _sparse_chunk(rng, n, bits, seed_labels=None):
+    rows = np.empty(n, dtype=object)
+    for r in range(n):
+        k = int(rng.integers(2, 7))
+        rows[r] = {
+            "i": rng.integers(0, 1 << bits, size=k).astype(np.int64),
+            "v": rng.normal(size=k).astype(np.float32),
+        }
+    labels = (
+        seed_labels if seed_labels is not None
+        else rng.integers(0, 2, size=n).astype(np.float64)
+    )
+    return DataFrame.from_dict({"features": rows, "label": labels})
+
+
+# -- feedback stream ---------------------------------------------------------
+
+
+def test_feedback_stream_pull_generator_stamps_and_exhausts():
+    from mmlspark_tpu.online import FeedbackStream
+
+    rng = np.random.default_rng(0)
+    stream = FeedbackStream.from_generator(
+        lambda i: _sparse_chunk(rng, 4, 10) if i < 3 else None
+    )
+    seen = 0
+    while True:
+        item = stream.poll(timeout_s=0.0)
+        if item is None:
+            break
+        ts, chunk = item
+        assert isinstance(ts, float) and len(chunk) == 4
+        seen += len(chunk)
+    assert seen == 12
+    assert stream.exhausted
+    assert stream.ingested == 12
+
+
+def test_feedback_stream_push_bound_drops_oldest():
+    from mmlspark_tpu.online import FeedbackStream
+
+    stream = FeedbackStream(max_chunks=2)
+    for tag in ("a", "b", "c"):
+        stream.push(DataFrame.from_dict({"tag": np.array([tag], object)}))
+    assert stream.depth() == 2
+    assert stream.dropped == 1
+    _, first = stream.poll(0.0)
+    # freshest-wins: the OLDEST chunk ("a") was shed, "b" survives
+    assert first["tag"][0] == "b"
+
+
+def test_feedback_http_ingest_and_fault_refusal():
+    from mmlspark_tpu.online import FeedbackStream
+
+    stream = FeedbackStream()
+    info = stream.serve(host="127.0.0.1", port=0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", info.port, timeout=5)
+        body = json.dumps({"rows": [
+            {"i": [1, 2], "v": [1.0, 0.5], "label": 1},
+            {"i": [3], "v": [2.0], "label": 0},
+        ]})
+        conn.request("POST", "/ingest", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200 and out["accepted"] == 2
+        # /health answers without consuming the buffer
+        conn.request("GET", "/health")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["buffered_chunks"] == 1
+        # injected ingest fault: the producer sees 503, nothing buffers
+        plan = FaultPlan().on("online.ingest", error=ConnectionError, at=(0,))
+        with plan.armed():
+            conn.request("POST", "/ingest", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+        assert resp.status == 503
+        assert stream.depth() == 1
+        assert plan.fires() == [("online.ingest", 0)]
+        # malformed rows refuse without killing the ingress
+        conn.request("POST", "/ingest", body=b'{"rows": []}',
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 503
+        conn.close()
+        ts, chunk = stream.poll(0.0)
+        assert len(chunk) == 2 and chunk["label"][1] == 0
+    finally:
+        stream.close()
+
+
+def test_feedback_pull_fault_refuses_without_losing_the_chunk():
+    """online.ingest on the PULL path fires before the draw: the refused
+    chunk stays in the iterator and the next poll delivers it — chaos
+    must never silently lose examples."""
+    from mmlspark_tpu.online import FeedbackStream
+
+    chunks = [DataFrame.from_dict({"x": np.array([i])}) for i in range(2)]
+    stream = FeedbackStream.from_generator(
+        lambda i: chunks[i] if i < 2 else None
+    )
+    plan = FaultPlan().on("online.ingest", error=ConnectionError, at=(0,))
+    with plan.armed():
+        with pytest.raises(ConnectionError):
+            stream.poll(0.0)
+        _, first = stream.poll(0.0)
+    assert first["x"][0] == 0  # the refused chunk was retried, not lost
+    assert stream.ingested == 1
+
+
+def test_streaming_materialize_on_unbounded_source_stops_at_cap():
+    """The satellite contract FeedbackStream's tests need: materialize
+    must stop PULLING an unbounded source once max_rows are buffered —
+    draining the iterator would hang forever on a live feedback feed."""
+    from mmlspark_tpu.io.stream import StreamingDataFrame
+
+    pulls = {"n": 0}
+
+    def make_chunk(i):  # unbounded: never returns None
+        pulls["n"] += 1
+        return DataFrame.from_dict({"x": np.arange(4) + i * 4})
+
+    sdf = StreamingDataFrame.from_generator(make_chunk)
+    df = sdf.materialize(max_rows=10)
+    assert len(df) == 10
+    assert list(df["x"]) == list(range(10))
+    assert pulls["n"] == 3  # ceil(10/4) chunks, not one more
+    # max_rows=0: nothing is pulled at all
+    pulls["n"] = 0
+    empty = sdf.materialize(max_rows=0)
+    assert len(empty) == 0 and pulls["n"] == 0
+
+
+# -- trainer -----------------------------------------------------------------
+
+
+def test_trainer_warm_start_bit_identity_vs_batch_retrain():
+    from mmlspark_tpu.online import OnlineTrainer
+
+    bits, batch = 11, 32
+    rng = np.random.default_rng(7)
+    full = _sparse_chunk(rng, 192, bits)
+    # the SAME rows, fed as 3 chunks of 64 (multiples of the minibatch)
+    chunks = [
+        DataFrame.from_dict({
+            "features": full["features"][lo:lo + 64],
+            "label": full["label"][lo:lo + 64],
+        })
+        for lo in range(0, 192, 64)
+    ]
+    online = OnlineTrainer(num_bits=bits, batch=batch)
+    for c in chunks:
+        online.step(c)
+    batch_trainer = OnlineTrainer(num_bits=bits, batch=batch)
+    batch_trainer.step(full)
+    assert online.examples == batch_trainer.examples == 192
+    assert np.array_equal(online.weights_host(), batch_trainer.weights_host())
+    # and the full state matches, not just the weights
+    assert np.array_equal(
+        np.asarray(online.state.g2), np.asarray(batch_trainer.state.g2)
+    )
+    assert float(online.state.t) == float(batch_trainer.state.t)
+
+
+def test_trainer_text_column_and_model_snapshot():
+    from mmlspark_tpu.online import OnlineTrainer
+
+    rng = np.random.default_rng(3)
+    texts = np.array(
+        [" ".join(rng.choice(["spam", "ham", "eggs", "nau"], size=5))
+         for _ in range(64)],
+        dtype=object,
+    )
+    labels = np.array([1.0 if "spam" in t else 0.0 for t in texts])
+    trainer = OnlineTrainer(num_bits=10, batch=32, text_col="text")
+    trained = trainer.step(DataFrame.from_dict({"text": texts, "label": labels}))
+    assert trained == 64
+    w = trainer.weights_host()
+    assert (w != 0).any()
+    model = trainer.to_model()
+    scored = model.transform(
+        trainer._featurizer.transform(DataFrame.from_dict({"text": texts}))
+    )
+    assert set(np.unique(scored["prediction"])) <= {0.0, 1.0}
+
+
+# -- publication -------------------------------------------------------------
+
+
+def test_publisher_zero_drop_across_consecutive_publications(tmp_path):
+    from mmlspark_tpu.online import OnlineTrainer, Publisher
+    from mmlspark_tpu.serving.modelstore import ModelDispatcher, ModelStore
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    bits = 10
+    rng = np.random.default_rng(1)
+    trainer = OnlineTrainer(num_bits=bits, batch=32)
+    store = ModelStore()
+    pub = Publisher(model="vw-online", snapshot_dir=str(tmp_path), store=store)
+    trainer.step(_sparse_chunk(rng, 64, bits))
+    pub.publish(trainer, oldest_ts=time.monotonic() - 0.1)
+    srv = WorkerServer()
+    info = srv.start()
+    disp = ModelDispatcher(srv, store, default_model="vw-online").start()
+    counters = {"ok": 0, "bad": 0}
+    stop = threading.Event()
+
+    def traffic():
+        conn = http.client.HTTPConnection("127.0.0.1", info.port, timeout=5)
+        payload = json.dumps({"i": [1, 2], "v": [0.5, -0.5]})
+        while not stop.is_set():
+            conn.request("POST", "/", body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            counters["ok" if resp.status == 200 else "bad"] += 1
+            time.sleep(0.001)
+        conn.close()
+
+    t = threading.Thread(target=traffic)
+    try:
+        t.start()
+        for _ in range(3):  # >= 3 consecutive publications under traffic
+            trainer.step(_sparse_chunk(rng, 64, bits))
+            pub.publish(trainer, oldest_ts=time.monotonic() - 0.05)
+            time.sleep(0.1)
+    finally:
+        stop.set()
+        t.join(5.0)
+        disp.stop()
+        srv.stop()
+    assert pub.publishes == 4
+    assert counters["ok"] > 50, "traffic never flowed"
+    assert counters["bad"] == 0, f"{counters['bad']} requests failed mid-swap"
+    assert len(pub.freshness_history) == 4
+    assert all(f >= 0 for f in pub.freshness_history)
+    # old versions drained and evicted; only the serving version resident
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        resident = [
+            v for v in store.models()["vw-online"]["versions"]
+            if v["state"] in ("ready", "warming")
+        ]
+        if len(resident) == 1:
+            break
+        time.sleep(0.05)
+    assert len(resident) == 1
+    # snapshot pruning keeps the artifact dir bounded
+    snaps = [p for p in tmp_path.iterdir() if p.suffix == ".npz"]
+    assert len(snaps) <= pub.keep_snapshots
+
+
+def test_publish_under_fault_rolls_back_and_recovers(tmp_path):
+    from mmlspark_tpu.online import OnlineTrainer, PublishError, Publisher
+    from mmlspark_tpu.serving.modelstore import ModelStore
+
+    bits = 10
+    rng = np.random.default_rng(2)
+    trainer = OnlineTrainer(num_bits=bits, batch=32)
+    store = ModelStore()
+    pub = Publisher(model="m", snapshot_dir=str(tmp_path), store=store)
+    trainer.step(_sparse_chunk(rng, 32, bits))
+    pub.publish(trainer)
+    v1 = store.serving_version("m")
+    assert v1 is not None
+    # the plan's per-point step counter starts at arming: the NEXT
+    # publish is step 0
+    plan = FaultPlan().on("online.publish", error=OSError, at=(0,))
+    with plan.armed():
+        trainer.step(_sparse_chunk(rng, 32, bits))
+        with pytest.raises(PublishError):
+            pub.publish(trainer)
+        # rollback: the alias never moved, serving is undisturbed
+        assert store.serving_version("m") == v1
+        assert pub.failures == 1 and pub.publishes == 1
+        # the next attempt (fault spent) succeeds and flips
+        pub.publish(trainer)
+    assert store.serving_version("m") != v1
+    assert pub.publishes == 2
+
+
+def test_loop_keeps_watermark_through_failed_publish(tmp_path):
+    """A failed publication must NOT advance the freshness watermark:
+    the next success reports freshness covering the outage."""
+    from mmlspark_tpu.online import (
+        FeedbackStream, OnlineLearningLoop, OnlineTrainer, Publisher,
+    )
+    from mmlspark_tpu.serving.modelstore import ModelStore
+
+    bits = 10
+    rng = np.random.default_rng(4)
+    clock = {"t": 100.0}
+    stream = FeedbackStream(time_fn=lambda: clock["t"])
+    trainer = OnlineTrainer(num_bits=bits, batch=32)
+    store = ModelStore()
+    pub = Publisher(
+        model="m", snapshot_dir=str(tmp_path), store=store,
+        time_fn=lambda: clock["t"],
+    )
+    loop = OnlineLearningLoop(
+        stream, trainer, pub, publish_every_s=0.0, poll_s=0.0,
+        time_fn=lambda: clock["t"],
+    )
+    stream.push(_sparse_chunk(rng, 32, bits))  # ingested at t=100
+    plan = FaultPlan().on("online.publish", error=OSError, at=(0,))
+    with plan.armed():
+        clock["t"] = 101.0
+        loop._tick()  # trains, publish attempt fails at t=101
+    assert pub.failures == 1 and pub.publishes == 0
+    clock["t"] = 105.0
+    loop._tick()  # retried: succeeds at t=105
+    assert pub.publishes == 1
+    # freshness spans back to the ORIGINAL ingest, not the retry
+    assert pub.freshness_history[-1] == pytest.approx(5.0)
+
+
+# -- vw: loader spec ---------------------------------------------------------
+
+
+def test_vw_loader_spec_contract(tmp_path):
+    from mmlspark_tpu.online import OnlineTrainer, Publisher
+    from mmlspark_tpu.serving.modelstore import build_loaded_model
+    from mmlspark_tpu.serving.modelstore.loaders import model_name_from_spec
+    from mmlspark_tpu.serving.server import CachedRequest
+    from mmlspark_tpu.vw.estimators import _append_constant
+    from mmlspark_tpu.vw.learner import predict_margin
+
+    bits = 10
+    rng = np.random.default_rng(5)
+    trainer = OnlineTrainer(num_bits=bits, batch=32)
+    trainer.step(_sparse_chunk(rng, 64, bits))
+    pub = Publisher(
+        model="vw-online", snapshot_dir=str(tmp_path),
+        worker_urls=["http://127.0.0.1:1/"],  # never reached: snapshot only
+    )
+    pub.seq = 6
+    path = pub._write_snapshot(trainer)
+    assert path.endswith("vw-online-v000006.npz")
+    assert model_name_from_spec(f"vw:{path}") == "vw-online"
+    # only the Publisher's exact -v%06d suffix strips: a hand-named
+    # snapshot keeps its full name (gateway routing depends on it)
+    assert model_name_from_spec("vw:/s/fraud-v2.npz") == "fraud-v2"
+    loaded = build_loaded_model(f"vw:{path}")
+    assert loaded.nbytes == (1 << bits) * 4
+    loaded.warmup()
+
+    def score(body):
+        req = CachedRequest(
+            id="r", epoch=0, method="POST", path="/", headers={},
+            body=json.dumps(body).encode(),
+        )
+        return loaded.handler([req])["r"]
+
+    code, payload, _ = score({"i": [3, 7], "v": [1.0, -2.0]})
+    assert code == 200
+    got = json.loads(payload)
+    idx, val = _append_constant(
+        np.array([[3, 7]], np.int64), np.array([[1.0, -2.0]], np.float32),
+        bits,
+    )
+    want = float(predict_margin(idx, val, trainer.weights_host())[0])
+    assert got["margin"] == pytest.approx(want, rel=1e-6)
+    assert got["probability"] == pytest.approx(
+        1.0 / (1.0 + np.exp(-want)), rel=1e-6
+    )
+    # rows batch contract + per-row isolation of malformed input
+    code, payload, _ = score({"rows": [
+        {"i": [1], "v": [1.0]}, {"i": [2], "v": [2.0]},
+    ]})
+    assert code == 200 and len(json.loads(payload)["rows"]) == 2
+    code, _payload, _ = score({"oops": 1})
+    assert code == 400
+
+
+# -- autoscaler --------------------------------------------------------------
+
+
+def _scaler(**kw):
+    from mmlspark_tpu.online import Autoscaler
+
+    clock = {"t": 0.0}
+    defaults = dict(
+        min_replicas=1, max_replicas=3, scale_out_cooldown_s=10.0,
+        scale_in_cooldown_s=20.0, idle_after_s=30.0,
+        time_fn=lambda: clock["t"],
+    )
+    defaults.update(kw)
+    return Autoscaler(**defaults), clock
+
+
+def test_autoscaler_scale_out_hysteresis_and_cap():
+    from mmlspark_tpu.online import ScaleSignals
+
+    asc, clock = _scaler()
+    overload = ScaleSignals(shed_delta=3.0)
+    n, why = asc.decide(1, overload)
+    assert n == 2 and "shed" in why
+    # inside the cooldown: overload persists, but no flap
+    clock["t"] = 5.0
+    assert asc.decide(2, overload)[0] == 2
+    clock["t"] = 15.0
+    assert asc.decide(2, overload)[0] == 3
+    # at the cap: overload can't push past max_replicas
+    clock["t"] = 30.0
+    assert asc.decide(3, overload)[0] == 3
+
+
+def test_autoscaler_scale_in_requires_sustained_idle():
+    from mmlspark_tpu.online import ScaleSignals
+
+    asc, clock = _scaler(scale_in_cooldown_s=0.0)
+    idle = ScaleSignals()
+    # idle but not SUSTAINED: the window hasn't elapsed
+    clock["t"] = 10.0
+    assert asc.decide(3, idle)[0] == 3
+    clock["t"] = 31.0
+    n, why = asc.decide(3, idle)
+    assert n == 2 and why == "sustained idle"
+    # one reap per idle window — the clock reset on the scale event
+    clock["t"] = 40.0
+    assert asc.decide(2, idle)[0] == 2
+    clock["t"] = 62.0
+    assert asc.decide(2, idle)[0] == 1
+    # floor: never below min_replicas
+    clock["t"] = 120.0
+    assert asc.decide(1, idle)[0] == 1
+
+
+def test_autoscaler_activity_and_utilization_signals():
+    from mmlspark_tpu.obs import slo
+    from mmlspark_tpu.online import ScaleSignals
+
+    asc, clock = _scaler()
+    # busy traffic resets the idle clock even without overload
+    clock["t"] = 31.0
+    assert asc.decide(2, ScaleSignals(accepted_delta=50.0))[0] == 2
+    clock["t"] = 45.0  # only 14 s idle since the busy tick
+    assert asc.decide(2, ScaleSignals())[0] == 2
+    # utilization >= threshold scales out; red SLO burn does too
+    n, why = asc.decide(2, ScaleSignals(inflight=17, limit=20))
+    assert n == 3 and "utilization" in why
+    asc2, clock2 = _scaler()
+    n, why = asc2.decide(1, ScaleSignals(slo_status=slo.RED))
+    assert n == 2 and why == "slo red"
+    # yellow alone does not (burn < page-now keeps the fleet steady)
+    asc3, _ = _scaler()
+    assert asc3.decide(1, ScaleSignals(slo_status=slo.YELLOW))[0] == 1
+
+
+@pytest.mark.xdist_group("latency")
+def test_supervisor_autoscale_spawns_and_reaps_only_its_own():
+    import sys as _sys
+
+    from mmlspark_tpu.online import Autoscaler, ScaleSignals
+    from mmlspark_tpu.serving.supervisor import FleetSupervisor, WorkerCharge
+
+    clock = {"t": 0.0}
+    signals = {"cur": ScaleSignals(shed_delta=1.0)}
+    asc = Autoscaler(
+        min_replicas=1, max_replicas=2, scale_out_cooldown_s=0.0,
+        scale_in_cooldown_s=0.0, idle_after_s=0.5,
+        time_fn=lambda: clock["t"],
+    )
+    operator_charge = WorkerCharge(
+        [_sys.executable, "-c", "import time; time.sleep(60)"], name="op-0"
+    )
+    sup = FleetSupervisor(
+        [operator_charge], probe_s=0.05, autoscaler=asc,
+        worker_template="--model echo",
+        signals_fn=lambda: signals["cur"],
+        spawn=lambda argv: __import__("subprocess").Popen(
+            [_sys.executable, "-c", "import time; time.sleep(60)"]
+        ),
+    ).start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while len(sup.charges) < 2 and time.monotonic() < deadline:
+            clock["t"] += 1.0
+            time.sleep(0.05)
+        assert len(sup.charges) == 2, "overload never spawned a replica"
+        assert sup.charges[1].name.startswith("autoscaled-")
+        # sustained idle reaps the autoscaled replica, not the operator's
+        signals["cur"] = ScaleSignals()
+        deadline = time.monotonic() + 5.0
+        while len(sup.charges) > 1 and time.monotonic() < deadline:
+            clock["t"] += 1.0
+            time.sleep(0.05)
+        assert [c.name for c in sup.charges] == ["op-0"]
+        # at the floor, idle forever never reaps the operator charge
+        clock["t"] += 100.0
+        time.sleep(0.2)
+        assert len(sup.charges) == 1
+    finally:
+        sup.stop()
+
+
+@pytest.mark.xdist_group("latency")
+def test_supervisor_autoscale_fault_point_suppresses_event():
+    import sys as _sys
+
+    from mmlspark_tpu.online import Autoscaler, ScaleSignals
+    from mmlspark_tpu.serving.supervisor import FleetSupervisor, WorkerCharge
+
+    asc = Autoscaler(
+        min_replicas=1, max_replicas=2, scale_out_cooldown_s=0.0,
+    )
+    c = WorkerCharge(
+        [_sys.executable, "-c", "import time; time.sleep(60)"], name="op-0"
+    )
+    sup = FleetSupervisor(
+        [c], probe_s=0.05, autoscaler=asc, worker_template="--model echo",
+        signals_fn=lambda: ScaleSignals(shed_delta=1.0),
+        spawn=lambda argv: __import__("subprocess").Popen(
+            [_sys.executable, "-c", "import time; time.sleep(60)"]
+        ),
+    )
+    plan = FaultPlan().on("autoscaler.scale", error=RuntimeError, at=(0,))
+    with plan.armed():
+        sup.start()
+        deadline = time.monotonic() + 5.0
+        while len(sup.charges) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+    try:
+        # the first scale-out was refused (chaos), a later tick landed it
+        assert len(sup.charges) == 2
+        assert ("autoscaler.scale", 0) in plan.fires()
+    finally:
+        sup.stop()
+
+
+# -- freshness SLO -----------------------------------------------------------
+
+
+def test_freshness_slo_target_goes_red_on_stale_publications():
+    from mmlspark_tpu.obs import slo
+
+    target = slo.freshness_target(budget_ms=1000.0, availability=0.95)
+    assert target.budget == pytest.approx(0.05)
+
+    def parsed(attempts, failures, le_half, le_one, inf):
+        return {
+            ("mmlspark_online_publish_attempts_total", ()): float(attempts),
+            ("mmlspark_online_publish_failures_total", ()): float(failures),
+            ("mmlspark_online_freshness_seconds_bucket",
+             (("le", "0.5"),)): float(le_half),
+            ("mmlspark_online_freshness_seconds_bucket",
+             (("le", "1.0"),)): float(le_one),
+            ("mmlspark_online_freshness_seconds_bucket",
+             (("le", "+Inf"),)): float(inf),
+        }
+
+    # publications all within the 1 s budget: green
+    engine = slo.SLOEngine([target], interval_s=1.0)
+    engine.tick(parsed(10, 0, 10, 10, 10), now=0.0)
+    rep = engine.tick(parsed(20, 0, 20, 20, 20), now=60.0)
+    assert rep[target.name]["status"] == "green"
+    # publication falls behind: 10 new publications ALL over budget ->
+    # bad fraction 1.0 against a 5% budget = burn 20 >= page-now 14.4
+    engine2 = slo.SLOEngine([target], interval_s=1.0)
+    engine2.tick(parsed(10, 0, 10, 10, 10), now=0.0)
+    rep = engine2.tick(parsed(20, 0, 10, 10, 20), now=60.0)
+    assert rep[target.name]["burn"]["5m"] >= slo.RED_BURN
+    assert rep[target.name]["status"] == "red"
+    # outright publish failures burn the same budget
+    engine3 = slo.SLOEngine([target], interval_s=1.0)
+    engine3.tick(parsed(10, 0, 10, 10, 10), now=0.0)
+    rep = engine3.tick(parsed(20, 10, 10, 10, 10), now=60.0)
+    assert rep[target.name]["status"] == "red"
+
+
+def test_smoke_freshness_gate_verdicts():
+    from tools.deploy import smoke
+
+    def parsed(ingested, attempts, published, slo_status=None):
+        out = {
+            ("mmlspark_online_ingested_total", ()): float(ingested),
+            ("mmlspark_online_publish_attempts_total", ()): float(attempts),
+            ("mmlspark_online_freshness_seconds_count", ()): float(published),
+        }
+        if slo_status is not None:
+            out[(
+                "mmlspark_slo_status_count", (("slo", "online-freshness"),)
+            )] = float(slo_status)
+        return out
+
+    # idle loop: skip, not fail
+    assert smoke._freshness_ok(parsed(0, 0, 0), "u")
+    # just started: ingesting, first publish interval not yet elapsed —
+    # skip (a deploy smoke must not flake on a healthy cold start)
+    assert smoke._freshness_ok(parsed(100, 0, 0), "u")
+    # publishing and green: ok
+    assert smoke._freshness_ok(parsed(100, 3, 3, slo_status=0), "u")
+    # attempted but never succeeded: a real failure
+    assert not smoke._freshness_ok(parsed(100, 2, 0), "u")
+    # red freshness burn: fail
+    assert not smoke._freshness_ok(parsed(100, 5, 5, slo_status=2), "u")
+    # no slo gauge at all: presence suffices
+    assert smoke._freshness_ok(parsed(100, 5, 5), "u")
+
+
+# -- registry HA (satellite) -------------------------------------------------
+
+
+@pytest.mark.xdist_group("latency")
+def test_registry_ha_worker_heartbeats_all_gateway_fails_over():
+    from mmlspark_tpu.serving import fleet
+    from mmlspark_tpu.serving.distributed import ServingGateway
+
+    reg_a = fleet.run_registry(host="127.0.0.1", port=0)
+    reg_b = fleet.run_registry(host="127.0.0.1", port=0)
+    multi = f"{reg_a.url},{reg_b.url}"
+    srv, q, stop = fleet.run_worker(
+        multi, model="echo", host="127.0.0.1", heartbeat_s=0.2
+    )
+    gw = None
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not (
+            reg_a.services("serving") and reg_b.services("serving")
+        ):
+            time.sleep(0.05)
+        # the worker heartbeats to BOTH registries
+        assert len(reg_a.services("serving")) == 1
+        assert len(reg_b.services("serving")) == 1
+        # gateway: first registry is dead on arrival -> fails over
+        dead = "http://127.0.0.1:9/"
+        gw = ServingGateway(
+            registry_url=f"{dead},{reg_a.url}", refresh_s=0.1,
+        )
+        ginfo = gw.start()
+        deadline = time.monotonic() + 5.0
+        while gw.pool.size() < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert gw.pool.size() == 1
+        conn = http.client.HTTPConnection("127.0.0.1", ginfo.port, timeout=5)
+        conn.request("POST", "/", body=json.dumps({"x": 1}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200 and body["echo"]["x"] == 1
+        # registry A dies mid-flight: refreshes fail over to B
+        gw._registry_urls = [dead, reg_b.url]
+        reg_a.stop()
+        time.sleep(0.3)
+        gw._refresh_once()
+        assert gw.pool.size() == 1
+        # clean worker shutdown deregisters from every live registry
+        stop.stop()
+        assert reg_b.services("serving") == []
+    finally:
+        if gw is not None:
+            gw.stop()
+        q.stop()
+        srv.stop()
+        try:
+            reg_a.stop()
+        except Exception:  # noqa: BLE001 — already stopped mid-test
+            pass
+        reg_b.stop()
+
+
+# -- fleet online role -------------------------------------------------------
+
+
+@pytest.mark.xdist_group("latency")
+def test_fleet_online_role_publishes_to_rostered_workers(tmp_path):
+    """The whole fleet path in-process: HTTP ingest -> loop -> remote
+    publication through a rostered worker's control plane -> the worker
+    serves the fresh model."""
+    from mmlspark_tpu.serving import fleet
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0)
+    srv, q, wstop = fleet.run_worker(
+        reg.url, model="echo", host="127.0.0.1", heartbeat_s=0.2
+    )
+    stream = loop = ostop = None
+    try:
+        stream, loop, ostop = fleet.run_online(
+            registry_url=reg.url, model="vw-online", host="127.0.0.1",
+            snapshot_dir=str(tmp_path), publish_every_s=0.2,
+            freshness_slo_ms=10_000.0, num_bits=10, batch=32,
+            heartbeat_s=0.2,
+        )
+        # the online loop heartbeats under <service>-online
+        deadline = time.monotonic() + 5.0
+        while not reg.services("serving-online") and (
+            time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert len(reg.services("serving-online")) == 1
+        rng = np.random.default_rng(9)
+        ingest_info = stream._ingress
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", ingest_info.port, timeout=5
+        )
+        rows = [
+            {"i": rng.integers(0, 1 << 10, size=3).tolist(),
+             "v": rng.normal(size=3).tolist(),
+             "label": int(rng.integers(0, 2))}
+            for _ in range(64)
+        ]
+        conn.request("POST", "/ingest", body=json.dumps({"rows": rows}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 200
+        conn.close()
+        # within a couple of publish intervals the WORKER serves vw-online
+        deadline = time.monotonic() + 15.0
+        scored = None
+        while time.monotonic() < deadline:
+            try:
+                wconn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=5
+                )
+                wconn.request(
+                    "POST", "/models/vw-online",
+                    body=json.dumps({"i": [1], "v": [1.0]}),
+                    headers={"Content-Type": "application/json"},
+                )
+                wresp = wconn.getresponse()
+                payload = wresp.read()
+                wconn.close()
+                if wresp.status == 200:
+                    scored = json.loads(payload)
+                    break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        assert scored is not None, "worker never served the published model"
+        assert "margin" in scored
+        assert loop.stats()["publishes"] >= 1
+    finally:
+        if ostop is not None:
+            ostop.stop()
+        wstop.stop()
+        q.stop()
+        srv.stop()
+        reg.stop()

@@ -28,6 +28,12 @@ gateway's own process (a fleet role armed with ``--fault-plan``), a
 breaker must additionally have OPENED at least once — proof the
 containment layer reacts to chaos rather than sleeping through it.
 
+Freshness gate (default on): when a continuous-learning loop is
+rostered under ``<service>-online`` (or the target itself exports
+``mmlspark_online_*`` metrics), its freshness histogram must have
+recorded a publication and its freshness SLO burn must not be red —
+fleets without online learning skip (docs/online-learning.md).
+
 Chaos smoke (``--fault-plan``): arm a deterministic fault plan
 (mmlspark_tpu/core/faults.py) in THIS client and route every request
 through the framework's retrying AdvancedHandler instead of a bare
@@ -469,6 +475,95 @@ def _verify_slo(url: str) -> bool:
     return ok
 
 
+def _freshness_ok(parsed: dict, where: str) -> bool:
+    """One online endpoint's freshness verdict (pure — unit-testable):
+    the freshness histogram must have recorded at least one publication
+    and the freshness SLO status (any ``mmlspark_slo_status_count``
+    whose target name contains 'freshness') must not be red. A loop
+    that has not ATTEMPTED a publication yet skips rather than failing
+    — idle (nothing ingested) or just-started (first publish interval
+    not elapsed) are both healthy; attempted-but-never-succeeded is
+    the real failure (the loop exists and cannot make models
+    servable — the failure counter and a red burn carry the evidence).
+    """
+    _ensure_repo_path()
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.obs import slo as slo_mod
+
+    ingested = obs.sum_samples(parsed, "mmlspark_online_ingested_total")
+    attempts = obs.sum_samples(
+        parsed, "mmlspark_online_publish_attempts_total"
+    )
+    published = obs.sum_samples(
+        parsed, "mmlspark_online_freshness_seconds_count"
+    )
+    if attempts == 0:
+        why = (
+            "idle (nothing ingested)" if ingested == 0
+            else "no publication due yet"
+        )
+        print(f"smoke: online loop at {where} is {why}; "
+              "freshness gate skipped for it")
+        return True
+    status = None
+    for (name, labels), v in parsed.items():
+        if name == "mmlspark_slo_status_count" and (
+            "freshness" in dict(labels).get("slo", "")
+        ):
+            status = max(status or 0, int(v))
+    present = published >= 1
+    non_red = status is None or status < slo_mod.RED
+    verdict = (
+        "ok" if present and non_red
+        else "MISMATCH (no publication recorded)" if not present
+        else "MISMATCH (freshness burn is RED)"
+    )
+    status_str = (
+        slo_mod.STATUS_NAMES.get(status, "?") if status is not None
+        else "no-slo-gauge"
+    )
+    print(
+        f"smoke: freshness at {where} — {published:.0f} publication(s) "
+        f"measured, slo {status_str} — {verdict}"
+    )
+    return present and non_red
+
+
+def _verify_freshness(url: str, registry_url, service: str) -> bool:
+    """Freshness gate (default on): when a continuous-learning loop is
+    rostered under ``<service>-online`` (or the smoke target itself
+    exports ``mmlspark_online_*`` metrics), its freshness histogram
+    must be present and its freshness SLO non-red; fleets without an
+    online loop skip — the gate never fails a deployment for not doing
+    continuous learning (docs/online-learning.md)."""
+    _ensure_repo_path()
+    from mmlspark_tpu.serving.fleet import (
+        scrape_metrics, worker_urls_from_registry,
+    )
+
+    candidates: list = []
+    if registry_url:
+        try:
+            for u in worker_urls_from_registry(
+                registry_url, f"{service}-online"
+            ):
+                candidates.append(u)
+        except Exception as e:  # noqa: BLE001 — gate degrades, smoke goes on
+            print(f"smoke: registry unavailable for freshness gate ({e})")
+    target = scrape_metrics(url)
+    parsed_by_url = {u: scrape_metrics(u) for u in candidates}
+    if target is not None and any(
+        name == "mmlspark_online_publish_attempts_total"
+        for (name, _labels) in target
+    ) and url not in parsed_by_url:
+        parsed_by_url[url] = target  # co-located loop (in-process fleets)
+    live = {u: p for u, p in parsed_by_url.items() if p is not None}
+    if not live:
+        print("smoke: no online loop rostered; skipping freshness gate")
+        return True
+    return all(_freshness_ok(p, u) for u, p in live.items())
+
+
 def _count_fault_records() -> int:
     _ensure_repo_path()
     from mmlspark_tpu.obs.flightrec import FLIGHT
@@ -593,6 +688,9 @@ def main(argv=None) -> int:
         )
         metrics_ok = _verify_slo(args.url) and metrics_ok
         metrics_ok = _verify_containment(before, after, plan) and metrics_ok
+        metrics_ok = _verify_freshness(
+            args.url, args.registry, args.service_name
+        ) and metrics_ok
     trace_ok = True
     if not args.no_verify_trace:
         trace_ok = _verify_trace(args.url, args.registry, args.service_name)
