@@ -62,7 +62,7 @@ SEGMENT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_SEGMENT_TIMEOUT", "200"))
 # A raised MMLSPARK_BENCH_SEGMENT_TIMEOUT still wins (max() at use); the
 # phase deadline caps everything regardless.
 SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280,
-                    "pipeline": 240, "freshness": 240}
+                    "pipeline": 240, "freshness": 240, "elastic": 240}
 
 # Canonical segment set. Two orders, learned the hard way:
 # - On the TPU attempt, spend the chip's uncertain lifetime on the
@@ -73,9 +73,11 @@ SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280,
 #   out of the CPU child identically.
 # - On the CPU fallback, cheap-first so a late death costs least.
 SEGMENTS = ["serving", "modelstore", "tracing", "overload", "freshness",
-            "pipeline", "hist", "vw", "gbdt", "sklearn", "featurizer"]
+            "elastic", "pipeline", "hist", "vw", "gbdt", "sklearn",
+            "featurizer"]
 TPU_ORDER = ["sklearn", "gbdt", "hist", "featurizer", "pipeline", "vw",
-             "serving", "modelstore", "tracing", "overload", "freshness"]
+             "serving", "modelstore", "tracing", "overload", "freshness",
+             "elastic"]
 CPU_ORDER = SEGMENTS
 
 
@@ -1091,6 +1093,130 @@ def _seg_pipeline(on_accel: bool, n_dev: int) -> dict:
     }
 
 
+def _seg_elastic(on_accel: bool, n_dev: int) -> dict:
+    """Elastic self-healing training (parallel/elastic.py): a real 2-host
+    gang (subprocess trainers, TCP histogram allreduce, shared checkpoint
+    dir) with one host SIGKILLed mid-round. Records the recovery story as
+    numbers: host-loss detection latency, reshard-to-first-new-round
+    time, kill-to-completion wall, and the per-round throughput retained
+    after the shrink (world 2 -> world 1). Runs on CPU subprocesses on
+    every backend — the elastic plane is host-side by design."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    from mmlspark_tpu.serving import fleet
+
+    out: dict = {}
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=1.2)
+    work = tempfile.mkdtemp(prefix="bench-elastic-")
+    ck = os.path.join(work, "ck")
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("PYTHONPATH", "PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
+                     "XLA_FLAGS")
+    }
+    env.update(
+        JAX_PLATFORMS="cpu", PYTHONPATH=HERE,
+        JAX_COMPILATION_CACHE_DIR=CACHE_DIR,
+    )
+    stall_round = 12
+    train_args = [
+        "--data", "synth:4000x16:7", "--partitions", "8",
+        "--num-iterations", "24", "--num-leaves", "15",
+        "--min-data-in-leaf", "5", "--seed", "3",
+        "--checkpoint-every", "2", "--heartbeat-s", "0.25",
+        "--no-growback",
+    ]
+
+    def spawn(name: str, fault: str = None) -> subprocess.Popen:
+        argv = [sys.executable, "-m", "mmlspark_tpu.serving.fleet"]
+        if fault:
+            argv += ["--fault-plan", fault]
+        argv += [
+            "train", "--registry", reg.url, "--name", name,
+            "--ckpt-dir", ck, "--world-size", "2",
+            "--status-file", os.path.join(work, f"{name}.json"),
+            *train_args,
+        ]
+        return subprocess.Popen(
+            argv, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True,
+        )
+
+    surv = vict = None
+    try:
+        fault = _json.dumps({"rules": [
+            {"point": "gbdt.round", "at": [stall_round], "delay_s": 600},
+        ]})
+        surv = spawn("a")
+        vict = spawn("b", fault=fault)
+        latest = os.path.join(ck, "LATEST")
+        deadline = time.monotonic() + 150.0
+        while time.monotonic() < deadline:
+            try:
+                with open(latest) as f:
+                    if f.read().strip() == f"round-{stall_round:07d}":
+                        break
+            except OSError:
+                pass
+            if vict.poll() is not None:
+                raise RuntimeError(
+                    "victim died early: " + vict.communicate()[1][-500:]
+                )
+            time.sleep(0.1)
+        with open(latest) as f:
+            if f.read().strip() != f"round-{stall_round:07d}":
+                # never kill from an arbitrary earlier state: the
+                # recorded numbers must measure THE mid-round-kill
+                # scenario or fail the segment honestly
+                raise RuntimeError(
+                    f"gang never reached round {stall_round} within the "
+                    "wait budget"
+                )
+        time.sleep(0.6)  # survivor is inside round 12's gang allreduce
+        kill_t = time.monotonic()
+        vict.kill()
+        _, err = surv.communicate(timeout=240)
+        if surv.returncode != 0:
+            raise RuntimeError("survivor failed: " + err[-500:])
+        done_t = time.monotonic()
+        with open(os.path.join(work, "a.json")) as f:
+            status = _json.load(f)
+        pre = status.get("rounds_per_s_pre") or 0.0
+        post = status.get("rounds_per_s_post") or 0.0
+        out["elastic_world"] = 2
+        out["elastic_reshards"] = status.get("reshards", 0)
+        out["elastic_detect_latency_s"] = status.get("detect_latency_s")
+        out["elastic_reshard_to_first_round_s"] = status.get(
+            "reshard_to_first_round_s"
+        )
+        out["elastic_kill_to_done_s"] = round(done_t - kill_t, 3)
+        out["elastic_rounds_per_s_pre_shrink"] = pre
+        out["elastic_rounds_per_s_post_shrink"] = post
+        # per-HOST round throughput retained after losing half the gang
+        # (the survivor now histograms ALL rows but skips the allreduce)
+        out["elastic_throughput_retained"] = (
+            round(post / pre, 3) if pre else None
+        )
+        out["elastic_resume_round"] = status.get("resume_round")
+    finally:
+        # failure paths must not leak trainer subprocesses (the victim
+        # sits in a 600s injected stall; the survivor may be mid-run)
+        for proc in (surv, vict):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+        for proc in (surv, vict):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001 — best-effort reap
+                    pass
+        reg.stop()
+    return out
+
+
 def _seg_freshness(on_accel: bool, n_dev: int) -> dict:
     """Continuous learning: example->servable freshness under a sustained
     feedback stream WITH serving traffic concurrent (docs/online-learning.md).
@@ -1266,6 +1392,7 @@ SEGMENT_FNS = {
     "tracing": _seg_tracing,
     "overload": _seg_overload,
     "freshness": _seg_freshness,
+    "elastic": _seg_elastic,
     "pipeline": _seg_pipeline,
     "hist": _seg_hist,
     "vw": _seg_vw,

@@ -55,6 +55,19 @@ point                     fires inside
                           about to be applied — an error suppresses that
                           scale event ("the scheduler refused", retried
                           next tick), delay stalls it
+``elastic.detect``        parallel/elastic.py GangContext.on_round detection
+                          check — a string payload names a member to declare
+                          lost WITHOUT killing anything (drives the whole
+                          reshard path as chaos), an error is the detector
+                          itself failing
+``elastic.reshard``       parallel/elastic.py as the new-generation commit is
+                          attempted — an error is "the commit refused",
+                          retried each heartbeat until the plan relents
+``train.round_abort``     parallel/elastic.py as an in-flight round is
+                          abandoned after a gang change — delay stalls the
+                          abort -> reshard turnaround (visible in recovery
+                          timings), an error kills the trainer (the
+                          supervisor-restart recovery path)
 ========================  ====================================================
 
 Schedules are **seeded and step-indexed**: a rule fires by absolute step

@@ -102,6 +102,18 @@ def grow_tree_depthwise_host(
     inside the scan-fused round loop). If the worker pool dies mid-tree
     the whole tree re-runs serially (pooled and serial paths are
     bit-identical, so the retry is invisible)."""
+    from mmlspark_tpu.parallel.elastic import gang_sum
+
+    # elastic gang training: level histograms are allreduced across gang
+    # members (parallel/elastic.py), which needs the serial kernel — the
+    # fork pool's split scan would run on member-LOCAL cubes
+    if gang_sum() is not None:
+        return _grow_host(
+            num_leaves, n_levels, num_bins, min_data_in_leaf,
+            sibling_subtract, has_categorical, min_gain, lambda_l2,
+            lambda_l1, min_sum_hessian, learning_rate, bins, grad, hess,
+            row_weight, feature_mask, categorical_mask, use_pool=False,
+        )
     try:
         return _grow_host(
             num_leaves, n_levels, num_bins, min_data_in_leaf,
@@ -144,6 +156,12 @@ def _grow_host(
     use_pool: bool,
 ) -> tuple:
     from mmlspark_tpu.ops.histpool import feature_candidates, get_pool
+    from mmlspark_tpu.parallel.elastic import gang_sum
+
+    # elastic gang: sum histograms (and child-size decisions) across the
+    # gang, LightGBM data-parallel style — every member then makes the
+    # identical split decision from the identical global cube
+    gsum = gang_sum()
 
     min_gain = float(np.asarray(min_gain))
     lambda_l2 = float(np.asarray(lambda_l2))
@@ -199,6 +217,10 @@ def _grow_host(
             # derive the other as parent - small
             P = S // 2
             counts = np.bincount(local, minlength=S + 1)
+            if gsum is not None:
+                # the smaller-child choice must be the GLOBAL one or the
+                # members' summed histograms would cover different children
+                counts = gsum(counts.astype(np.float64))
             right_small = counts[1:2 * P:2] <= counts[0:2 * P:2]
             pairi = local >> 1
             is_small = (local < 2 * P) & (
@@ -231,6 +253,8 @@ def _grow_host(
             half = _host_multi_kernel(
                 ns_hist, B, True, b, stats, slot_hist
             ).reshape(ns_hist, d, B, 3)
+            if gsum is not None:
+                half = gsum(half)
             if sib:
                 parents_ok = parent_local >= 0
                 parents = cube_prev[np.maximum(parent_local, 0)]
@@ -326,6 +350,8 @@ def _grow_host(
     Gl = np.bincount(row_slot, weights=g, minlength=L)[:L]
     Hl = np.bincount(row_slot, weights=h, minlength=L)[:L]
     Cl = np.bincount(row_slot, weights=w, minlength=L)[:L]
+    if gsum is not None:
+        Gl, Hl, Cl = gsum(np.stack([Gl, Hl, Cl]))
     with np.errstate(divide="ignore", invalid="ignore"):
         leaf_values = np.where(
             Cl > 0,
@@ -374,6 +400,10 @@ def grow_tree_lossguide_host(
     cache the XLA grower carries). Early exhaustion breaks the loop — the
     XLA grower's remaining steps are provable no-ops."""
     from mmlspark_tpu.ops.histogram import _host_multi_kernel as _mk
+    from mmlspark_tpu.parallel.elastic import gang_sum
+
+    # elastic gang: histograms summed across members (see _grow_host)
+    gsum = gang_sum()
 
     min_gain = float(np.asarray(min_gain))
     lambda_l2 = float(np.asarray(lambda_l2))
@@ -408,9 +438,10 @@ def grow_tree_lossguide_host(
     cache_cm = np.zeros((L, B), bool)
 
     # root: the only full-data histogram of the tree (pool-eligible)
-    hist[0] = _mk(1, B, True, b, stats, np.zeros(n, np.int64)).reshape(
+    root = _mk(1, B, True, b, stats, np.zeros(n, np.int64)).reshape(
         1, d, B, 3
     )[0]
+    hist[0] = gsum(root) if gsum is not None else root
     prev_pair = np.array([0, 0])
 
     def _refresh(pair: np.ndarray) -> None:
@@ -448,12 +479,20 @@ def grow_tree_lossguide_host(
         moved = goes_right
         n_right = int(moved.sum())
         n_left = int(in_leaf.sum()) - n_right
+        if gsum is not None:
+            # globalize the child sizes: members must histogram the SAME
+            # child of the pair or the summed planes would be incoherent
+            n_left, n_right = gsum(
+                np.array([n_left, n_right], np.float64)
+            )
         row_leaf = np.where(moved, new_id, row_leaf)
         # histogram the smaller child over its COMPACTED rows, derive the
         # sibling as parent - small
         small_mask = moved if n_right <= n_left else (in_leaf & ~moved)
         slot = np.where(small_mask, 0, 1).astype(np.int64)  # 1 = dropped
         small = _mk(1, B, True, b, stats, slot).reshape(1, d, B, 3)[0]
+        if gsum is not None:
+            small = gsum(small)
         parent = hist[bl]
         if n_right <= n_left:
             hist[new_id] = small
@@ -477,6 +516,8 @@ def grow_tree_lossguide_host(
     Gl = np.bincount(row_leaf, weights=g, minlength=L)[:L]
     Hl = np.bincount(row_leaf, weights=h, minlength=L)[:L]
     Cl = np.bincount(row_leaf, weights=w, minlength=L)[:L]
+    if gsum is not None:
+        Gl, Hl, Cl = gsum(np.stack([Gl, Hl, Cl]))
     with np.errstate(divide="ignore", invalid="ignore"):
         leaf_values = np.where(
             Cl > 0,

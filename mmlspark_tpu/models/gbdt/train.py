@@ -878,6 +878,31 @@ def train(
     # the histogram allreduce over DCN (the reference's per-machine dataset
     # build + socket allreduce, TrainUtils.scala:26-66,496-512)
     multihost = shard and jax.process_count() > 1
+    # elastic gang training (parallel/elastic.py): each member trains its
+    # contiguous partition rows UNSHARDED; the host growers' histograms
+    # are summed across members by the gang's TCP allreduce, so every
+    # member grows the identical tree. Checkpoints gather/scatter global
+    # row state so a resume at a different world size is well-defined.
+    from mmlspark_tpu.parallel import elastic as _elastic
+
+    gang = _elastic.active_gang()
+    if gang is not None:
+        if shard or multihost:
+            raise ValueError(
+                "elastic gang training requires shard=False (members "
+                "train their partition rows unsharded; the gang "
+                "allreduce crosses hosts)"
+            )
+        if sparse_input:
+            raise ValueError(
+                "elastic gang training requires dense input (the global "
+                "bin-bound gather is dense)"
+            )
+        if valid_mask is not None and np.any(valid_mask):
+            raise ValueError(
+                "elastic gang training does not support validation/"
+                "early stopping (the eval metric would be member-local)"
+            )
     # lambdarank across processes: each process computes its own groups'
     # pairwise gradients on host — a query group must live ENTIRELY on one
     # process (the reference has the same contract: LightGBMRanker requires
@@ -939,6 +964,16 @@ def train(
         global_sample = np.asarray(mhu.process_allgather(samp)).reshape(-1, d)
         mapper = BinMapper.fit(
             global_sample, max_bin=cfg.max_bin, seed=cfg.seed,
+            categorical_features=cat_features,
+        )
+    elif gang is not None:
+        # bin bounds must be identical on every gang member AND invariant
+        # across world sizes (a resumed shrunk-world run must interpret
+        # bins exactly like a fresh run from the same checkpoint): fit on
+        # the gang-gathered GLOBAL rows, not this member's slice
+        mapper = BinMapper.fit(
+            gang.binning_rows(np.asarray(x, np.float32)),
+            max_bin=cfg.max_bin, seed=cfg.seed,
             categorical_features=cat_features,
         )
     else:
@@ -1180,7 +1215,11 @@ def train(
             save_checkpoint,
         )
 
-        _ckpt_fp = config_fingerprint(cfg, n, d, k)
+        # elastic gang: fingerprint the GLOBAL dataset shape — the same
+        # run re-sharded over a different world is still the same run
+        _ckpt_fp = config_fingerprint(
+            cfg, gang.global_n if gang is not None else n, d, k
+        )
     if resume_from:
         _rck = load_checkpoint(resume_from)
         if _rck is not None:
@@ -1191,10 +1230,17 @@ def train(
                     "refusing to resume (fingerprint mismatch)"
                 )
             start_round = _rck.round
-            scores = padded(
-                np.asarray(_rck.scores, np.float32).reshape(scores0.shape)
-            )
+            _res_scores = np.asarray(_rck.scores, np.float32)
+            if gang is not None:
+                # the checkpoint holds GLOBAL row state in global row
+                # order: take this member's contiguous slice (which may
+                # differ from the slice the checkpoint was written under
+                # — that is exactly what a reshard is)
+                _res_scores = np.asarray(gang.take_local(_res_scores))
+            scores = padded(_res_scores.reshape(scores0.shape))
             resume_bag = _rck.bag
+            if gang is not None and resume_bag is not None:
+                resume_bag = np.asarray(gang.take_local(resume_bag))
             if resume_bag is not None:
                 # the dispatch-per-iteration loop's bagging carry; the
                 # fast path re-pads resume_bag into its own scan carry
@@ -1211,15 +1257,26 @@ def train(
     def _save_ckpt(next_round: int, bag_state: Any) -> None:
         """Persist state as of entering ``next_round`` (reads the CURRENT
         loop locals — call only at a completed round boundary)."""
+        scores_arr = np.asarray(scores)[:n]
+        bag_arr = (
+            np.asarray(bag_state)[:n] if bag_state is not None else None
+        )
+        if gang is not None:
+            # collective: EVERY member gathers global row state (scatter
+            # + allreduce keeps the gang in lockstep), but only the
+            # generation coordinator writes the shared checkpoint dir
+            scores_arr = gang.all_rows(scores_arr)
+            if bag_arr is not None:
+                bag_arr = gang.all_rows(bag_arr)
+            if not gang.is_writer:
+                return
         save_checkpoint(
             checkpoint_dir,
             TrainCheckpoint(
                 round=next_round,
                 booster=booster,
-                scores=np.asarray(scores)[:n],
-                bag=(
-                    np.asarray(bag_state)[:n] if bag_state is not None else None
-                ),
+                scores=scores_arr,
+                bag=bag_arr,
                 rng_state=rng.bit_generator.state,
                 fingerprint=_ckpt_fp,
                 best_val=best_val,
@@ -1330,6 +1387,10 @@ def train(
             # preemption fires BETWEEN rounds: state through round it0-1 is
             # checkpointed, rounds >= it0 have not run
             faults.inject("gbdt.round", step=it0)
+            if gang is not None:
+                # elastic gang boundary: straggler EWMA, loss detection,
+                # grow-back — raises to abort when the world changed
+                gang.on_round(it0)
             t_chunk_ns = _time.perf_counter_ns()
             C = min(C_full, cfg.num_iterations - it0)
             if cfg.feature_fraction < 1.0:
@@ -1429,6 +1490,8 @@ def train(
     # delegates / host-only eval metrics)
     for it in (range(0) if fast else range(start_round, cfg.num_iterations)):
         faults.inject("gbdt.round", step=it)
+        if gang is not None:
+            gang.on_round(it)
         t_round_ns = _time.perf_counter_ns()
         if delegate is not None:
             delegate.before_train_iteration(it)

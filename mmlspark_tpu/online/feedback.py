@@ -28,6 +28,7 @@ intake.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -57,8 +58,140 @@ _M_REFUSED = obs.counter(
     "mmlspark_online_ingest_refused_total",
     "Ingest requests refused (injected fault or malformed rows)",
 )
+_M_SPILL_REPLAYED = obs.counter(
+    "mmlspark_online_spill_replayed_total",
+    "Feedback examples replayed from the disk spill after a restart",
+)
+_M_SPILL_PENDING = obs.gauge(
+    "mmlspark_online_spill_pending_count",
+    "Spilled micro-batches not yet confirmed trained",
+)
 
 _JSON = {"Content-Type": "application/json"}
+
+
+def _np_default(o: Any) -> Any:
+    import numpy as np
+
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, np.generic):
+        return o.item()
+    raise TypeError(f"not JSON-serializable: {type(o)!r}")
+
+
+def _df_rows(df: DataFrame) -> list:
+    cols = df.columns
+    return [{c: df[c][i] for c in cols} for i in range(len(df))]
+
+
+class _SpillLog:
+    """Append-only chunk log backing a FeedbackStream.
+
+    Layout: ``spill-<n>.jsonl`` segment files of JSON records
+    ``{"seq", "ts", "rows"}`` plus an ``ACKED`` watermark file (the
+    largest seq confirmed trained; atomic rewrite). Chunks leave the
+    buffer oldest-first (trained or shed), so acknowledgement is a
+    watermark, not a set; segments wholly below the watermark are
+    unlinked — that is the "truncated on successful train step"
+    guarantee. On restart, records above the watermark replay."""
+
+    def __init__(self, path: str, segment_chunks: int = 64):
+        self.path = path
+        self.segment_chunks = max(1, int(segment_chunks))
+        os.makedirs(path, exist_ok=True)
+        self._f = None
+        self._seg_idx = -1
+        self._seg_count = 0
+        # per-segment max seq, maintained in memory (append/replay) so
+        # ack() can unlink without re-reading files under the lock
+        self._seg_max: dict = {}
+        segs = self._segments()
+        if segs:
+            self._seg_idx = max(int(s.split("-")[1].split(".")[0])
+                                for s in segs)
+
+    def _segments(self) -> list:
+        return sorted(
+            e for e in os.listdir(self.path)
+            if e.startswith("spill-") and e.endswith(".jsonl")
+        )
+
+    def watermark(self) -> int:
+        try:
+            with open(os.path.join(self.path, "ACKED")) as f:
+                return int(f.read().strip() or -1)
+        except (OSError, ValueError):
+            return -1
+
+    def append(self, seq: int, ts: float, df: DataFrame) -> None:
+        if self._f is None or self._seg_count >= self.segment_chunks:
+            if self._f is not None:
+                self._f.close()
+            self._seg_idx += 1
+            self._f = open(
+                os.path.join(self.path, f"spill-{self._seg_idx:06d}.jsonl"),
+                "a",
+            )
+            self._seg_count = 0
+        self._f.write(json.dumps(
+            {"seq": seq, "ts": ts, "rows": _df_rows(df)},
+            default=_np_default,
+        ) + "\n")
+        self._f.flush()
+        self._seg_count += 1
+        name = f"spill-{self._seg_idx:06d}.jsonl"
+        self._seg_max[name] = max(self._seg_max.get(name, -1), seq)
+
+    def ack(self, watermark: int) -> None:
+        """Persist the trained watermark and unlink fully-acked
+        segments (the current write segment is never unlinked). Unlink
+        eligibility comes from the in-memory per-segment max seq — no
+        file re-reads under the stream lock; a segment whose max is
+        unknown (shouldn't happen) just survives until restart."""
+        tmp = os.path.join(self.path, f".ACKED-{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(str(watermark))
+        os.replace(tmp, os.path.join(self.path, "ACKED"))
+        current = (
+            f"spill-{self._seg_idx:06d}.jsonl" if self._seg_idx >= 0 else ""
+        )
+        for seg, max_seq in list(self._seg_max.items()):
+            if seg == current or max_seq > watermark:
+                continue
+            try:
+                os.unlink(os.path.join(self.path, seg))
+            except OSError:
+                pass
+            del self._seg_max[seg]
+
+    def replay(self) -> list:
+        """Unacked ``(seq, ts, rows)`` records in seq order."""
+        wm = self.watermark()
+        out = []
+        for seg in self._segments():
+            try:
+                with open(os.path.join(self.path, seg)) as f:
+                    for line in f:
+                        if not line.strip():
+                            continue
+                        rec = json.loads(line)
+                        self._seg_max[seg] = max(
+                            self._seg_max.get(seg, -1), rec["seq"]
+                        )
+                        if rec["seq"] > wm:
+                            out.append(
+                                (rec["seq"], rec["ts"], rec["rows"])
+                            )
+            except (OSError, ValueError, KeyError):
+                continue  # torn tail of a crashed writer: best-effort
+        out.sort(key=lambda r: r[0])
+        return out
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
 
 
 class FeedbackStream:
@@ -74,8 +207,19 @@ class FeedbackStream:
         source: Optional[Callable[[], Iterator[DataFrame]]] = None,
         max_chunks: int = 1024,
         time_fn: Callable[[], float] = time.monotonic,
+        spill_dir: Optional[str] = None,
+        spill_segment_chunks: int = 64,
     ):
-        self._buf: deque = deque()  # (ingest_ts, DataFrame)
+        """``spill_dir``: optional durability — every PUSHED micro-batch
+        is appended to an on-disk chunk log (:class:`_SpillLog`) before
+        it is buffered, replayed into the buffer on construction after a
+        crash, and truncated once the consumer confirms training
+        (:meth:`ack_trained`, wired by OnlineLearningLoop). Pull-source
+        chunks never spill — their source is already durable/re-iterable.
+        Bounded-buffer sheds are acknowledged as handled (deliberate
+        freshest-wins policy, counted), so only genuinely-untrained
+        pushes ever replay."""
+        self._buf: deque = deque()  # (ingest_ts, DataFrame, seq-or-None)
         self._cond = threading.Condition()
         self._max_chunks = max(1, int(max_chunks))
         self._now = time_fn
@@ -85,8 +229,46 @@ class FeedbackStream:
         self._closed = False
         self.ingested = 0   # examples accepted
         self.dropped = 0    # chunks dropped by the bound
+        self.dropped_examples = 0
+        self.replayed = 0   # examples restored from the spill
         self._ingress: Any = None
         self._router: Optional[threading.Thread] = None
+        # spill bookkeeping: chunks leave the buffer oldest-first, so the
+        # trained/shed frontier is a seq watermark
+        self._spill: Optional[_SpillLog] = None
+        self._seq = 0
+        # chunks polled out, awaiting ack: (seq, ts, chunk) — the chunk
+        # is kept so a FAILED train step can requeue it (nack_failed)
+        self._handed: list = []
+        self._done: set = set()     # seqs trained or deliberately shed
+        self._watermark = -1
+        self._spill_lock = threading.Lock()
+        if spill_dir:
+            self._spill = _SpillLog(spill_dir, spill_segment_chunks)
+            self._watermark = self._spill.watermark()
+            self._seq = self._watermark + 1
+            now = self._now()
+            for seq, ts, rows in self._spill.replay():
+                chunk = DataFrame.from_rows(rows)
+                # monotonic stamps do not survive a reboot (the clock
+                # restarts): clamp to "now", so a replayed chunk's age
+                # counts from replay — conservative, never garbage
+                self._buf.append((min(ts, now), chunk, seq))
+                self._seq = max(self._seq, seq + 1)
+                self.replayed += len(chunk)
+                _M_SPILL_REPLAYED.inc(len(chunk))
+            # the bound applies to replayed backlog too: re-shed the
+            # oldest past max_chunks (freshest-wins holds across a
+            # crash; the sheds are acked so they never replay again)
+            while len(self._buf) > self._max_chunks:
+                _, shed, shed_seq = self._buf.popleft()
+                self.dropped += 1
+                self.dropped_examples += len(shed)
+                _M_DROPPED.inc()
+                if shed_seq is not None:
+                    self._mark_done_locked(shed_seq)
+            _M_SPILL_PENDING.set(self._spill_pending_locked())
+            _M_DEPTH.set(len(self._buf))
 
     # -- construction --------------------------------------------------------
 
@@ -136,18 +318,85 @@ class FeedbackStream:
         # (producer-visible), delay_s stalls intake
         faults.inject("online.ingest", context={"rows": len(chunk)})
         ts = self._now() if ts is None else ts
+        seq = None
+        if self._spill is not None:
+            # spill BEFORE buffering: once push() returns, a crash
+            # cannot lose this chunk (replayed on restart). The disk
+            # write holds only the spill lock — a slow disk must not
+            # stall concurrent poll()/ingest on the buffer condition
+            with self._spill_lock:
+                seq = self._seq
+                self._seq += 1
+                self._spill.append(seq, ts, chunk)
         with self._cond:
-            self._buf.append((ts, chunk))
+            if seq is not None:
+                _M_SPILL_PENDING.set(self._spill_pending_locked())
+            self._buf.append((ts, chunk, seq))
             if len(self._buf) > self._max_chunks:
-                self._buf.popleft()  # freshest-wins: shed the oldest
-                self.dropped += 1
+                _, shed, shed_seq = self._buf.popleft()
+                self.dropped += 1  # freshest-wins: shed the oldest
+                self.dropped_examples += len(shed)
                 _M_DROPPED.inc()
+                if shed_seq is not None:
+                    # a deliberate shed is HANDLED, not lost: ack it so
+                    # the spill does not resurrect rejected backlog
+                    self._mark_done_locked(shed_seq)
             self.ingested += len(chunk)
             _M_DEPTH.set(len(self._buf))
             self._cond.notify()
         _M_INGESTED.inc(len(chunk))
         _M_CHUNKS.inc()
         return len(chunk)
+
+    # -- spill acknowledgement -------------------------------------------------
+
+    def _spill_pending_locked(self) -> int:
+        return max(
+            0, (self._seq - 1 - self._watermark) - len(self._done)
+        )
+
+    def _mark_done_locked(self, seq: int) -> None:
+        self._done.add(seq)
+        advanced = False
+        while (self._watermark + 1) in self._done:
+            self._watermark += 1
+            self._done.discard(self._watermark)
+            advanced = True
+        if advanced and self._spill is not None:
+            self._spill.ack(self._watermark)
+        if self._spill is not None:
+            _M_SPILL_PENDING.set(self._spill_pending_locked())
+
+    def ack_trained(self) -> None:
+        """Confirm every chunk currently handed out by :meth:`poll` was
+        folded into the model — the spill truncates up to the trained
+        watermark. Called by OnlineLearningLoop after each successful
+        train step; a crash between poll and ack replays the chunk. A
+        FAILED step must :meth:`nack_failed` first, or its chunk would
+        ride a later success's acknowledgement."""
+        with self._cond:
+            handed, self._handed = self._handed, []
+            for seq, _, _ in handed:
+                if seq is not None:
+                    self._mark_done_locked(seq)
+
+    def nack_failed(self) -> None:
+        """Requeue every handed-out-but-unconfirmed chunk at the FRONT
+        of the buffer (original order): a train step that raised did not
+        consume its chunk — it is retried by the next poll, and the
+        spill keeps it replayable meanwhile."""
+        with self._cond:
+            handed, self._handed = self._handed, []
+            for seq, ts, chunk in reversed(handed):
+                self._buf.appendleft((ts, chunk, seq))
+            _M_DEPTH.set(len(self._buf))
+
+    def spill_pending(self) -> int:
+        """Spilled chunks not yet confirmed trained (0 without a spill)."""
+        if self._spill is None:
+            return 0
+        with self._cond:
+            return self._spill_pending_locked()
 
     # -- consumption ---------------------------------------------------------
 
@@ -163,9 +412,13 @@ class FeedbackStream:
         system); otherwise block up to ``timeout_s`` for a push."""
         with self._cond:
             if self._buf:
-                item = self._buf.popleft()
+                ts0, chunk0, seq0 = self._buf.popleft()
+                # seq may be None (no spill): still tracked, so
+                # nack_failed() can requeue a transiently-failed chunk
+                # on ANY stream, not only disk-backed ones
+                self._handed.append((seq0, ts0, chunk0))
                 _M_DEPTH.set(len(self._buf))
-                return item
+                return (ts0, chunk0)
         if self._source is not None and not self._exhausted:
             if self._iter is None:
                 self._iter = self._source()
@@ -187,9 +440,10 @@ class FeedbackStream:
             if not self._buf and timeout_s > 0:
                 self._cond.wait(timeout_s)
             if self._buf:
-                item = self._buf.popleft()
+                ts0, chunk0, seq0 = self._buf.popleft()
+                self._handed.append((seq0, ts0, chunk0))
                 _M_DEPTH.set(len(self._buf))
-                return item
+                return (ts0, chunk0)
         return None
 
     @property
@@ -283,6 +537,8 @@ class FeedbackStream:
             self._router.join(5.0)
         if self._ingress is not None:
             self._ingress.stop()
+        if self._spill is not None:
+            self._spill.close()
         with self._cond:
             self._cond.notify_all()
 

@@ -74,6 +74,12 @@ class OnlineLearningLoop:
         self._pending_examples = 0
         self._last_publish_t = 0.0
         self.publish_results: list = []  # successful publish() returns
+        # poison-chunk escape: a chunk whose step fails this many times
+        # CONSECUTIVELY is discarded (acked away) instead of retried
+        # forever — one bad chunk must not head-of-line-block the loop
+        self.max_step_retries = 3
+        self._step_failures = 0
+        self.poisoned_chunks = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -121,7 +127,39 @@ class OnlineLearningLoop:
         item = self.stream.poll(self.poll_s)
         if item is not None:
             ts, chunk = item
-            trained = self.trainer.step(chunk)
+            try:
+                trained = self.trainer.step(chunk)
+                self._step_failures = 0
+            except BaseException:
+                self._step_failures += 1
+                if self._step_failures >= self.max_step_retries:
+                    # poison chunk: discard (ack so the spill truncates)
+                    # rather than hot-retry it forever while everything
+                    # behind it goes stale
+                    self._step_failures = 0
+                    self.poisoned_chunks += 1
+                    ack = getattr(self.stream, "ack_trained", None)
+                    if ack is not None:
+                        ack()
+                    print(
+                        f"online: dropping poison chunk after "
+                        f"{self.max_step_retries} failed train steps",
+                        file=sys.stderr, flush=True,
+                    )
+                else:
+                    # a transiently-failed step did NOT consume the
+                    # chunk: requeue it (retried next tick) so a later
+                    # success's ack cannot silently truncate it
+                    nack = getattr(self.stream, "nack_failed", None)
+                    if nack is not None:
+                        nack()
+                raise
+            # the step succeeded: confirm the spill (disk-backed streams
+            # truncate their chunk log; a crash BEFORE this point replays
+            # the chunk on restart — no feedback loss)
+            ack = getattr(self.stream, "ack_trained", None)
+            if ack is not None:
+                ack()
             if trained:
                 if self._pending_oldest_ts is None or ts < self._pending_oldest_ts:
                     self._pending_oldest_ts = ts

@@ -1,0 +1,1405 @@
+"""Elastic self-healing distributed training: survive host loss mid-round,
+re-shard, and resume bit-identically.
+
+The training-plane sibling of the serving supervisor (PR 5): the paper's
+headline rebuild of LightGBM's gang-scheduled socket allreduce previously
+died on the first lost host — ``barrier()`` named the missing process and
+raised, and the run was over until a human restarted it from a checkpoint.
+This module closes the detect -> react loop:
+
+- **Gang membership** rides the existing DriverRegistry heartbeats: every
+  trainer registers under ``<service>-gang`` and heartbeats; a host whose
+  beats stop vanishes from the TTL'd roster.
+- **Detection**: a lost host surfaces either as a TTL expiry seen at a
+  round boundary (:meth:`GangContext.on_round`) or as a gang allreduce
+  whose peer frames never arrive mid-round (the socket-level failure the
+  reference's ``allreduce`` hit, recoverable here instead of fatal).
+- **Reaction**: survivors abort the in-flight round (state through the
+  last checkpoint stands), agree on a new epoch/world through a
+  **registry-stamped generation** record, re-shard the data partitions
+  contiguously over the shrunk gang, and resume from the latest round
+  checkpoint — all in-process, no operator action.
+- **Contract**: the resumed booster on ``k-1`` hosts is **bit-identical**
+  to a fresh ``k-1``-host run started from that same checkpoint (the
+  reshard snapshots the checkpoint it resumed from so the claim is
+  auditable; tests/test_elastic.py proves it byte-for-byte).
+- **Grow-back**: a supervisor-restarted host re-registers and rejoins at
+  the next checkpoint boundary (generation bump with reason ``grow``)
+  instead of being lost for the run.
+- **Stragglers**: per-host round-time EWMAs ride the heartbeat payload;
+  the generation coordinator flags sustained-slow hosts
+  (:class:`StragglerTracker`) and can evict them through the same resize
+  path (reason ``straggler``).
+
+Data plane: within a generation the gang trains the existing GBDT loop
+(``models/gbdt/train.py``, unsharded per host) with the PR-8 host growers'
+histograms **summed across members** by :class:`TcpReducer` — the literal
+LightGBM data-parallel pattern (local histogram + allreduce + identical
+split decisions everywhere), carried over plain TCP so a dead peer is a
+recoverable socket timeout, not an uncancellable XLA collective. Every
+member grows the identical tree; the booster is SPMD-identical across the
+gang.
+
+Global row order is world-invariant: partitions are contiguous row blocks
+of the common dataset and members take contiguous partition runs in
+sorted-name order, so the gathered checkpoint scores mean the same thing
+at every world size — the property the bit-identity contract rests on.
+
+Fault points (docs/robustness.md): ``elastic.detect`` fires at every
+detection check (a payload forces a named host "lost" without killing
+anything), ``elastic.reshard`` as a reshard commit is attempted (an error
+is "the coordinator refused", retried), ``train.round_abort`` as an
+in-flight round is aborted (a delay stalls the abort -> reshard
+turnaround, visible in the detection-latency metric).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.parallel.distributed import BarrierTimeoutError
+
+_M_GEN = obs.gauge(
+    "mmlspark_elastic_generation_count",
+    "Current training-gang generation (bumped by every reshard)",
+)
+_M_MEMBERS = obs.gauge(
+    "mmlspark_elastic_members_count", "Live members of the training gang",
+)
+_M_RESHARDS = obs.counter(
+    "mmlspark_elastic_reshards_total",
+    "Generation bumps: world changed and partitions were re-assigned",
+    labels=("reason",),
+)
+_M_DETECT = obs.histogram(
+    "mmlspark_elastic_detect_seconds",
+    "Host-loss detection latency: last heartbeat seen -> loss declared",
+)
+_M_ROUND_EWMA = obs.gauge(
+    "mmlspark_elastic_round_ewma_seconds",
+    "Per-host boosting-round wall-time EWMA (straggler signal)",
+    labels=("host",),
+)
+_M_STRAGGLERS = obs.gauge(
+    "mmlspark_elastic_stragglers_count",
+    "Members currently flagged sustained-slow by the coordinator",
+)
+_M_ABORTS = obs.counter(
+    "mmlspark_elastic_round_aborts_total",
+    "In-flight rounds abandoned because the gang changed under them",
+)
+_M_ALLREDUCE = obs.histogram(
+    "mmlspark_elastic_allreduce_seconds",
+    "Gang histogram-allreduce wall time (TCP full mesh)",
+)
+
+
+class HostLostError(RuntimeError):
+    """A gang member stopped answering mid-run; carries the culprits."""
+
+    def __init__(self, lost: list, gen: int = 0, detail: str = ""):
+        self.lost = sorted(set(lost))
+        self.gen = gen
+        msg = (
+            f"training gang generation {gen} lost host(s): "
+            f"{', '.join(self.lost) or '?'}"
+        )
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+
+
+class WorldChangedError(RuntimeError):
+    """Another member committed a newer generation — re-form, don't die."""
+
+    def __init__(self, gen: int):
+        self.gen = gen
+        super().__init__(f"training gang moved to generation {gen}")
+
+
+# -- deterministic partition assignment ---------------------------------------
+
+
+def partition_bounds(n_rows: int, n_partitions: int) -> list:
+    """Contiguous ``(lo, hi)`` row slices of the global dataset."""
+    p = max(1, int(n_partitions))
+    return [
+        (i * n_rows // p, (i + 1) * n_rows // p) for i in range(p)
+    ]
+
+
+def assign_partitions(n_partitions: int, members: list) -> dict:
+    """Member name -> list of partition ids. Members take CONTIGUOUS
+    partition runs in sorted-name order, so the concatenation of every
+    member's rows is the global dataset in its original order at every
+    world size — the invariance the checkpoint bit-identity contract
+    needs (a round-robin assignment would permute rows per world)."""
+    names = sorted(members)
+    m = len(names)
+    out = {}
+    for j, name in enumerate(names):
+        out[name] = list(range(j * n_partitions // m,
+                               (j + 1) * n_partitions // m))
+    return out
+
+
+def member_row_slice(
+    n_rows: int, n_partitions: int, members: list, me: str
+) -> tuple:
+    """This member's contiguous ``(lo, hi)`` global row range."""
+    parts = assign_partitions(n_partitions, members)[me]
+    bounds = partition_bounds(n_rows, n_partitions)
+    if not parts:
+        return (0, 0)
+    return (bounds[parts[0]][0], bounds[parts[-1]][1])
+
+
+# -- straggler policy ---------------------------------------------------------
+
+
+class StragglerTracker:
+    """Flag members whose round-time EWMA stays ``factor`` x the gang
+    median for ``sustain`` consecutive observations. Pure policy — the
+    coordinator feeds it roster EWMAs and acts on the flags."""
+
+    def __init__(self, factor: float = 3.0, sustain: int = 3):
+        self.factor = float(factor)
+        self.sustain = max(1, int(sustain))
+        self._slow_streak: dict = {}
+
+    def observe(self, ewmas: dict) -> list:
+        """``{host: ewma_seconds}`` -> hosts flagged sustained-slow."""
+        vals = [v for v in ewmas.values() if v and v > 0]
+        if len(vals) < 2:
+            self._slow_streak.clear()
+            return []
+        median = float(np.median(vals))
+        flagged = []
+        for host, v in ewmas.items():
+            if v and median > 0 and v > self.factor * median:
+                self._slow_streak[host] = self._slow_streak.get(host, 0) + 1
+                if self._slow_streak[host] >= self.sustain:
+                    flagged.append(host)
+            else:
+                self._slow_streak.pop(host, None)
+        for host in list(self._slow_streak):
+            if host not in ewmas:
+                self._slow_streak.pop(host)
+        return sorted(flagged)
+
+
+# -- generation record over the registry --------------------------------------
+
+
+@dataclass
+class Generation:
+    """One agreed (epoch, world): who trains, and from where."""
+
+    gen: int
+    members: list
+    reason: str = "init"
+    resume_round: int = 0
+    snapshot: Optional[str] = None
+    committer: str = ""
+    detect_latency_s: float = 0.0
+    stamp: float = 0.0          # registry-side registration ts
+    # straggler evictions: name -> boot stamp at eviction. Grow-back
+    # re-admits an evicted host only once it re-registers with a NEW
+    # boot (a restarted process gets a clean slate; the same slow
+    # process does not bounce straight back in)
+    evicted: dict = field(default_factory=dict)
+
+def _post_json(url: str, payload: dict, timeout: float = 5.0) -> bool:
+    from mmlspark_tpu.io.clients import send_request
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+    resp = send_request(
+        HTTPRequestData(
+            url, "POST", {"Content-Type": "application/json"},
+            json.dumps(payload),
+        ),
+        timeout=timeout,
+    )
+    return resp["status_code"] == 200
+
+
+def _get_roster(url: str, timeout: float = 5.0) -> Optional[dict]:
+    from mmlspark_tpu.io.clients import send_request
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+    resp = send_request(
+        HTTPRequestData(url.rstrip("/") + "/", "GET"), timeout=timeout
+    )
+    if resp["status_code"] != 200:
+        return None
+    try:
+        return json.loads(resp["entity"])
+    except ValueError:
+        return None
+
+
+# -- gang membership ----------------------------------------------------------
+
+
+class GangMember:
+    """One training host's registry presence: heartbeat registration,
+    TTL'd roster reads, and the registry-stamped generation record.
+
+    The member's heartbeat carries its allreduce listener port and its
+    round-time EWMA; it also re-posts the member's currently-adopted
+    generation record each beat so the record outlives the registry TTL
+    for as long as anyone still believes in it."""
+
+    def __init__(
+        self,
+        registry_urls: Any,
+        name: str,
+        service: str = "train",
+        advertise_host: str = "127.0.0.1",
+        heartbeat_s: float = 1.0,
+    ):
+        from mmlspark_tpu.serving.fleet import split_registry_urls
+
+        self.registry_urls = split_registry_urls(registry_urls)
+        if not self.registry_urls:
+            raise ValueError("elastic training needs at least one --registry")
+        self.name = name
+        self.service = service
+        self.advertise_host = advertise_host
+        self.heartbeat_s = float(heartbeat_s)
+        self.boot = time.time()
+        self.ewma_s = 0.0
+        self.last_seen: dict = {}       # member -> wall ts last on roster
+        self._adopted: Optional[Generation] = None
+        self._stop = threading.Event()
+        # allreduce frame listener (one across generations; the port is
+        # what peers learn from the roster)
+        self._inbox: dict = {}          # (gen, seq, sender) -> bytes
+        self._inbox_cond = threading.Condition()
+        self._srv = socket.create_server(("0.0.0.0", 0))
+        self._srv.settimeout(0.5)
+        self.port = self._srv.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"gang-listen-{name}", daemon=True
+        )
+        self._accept_thread.start()
+        self._beat_thread = threading.Thread(
+            target=self._beat_loop, name=f"gang-beat-{name}", daemon=True
+        )
+        self._beat_thread.start()
+
+    # -- listener ------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._read_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(None)
+            f = conn.makefile("rb")
+            while not self._stop.is_set():
+                head = f.read(28)
+                if len(head) < 28:
+                    return
+                gen, seq, nonce, name_len, nbytes = struct.unpack(
+                    "<qqIii", head
+                )
+                sender = f.read(name_len).decode("utf-8")
+                payload = f.read(nbytes)
+                if len(payload) < nbytes:
+                    return
+                with self._inbox_cond:
+                    self._inbox[(gen, nonce, seq, sender)] = payload
+                    self._inbox_cond.notify_all()
+        except Exception:  # noqa: BLE001 — a dead peer's conn just ends
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def take_frame(
+        self, gen: int, nonce: int, seq: int, sender: str, timeout_s: float
+    ) -> Optional[bytes]:
+        deadline = time.monotonic() + timeout_s
+        with self._inbox_cond:
+            while True:
+                buf = self._inbox.pop((gen, nonce, seq, sender), None)
+                if buf is not None:
+                    return buf
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._inbox_cond.wait(min(remaining, 0.05))
+
+    def drop_stale_frames(self, current_gen: int) -> None:
+        with self._inbox_cond:
+            for key in [k for k in self._inbox if k[0] < current_gen]:
+                del self._inbox[key]
+
+    # -- registration ---------------------------------------------------------
+
+    def _registration(self) -> dict:
+        return {
+            "name": f"{self.service}-gang",
+            "host": self.name,
+            "port": self.port,
+            "addr": self.advertise_host,
+            "boot": self.boot,
+            "ewma_ms": round(self.ewma_s * 1e3, 3),
+        }
+
+    def heartbeat(self) -> None:
+        """One registration beat to every registry (also refreshes the
+        adopted generation record's TTL).
+
+        Conflict rule: the registry's copy of a generation is
+        authoritative (last writer wins — one entry per gen number). If
+        the current record for our adopted gen carries DIFFERENT members
+        (racing survivors with divergent lost-sets each committed), we
+        ADOPT the registry's copy instead of re-posting ours, so the
+        record converges instead of flapping; the training loop notices
+        the membership change at its next round boundary."""
+        gen = self._adopted
+        if gen is not None:
+            cur = self.read_generation()
+            if cur is not None and cur.gen >= gen.gen and (
+                cur.gen > gen.gen
+                or sorted(cur.members) != sorted(gen.members)
+            ):
+                self._adopted = gen = cur
+        for url in self.registry_urls:
+            try:
+                _post_json(url, self._registration())
+                if gen is not None:
+                    _post_json(url, self._gen_payload(gen))
+            except Exception:  # noqa: BLE001 — registry may be restarting
+                pass
+
+    def _beat_loop(self) -> None:
+        while not self._stop.is_set():
+            self.heartbeat()
+            self._stop.wait(self.heartbeat_s)
+
+    def roster(self) -> Optional[dict]:
+        """Live gang members (TTL-filtered by the registry): name ->
+        entry, or **None when no registry answered** — blindness is not
+        evidence of death (a restarting registry must not make every
+        survivor declare every peer lost and split-brain the gang).
+        Tracks ``last_seen`` wall times for the detection-latency
+        metric. The first live registry answers (registry HA)."""
+        for url in self.registry_urls:
+            data = _get_roster(url)
+            if data is None:
+                continue
+            entries = {
+                e.get("host"): e for e in data.get(f"{self.service}-gang", [])
+            }
+            now = time.time()
+            for host in entries:
+                self.last_seen[host] = now
+            return entries
+        return None
+
+    # -- generation record -----------------------------------------------------
+
+    def _gen_payload(self, g: Generation) -> dict:
+        return {
+            "name": f"{self.service}-gen",
+            # the (host, port) identity key: one entry per generation,
+            # re-posts replace (heartbeat refresh), max port wins on read
+            "host": "generation",
+            "port": int(g.gen),
+            "members": list(g.members),
+            "reason": g.reason,
+            "resume_round": int(g.resume_round),
+            "snapshot": g.snapshot,
+            "committer": g.committer,
+            "detect_latency_s": g.detect_latency_s,
+            "evicted": dict(g.evicted),
+        }
+
+    def declared_dead(
+        self, candidates: list, ros: Optional[dict], grace_s: float
+    ) -> list:
+        """THE loss policy, shared by round-boundary detection and the
+        allreduce wait (one implementation — the two sites must never
+        drift): a candidate is dead only when the roster is NOT blind
+        (some registry answered AND it has collected our own heartbeat
+        — a freshly-restarted registry's empty roster is blindness, not
+        mass death), the candidate is absent, and its last sighting is
+        older than the grace (debounces the re-registration race)."""
+        if not candidates or ros is None or self.name not in ros:
+            return []
+        now = time.time()
+        return [
+            c for c in candidates
+            if c not in ros
+            and now - self.last_seen.get(c, 0.0) >= grace_s
+        ]
+
+    def read_generation(self) -> Optional[Generation]:
+        # consult EVERY answering registry and take the highest
+        # generation (registry HA: a just-restarted registry may answer
+        # with an empty roster while a peer still holds the record)
+        entries: list = []
+        for url in self.registry_urls:
+            data = _get_roster(url)
+            if data is None:
+                continue
+            entries.extend(data.get(f"{self.service}-gen", []))
+        if entries:
+            e = max(
+                entries,
+                key=lambda x: (x.get("port", 0), x.get("ts", 0.0)),
+            )
+            return Generation(
+                gen=int(e.get("port", 0)),
+                members=list(e.get("members", [])),
+                reason=e.get("reason", ""),
+                resume_round=int(e.get("resume_round", 0)),
+                snapshot=e.get("snapshot"),
+                committer=e.get("committer", ""),
+                detect_latency_s=float(e.get("detect_latency_s", 0.0)),
+                stamp=float(e.get("ts", 0.0)),
+                evicted=dict(e.get("evicted") or {}),
+            )
+        return None
+
+    def commit_generation(self, g: Generation) -> Generation:
+        """POST the generation record; the registry stamps it (``ts``).
+        Deterministic content, so racing survivors committing the same
+        world collapse to one record."""
+        g.committer = self.name
+        for url in self.registry_urls:
+            try:
+                _post_json(url, self._gen_payload(g))
+            except Exception:  # noqa: BLE001
+                pass
+        self._adopted = g
+        _M_GEN.set(g.gen)
+        _M_MEMBERS.set(len(g.members))
+        return g
+
+    def adopt(self, g: Generation) -> None:
+        self._adopted = g
+        _M_GEN.set(g.gen)
+        _M_MEMBERS.set(len(g.members))
+
+    def await_generation(
+        self,
+        world_size: int,
+        timeout_s: float = 60.0,
+        min_gen: int = 0,
+        poll_s: float = 0.1,
+    ) -> Generation:
+        """Adopt the current generation once it includes this member; if
+        none exists, the lowest-named of the first ``world_size``
+        registrants commits generation 1."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            g = self.read_generation()
+            if g is not None and g.gen > min_gen and self.name in g.members:
+                self.adopt(g)
+                return g
+            if g is None and min_gen == 0:
+                ros = self.roster()
+                names = sorted(ros or {})
+                if (
+                    self.name in names
+                    and len(names) >= world_size
+                    and self.name == names[0]
+                ):
+                    return self.commit_generation(
+                        Generation(gen=1, members=names[:world_size])
+                    )
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"member {self.name!r}: no generation including me appeared "
+            f"within {timeout_s:g}s (world_size={world_size}, "
+            f"current={self.read_generation()})"
+        )
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        from mmlspark_tpu.io.clients import send_request
+        from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+        for url in self.registry_urls:
+            try:
+                send_request(
+                    HTTPRequestData(
+                        url, "DELETE", {"Content-Type": "application/json"},
+                        json.dumps({
+                            "name": f"{self.service}-gang",
+                            "host": self.name, "port": self.port,
+                        }),
+                    ),
+                    timeout=5.0,
+                )
+            except Exception:  # noqa: BLE001 — registry may be gone
+                pass
+
+
+# -- the TCP allreduce --------------------------------------------------------
+
+
+class TcpReducer:
+    """Full-mesh framed-TCP sum-allreduce among one generation's members.
+
+    Every member executes the identical sequence of collectives (the host
+    growers are SPMD over the gang), so a monotonically increasing
+    ``seq`` pairs frames without negotiation. Sums accumulate in f64 in
+    sorted-member order — every member computes the bit-identical total.
+
+    A peer whose frame never arrives AND whose registry heartbeats have
+    lapsed raises :class:`HostLostError` — the socket-level failure the
+    reference's LightGBM allreduce dies on becomes the detection signal.
+    """
+
+    def __init__(
+        self,
+        member: GangMember,
+        generation: Generation,
+        timeout_s: float = 60.0,
+        connect_timeout_s: float = 10.0,
+    ):
+        self.member = member
+        self.gen = generation.gen
+        self.members = sorted(generation.members)
+        self.me = member.name
+        self.timeout_s = float(timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        # same loss debounce as GangContext.on_round: a freshly
+        # restarted registry's empty roster must not read as mass death
+        self.loss_grace_s = max(1.0, 2.0 * member.heartbeat_s)
+        # incarnation nonce: a content hash of the generation record,
+        # identical on every member that adopted the SAME record —
+        # frames from an aborted same-gen-number incarnation (the
+        # membership-conflict path) key differently and can never be
+        # consumed as this incarnation's sums
+        import zlib
+
+        self.nonce = zlib.crc32(json.dumps(
+            [generation.gen, sorted(generation.members),
+             generation.resume_round, generation.committer],
+        ).encode()) & 0xFFFFFFFF
+        self.seq = 0
+        self._conns: dict = {}
+        self._send_lock = threading.Lock()
+        self.world = len(self.members)
+        member.drop_stale_frames(self.gen)
+
+    def _conn(self, peer: str) -> socket.socket:
+        c = self._conns.get(peer)
+        if c is not None:
+            return c
+        ros = self.member.roster()
+        if ros is None:
+            # blind (no registry answered) is transient, not a death
+            raise OSError("no registry reachable for peer lookup")
+        e = ros.get(peer)
+        if e is None:
+            raise HostLostError([peer], self.gen, "peer not on roster")
+        c = socket.create_connection(
+            (e.get("addr", "127.0.0.1"), int(e["port"])),
+            timeout=self.connect_timeout_s,
+        )
+        c.settimeout(None)
+        self._conns[peer] = c
+        return c
+
+    def allreduce(self, arr: np.ndarray) -> np.ndarray:
+        """Sum ``arr`` across the gang; returns the same dtype/shape.
+        World 1 is an exact no-op (bit-identical to unsharded training)."""
+        if self.world <= 1:
+            return arr
+        t0 = time.perf_counter()
+        x = np.ascontiguousarray(np.asarray(arr, np.float64))
+        seq = self.seq
+        self.seq += 1
+        head = struct.pack(
+            "<qqIii", self.gen, seq, self.nonce,
+            len(self.me.encode()), x.nbytes,
+        )
+        frame = head + self.me.encode() + x.tobytes()
+        peers = [m for m in self.members if m != self.me]
+
+        def send_to(targets: list) -> list:
+            """Send the frame; returns the peers it could NOT reach —
+            retried below, because a transiently dropped send would
+            otherwise wedge the PEER for the full timeout and get this
+            healthy host wrongly evicted as 'wedged'."""
+            failed = []
+            with self._send_lock:
+                for p in targets:
+                    try:
+                        self._conn(p).sendall(frame)
+                    except (OSError, HostLostError):
+                        # a dead socket is not yet a dead HOST: the
+                        # roster decides below (may be mid-restart)
+                        self._conns.pop(p, None)
+                        failed.append(p)
+            return failed
+
+        unsent = send_to(peers)
+        bufs = {self.me: x.reshape(-1)}
+        deadline = time.monotonic() + self.timeout_s
+        next_roster_check = time.monotonic() + 0.5
+        while len(bufs) < self.world:
+            missing = [p for p in peers if p not in bufs]
+            got = self.member.take_frame(
+                self.gen, self.nonce, seq, missing[0], 0.05
+            )
+            if got is not None:
+                bufs[missing[0]] = np.frombuffer(got, np.float64)
+                continue
+            now = time.monotonic()
+            if now >= next_roster_check:
+                next_roster_check = now + 0.5
+                if unsent:
+                    unsent = send_to(unsent)
+                # one shared loss policy with on_round (blindness is
+                # not death; grace debounces): GangMember.declared_dead
+                dead = self.member.declared_dead(
+                    missing, self.member.roster(), self.loss_grace_s
+                )
+                if dead:
+                    latency = [
+                        time.time() - self.member.last_seen.get(p, time.time())
+                        for p in dead
+                    ]
+                    for lat in latency:
+                        _M_DETECT.observe(max(0.0, lat))
+                    raise HostLostError(
+                        dead, self.gen,
+                        f"allreduce seq {seq}: no frame, heartbeats lapsed "
+                        f"(detect latency ~{max(latency):.2f}s)",
+                    )
+                g = self.member.read_generation()
+                if g is not None and g.gen > self.gen:
+                    raise WorldChangedError(g.gen)
+            if now >= deadline:
+                raise HostLostError(
+                    missing, self.gen,
+                    f"allreduce seq {seq} timed out after "
+                    f"{self.timeout_s:g}s with live heartbeats — wedged "
+                    "peer(s)",
+                )
+        total = bufs[self.members[0]].astype(np.float64, copy=True)
+        for m in self.members[1:]:
+            total = total + bufs[m]
+        _M_ALLREDUCE.observe(time.perf_counter() - t0)
+        return total.reshape(x.shape).astype(np.asarray(arr).dtype)
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._conns.clear()
+
+
+# -- the per-generation training context --------------------------------------
+
+
+class GangContext:
+    """What ``train()`` and the host growers consult while a generation
+    trains. Installed process-globally with :func:`activate` (the host
+    growers run on callback threads, so a thread-local would miss)."""
+
+    def __init__(
+        self,
+        member: GangMember,
+        generation: Generation,
+        n_rows: int,
+        n_partitions: int,
+        checkpoint_every: int = 10,
+        reducer: Optional[TcpReducer] = None,
+        stragglers: Optional[StragglerTracker] = None,
+        evict_stragglers: bool = False,
+        min_world: int = 1,
+        allow_growback: bool = True,
+        global_rows: Optional[np.ndarray] = None,
+    ):
+        """``global_rows``: the full global feature matrix when the host
+        already has it (the ``fleet train`` data model: every host loads
+        the same ``--data``) — :meth:`binning_rows` then avoids
+        allreducing the entire dataset just to re-fit bin bounds."""
+        self.member = member
+        self.generation = generation
+        self.members = sorted(generation.members)
+        self.world = len(self.members)
+        self.global_n = int(n_rows)
+        self.n_partitions = int(n_partitions)
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.reducer = reducer
+        self.straggler_tracker = stragglers
+        self.evict_stragglers = evict_stragglers
+        self.min_world = max(1, int(min_world))
+        self.allow_growback = allow_growback
+        self.global_rows = global_rows
+        # loss debounce: a peer missing from the roster is only declared
+        # dead once its last sighting is older than this — an
+        # answering-but-freshly-restarted registry returns an EMPTY
+        # roster, and that window must not read as "everyone died"
+        self.loss_grace_s = max(1.0, 2.0 * member.heartbeat_s)
+        self.lo, self.hi = member_row_slice(
+            n_rows, n_partitions, self.members, member.name
+        )
+        self.lost: list = []
+        self.world_changed: Optional[int] = None
+        self.rounds_seen = 0
+        self._round_t = time.monotonic()
+        self._last_it = 0
+        self.started_t = time.monotonic()
+        self.first_round_done_t: Optional[float] = None
+        self._join_seq = 0
+        self.flagged_stragglers: list = []
+
+    # -- data movement --------------------------------------------------------
+
+    @property
+    def is_writer(self) -> bool:
+        """One checkpoint writer per generation: the coordinator. Every
+        member still participates in the gather (it is a collective)."""
+        return self.member.name == self.members[0]
+
+    def allreduce(self, arr: np.ndarray) -> np.ndarray:
+        if self.reducer is None or self.world <= 1:
+            return arr
+        try:
+            return self.reducer.allreduce(arr)
+        except HostLostError as e:
+            self.lost = e.lost
+            raise
+        except WorldChangedError as e:
+            self.world_changed = e.gen
+            raise
+
+    def all_rows(self, local: np.ndarray) -> np.ndarray:
+        """Local rows -> the (global_n, ...) array in global row order
+        (scatter + sum-allreduce; exact for f32 payloads on the f64
+        wire). The collective every member runs at checkpoint time."""
+        local = np.asarray(local)
+        if self.world <= 1:
+            return local
+        out = np.zeros((self.global_n,) + local.shape[1:], np.float64)
+        out[self.lo:self.hi] = local
+        return self.allreduce(out).astype(local.dtype)
+
+    def take_local(self, global_arr: np.ndarray) -> np.ndarray:
+        return np.asarray(global_arr)[self.lo:self.hi]
+
+    def binning_rows(self, local: np.ndarray) -> np.ndarray:
+        """The global rows bin bounds are fitted on. When the host holds
+        the full dataset (``global_rows``), hand it over directly —
+        bit-identical to the gather, with zero network traffic; the
+        allreduce path remains for gangs whose members only hold their
+        own slice."""
+        if self.global_rows is not None:
+            return np.asarray(self.global_rows, local.dtype)
+        return self.all_rows(local)
+
+    # -- round boundary hooks --------------------------------------------------
+
+    def on_round(self, it: int) -> None:
+        """Called by the training loop entering round/chunk ``it``:
+        update the straggler EWMA, run the detection check (fault point
+        ``elastic.detect``), and — on checkpoint boundaries, coordinator
+        only — grow-back and straggler policy. Raises
+        :class:`HostLostError` / :class:`WorldChangedError` to abort."""
+        now = time.monotonic()
+        if self.rounds_seen > 0:
+            # boundaries are CHUNK boundaries on the scan-fused path and
+            # ROUND boundaries on the per-iteration path: amortize over
+            # the rounds actually elapsed since the last boundary
+            dt = (now - self._round_t) / max(1, it - self._last_it)
+            a = 0.3
+            self.member.ewma_s = (
+                dt if self.member.ewma_s == 0.0
+                else a * dt + (1 - a) * self.member.ewma_s
+            )
+            _M_ROUND_EWMA.labels(host=self.member.name).set(
+                self.member.ewma_s
+            )
+            if self.first_round_done_t is None:
+                self.first_round_done_t = now
+        self._round_t = now
+        self._last_it = it
+        self.rounds_seen += 1
+        if (
+            self.world > 1 and self.rounds_seen == 2
+            and self.reducer is not None
+            and self.reducer.seq <= self._join_seq
+        ):
+            raise RuntimeError(
+                "elastic gang trained a round without a single gang "
+                "allreduce — the host histogram lowering was not selected "
+                "(elastic training requires the CPU host growers: "
+                "shard=False and MMLSPARK_TPU_HIST_HOST!=0)"
+            )
+        # fault point elastic.detect: a payload names a member to declare
+        # lost without killing anything (chaos for the reshard path); an
+        # injected error is the detector itself failing
+        forced = faults.inject(
+            "elastic.detect", context={"gen": self.generation.gen, "it": it}
+        )
+        ros = self.member.roster()
+        # roster None = every registry unreachable; a roster that lacks
+        # even OUR OWN entry is a registry that just restarted and has
+        # not collected heartbeats yet. Blindness in either form is not
+        # evidence of death — hold rather than split-brain the gang.
+        # For visible peers, a miss only counts once the last sighting
+        # is older than the loss grace (debounces the re-register race).
+        now_w = time.time()
+        lost = self.member.declared_dead(
+            [m for m in self.members if m != self.member.name],
+            ros, self.loss_grace_s,
+        )
+        if isinstance(forced, str) and forced in self.members:
+            lost.append(forced)
+        if lost:
+            for m in lost:
+                _M_DETECT.observe(
+                    max(0.0, now_w - self.member.last_seen.get(m, now_w))
+                )
+            self.lost = sorted(set(lost))
+            raise HostLostError(self.lost, self.generation.gen,
+                                "heartbeats lapsed at round boundary")
+        g = self.member.read_generation()
+        if g is not None and (
+            g.gen > self.generation.gen
+            or (
+                # same gen number, DIFFERENT members: racing survivors
+                # with divergent lost-sets committed conflicting records
+                # and the registry's last writer won — defer to it
+                g.gen == self.generation.gen
+                and sorted(g.members) != self.members
+            )
+        ):
+            self.world_changed = g.gen
+            raise WorldChangedError(g.gen)
+        if (
+            it % self.checkpoint_every == 0 and self.is_writer
+            and ros is not None
+        ):
+            self._coordinate(ros, it)
+
+    def _coordinate(self, ros: dict, it: int) -> None:
+        """Checkpoint-boundary duties of the generation coordinator:
+        grow-back (admit re-registered hosts) and straggler policy."""
+        joiners = sorted(
+            j for j in set(ros) - set(self.members)
+            # an evicted straggler only re-enters with a fresh boot (a
+            # restarted process); the same slow process stays out
+            if self.generation.evicted.get(j) != ros[j].get("boot")
+        )
+        # capacity: every member must own at least one partition — a
+        # 0-row member would gang-sum empty-gradient NaNs into everyone
+        joiners = joiners[:max(0, self.n_partitions - self.world)]
+        if joiners and self.allow_growback and it > 0:
+            g = Generation(
+                gen=self.generation.gen + 1,
+                members=sorted(set(self.members) | set(joiners)),
+                reason="grow",
+                resume_round=it,
+            )
+            self.member.commit_generation(g)
+            _M_RESHARDS.labels(reason="grow").inc()
+            self.world_changed = g.gen
+            raise WorldChangedError(g.gen)
+        if self.straggler_tracker is not None and self.world > 1:
+            ewmas = {
+                m: float(ros[m].get("ewma_ms", 0.0)) / 1e3
+                for m in self.members if m in ros
+            }
+            flagged = self.straggler_tracker.observe(ewmas)
+            self.flagged_stragglers = flagged
+            _M_STRAGGLERS.set(len(flagged))
+            evictable = [m for m in flagged if m != self.member.name]
+            if (
+                self.evict_stragglers and evictable
+                and self.world - len(evictable) >= self.min_world
+            ):
+                g = Generation(
+                    gen=self.generation.gen + 1,
+                    members=[m for m in self.members if m not in evictable],
+                    reason="straggler",
+                    resume_round=it,
+                    evicted={
+                        **self.generation.evicted,
+                        **{m: ros.get(m, {}).get("boot") for m in evictable},
+                    },
+                )
+                self.member.commit_generation(g)
+                _M_RESHARDS.labels(reason="straggler").inc()
+                self.world_changed = g.gen
+                raise WorldChangedError(g.gen)
+
+    # -- abort classification --------------------------------------------------
+
+    def abort_reason(self, exc: BaseException) -> Optional[Exception]:
+        """Was ``exc`` a gang change? In-callback failures surface as
+        ``XlaRuntimeError`` with the real cause recorded on this context,
+        so classify by state, not by exception type."""
+        if isinstance(exc, (HostLostError, WorldChangedError)):
+            return exc
+        if self.lost:
+            return HostLostError(self.lost, self.generation.gen)
+        if self.world_changed is not None:
+            return WorldChangedError(self.world_changed)
+        return None
+
+    def join(self, timeout_s: float = 30.0) -> None:
+        """Generation-formation barrier: one tiny allreduce proves every
+        member's transport before any training work. A member that died
+        between commit and join surfaces as a
+        :class:`~mmlspark_tpu.parallel.distributed.BarrierTimeoutError`
+        naming the missing host (the same diagnostic shape the SPMD
+        barrier raises)."""
+        if self.reducer is None or self.world <= 1:
+            return
+        old = self.reducer.timeout_s
+        self.reducer.timeout_s = timeout_s
+        try:
+            total = self.reducer.allreduce(np.ones(1))
+            if int(round(float(total[0]))) != self.world:
+                raise RuntimeError(
+                    f"gen {self.generation.gen} join barrier summed "
+                    f"{total[0]} != world {self.world}"
+                )
+        except HostLostError as e:
+            raise BarrierTimeoutError(
+                f"elastic-gen-{self.generation.gen}", timeout_s,
+                missing=e.lost,
+            ) from e
+        finally:
+            self.reducer.timeout_s = old
+            self._join_seq = self.reducer.seq
+
+    def healthy(self) -> bool:
+        return not self.lost and self.world_changed is None
+
+    def close(self) -> None:
+        if self.reducer is not None:
+            self.reducer.close()
+
+
+# -- process-global active gang (callback threads must see it) ---------------
+
+_ACTIVE_GANG: Optional[GangContext] = None
+
+
+def active_gang() -> Optional[GangContext]:
+    return _ACTIVE_GANG
+
+
+@contextlib.contextmanager
+def activate(gang: GangContext) -> Iterator[GangContext]:
+    global _ACTIVE_GANG
+    if _ACTIVE_GANG is not None:
+        raise RuntimeError("one elastic gang per process")
+    _ACTIVE_GANG = gang
+    try:
+        yield gang
+    finally:
+        _ACTIVE_GANG = None
+
+
+def gang_sum() -> Optional[Callable[[np.ndarray], np.ndarray]]:
+    """The host growers' hook: a summing callable when a multi-member
+    gang is active, else None (the common case costs one global read)."""
+    g = _ACTIVE_GANG
+    if g is None or g.world <= 1:
+        return None
+    return g.allreduce
+
+
+# -- checkpoint snapshot (the bit-identity audit trail) -----------------------
+
+
+def snapshot_checkpoint(ckpt_dir: str, gen: int) -> tuple:
+    """Copy the LATEST complete checkpoint into
+    ``<ckpt_dir>/reshard-g<gen>`` so the exact state a reshard resumed
+    from survives later checkpoints — a fresh shrunk-world run from this
+    snapshot must reproduce the survivor's booster bit-for-bit. Returns
+    ``(snapshot_dir, resume_round)``; ``(None, 0)`` when no checkpoint
+    exists yet (the reshard then restarts from round 0)."""
+    latest = os.path.join(ckpt_dir, "LATEST")
+    if not os.path.exists(latest):
+        return None, 0
+    with open(latest) as f:
+        name = f.read().strip()
+    src = os.path.join(ckpt_dir, name)
+    # the round rides the snapshot name: a leftover same-gen snapshot
+    # from an earlier run of this ckpt_dir can never be silently reused
+    # for a different resume point, and racing survivors whose LATEST
+    # reads were skewed publish DISTINCT snapshots, each self-consistent
+    # with the (snapshot, resume_round) pair its generation record names
+    snap = os.path.join(ckpt_dir, f"reshard-g{gen:04d}-{name}")
+    if not os.path.isdir(snap):
+        # build in a private tmp, publish with one atomic rename —
+        # racing survivors (divergent lost-sets can slip two committers
+        # past the lowest-survivor gate) then FIRST-WIN cleanly instead
+        # of interleaving rmtree/copytree on the same path
+        tmp = snap + f".tmp-{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        shutil.copytree(src, os.path.join(tmp, name))
+        with open(os.path.join(tmp, "LATEST"), "w") as f:
+            f.write(name)
+        try:
+            os.rename(tmp, snap)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)  # a racer won
+    with open(os.path.join(snap, "LATEST")) as f:
+        committed = f.read().strip()
+    return snap, int(committed.split("-")[-1])
+
+
+# -- the elastic trainer ------------------------------------------------------
+
+
+class ElasticTrainer:
+    """Drive one host's share of an elastic GBDT training run.
+
+    All hosts run this same loop (SPMD at the control plane): join the
+    gang, adopt/form a generation, load the contiguous partition run
+    assigned for that world, and train through ``models/gbdt/train.py``
+    with gang-summed histograms. A lost host aborts the in-flight round,
+    re-shards, and resumes from the latest checkpoint; a re-registered
+    host is grown back at the next checkpoint boundary."""
+
+    def __init__(
+        self,
+        registry_urls: Any,
+        name: str,
+        x: np.ndarray,
+        y: np.ndarray,
+        cfg: Any,
+        ckpt_dir: str,
+        n_partitions: int = 8,
+        world_size: int = 1,
+        service: str = "train",
+        checkpoint_every: int = 2,
+        heartbeat_s: float = 0.5,
+        gen_timeout_s: float = 120.0,
+        allreduce_timeout_s: float = 120.0,
+        resume_from: Optional[str] = None,
+        advertise_host: str = "127.0.0.1",
+        straggler_factor: float = 3.0,
+        straggler_rounds: int = 3,
+        evict_stragglers: bool = False,
+        min_world: int = 1,
+        status_file: Optional[str] = None,
+        allow_growback: bool = True,
+    ):
+        self.registry_urls = registry_urls
+        self.name = name
+        self.x = np.asarray(x)
+        self.y = np.asarray(y)
+        self.cfg = cfg
+        self.ckpt_dir = ckpt_dir
+        self.n_partitions = int(n_partitions)
+        self.world_size = int(world_size)
+        self.service = service
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.heartbeat_s = heartbeat_s
+        self.gen_timeout_s = gen_timeout_s
+        self.allreduce_timeout_s = allreduce_timeout_s
+        self.resume_from = resume_from
+        self.advertise_host = advertise_host
+        self.straggler_factor = straggler_factor
+        self.straggler_rounds = straggler_rounds
+        self.evict_stragglers = evict_stragglers
+        self.min_world = min_world
+        self.status_file = status_file
+        self.allow_growback = allow_growback
+        if self.world_size > self.n_partitions:
+            # every member must own >= 1 partition (a 0-row member's
+            # gang-summed empty gradients would poison the whole gang)
+            raise ValueError(
+                f"world_size {self.world_size} > n_partitions "
+                f"{self.n_partitions}: every member needs at least one "
+                "partition"
+            )
+        self.status: dict = {
+            "name": name, "gen": 0, "members": [], "round": 0,
+            "reshards": 0, "reshard_reasons": [], "resume_round": 0,
+            "snapshot": None, "detect_latency_s": None,
+            "reshard_to_first_round_s": None, "rounds_per_s_pre": None,
+            "rounds_per_s_post": None, "done": False,
+        }
+
+    # -- status ---------------------------------------------------------------
+
+    def _write_status(self) -> None:
+        if not self.status_file:
+            return
+        tmp = self.status_file + f".tmp-{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self.status, f)
+            os.replace(tmp, self.status_file)
+        except OSError:
+            pass
+
+    # -- the loop -------------------------------------------------------------
+
+    def run(self) -> Any:
+        from mmlspark_tpu.ops.histogram import use_host_hist
+
+        # the gang data plane lives in the host growers' histograms —
+        # refuse to train "distributed" through a lowering that would
+        # silently never call the gang allreduce
+        if not use_host_hist():
+            raise RuntimeError(
+                "elastic gang training requires the host histogram "
+                "lowering (MMLSPARK_TPU_HIST_HOST)"
+            )
+        member = GangMember(
+            self.registry_urls, self.name, service=self.service,
+            advertise_host=self.advertise_host,
+            heartbeat_s=self.heartbeat_s,
+        )
+        try:
+            gen = member.await_generation(
+                self.world_size, timeout_s=self.gen_timeout_s
+            )
+            while True:
+                booster = self._train_generation(member, gen)
+                if booster is not None:
+                    self.status["done"] = True
+                    self._write_status()
+                    return booster
+                g = member.read_generation()
+                if (
+                    g is not None and self.name not in g.members
+                    and g.evicted.get(self.name) == member.boot
+                ):
+                    # evicted as a straggler: exit so a supervisor
+                    # restart (fresh boot) can grow this host back in
+                    raise HostLostError(
+                        [self.name], g.gen,
+                        "evicted as sustained straggler",
+                    )
+                # min_gen = gen - 1: a membership CONFLICT resolves to a
+                # record with the SAME generation number (the registry's
+                # last writer), which must still be adoptable
+                gen = member.await_generation(
+                    self.world_size, timeout_s=self.gen_timeout_s,
+                    min_gen=gen.gen - 1,
+                )
+        finally:
+            member.close()
+
+    def _train_generation(self, member: GangMember, gen: Generation):
+        """Train under one generation. Returns the booster on completion
+        or None when the gang changed (the caller re-forms)."""
+        from mmlspark_tpu.models.gbdt.train import train
+
+        lo, hi = member_row_slice(
+            len(self.x), self.n_partitions, gen.members, self.name
+        )
+        if hi <= lo:
+            raise RuntimeError(
+                f"member {self.name!r} holds no partitions at world "
+                f"{len(gen.members)} (n_partitions={self.n_partitions})"
+            )
+        reducer = (
+            TcpReducer(member, gen, timeout_s=self.allreduce_timeout_s)
+            if len(gen.members) > 1 else None
+        )
+        gang = GangContext(
+            member, gen, n_rows=len(self.x),
+            n_partitions=self.n_partitions,
+            checkpoint_every=self.checkpoint_every, reducer=reducer,
+            global_rows=self.x,
+            stragglers=StragglerTracker(
+                self.straggler_factor, self.straggler_rounds
+            ),
+            evict_stragglers=self.evict_stragglers,
+            min_world=self.min_world,
+            allow_growback=self.allow_growback,
+        )
+        self.status.update(gen=gen.gen, members=sorted(gen.members))
+        self._write_status()
+        # the agreed resume point: a reshard's snapshot when there is
+        # one (every survivor resumes from the SAME state even if the
+        # writer's live dir ran one chunk ahead), else the live dir
+        # (crash-loop-safe auto-resume for supervisor-restarted hosts).
+        # An explicit --resume-from only seeds the run BEFORE it has a
+        # checkpoint of its own: later generations (grow/straggler carry
+        # no snapshot) must resume from the run's LATEST, not roll the
+        # whole gang back to the stale seed
+        has_own_ckpt = os.path.exists(os.path.join(self.ckpt_dir, "LATEST"))
+        resume = gen.snapshot or (
+            self.resume_from if not has_own_ckpt else None
+        ) or self.ckpt_dir
+        resume_t0 = time.monotonic()
+        try:
+            gang.join(timeout_s=self.gen_timeout_s)
+            with activate(gang):
+                booster = train(
+                    self.x[lo:hi], self.y[lo:hi], self.cfg, shard=False,
+                    checkpoint_dir=self.ckpt_dir,
+                    checkpoint_every=self.checkpoint_every,
+                    resume_from=resume,
+                )
+            if gang.first_round_done_t is not None and gen.gen > 1:
+                # generation adopted -> first completed round of the new
+                # world: the reshard-to-first-new-round recovery time
+                self.status["reshard_to_first_round_s"] = round(
+                    gang.first_round_done_t - resume_t0, 4
+                )
+            self.status["round"] = int(self.cfg.num_iterations)
+            if member.ewma_s:
+                self.status["rounds_per_s_post"] = round(
+                    1.0 / member.ewma_s, 3
+                )
+            self._write_status()
+            return booster
+        except BaseException as e:  # noqa: BLE001 — classify, then decide
+            abort = gang.abort_reason(e)
+            if abort is None:
+                if isinstance(e, BarrierTimeoutError) and e.missing:
+                    abort = HostLostError(e.missing, gen.gen, "join barrier")
+                else:
+                    raise
+            # fault point train.round_abort: fires as the in-flight round
+            # is abandoned; an injected delay stalls the abort -> reshard
+            # turnaround (shows up in recovery timings), an error kills
+            # the trainer (the supervisor-restart path)
+            faults.inject(
+                "train.round_abort",
+                context={"gen": gen.gen, "cause": type(abort).__name__},
+            )
+            _M_ABORTS.inc()
+            if member.ewma_s:
+                # throughput at the old world size, as of the abort —
+                # the denominator of "throughput retained after shrink"
+                self.status["rounds_per_s_pre"] = round(
+                    1.0 / member.ewma_s, 3
+                )
+            if isinstance(abort, HostLostError):
+                self._reshard(member, gen, abort)
+            return None
+        finally:
+            gang.close()
+
+    def _reshard(
+        self, member: GangMember, gen: Generation, err: HostLostError
+    ) -> None:
+        """Commit (coordinator) or await the shrunk generation."""
+        survivors = sorted(set(gen.members) - set(err.lost))
+        if self.name not in survivors:
+            return  # evicted/forced out: wait for grow-back
+        detect_latency = max(
+            (
+                time.time() - member.last_seen[m]
+                for m in err.lost if m in member.last_seen
+            ),
+            default=0.0,
+        )
+        self.status["reshards"] += 1
+        self.status["reshard_reasons"].append("lost")
+        self.status["detect_latency_s"] = round(detect_latency, 3)
+        self._write_status()
+        cur = member.read_generation()
+        if cur is not None and cur.gen > gen.gen:
+            return  # another survivor already committed the next world
+        if self.name == survivors[0]:
+            # fault point elastic.reshard: an injected error is "the
+            # commit refused" — retried until the plan relents
+            for attempt in range(100):
+                try:
+                    faults.inject(
+                        "elastic.reshard",
+                        context={"gen": gen.gen + 1, "attempt": attempt},
+                    )
+                    break
+                except Exception:  # noqa: BLE001 — injected refusal
+                    time.sleep(self.heartbeat_s)
+            snap, resume_round = snapshot_checkpoint(
+                self.ckpt_dir, gen.gen + 1
+            )
+            self.status.update(snapshot=snap, resume_round=resume_round)
+            member.commit_generation(Generation(
+                gen=gen.gen + 1, members=survivors, reason="lost",
+                resume_round=resume_round, snapshot=snap,
+                detect_latency_s=round(detect_latency, 3),
+            ))
+            _M_RESHARDS.labels(reason="lost").inc()
+        self._write_status()
+
+
+# -- data specs for the fleet `train` role ------------------------------------
+
+
+def load_training_data(spec: str) -> tuple:
+    """``synth:<n>x<d>:<seed>`` — the deterministic toy binary dataset
+    every host regenerates identically; ``npz:<path>`` — ``x``/``y``
+    arrays on a shared filesystem."""
+    if spec.startswith("synth:"):
+        shape, _, seed = spec[len("synth:"):].partition(":")
+        n, _, d = shape.partition("x")
+        n, d, seed = int(n), int(d), int(seed or 0)
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(n, d)).astype(np.float32)
+        y = (
+            x[:, 0] + 0.5 * x[:, 1] + 0.1 * r.normal(size=n) > 0
+        ).astype(np.float64)
+        return x, y
+    if spec.startswith("npz:"):
+        with np.load(spec[len("npz:"):]) as z:
+            return np.asarray(z["x"]), np.asarray(z["y"])
+    raise ValueError(f"unknown training data spec {spec!r}")
+
+
+__all__ = [
+    "ElasticTrainer",
+    "GangContext",
+    "GangMember",
+    "Generation",
+    "HostLostError",
+    "StragglerTracker",
+    "TcpReducer",
+    "WorldChangedError",
+    "active_gang",
+    "activate",
+    "assign_partitions",
+    "gang_sum",
+    "load_training_data",
+    "member_row_slice",
+    "partition_bounds",
+    "snapshot_checkpoint",
+]

@@ -51,12 +51,16 @@ def make_model_handler(model_spec: str) -> Callable:
 
 
 def run_registry(
-    host: str = "0.0.0.0", port: int = 9090, ttl_s: Optional[float] = None
+    host: str = "0.0.0.0", port: int = 9090, ttl_s: Optional[float] = None,
+    peers: Optional[list] = None, reconcile_s: float = 5.0,
 ) -> Any:
     from mmlspark_tpu import obs
     from mmlspark_tpu.serving.registry import DriverRegistry
 
-    reg = DriverRegistry(host=host, port=port, ttl_s=ttl_s)
+    reg = DriverRegistry(
+        host=host, port=port, ttl_s=ttl_s, peers=peers,
+        reconcile_s=reconcile_s,
+    )
     obs.set_process_label(f"registry@{reg.host}:{reg.port}")
     print(f"registry: {reg.url}", flush=True)
     return reg
@@ -685,6 +689,87 @@ def run_gateway(
     return gw
 
 
+def run_train(
+    registry_url: str,
+    name: str,
+    data: str,
+    ckpt_dir: str,
+    partitions: int = 8,
+    world_size: int = 1,
+    service_name: str = "train",
+    num_iterations: int = 100,
+    num_leaves: int = 31,
+    learning_rate: float = 0.1,
+    min_data_in_leaf: int = 20,
+    seed: int = 0,
+    objective: str = "binary",
+    boosting_type: str = "gbdt",
+    growth_policy: str = "lossguide",
+    checkpoint_every: int = 2,
+    heartbeat_s: float = 0.5,
+    gen_timeout_s: float = 120.0,
+    advertise_host: str = "127.0.0.1",
+    straggler_factor: float = 3.0,
+    straggler_rounds: int = 3,
+    evict_stragglers: bool = False,
+    min_world: int = 1,
+    resume_from: Optional[str] = None,
+    status_file: Optional[str] = None,
+    out_model: Optional[str] = None,
+    allow_growback: bool = True,
+) -> Any:
+    """``fleet train``: one elastic training host (parallel/elastic.py).
+
+    All hosts of the gang run this same role with the same ``--data`` /
+    config and a shared ``--ckpt-dir``; membership and the generation
+    record ride the ``--registry`` (run it with ``--ttl-s`` a few
+    heartbeat periods so a dead host's loss is detectable). A SIGKILLed
+    trainer restarted by ``fleet supervise --train`` auto-resumes from
+    its checkpoint dir and grows back into the gang at the next
+    checkpoint boundary. Batch-style role: returns the booster when the
+    run completes (the process exits, unlike the serving roles)."""
+    import hashlib
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.models.gbdt.train import TrainConfig
+    from mmlspark_tpu.parallel.elastic import (
+        ElasticTrainer,
+        load_training_data,
+    )
+
+    obs.set_process_label(f"{service_name}@{name}")
+    x, y = load_training_data(data)
+    cfg = TrainConfig(
+        objective=objective, num_iterations=num_iterations,
+        num_leaves=num_leaves, learning_rate=learning_rate,
+        min_data_in_leaf=min_data_in_leaf, seed=seed,
+        boosting_type=boosting_type, growth_policy=growth_policy,
+    )
+    trainer = ElasticTrainer(
+        registry_url, name, x, y, cfg, ckpt_dir,
+        n_partitions=partitions, world_size=world_size,
+        service=service_name, checkpoint_every=checkpoint_every,
+        heartbeat_s=heartbeat_s, gen_timeout_s=gen_timeout_s,
+        resume_from=resume_from, advertise_host=advertise_host,
+        straggler_factor=straggler_factor,
+        straggler_rounds=straggler_rounds,
+        evict_stragglers=evict_stragglers, min_world=min_world,
+        status_file=status_file, allow_growback=allow_growback,
+    )
+    booster = trainer.run()
+    model = booster.to_model_string()
+    if out_model:
+        tmp = out_model + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(model)
+        import os as _os
+
+        _os.replace(tmp, out_model)
+    digest = hashlib.sha256(model.encode()).hexdigest()
+    print(f"train: {name} done, model sha256 {digest}", flush=True)
+    return booster
+
+
 def run_supervise(
     registry_url: str,
     workers: list,
@@ -704,6 +789,7 @@ def run_supervise(
     idle_after_s: float = 30.0,
     util_threshold: float = 0.85,
     gateway_url: Optional[str] = None,
+    trains: Optional[list] = None,
 ) -> Any:
     """``fleet supervise``: spawn each ``--worker`` charge as a ``fleet
     worker`` process and keep it alive — restart on crash, kill+restart
@@ -722,12 +808,20 @@ def run_supervise(
     from mmlspark_tpu import obs
     from mmlspark_tpu.serving.supervisor import (
         FleetSupervisor,
+        charge_from_train_args,
         charge_from_worker_args,
     )
 
     charges = [
         charge_from_worker_args(w, registry_url, i)
         for i, w in enumerate(workers)
+    ]
+    # training charges: a SIGKILLed elastic trainer restarts with its
+    # full argv, auto-resumes from its --ckpt-dir, and grows back into
+    # the gang at the next checkpoint boundary (parallel/elastic.py)
+    charges += [
+        charge_from_train_args(t, registry_url, i)
+        for i, t in enumerate(trains or [])
     ]
     autoscaler = signals_fn = None
     template = worker_template
@@ -970,6 +1064,16 @@ def main(argv: Optional[list] = None) -> None:
         help="drop roster entries not re-registered within this many "
         "seconds (a few worker heartbeat periods)",
     )
+    r.add_argument(
+        "--peer", action="append", default=[],
+        help="peer registry base URL for anti-entropy (repeatable): "
+        "rosters are periodically pulled from peers and merged by "
+        "newest registration stamp, so partitioned registries reconverge",
+    )
+    r.add_argument(
+        "--reconcile-s", type=float, default=5.0,
+        help="anti-entropy pull interval against --peer registries",
+    )
     w = sub.add_parser("worker")
     w.add_argument("--registry", required=True)
     w.add_argument("--model", default="echo")
@@ -1057,11 +1161,19 @@ def main(argv: Optional[list] = None) -> None:
     )
     sv.add_argument("--registry", required=True)
     sv.add_argument(
-        "--worker", action="append", default=[], required=True,
+        "--worker", action="append", default=[],
         metavar="\"WORKER ARGS\"",
         help="one supervised worker's `fleet worker` arguments, quoted "
         "(repeatable); --registry is prepended automatically. A fixed "
         "--port enables /health wedge detection",
+    )
+    sv.add_argument(
+        "--train", action="append", default=[],
+        metavar="\"TRAIN ARGS\"",
+        help="one supervised elastic trainer's `fleet train` arguments, "
+        "quoted (repeatable); a SIGKILLed trainer restarts warm from "
+        "its --ckpt-dir and rejoins the gang at the next checkpoint "
+        "boundary",
     )
     sv.add_argument("--service-name", default="serving")
     sv.add_argument("--host", default="127.0.0.1")
@@ -1147,6 +1259,54 @@ def main(argv: Optional[list] = None) -> None:
         "--distributed", action="store_true",
         help="shard micro-batches over the device mesh with a pmean "
         "allreduce per pass (multi-chip training)",
+    )
+    tn = sub.add_parser(
+        "train",
+        help="one elastic training host: gang membership over the "
+        "registry, TCP histogram allreduce, reshard-and-resume on host "
+        "loss (parallel/elastic.py; docs/robustness.md)",
+    )
+    tn.add_argument("--registry", required=True)
+    tn.add_argument("--name", required=True,
+                    help="this host's gang member name")
+    tn.add_argument(
+        "--data", required=True,
+        help="training data spec: synth:<n>x<d>:<seed> or npz:<path> "
+        "(every host must see the same dataset)",
+    )
+    tn.add_argument("--ckpt-dir", required=True,
+                    help="shared checkpoint dir (doubles as auto-resume)")
+    tn.add_argument("--partitions", type=int, default=8)
+    tn.add_argument("--world-size", type=int, default=1,
+                    help="members to wait for before generation 1 forms")
+    tn.add_argument("--service-name", default="train")
+    tn.add_argument("--num-iterations", type=int, default=100)
+    tn.add_argument("--num-leaves", type=int, default=31)
+    tn.add_argument("--learning-rate", type=float, default=0.1)
+    tn.add_argument("--min-data-in-leaf", type=int, default=20)
+    tn.add_argument("--seed", type=int, default=0)
+    tn.add_argument("--objective", default="binary")
+    tn.add_argument("--boosting-type", default="gbdt")
+    tn.add_argument("--growth-policy", default="lossguide")
+    tn.add_argument("--checkpoint-every", type=int, default=2)
+    tn.add_argument("--heartbeat-s", type=float, default=0.5)
+    tn.add_argument("--gen-timeout-s", type=float, default=120.0)
+    tn.add_argument("--advertise-host", default="127.0.0.1")
+    tn.add_argument("--straggler-factor", type=float, default=3.0)
+    tn.add_argument("--straggler-rounds", type=int, default=3)
+    tn.add_argument("--evict-stragglers", action="store_true")
+    tn.add_argument("--min-world", type=int, default=1)
+    tn.add_argument("--resume-from", default=None,
+                    help="resume from this checkpoint dir/snapshot "
+                    "instead of --ckpt-dir's LATEST")
+    tn.add_argument("--status-file", default=None,
+                    help="JSON progress/recovery-timing file (atomic "
+                    "rewrites; the bench and chaos tests read it)")
+    tn.add_argument("--out-model", default=None,
+                    help="write the final model string here")
+    tn.add_argument(
+        "--no-growback", action="store_true",
+        help="do not admit re-registered hosts at checkpoint boundaries",
     )
     t = sub.add_parser(
         "top", help="scrape /metrics across the fleet, print a summary"
@@ -1256,11 +1416,35 @@ def main(argv: Optional[list] = None) -> None:
             if args.watch <= 0:
                 break
             time.sleep(args.watch)
+    elif args.role == "train":
+        run_train(
+            args.registry, args.name, args.data, args.ckpt_dir,
+            partitions=args.partitions, world_size=args.world_size,
+            service_name=args.service_name,
+            num_iterations=args.num_iterations,
+            num_leaves=args.num_leaves, learning_rate=args.learning_rate,
+            min_data_in_leaf=args.min_data_in_leaf, seed=args.seed,
+            objective=args.objective, boosting_type=args.boosting_type,
+            growth_policy=args.growth_policy,
+            checkpoint_every=args.checkpoint_every,
+            heartbeat_s=args.heartbeat_s,
+            gen_timeout_s=args.gen_timeout_s,
+            advertise_host=args.advertise_host,
+            straggler_factor=args.straggler_factor,
+            straggler_rounds=args.straggler_rounds,
+            evict_stragglers=args.evict_stragglers,
+            min_world=args.min_world, resume_from=args.resume_from,
+            status_file=args.status_file, out_model=args.out_model,
+            allow_growback=not args.no_growback,
+        )
     elif args.role == "registry":
         from mmlspark_tpu.obs.flightrec import install_sigusr1
 
         install_sigusr1()
-        reg = run_registry(args.host, args.port, args.ttl_s)
+        reg = run_registry(
+            args.host, args.port, args.ttl_s, peers=args.peer or None,
+            reconcile_s=args.reconcile_s,
+        )
         _serve_forever([reg])
     elif args.role == "worker":
         from mmlspark_tpu.obs.flightrec import install_sigusr1
@@ -1280,8 +1464,11 @@ def main(argv: Optional[list] = None) -> None:
         )
         _serve_forever([stop, q, srv])
     elif args.role == "supervise":
+        if not args.worker and not args.train:
+            ap.error("supervise needs at least one --worker or --train")
         sup = run_supervise(
             args.registry, args.worker, service_name=args.service_name,
+            trains=args.train,
             probe_s=args.probe_s, wedge_after=args.wedge_after,
             backoff_s=args.backoff_s, backoff_max_s=args.backoff_max_s,
             host=args.host, port=args.port,

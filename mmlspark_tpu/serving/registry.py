@@ -35,6 +35,14 @@ _M_ENTRIES = obs.gauge(
     "mmlspark_registry_entries_count",
     "Live roster entries per service", labels=("service",),
 )
+_M_RECONCILES = obs.counter(
+    "mmlspark_registry_reconciles_total",
+    "Anti-entropy passes pulled from peer registries",
+)
+_M_RECONCILED = obs.counter(
+    "mmlspark_registry_reconciled_entries_total",
+    "Roster entries adopted from peers (newer registration stamp)",
+)
 
 
 class DriverRegistry:
@@ -42,6 +50,8 @@ class DriverRegistry:
         self, host: str = "127.0.0.1", port: int = 0,
         max_entries_per_service: int = 256,
         ttl_s: Optional[float] = None,
+        peers: Optional[list] = None,
+        reconcile_s: float = 5.0,
     ):
         """``max_entries_per_service`` bounds each roster: crash-looping
         workers on ephemeral ports register a NEW (host, port) every
@@ -52,12 +62,31 @@ class DriverRegistry:
         is older than this is dropped at the next read. Workers heartbeat
         by re-registering (serving/fleet.py), so a silently-dead host
         vanishes from the roster within one TTL instead of lingering until
-        gateway failures evict it; set it to a few heartbeat periods."""
+        gateway failures evict it; set it to a few heartbeat periods.
+
+        ``peers``: anti-entropy (ROADMAP 5c) — multi-registry fleets can
+        disagree after a partition (clients multi-home their heartbeats,
+        but a registry that missed beats holds a stale roster). Every
+        ``reconcile_s`` this registry pulls each peer's roster and merges
+        entries by NEWEST registration stamp; a worker that could only
+        reach one registry during a partition becomes visible on all of
+        them within one pass after heal. TTL still governs liveness, so
+        a truly-dead entry adopted from a peer expires normally."""
         self.host = host
         self.max_entries_per_service = max_entries_per_service
         self.ttl_s = ttl_s
+        self.peers = [p.rstrip("/") for p in (peers or [])]
+        self.reconcile_s = reconcile_s
         self._services: dict[str, list] = {}
+        # anti-entropy tombstones: explicit DELETEs recorded by (service,
+        # host, port) -> delete time, so a reconcile pass cannot
+        # resurrect a cleanly-deregistered worker from a peer that
+        # missed the goodbye (a RE-registration after the delete carries
+        # a newer stamp and wins over the tombstone)
+        self._tombstones: dict = {}
         self._lock = threading.Lock()
+        self._stop_reconcile = threading.Event()
+        self._reconcile_thread: Optional[threading.Thread] = None
         registry = self
 
         def expire_locked() -> None:
@@ -155,6 +184,8 @@ class DriverRegistry:
                         if (e.get("host"), e.get("port")) != key
                     ]
                     removed = before - len(entries)
+                    registry._tombstones[(name,) + key] = time.time()
+                    registry._prune_tombstones_locked()
                     if removed:
                         _M_DEREGISTRATIONS.labels(service=name).inc(removed)
                         _M_ENTRIES.labels(service=name).set(len(entries))
@@ -206,6 +237,93 @@ class DriverRegistry:
             target=self._httpd.serve_forever, name="driver-registry", daemon=True
         )
         self._thread.start()
+        if self.peers:
+            self._reconcile_thread = threading.Thread(
+                target=self._reconcile_loop, name="registry-reconcile",
+                daemon=True,
+            )
+            self._reconcile_thread.start()
+
+    # -- anti-entropy ---------------------------------------------------------
+
+    def _prune_tombstones_locked(self) -> None:
+        """Tombstones older than any peer's plausible stale copy can be
+        forgotten (a dead entry that old fails the TTL floor anyway);
+        without a TTL keep them a few minutes. Called on every DELETE
+        too, so a peer-less registry under restart churn cannot grow
+        them without bound."""
+        horizon = time.time() - (
+            2 * self.ttl_s if self.ttl_s is not None else 300.0
+        )
+        for k in [k for k, t in self._tombstones.items() if t < horizon]:
+            del self._tombstones[k]
+
+    def _reconcile_loop(self) -> None:
+        while not self._stop_reconcile.is_set():
+            self._stop_reconcile.wait(self.reconcile_s)
+            if self._stop_reconcile.is_set():
+                return
+            try:
+                self.reconcile_now()
+            except Exception:  # noqa: BLE001 — a dead peer must not kill us
+                pass
+
+    def reconcile_now(self) -> int:
+        """One anti-entropy pass: pull every peer's roster, merge entries
+        whose registration stamp is newer than the local copy's (or that
+        the local roster lacks entirely). Returns entries adopted.
+        Exposed separately so tests drive deterministic passes."""
+        adopted = 0
+        for peer in self.peers:
+            try:
+                resp = send_request(
+                    HTTPRequestData(peer + "/", "GET"), timeout=5.0
+                )
+                if resp["status_code"] != 200:
+                    continue
+                remote = json.loads(resp["entity"])
+            except Exception:  # noqa: BLE001 — partitioned/dead peer: skip
+                continue
+            floor = (
+                time.time() - self.ttl_s if self.ttl_s is not None else None
+            )
+            with self._lock:
+                self._prune_tombstones_locked()
+                for svc, entries in remote.items():
+                    local = self._services.setdefault(svc, [])
+                    by_key = {
+                        (e.get("host"), e.get("port")): e for e in local
+                    }
+                    for e in entries:
+                        ts = float(e.get("ts", 0.0))
+                        if floor is not None and ts < floor:
+                            continue  # would expire immediately anyway
+                        key = (e.get("host"), e.get("port"))
+                        dead = self._tombstones.get((svc,) + key)
+                        if dead is not None and ts <= dead:
+                            continue  # explicitly deregistered here —
+                            # only a NEWER re-registration resurrects it
+                        mine = by_key.get(key)
+                        if mine is not None and float(
+                            mine.get("ts", 0.0)
+                        ) >= ts:
+                            continue  # local copy is as new or newer
+                        if mine is not None:
+                            local.remove(mine)
+                        local.append(dict(e))
+                        by_key[key] = e
+                        adopted += 1
+                    if len(local) > self.max_entries_per_service:
+                        local.sort(key=lambda e: e.get("ts", 0.0))
+                        del local[: len(local) - self.max_entries_per_service]
+                    if not local:
+                        self._services.pop(svc, None)
+                    else:
+                        _M_ENTRIES.labels(service=svc).set(len(local))
+        _M_RECONCILES.inc()
+        if adopted:
+            _M_RECONCILED.inc(adopted)
+        return adopted
 
     @property
     def url(self) -> str:
@@ -225,6 +343,9 @@ class DriverRegistry:
         return sorted({e.get("host") for e in self.services(name)})
 
     def stop(self) -> None:
+        self._stop_reconcile.set()
+        if self._reconcile_thread is not None:
+            self._reconcile_thread.join(5.0)
         self._httpd.shutdown()
         self._thread.join(5.0)
         # shutdown() only stops the serve loop; the listening socket must

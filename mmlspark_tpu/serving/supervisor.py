@@ -487,6 +487,33 @@ class FleetSupervisor:
             self._stop.wait(self.probe_s)
 
 
+def charge_from_train_args(
+    args_str: str, registry_url: str, index: int,
+    python: Optional[str] = None,
+) -> WorkerCharge:
+    """One ``--train "<fleet train args>"`` CLI string -> a charge.
+
+    Training charges make the supervisor the training plane's crash
+    handler: a SIGKILLed trainer is re-spawned with its full original
+    argv, and because ``fleet train`` auto-resumes from its ``--ckpt-dir``
+    (checkpoint_dir doubles as resume_from), the restart comes back WARM
+    at the latest round checkpoint and rejoins the gang at the next
+    checkpoint boundary (parallel/elastic.py grow-back). Trainers run no
+    HTTP ingress, so they are supervised on process liveness alone."""
+    extra = shlex.split(args_str)
+    argv = [
+        python or sys.executable, "-m", "mmlspark_tpu.serving.fleet",
+        "train", "--registry", registry_url, *extra,
+    ]
+    name = "trainer"
+    if "--name" in extra:
+        try:
+            name = extra[extra.index("--name") + 1]
+        except IndexError:
+            pass
+    return WorkerCharge(argv, name=f"train-{index}:{name}", health_url=None)
+
+
 def charge_from_worker_args(
     args_str: str, registry_url: str, index: int,
     python: Optional[str] = None,

@@ -887,6 +887,7 @@ def test_chaos_online_worker_kill_mid_training_zero_drop(tmp_path):
     # snapshot dir; the restart --load spec must survive all soak long)
     trainer = OnlineTrainer(num_bits=bits, batch=32)
     trainer.step(feedback_chunk())
+    seed_examples = trainer.examples  # pre-stream seed, excluded below
     seed_dir = tmp_path / "seed"
     seed_pub = Publisher(
         model="vw-online", snapshot_dir=str(seed_dir),
@@ -920,7 +921,10 @@ def test_chaos_online_worker_kill_mid_training_zero_drop(tmp_path):
             gateway_url=f"http://127.0.0.1:{ginfo.port}",
         ),
     ).start()
-    stream = FeedbackStream(max_chunks=64)
+    # disk-backed spill: the soak can assert no FEEDBACK loss (not just
+    # no request loss) — every ingested example must end trained,
+    # buffered, deliberately shed, or crash-replayable
+    stream = FeedbackStream(max_chunks=64, spill_dir=str(tmp_path / "spill"))
     publisher = Publisher(
         model="vw-online", snapshot_dir=str(tmp_path / "snaps"),
         registry_url=reg.url,
@@ -1016,6 +1020,21 @@ def test_chaos_online_worker_kill_mid_training_zero_drop(tmp_path):
         )
         # -- the autoscaler held the floor ----------------------------------
         assert len(sup.charges) >= 2, "autoscaler shrank below min_replicas"
+        # -- no silent feedback loss ----------------------------------------
+        loop.stop()  # freeze consumption before the accounting reads
+        # every example that entered the stream is accounted for: folded
+        # into the model, still buffered, or deliberately shed by the
+        # bounded buffer (counted) — nothing vanished
+        with stream._cond:
+            buffered = sum(len(c) for _, c, _ in stream._buf)
+        consumed = trainer.examples - seed_examples
+        assert stream.ingested == (
+            consumed + buffered + stream.dropped_examples
+        ), (stream.ingested, consumed, buffered, stream.dropped_examples)
+        # and the backlog is crash-durable: a fresh stream over the same
+        # spill replays exactly the unserved examples
+        replay = FeedbackStream(spill_dir=str(tmp_path / "spill"))
+        assert replay.replayed == buffered, (replay.replayed, buffered)
     finally:
         stop_traffic.set()
         loop.stop()

@@ -1,0 +1,536 @@
+"""Elastic self-healing distributed training (parallel/elastic.py).
+
+Unit layer: partition assignment invariance, straggler policy, the
+registry-stamped generation protocol, TCP allreduce + loss detection,
+and the world-1 bit-identity anchor. Chaos layer (subprocess gangs over
+a real registry): SIGKILL one training host mid-round — survivors
+detect, re-shard, resume, and the final booster is bit-identical to a
+fresh shrunk-world run from the same checkpoint; a supervisor-restarted
+host grows back in at the next checkpoint boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.faults import FaultPlan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _child_env() -> dict:
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # scrub the axon sitecustomize: children must be plain CPU
+        if k not in ("PYTHONPATH", "PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
+                     "XLA_FLAGS")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+    return env
+
+
+# -- partition assignment -----------------------------------------------------
+
+
+def test_partition_assignment_contiguous_and_world_invariant():
+    """Members take contiguous partition runs in sorted order, so the
+    concatenation of member rows is the global dataset in original order
+    at EVERY world size — the bit-identity contract's foundation."""
+    from mmlspark_tpu.parallel.elastic import (
+        assign_partitions,
+        member_row_slice,
+        partition_bounds,
+    )
+
+    bounds = partition_bounds(1003, 8)
+    assert bounds[0][0] == 0 and bounds[-1][1] == 1003
+    assert all(b[0] == a[1] for a, b in zip(bounds, bounds[1:]))
+    for members in (["a"], ["a", "b"], ["c", "a", "b"], list("abcdefgh")):
+        asg = assign_partitions(8, members)
+        flat = [p for m in sorted(members) for p in asg[m]]
+        assert flat == list(range(8))  # every partition exactly once
+        slices = [member_row_slice(1003, 8, members, m)
+                  for m in sorted(members)]
+        assert slices[0][0] == 0 and slices[-1][1] == 1003
+        assert all(s[1] == t[0] for s, t in zip(slices, slices[1:]))
+
+
+def test_straggler_tracker_flags_sustained_slow_only():
+    from mmlspark_tpu.parallel.elastic import StragglerTracker
+
+    t = StragglerTracker(factor=3.0, sustain=3)
+    fast = {"a": 0.1, "b": 0.1, "c": 0.1}
+    assert t.observe(fast) == []
+    slow = {"a": 0.1, "b": 0.1, "c": 0.9}
+    assert t.observe(slow) == []          # 1st slow observation
+    assert t.observe(slow) == []          # 2nd
+    assert t.observe(slow) == ["c"]       # sustained -> flagged
+    assert t.observe(fast) == []          # recovered -> streak reset
+    assert t.observe(slow) == []          # must re-sustain from scratch
+
+
+# -- generation protocol over the registry ------------------------------------
+
+
+@pytest.fixture()
+def gang_registry():
+    from mmlspark_tpu.serving import fleet
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=1.0)
+    yield reg
+    reg.stop()
+
+
+def test_generation_record_is_registry_stamped_latest_wins(gang_registry):
+    from mmlspark_tpu.parallel.elastic import GangMember, Generation
+
+    m = GangMember(gang_registry.url, "a", heartbeat_s=0.2)
+    try:
+        m.commit_generation(Generation(gen=1, members=["a", "b"]))
+        m.commit_generation(Generation(
+            gen=2, members=["a"], reason="lost", resume_round=6,
+        ))
+        g = m.read_generation()
+        assert g.gen == 2 and g.members == ["a"] and g.reason == "lost"
+        assert g.resume_round == 6 and g.committer == "a"
+        assert g.stamp > 0  # the REGISTRY stamped it, not the member
+    finally:
+        m.close()
+
+
+def test_gang_members_form_generation_and_detect_loss(gang_registry):
+    """Two members rendezvous through the registry (lowest name commits
+    generation 1); when one's heartbeats stop, the survivor's next round
+    boundary raises HostLostError naming exactly the dead host."""
+    from mmlspark_tpu.parallel.elastic import (
+        GangContext,
+        GangMember,
+        HostLostError,
+        WorldChangedError,
+        Generation,
+    )
+
+    a = GangMember(gang_registry.url, "a", heartbeat_s=0.2)
+    b = GangMember(gang_registry.url, "b", heartbeat_s=0.2)
+    try:
+        gens = {}
+
+        def await_b():
+            gens["b"] = b.await_generation(2, timeout_s=20.0)
+
+        t = threading.Thread(target=await_b)
+        t.start()
+        gens["a"] = a.await_generation(2, timeout_s=20.0)
+        t.join(20.0)
+        assert gens["a"].gen == 1 and gens["a"].members == ["a", "b"]
+        assert gens["b"].gen == 1
+        ros = a.roster()
+        assert set(ros) == {"a", "b"} and "ewma_ms" in ros["a"]
+        # b dies (clean close deregisters; a crash would TTL out instead)
+        b.close()
+        deadline = time.monotonic() + 10.0
+        while "b" in (a.roster() or {}) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        gang = GangContext(a, gens["a"], n_rows=100, n_partitions=4)
+        # inside the loss grace, absence is not yet death (debounces a
+        # freshly-restarted registry's empty roster)
+        gang.on_round(0)
+        time.sleep(gang.loss_grace_s + 0.2)
+        with pytest.raises(HostLostError) as ei:
+            gang.on_round(1)
+        assert ei.value.lost == ["b"]
+        # a newer generation committed by someone else aborts too (all
+        # of THIS gang's members alive, so loss detection stays quiet)
+        gang2 = GangContext(
+            a, Generation(gen=2, members=["a"]), n_rows=100, n_partitions=4
+        )
+        a.commit_generation(Generation(gen=5, members=["a"]))
+        with pytest.raises(WorldChangedError):
+            gang2.on_round(1)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_forced_detect_and_reshard_commit_retries_through_fault(
+    gang_registry, tmp_path
+):
+    """Fault point ``elastic.detect``: a payload declares a named member
+    lost without killing anything; ``elastic.reshard``: an injected
+    commit refusal is retried until the plan relents."""
+    from mmlspark_tpu.models.gbdt.train import TrainConfig
+    from mmlspark_tpu.parallel.elastic import (
+        ElasticTrainer,
+        GangContext,
+        GangMember,
+        Generation,
+        HostLostError,
+    )
+
+    a = GangMember(gang_registry.url, "a", heartbeat_s=0.2)
+    b = GangMember(gang_registry.url, "b", heartbeat_s=0.2)
+    try:
+        gen = Generation(gen=1, members=["a", "b"])
+        a.adopt(gen)
+        gang = GangContext(a, gen, n_rows=100, n_partitions=4)
+        plan = FaultPlan().on("elastic.detect", payload="b", at=(0,))
+        with plan.armed():
+            with pytest.raises(HostLostError) as ei:
+                gang.on_round(0)
+        assert ei.value.lost == ["b"]
+        # the reshard commit: first attempt refused, second lands
+        x = np.zeros((100, 4), np.float32)
+        trainer = ElasticTrainer(
+            gang_registry.url, "a", x, np.zeros(100), TrainConfig(),
+            str(tmp_path / "ck"), n_partitions=4, heartbeat_s=0.05,
+        )
+        plan2 = FaultPlan().on(
+            "elastic.reshard", error=ConnectionError, max_fires=1
+        )
+        with plan2.armed():
+            trainer._reshard(a, gen, ei.value)
+        assert len(plan2.fires()) == 1  # refused once, then committed
+        g2 = a.read_generation()
+        assert g2.gen == 2 and g2.members == ["a"] and g2.reason == "lost"
+        assert trainer.status["reshards"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+# -- the TCP allreduce --------------------------------------------------------
+
+
+def test_tcp_reducer_allreduce_sums_and_detects_loss(gang_registry):
+    from mmlspark_tpu.parallel.elastic import (
+        GangMember,
+        Generation,
+        HostLostError,
+        TcpReducer,
+    )
+
+    a = GangMember(gang_registry.url, "a", heartbeat_s=0.2)
+    b = GangMember(gang_registry.url, "b", heartbeat_s=0.2)
+    try:
+        time.sleep(0.3)  # both registered
+        gen = Generation(gen=1, members=["a", "b"])
+        ra = TcpReducer(a, gen, timeout_s=20.0)
+        rb = TcpReducer(b, gen, timeout_s=20.0)
+        out = {}
+
+        def side(red, arrs, key):
+            got = [red.allreduce(x) for x in arrs]
+            out[key] = got
+
+        xa = [np.arange(6, dtype=np.float32).reshape(2, 3),
+              np.ones(4, np.float64)]
+        xb = [np.full((2, 3), 10.0, np.float32),
+              np.full(4, 2.0, np.float64)]
+        t = threading.Thread(target=side, args=(rb, xb, "b"))
+        t.start()
+        side(ra, xa, "a")
+        t.join(20.0)
+        for got_a, got_b, ea, eb in zip(out["a"], out["b"], xa, xb):
+            np.testing.assert_array_equal(got_a, got_b)
+            np.testing.assert_allclose(got_a, ea + eb)
+            assert got_a.dtype == ea.dtype and got_a.shape == ea.shape
+        # b vanishes: a's next allreduce fails naming it once the TTL
+        # lapses, instead of hanging forever (the socket-allreduce fix)
+        rb.close()
+        b.close()
+        with pytest.raises(HostLostError) as ei:
+            ra.allreduce(np.ones(2))
+        assert ei.value.lost == ["b"]
+        ra.close()
+    finally:
+        a.close()
+        b.close()
+
+
+# -- world-1 anchor: the gang path IS the plain path --------------------------
+
+
+def test_world1_elastic_training_bit_identical_to_plain_train(
+    gang_registry, tmp_path
+):
+    """A single-member gang must train bit-identically to plain
+    unsharded ``train()`` — the anchor that makes the shrunk-world
+    comparison meaningful."""
+    from mmlspark_tpu.models.gbdt.train import TrainConfig, train
+    from mmlspark_tpu.parallel.elastic import (
+        ElasticTrainer,
+        load_training_data,
+    )
+
+    x, y = load_training_data("synth:400x6:7")
+    cfg = TrainConfig(
+        objective="binary", num_iterations=4, num_leaves=7,
+        min_data_in_leaf=5, seed=3,
+    )
+    booster = ElasticTrainer(
+        gang_registry.url, "solo", x, y, cfg, str(tmp_path / "ck"),
+        n_partitions=4, world_size=1, heartbeat_s=0.2,
+        status_file=str(tmp_path / "status.json"),
+    ).run()
+    ref = train(x, y, cfg, shard=False)
+    assert booster.to_model_string() == ref.to_model_string()
+    status = json.load(open(tmp_path / "status.json"))
+    assert status["done"] and status["gen"] == 1
+
+
+def test_snapshot_checkpoint_freezes_latest(tmp_path):
+    from mmlspark_tpu.models.gbdt.booster import Booster
+    from mmlspark_tpu.models.gbdt.checkpoint import (
+        TrainCheckpoint,
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from mmlspark_tpu.parallel.elastic import snapshot_checkpoint
+
+    d = str(tmp_path)
+    assert snapshot_checkpoint(d, 2) == (None, 0)  # nothing yet
+    rng = np.random.default_rng(0)
+    save_checkpoint(d, TrainCheckpoint(
+        round=6, booster=Booster(), scores=np.zeros(4, np.float32),
+        bag=None, rng_state=rng.bit_generator.state, fingerprint="fp",
+    ))
+    snap, rnd = snapshot_checkpoint(d, 2)
+    assert rnd == 6 and os.path.isdir(snap)
+    # later checkpoints do not disturb the frozen snapshot
+    save_checkpoint(d, TrainCheckpoint(
+        round=8, booster=Booster(), scores=np.ones(4, np.float32),
+        bag=None, rng_state=rng.bit_generator.state, fingerprint="fp",
+    ))
+    loaded = load_checkpoint(snap)
+    assert loaded.round == 6 and float(loaded.scores.sum()) == 0.0
+
+
+def test_charge_from_train_args_builds_train_argv():
+    from mmlspark_tpu.serving.supervisor import charge_from_train_args
+
+    c = charge_from_train_args(
+        "--name hostA --data synth:100x4:0 --ckpt-dir /tmp/ck",
+        "http://reg:9090/", 0,
+    )
+    assert c.argv[1:5] == ["-m", "mmlspark_tpu.serving.fleet", "train",
+                           "--registry"]
+    assert "--name" in c.argv and "hostA" in c.argv
+    assert c.health_url is None          # trainers have no HTTP ingress
+    assert c.name == "train-0:hostA"
+
+
+# -- chaos: the acceptance scenario -------------------------------------------
+
+
+_TRAIN_ARGS = [
+    "--data", "synth:600x8:5", "--partitions", "4",
+    "--num-iterations", "12", "--num-leaves", "7",
+    "--min-data-in-leaf", "5", "--seed", "3",
+    "--checkpoint-every", "2", "--heartbeat-s", "0.25",
+]
+
+
+def _spawn_trainer(
+    reg_url: str, name: str, ckpt: str, out_dir: str, world: int,
+    extra: list = (), fault: str = None, train_args: list = None,
+):
+    argv = [sys.executable, "-m", "mmlspark_tpu.serving.fleet"]
+    if fault:
+        argv += ["--fault-plan", fault]
+    argv += [
+        "train", "--registry", reg_url, "--name", name,
+        "--ckpt-dir", ckpt, "--world-size", str(world),
+        "--out-model", os.path.join(out_dir, f"model-{name}.txt"),
+        "--status-file", os.path.join(out_dir, f"status-{name}.json"),
+        *(train_args if train_args is not None else _TRAIN_ARGS),
+        *extra,
+    ]
+    return subprocess.Popen(
+        argv, env=_child_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _status(out_dir: str, name: str) -> dict:
+    try:
+        with open(os.path.join(out_dir, f"status-{name}.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+@pytest.mark.chaos
+@pytest.mark.xdist_group("latency")
+def test_chaos_elastic_host_loss_mid_round_resumes_bit_identical(tmp_path):
+    """The acceptance scenario: a 2-host gang trains over the TCP
+    histogram allreduce; one host is SIGKILLed MID-ROUND (an injected
+    ``gbdt.round`` stall parks it inside round 6 while the survivor
+    blocks in the round's allreduce). The survivor must detect the loss
+    (TTL expiry), abort the in-flight round (through an armed
+    ``train.round_abort`` point), re-shard to world 1, resume from the
+    snapshotted checkpoint, and finish — and its final booster must be
+    BIT-IDENTICAL to a fresh world-1 run started from that same
+    snapshot. Recovery timings land in the status file (the bench's
+    ``elastic`` segment records the same numbers)."""
+    from mmlspark_tpu.serving import fleet
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=1.2)
+    out = str(tmp_path)
+    ck = os.path.join(out, "ck")
+    try:
+        # victim stalls ENTERING round 6 (a chunk boundary), so the
+        # survivor is wedged inside round 6's first gang allreduce when
+        # the SIGKILL lands — a genuine mid-round loss
+        victim_fault = json.dumps({
+            "rules": [{"point": "gbdt.round", "at": [6], "delay_s": 600}],
+        })
+        # the survivor's abort path runs through an armed
+        # train.round_abort (delay: a slow abort must still recover)
+        survivor_fault = json.dumps({
+            "rules": [
+                {"point": "train.round_abort", "delay_s": 0.1,
+                 "max_fires": 1},
+            ],
+        })
+        surv = _spawn_trainer(
+            reg.url, "a", ck, out, world=2, extra=["--no-growback"],
+            fault=survivor_fault,
+        )
+        vict = _spawn_trainer(
+            reg.url, "b", ck, out, world=2, extra=["--no-growback"],
+            fault=victim_fault,
+        )
+        # wait for the round-6 checkpoint to commit, then give the
+        # survivor a beat to enter round 6's allreduce before the kill
+        latest = os.path.join(ck, "LATEST")
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            try:
+                with open(latest) as f:
+                    if f.read().strip() == "round-0000006":
+                        break
+            except OSError:
+                pass
+            assert vict.poll() is None, vict.communicate()[1][-2000:]
+            time.sleep(0.1)
+        time.sleep(0.6)
+        vict.kill()
+        out_a, err_a = surv.communicate(timeout=180)
+        assert surv.returncode == 0, err_a[-3000:]
+        sa = _status(out, "a")
+        assert sa["done"] and sa["reshards"] == 1
+        assert sa["members"] == ["a"] and sa["gen"] == 2
+        assert sa["reshard_reasons"] == ["lost"]
+        assert sa["resume_round"] == 6
+        assert sa["snapshot"] and os.path.isdir(sa["snapshot"])
+        # recovery timings recorded (the bench reads these)
+        assert sa["detect_latency_s"] > 0
+        assert sa["reshard_to_first_round_s"] > 0
+        # -- the hard contract: fresh world-1 run from the SAME snapshot
+        fresh = _spawn_trainer(
+            reg.url, "c", os.path.join(out, "ck-fresh"), out, world=1,
+            extra=["--resume-from", sa["snapshot"]],
+        )
+        out_c, err_c = fresh.communicate(timeout=180)
+        assert fresh.returncode == 0, err_c[-3000:]
+        with open(os.path.join(out, "model-a.txt")) as f:
+            survivor_model = f.read()
+        with open(os.path.join(out, "model-c.txt")) as f:
+            fresh_model = f.read()
+        assert survivor_model == fresh_model, (
+            "survivor's resumed booster != fresh shrunk-world run from "
+            "the same checkpoint"
+        )
+    finally:
+        reg.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.xdist_group("latency")
+def test_chaos_elastic_supervisor_growback_at_checkpoint_boundary(tmp_path):
+    """``fleet supervise`` training charges close the loop: a SIGKILLed
+    trainer is restarted with its full argv, auto-resumes from the
+    shared checkpoint dir, and is grown back into the gang at the next
+    checkpoint boundary (generation reason ``grow``) — and both hosts
+    finish with the identical booster."""
+    from mmlspark_tpu.serving import fleet
+    from mmlspark_tpu.serving.supervisor import (
+        FleetSupervisor,
+        charge_from_train_args,
+    )
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=1.2)
+    out = str(tmp_path)
+    ck = os.path.join(out, "ck")
+    # slow every chunk so the run comfortably outlives the restart
+    fault = json.dumps({"rules": [{"point": "gbdt.round", "delay_s": 0.35}]})
+    env = _child_env()
+
+    def spawn(argv):
+        return subprocess.Popen(
+            argv, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True,
+        )
+
+    def args(name):
+        return (
+            f"--name {name} --data synth:600x8:5 --partitions 4 "
+            f"--world-size 2 --ckpt-dir {ck} --num-iterations 40 "
+            f"--num-leaves 7 --min-data-in-leaf 5 --seed 3 "
+            f"--checkpoint-every 2 --heartbeat-s 0.25 "
+            f"--out-model {out}/model-{name}.txt "
+            f"--status-file {out}/status-{name}.json"
+        )
+
+    charges = [
+        charge_from_train_args(args(n), reg.url, i)
+        for i, n in enumerate("ab")
+    ]
+    for c in charges:  # arm the chunk-slowdown plan in every trainer
+        c.argv = c.argv[:3] + ["--fault-plan", fault] + c.argv[3:]
+    sup = FleetSupervisor(
+        charges, registry_url=reg.url, probe_s=0.3, backoff_s=0.3,
+        stable_s=30.0, spawn=spawn,
+    ).start()
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if _status(out, "a").get("gen") == 1:
+                break
+            time.sleep(0.2)
+        assert _status(out, "a").get("gen") == 1, "gang never formed"
+        time.sleep(2.0)  # into the run
+        victim = charges[1]
+        victim.proc.kill()
+        deadline = time.monotonic() + 150.0
+        while time.monotonic() < deadline:
+            sa, sb = _status(out, "a"), _status(out, "b")
+            if sa.get("done") and sb.get("done"):
+                break
+            time.sleep(0.4)
+        sa, sb = _status(out, "a"), _status(out, "b")
+        assert sa.get("done") and sb.get("done"), (sa, sb)
+        assert victim.restarts >= 1, "supervisor never restarted the victim"
+        # the survivor shrank (lost), then the restarted host grew back:
+        # the final generation includes both again
+        assert sa["reshard_reasons"][:1] == ["lost"]
+        assert sa["gen"] >= 3 and sorted(sa["members"]) == ["a", "b"]
+        with open(os.path.join(out, "model-a.txt")) as f:
+            ma = f.read()
+        with open(os.path.join(out, "model-b.txt")) as f:
+            mb = f.read()
+        assert ma == mb, "grown-back gang disagreed on the final booster"
+    finally:
+        sup.stop()
+        reg.stop()

@@ -149,6 +149,47 @@ def _gated_warmup_loader(entered, gate, nbytes=60):
     return loader
 
 
+def test_injected_load_fault_fails_version_serving_survives():
+    """Fault point ``modelstore.load``: an injected error is a corrupt
+    model artifact — the version lands FAILED (recorded error), the
+    serving version keeps serving, and a retried load succeeds; an
+    injected delay is a slow deserialize the background load absorbs
+    while traffic continues."""
+    from mmlspark_tpu.serving.modelstore.store import FAILED
+
+    store = ModelStore()
+    store.load("m", _tagged_loaded("v1"))
+    plan = FaultPlan().on("modelstore.load", error=OSError, at=(0,))
+    with plan.armed():
+        with pytest.raises(OSError):
+            store.load("m", _tagged_loaded("v2"), wait=True)
+        # the fault consumed: the store is not poisoned — retry lands
+        v3 = store.load("m", _tagged_loaded("v3"), wait=True)
+    assert len(plan.fires("modelstore.load")) == 1
+    listing = {v["version"]: v for v in store.models()["m"]["versions"]}
+    assert listing[2]["state"] == FAILED
+    assert listing[v3]["state"] == READY
+    assert store.serving_version("m") == 1  # v1 never stopped serving
+    mv = store.acquire("m")
+    assert mv.version == 1
+    store.release(mv)
+    # injected LATENCY on a background load: serving continues through it
+    plan2 = FaultPlan().on("modelstore.load", delay_s=0.3, at=(0,))
+    with plan2.armed():
+        v4 = store.load("m", _tagged_loaded("v4"), wait=False)
+        for _ in range(5):
+            mv = store.acquire("m")
+            assert mv.version == 1
+            store.release(mv)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            st = {v["version"]: v for v in store.models()["m"]["versions"]}
+            if st[v4]["state"] == READY:
+                break
+            time.sleep(0.02)
+    assert st[v4]["state"] == READY
+
+
 def test_budget_never_evicts_a_warming_version():
     """A WARMING version's load thread is still running warmup on its
     weights: budget pressure must fail the competing load rather than

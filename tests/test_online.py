@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import threading
 import time
 
@@ -775,3 +776,158 @@ def test_fleet_online_role_publishes_to_rostered_workers(tmp_path):
         q.stop()
         srv.stop()
         reg.stop()
+
+# -- durable feedback: the disk spill -----------------------------------------
+
+
+def test_feedback_spill_replays_untrained_chunks_after_crash(tmp_path):
+    """Pushed chunks survive a crash: trained chunks are acked away,
+    everything else — including a chunk handed out but never confirmed
+    trained — replays in order with its original ingest timestamp and
+    its rows intact."""
+    from mmlspark_tpu.online import FeedbackStream
+
+    spill = str(tmp_path / "spill")
+    rng = np.random.default_rng(3)
+    stream = FeedbackStream(spill_dir=spill)
+    stamps = []
+    for i in range(5):
+        c = _sparse_chunk(rng, 3 + i, 10)
+        stream.push(c, ts=100.0 + i)
+        stamps.append((100.0 + i, len(c)))
+    ts, chunk = stream.poll(0.0)
+    assert ts == 100.0
+    stream.ack_trained()                  # chunk 0 confirmed trained
+    stream.poll(0.0)                      # chunk 1 handed out, NO ack:
+    # ...the process "crashes" here (no close, like a SIGKILL)
+    replay = FeedbackStream(spill_dir=spill)
+    assert replay.replayed == sum(n for _, n in stamps[1:])
+    got = []
+    while True:
+        item = replay.poll(0.0)
+        if item is None:
+            break
+        got.append((item[0], len(item[1])))
+        # rows round-trip through JSON: the sparse wire shape survives
+        row = item[1]["features"][0]
+        assert set(row) == {"i", "v"} and len(row["i"]) == len(row["v"])
+    assert got == stamps[1:]              # order + stamps + sizes intact
+
+
+def test_feedback_spill_truncates_on_ack_and_acks_deliberate_sheds(tmp_path):
+    from mmlspark_tpu.online import FeedbackStream
+
+    spill = str(tmp_path / "spill")
+    rng = np.random.default_rng(4)
+    stream = FeedbackStream(spill_dir=spill, spill_segment_chunks=2)
+    for _ in range(6):
+        stream.push(_sparse_chunk(rng, 4, 10))
+    for _ in range(6):
+        assert stream.poll(0.0) is not None
+        stream.ack_trained()
+    assert stream.spill_pending() == 0
+    # fully-acked segments are unlinked — the log cannot grow forever
+    segs = [e for e in os.listdir(spill) if e.startswith("spill-")]
+    assert len(segs) <= 1
+    assert FeedbackStream(spill_dir=spill).replayed == 0
+
+    # bounded-buffer sheds are deliberate (freshest-wins policy): they
+    # are acknowledged as handled, never resurrected as stale backlog
+    spill2 = str(tmp_path / "spill2")
+    s2 = FeedbackStream(spill_dir=spill2, max_chunks=2)
+    for _ in range(5):
+        s2.push(_sparse_chunk(rng, 4, 10))
+    assert s2.dropped == 3 and s2.dropped_examples == 12
+    replay = FeedbackStream(spill_dir=spill2)
+    assert replay.replayed == 8           # only the 2 still-buffered
+
+
+def test_online_loop_acks_spill_after_successful_train_step(tmp_path):
+    """The loop confirms the spill only AFTER trainer.step returns — a
+    step that raises leaves the chunk replayable."""
+    from mmlspark_tpu.online import FeedbackStream, OnlineLearningLoop
+
+    class FlakyTrainer:
+        examples = 0
+        fail_next = False
+
+        def step(self, chunk):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("device fell over")
+            self.examples += len(chunk)
+            return len(chunk)
+
+    class NoopPublisher:
+        publishes = failures = 0
+        last_freshness_s = None
+        freshness_history: list = []
+
+        def publish(self, trainer, oldest_ts=None):
+            self.publishes += 1
+            return {"version": self.publishes}
+
+    rng = np.random.default_rng(5)
+    spill = str(tmp_path / "spill")
+    stream = FeedbackStream(spill_dir=spill)
+    trainer = FlakyTrainer()
+    loop = OnlineLearningLoop(
+        stream, trainer, NoopPublisher(), publish_every_s=3600.0,
+        poll_s=0.0,
+    )
+    stream.push(_sparse_chunk(rng, 4, 10))
+    loop._tick()
+    assert trainer.examples == 4 and stream.spill_pending() == 0
+    trainer.fail_next = True
+    stream.push(_sparse_chunk(rng, 4, 10))
+    with pytest.raises(RuntimeError):
+        loop._tick()
+    # unconfirmed: the failed-over chunk is requeued in memory AND
+    # replayable from disk — a later success must not ack it away
+    assert stream.spill_pending() == 1 and stream.depth() == 1
+    assert FeedbackStream(spill_dir=spill).replayed == 4
+    # the retry trains it and only THEN truncates the spill
+    loop._tick()
+    assert trainer.examples == 8 and stream.spill_pending() == 0
+    assert FeedbackStream(spill_dir=spill).replayed == 0
+
+
+def test_online_loop_discards_poison_chunk_after_bounded_retries(tmp_path):
+    """A chunk whose train step fails DETERMINISTICALLY is discarded
+    (acked away, counted) after max_step_retries — one poison chunk
+    must not head-of-line-block every example behind it forever."""
+    from mmlspark_tpu.online import FeedbackStream, OnlineLearningLoop
+
+    class PoisonedTrainer:
+        examples = 0
+
+        def step(self, chunk):
+            if float(chunk["label"][0]) == -1.0:
+                raise ValueError("poison row")
+            self.examples += len(chunk)
+            return len(chunk)
+
+    class NoopPublisher:
+        publishes = failures = 0
+        last_freshness_s = None
+        freshness_history: list = []
+
+        def publish(self, trainer, oldest_ts=None):
+            return {}
+
+    rng = np.random.default_rng(6)
+    stream = FeedbackStream(spill_dir=str(tmp_path / "spill"))
+    trainer = PoisonedTrainer()
+    loop = OnlineLearningLoop(
+        stream, trainer, NoopPublisher(), publish_every_s=3600.0,
+        poll_s=0.0,
+    )
+    stream.push(_sparse_chunk(rng, 3, 10, seed_labels=np.full(3, -1.0)))
+    stream.push(_sparse_chunk(rng, 4, 10))
+    for _ in range(loop.max_step_retries):
+        with pytest.raises(ValueError):
+            loop._tick()
+    assert loop.poisoned_chunks == 1
+    loop._tick()  # the queue moves: the healthy chunk trains
+    assert trainer.examples == 4
+    assert stream.spill_pending() == 0  # poison acked away, not replayed

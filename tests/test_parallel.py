@@ -91,3 +91,89 @@ def test_distributed_initialize_single_host():
     distributed.initialize()  # no coordinator -> no-op
     assert distributed.is_coordinator()
     distributed.barrier()
+
+def test_barrier_timeout_counter_increments_exactly_once_per_waiter():
+    """An injected ``parallel.barrier`` delay under a tight timeout must
+    increment ``mmlspark_parallel_barrier_timeouts_total`` exactly once
+    per waiter — N threads hitting the same named barrier yield N
+    timeout samples, not 1 and not N x retries."""
+    import threading
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.core.faults import FaultPlan
+    from mmlspark_tpu.parallel.distributed import (
+        BarrierTimeoutError,
+        barrier,
+    )
+
+    name = "elastic-waiters-gate"
+
+    def count() -> float:
+        return obs.sum_samples(
+            obs.parse_text(obs.render()),
+            "mmlspark_parallel_barrier_timeouts_total", {"name": name},
+        )
+
+    before = count()
+    errs: list = []
+
+    def waiter() -> None:
+        try:
+            barrier(name, timeout_s=0.15)
+        except BarrierTimeoutError as e:
+            errs.append(e)
+
+    plan = FaultPlan().on("parallel.barrier", delay_s=5.0)
+    with plan.armed():
+        threads = [threading.Thread(target=waiter) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+    assert len(errs) == 3
+    assert count() - before == 3.0
+
+
+def test_barrier_timeout_names_missing_host_partially_expired_roster():
+    """The roster diagnosis with a PARTIALLY-expired registry: both
+    heartbeats lapse, only one host comes back — the timeout error must
+    name exactly the still-dead one. A roster callable that itself dies
+    degrades to no names, never to a second exception."""
+    import time as _t
+
+    from mmlspark_tpu.core.faults import FaultPlan
+    from mmlspark_tpu.parallel.distributed import (
+        BarrierTimeoutError,
+        barrier,
+    )
+    from mmlspark_tpu.serving.registry import DriverRegistry
+    from mmlspark_tpu.serving.server import ServiceInfo
+
+    reg = DriverRegistry(host="127.0.0.1", port=0, ttl_s=0.4)
+    try:
+        DriverRegistry.register(reg.url, ServiceInfo("gang", "host-a", 1))
+        DriverRegistry.register(reg.url, ServiceInfo("gang", "host-b", 2))
+        _t.sleep(0.6)  # BOTH expire...
+        DriverRegistry.register(reg.url, ServiceInfo("gang", "host-a", 1))
+        plan = FaultPlan().on("parallel.barrier", delay_s=5.0)
+        with plan.armed():
+            with pytest.raises(BarrierTimeoutError) as ei:
+                barrier(
+                    "partial-expiry", timeout_s=0.15,
+                    expected=["host-a", "host-b"],
+                    alive=lambda: reg.live_hosts("gang"),
+                )
+        assert ei.value.missing == ["host-b"]
+        assert "host-b" in str(ei.value)
+        # roster source dies mid-diagnosis: best-effort, no names
+        plan2 = FaultPlan().on("parallel.barrier", delay_s=5.0)
+        with plan2.armed():
+            with pytest.raises(BarrierTimeoutError) as ei2:
+                barrier(
+                    "roster-dead", timeout_s=0.15,
+                    expected=["host-a"],
+                    alive=lambda: (_ for _ in ()).throw(OSError("down")),
+                )
+        assert ei2.value.missing == []
+    finally:
+        reg.stop()

@@ -1037,3 +1037,103 @@ def test_fleet_top_admission_and_breaker_columns_degrade():
     finally:
         q.stop()
         srv.stop()
+
+
+def test_registry_anti_entropy_reconciles_partitioned_rosters():
+    """ROADMAP 5c: peered registries re-converge after a partition. A
+    worker that could only reach registry A becomes visible on B within
+    one reconcile pass; merges go by NEWEST registration stamp, so a
+    stale peer copy never overwrites a fresher local one; and TTL still
+    governs liveness — an adopted entry expires normally."""
+    import time as _t
+
+    from mmlspark_tpu.serving.registry import DriverRegistry
+    from mmlspark_tpu.serving.server import ServiceInfo
+
+    reg_a = DriverRegistry(host="127.0.0.1", port=0, ttl_s=30.0)
+    reg_b = DriverRegistry(
+        host="127.0.0.1", port=0, ttl_s=30.0, peers=[reg_a.url],
+        reconcile_s=0.15,
+    )
+    try:
+        # partition: the worker reaches only A
+        info = ServiceInfo("svc", "w1", 1234, models=("m1",))
+        assert DriverRegistry.register(reg_a.url, info)
+        assert reg_b.services("svc") == []
+        deadline = _t.monotonic() + 10.0
+        while not reg_b.services("svc") and _t.monotonic() < deadline:
+            _t.sleep(0.05)
+        got = reg_b.services("svc")
+        assert [e["host"] for e in got] == ["w1"], "B never learned w1"
+        assert got[0]["models"] == ["m1"]
+        # heal + update: a NEWER registration on A (new model set)
+        # propagates; ts is the merge key
+        _t.sleep(0.05)
+        DriverRegistry.register(
+            reg_a.url, ServiceInfo("svc", "w1", 1234, models=("m1", "m2"))
+        )
+        deadline = _t.monotonic() + 10.0
+        while _t.monotonic() < deadline:
+            got = reg_b.services("svc")
+            if got and got[0].get("models") == ["m1", "m2"]:
+                break
+            _t.sleep(0.05)
+        assert got[0]["models"] == ["m1", "m2"]
+        # a STALE peer copy never clobbers a fresher local one: B now
+        # holds the freshest w1; pulling A again must keep it
+        b_ts = reg_b.services("svc")[0]["ts"]
+        assert reg_b.reconcile_now() == 0
+        assert reg_b.services("svc")[0]["ts"] == b_ts
+        # reverse direction via explicit peers: A pulls an entry only B
+        # has (registered during the partition, B-side)
+        DriverRegistry.register(
+            reg_b.url, ServiceInfo("svc", "w2", 5678)
+        )
+        reg_a.peers = [reg_b.url]
+        assert reg_a.reconcile_now() >= 1
+        assert sorted(
+            e["host"] for e in reg_a.services("svc")
+        ) == ["w1", "w2"]
+        # tombstones: a clean DELETE on A must not be resurrected by the
+        # next reconcile pull from B (which still holds the entry)...
+        DriverRegistry.deregister(reg_a.url, ServiceInfo("svc", "w2", 5678))
+        assert sorted(e["host"] for e in reg_a.services("svc")) == ["w1"]
+        reg_a.reconcile_now()
+        assert sorted(e["host"] for e in reg_a.services("svc")) == ["w1"]
+        # ...but a RE-registration after the delete (newer stamp) wins
+        _t.sleep(0.05)
+        DriverRegistry.register(reg_b.url, ServiceInfo("svc", "w2", 5678))
+        assert reg_a.reconcile_now() >= 1
+        assert sorted(
+            e["host"] for e in reg_a.services("svc")
+        ) == ["w1", "w2"]
+    finally:
+        reg_a.stop()
+        reg_b.stop()
+
+
+def test_registry_anti_entropy_adopted_entries_still_expire():
+    """An entry adopted from a peer is not immortal: the local TTL
+    applies from its ORIGINAL registration stamp, and an entry already
+    older than the TTL is never adopted at all."""
+    import time as _t
+
+    from mmlspark_tpu.serving.registry import DriverRegistry
+    from mmlspark_tpu.serving.server import ServiceInfo
+
+    reg_a = DriverRegistry(host="127.0.0.1", port=0, ttl_s=0.4)
+    reg_b = DriverRegistry(host="127.0.0.1", port=0, ttl_s=0.4)
+    try:
+        DriverRegistry.register(reg_a.url, ServiceInfo("svc", "w1", 1))
+        reg_b.peers = [reg_a.url]
+        assert reg_b.reconcile_now() == 1
+        assert [e["host"] for e in reg_b.services("svc")] == ["w1"]
+        _t.sleep(0.6)  # no heartbeats: the adopted copy expires too
+        assert reg_b.services("svc") == []
+        # and an expired-at-the-source entry is never adopted: A still
+        # HOLDS the stale record internally, but B's floor rejects it
+        assert reg_b.reconcile_now() == 0
+        assert reg_b.services("svc") == []
+    finally:
+        reg_a.stop()
+        reg_b.stop()

@@ -76,3 +76,32 @@ def test_metric_name_linter_knows_slo_subsystem(tmp_path):
     violations, seen = lint([str(src)])
     assert seen == 3
     assert [v[1] for v in violations] == ["mmlspark_slo_burn_rate"]
+
+
+def test_fault_points_all_exercised_by_tests():
+    """Every faults.inject() point in the production tree must be named
+    by at least one test — untested recovery machinery has never been
+    watched recovering (tools/lint_fault_points.py)."""
+    from tools.lint_fault_points import MIN_EXPECTED, lint
+
+    violations, seen = lint()
+    assert not violations, violations
+    assert seen >= MIN_EXPECTED, (
+        f"only {seen} injection points found — the linter's scan regex "
+        "no longer matches the inject() idiom"
+    )
+
+
+def test_fault_point_linter_catches_unexercised_point(tmp_path):
+    from tools.lint_fault_points import lint
+
+    prod = tmp_path / "prod.py"
+    prod.write_text(
+        'faults.inject("elastic.detect", context={})\n'     # exercised
+        'inject("zzz.never_tested")\n'                      # not
+    )
+    tests_file = tmp_path / "test_x.py"
+    tests_file.write_text('plan.on("elastic.detect", payload=1)\n')
+    violations, seen = lint([str(prod)], [str(tests_file)])
+    assert seen == 2
+    assert [v[0] for v in violations] == ["zzz.never_tested"]

@@ -1,0 +1,130 @@
+"""Every fault-injection point wired into production code must be
+exercised by at least one test.
+
+The fault framework (core/faults.py) only proves anything when each
+``faults.inject("<point>")`` call site has a chaos test arming a plan at
+that point — an untested point is recovery machinery nobody has ever
+watched recover. Same spirit as tools/lint_metric_names.py: grep-based,
+wired into tier-1 (tests/test_tools.py), so a new injection point cannot
+land without a test naming it.
+
+- **Registered points**: string-literal first arguments of
+  ``faults.inject(...)`` / ``inject(...)`` calls under the scan dirs
+  (the production tree; tests and build outputs excluded).
+- **Exercised**: the point's literal name appears in at least one file
+  under ``tests/`` (a ``plan.on("point", ...)``, a JSON plan, or an
+  assertion on its fires — any mention counts; the gate is grep-grade
+  by design).
+
+A minimum-points guard protects the scan regex itself: if a refactor
+moves injection sites out of the pattern's reach, the linter fails
+loudly instead of silently passing an empty scan.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Iterator, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = ("mmlspark_tpu", "tools")
+TEST_DIR = "tests"
+
+# faults.inject("point", ...) with a literal first argument, possibly
+# wrapped to the next line
+_INJECT_RE = re.compile(
+    r"""\b(?:faults\s*\.\s*)?inject\(\s*["']([a-z0-9_]+(?:\.[a-z0-9_]+)+)["']""",
+    re.S,
+)
+# fewer registered points than this means the scan regex rotted, not
+# that the tree lost its chaos hooks
+MIN_EXPECTED = 12
+
+
+def iter_sources(base_dirs: tuple = SCAN_DIRS) -> Iterator[str]:
+    for d in base_dirs:
+        for root, dirs, files in os.walk(os.path.join(REPO, d)):
+            dirs[:] = [x for x in dirs if x != "__pycache__"]
+            if f"{os.sep}build{os.sep}" in root + os.sep:
+                continue
+            for f in files:
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def registered_points(paths: Optional[list] = None) -> dict:
+    """Point name -> first production file registering it."""
+    points: dict = {}
+    for path in paths or iter_sources():
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        rel = os.path.relpath(path, REPO)
+        for m in _INJECT_RE.finditer(src):
+            points.setdefault(m.group(1), rel)
+    return points
+
+
+def exercised_points(test_paths: Optional[list] = None) -> set:
+    """Every dotted point name mentioned anywhere under tests/."""
+    mentioned: set = set()
+    paths = test_paths or [
+        os.path.join(REPO, TEST_DIR, f)
+        for f in os.listdir(os.path.join(REPO, TEST_DIR))
+        if f.endswith(".py")
+    ]
+    name_re = re.compile(r"""["']([a-z0-9_]+(?:\.[a-z0-9_]+)+)["']""")
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        mentioned.update(name_re.findall(src))
+    return mentioned
+
+
+def lint(
+    paths: Optional[list] = None, test_paths: Optional[list] = None
+) -> tuple:
+    """Returns (violations, n_points); violations are (point, file)
+    tuples for registered points no test ever names."""
+    points = registered_points(paths)
+    tested = exercised_points(test_paths)
+    violations = sorted(
+        (p, f) for p, f in points.items() if p not in tested
+    )
+    return violations, len(points)
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(prog="lint_fault_points.py")
+    ap.add_argument("paths", nargs="*",
+                    help="production files to scan (default: tree)")
+    args = ap.parse_args(argv)
+    violations, seen = lint(args.paths or None)
+    if seen < MIN_EXPECTED and not args.paths:
+        print(
+            f"lint_fault_points: only {seen} injection points found "
+            f"(expected >= {MIN_EXPECTED}) — the scan regex no longer "
+            "matches the inject() idiom",
+            file=sys.stderr,
+        )
+        return 2
+    for point, rel in violations:
+        print(
+            f"{rel}: fault point {point!r} is exercised by no test "
+            "(add a chaos test arming a FaultPlan at it)",
+            file=sys.stderr,
+        )
+    if violations:
+        print(
+            f"lint_fault_points: {len(violations)} untested point(s) of "
+            f"{seen}", file=sys.stderr,
+        )
+        return 1
+    print(f"lint_fault_points: {seen} fault points all exercised by tests")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
