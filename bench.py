@@ -72,12 +72,12 @@ SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280,
 #   relay's RPC floor, while its real claims (local + gateway p50) come
 #   out of the CPU child identically.
 # - On the CPU fallback, cheap-first so a late death costs least.
-SEGMENTS = ["serving", "modelstore", "tracing", "overload", "freshness",
-            "elastic", "pipeline", "hist", "vw", "gbdt", "sklearn",
-            "featurizer"]
+SEGMENTS = ["serving", "modelstore", "tracing", "artifact", "overload",
+            "freshness", "elastic", "pipeline", "hist", "vw", "gbdt",
+            "sklearn", "featurizer"]
 TPU_ORDER = ["sklearn", "gbdt", "hist", "featurizer", "pipeline", "vw",
-             "serving", "modelstore", "tracing", "overload", "freshness",
-             "elastic"]
+             "serving", "modelstore", "tracing", "artifact", "overload",
+             "freshness", "elastic"]
 CPU_ORDER = SEGMENTS
 
 
@@ -1217,6 +1217,127 @@ def _seg_elastic(on_accel: bool, n_dev: int) -> dict:
     return out
 
 
+def _seg_artifact(on_accel: bool, n_dev: int) -> dict:
+    """Content-addressed artifact plane (serving/artifacts.py): the
+    transfer rates the no-shared-fs recovery story pays for. Records
+    push (put: pack+hash+install) and pull (ranged HTTP fetch + verify)
+    MB/s over loopback, the sha256 verify overhead as a fraction of the
+    pull, and the kill-mid-transfer story as a number: a peer that dies
+    half-way through the body, with the fetch resuming from the byte
+    offset on a second peer — resume-to-done wall seconds and the bytes
+    that did NOT have to be re-transferred. Host-side by design: runs
+    identically on every backend."""
+    import hashlib
+    import shutil
+    import socket as socket_mod
+    import tempfile
+    import threading
+
+    from mmlspark_tpu.serving.artifacts import (
+        ArtifactServer,
+        ArtifactStore,
+    )
+
+    out: dict = {}
+    work = tempfile.mkdtemp(prefix="bench-artifact-")
+    n_bytes = 32 << 20  # 32 MiB: big enough to time, small enough to bench
+    payload = np.random.default_rng(0).integers(
+        0, 256, size=n_bytes, dtype=np.uint8
+    ).tobytes()
+    src = os.path.join(work, "weights.bin")
+    with open(src, "wb") as f:
+        f.write(payload)
+    try:
+        producer = ArtifactStore(os.path.join(work, "producer"))
+        t0 = time.perf_counter()
+        ref = producer.put(src, name="weights.bin")
+        push_s = time.perf_counter() - t0
+        out["artifact_bytes_mb"] = round(n_bytes / 1e6, 1)
+        out["artifact_push_mb_s"] = round(n_bytes / 1e6 / push_s, 1)
+        srv = ArtifactServer(producer)
+        consumer = ArtifactStore(os.path.join(work, "consumer"))
+        t0 = time.perf_counter()
+        consumer.fetch(ref.digest, [srv.url], name="weights.bin")
+        pull_s = time.perf_counter() - t0
+        out["artifact_pull_mb_s"] = round(n_bytes / 1e6 / pull_s, 1)
+        # verify overhead: the sha256 pass every completed transfer pays
+        t0 = time.perf_counter()
+        hashlib.sha256(payload).hexdigest()
+        verify_s = time.perf_counter() - t0
+        out["artifact_verify_mb_s"] = round(n_bytes / 1e6 / verify_s, 1)
+        out["artifact_verify_overhead_pct"] = round(
+            100.0 * verify_s / pull_s, 1
+        )
+
+        # -- kill mid-transfer -> Range resume on a second peer ----------
+        class TruncPeer:
+            """Serves correct headers, sends half the body, dies."""
+
+            def __init__(self):
+                self._srv = socket_mod.create_server(("127.0.0.1", 0))
+                self._srv.settimeout(0.5)
+                self.port = self._srv.getsockname()[1]
+                self.stop = threading.Event()
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                while not self.stop.is_set():
+                    try:
+                        conn, _ = self._srv.accept()
+                    except socket_mod.timeout:
+                        continue
+                    except OSError:
+                        return
+                    try:
+                        conn.settimeout(2.0)
+                        data = b""
+                        while b"\r\n\r\n" not in data:
+                            data += conn.recv(4096)
+                        body = payload
+                        conn.sendall((
+                            "HTTP/1.1 200 OK\r\n"
+                            f"Content-Length: {len(body)}\r\n"
+                            f"X-Artifact-Size: {len(body)}\r\n\r\n"
+                        ).encode())
+                        conn.sendall(body[: len(body) // 2])
+                        conn.shutdown(socket_mod.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    finally:
+                        conn.close()
+
+            def close(self):
+                self.stop.set()
+                try:
+                    self._srv.close()
+                except OSError:
+                    pass
+
+        trunc = TruncPeer()
+        resumer = ArtifactStore(os.path.join(work, "resumer"))
+        from mmlspark_tpu import obs
+
+        before = obs.parse_text(obs.render())
+        t0 = time.perf_counter()
+        resumer.fetch(
+            ref.digest, [f"http://127.0.0.1:{trunc.port}", srv.url],
+            name="weights.bin", backoffs_ms=(10,),
+        )
+        out["artifact_resume_to_done_s"] = round(
+            time.perf_counter() - t0, 3
+        )
+        after = obs.parse_text(obs.render())
+        out["artifact_resumes"] = int(obs.sum_samples(
+            after, "mmlspark_artifact_resumes_total"
+        ) - obs.sum_samples(before, "mmlspark_artifact_resumes_total"))
+        out["artifact_resume_saved_mb"] = round(n_bytes / 2 / 1e6, 1)
+        trunc.close()
+        srv.stop()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return out
+
+
 def _seg_freshness(on_accel: bool, n_dev: int) -> dict:
     """Continuous learning: example->servable freshness under a sustained
     feedback stream WITH serving traffic concurrent (docs/online-learning.md).
@@ -1390,6 +1511,7 @@ SEGMENT_FNS = {
     "serving": _seg_serving,
     "modelstore": _seg_modelstore,
     "tracing": _seg_tracing,
+    "artifact": _seg_artifact,
     "overload": _seg_overload,
     "freshness": _seg_freshness,
     "elastic": _seg_elastic,

@@ -68,6 +68,17 @@ point                     fires inside
                           abort -> reshard turnaround (visible in recovery
                           timings), an error kills the trainer (the
                           supervisor-restart recovery path)
+``artifact.put``          serving/artifacts.py before an artifact is stored
+                          — an error is a refused push (producers degrade
+                          to shared-dir semantics or retry)
+``artifact.fetch``        serving/artifacts.py per transfer attempt — an
+                          error fails that peer (failover), delay is a slow
+                          network; a mid-stream death leaves a partial the
+                          next attempt resumes by Range
+``artifact.verify``       serving/artifacts.py as a local blob is hash-
+                          checked — a truthy payload forces the failure
+                          verdict (quarantine + re-fetch-elsewhere path)
+                          without corrupting anything
 ========================  ====================================================
 
 Schedules are **seeded and step-indexed**: a rule fires by absolute step

@@ -91,7 +91,18 @@ class Publisher:
         keep_snapshots: int = 4,
         request_timeout_s: float = 60.0,
         time_fn: Callable[[], float] = time.monotonic,
+        artifact_store: Any = None,
+        artifact_url: Optional[str] = None,
     ):
+        """``artifact_store`` (an :class:`~mmlspark_tpu.serving.artifacts.
+        ArtifactStore`) switches publication to **artifact mode**: each
+        snapshot is ``put()`` into the store and workers receive an
+        ``artifact:vw:<name>@<sha256>`` spec instead of a filesystem path
+        — they pull the bytes over HTTP (hash-verified, resumable) from
+        ``artifact_url`` (this process's ingress serving ``/artifacts``)
+        or any registry-advertised peer, so the fleet needs NO shared
+        filesystem. Leaving it None keeps the shared-fs ``vw:<path>``
+        fast path exactly as before."""
         if store is None and not worker_urls and not registry_url:
             raise ValueError(
                 "Publisher needs a target: store=, worker_urls= or "
@@ -108,6 +119,26 @@ class Publisher:
         self.keep_snapshots = max(1, int(keep_snapshots))
         self.request_timeout_s = request_timeout_s
         self._now = time_fn
+        self.artifact_store = artifact_store
+        self.artifact_url = artifact_url
+        # version ledger for _gc: (snapshot path, artifact digest | None)
+        # in publication order — GC never touches a version it cannot
+        # first unadvertise (pinned / mid-pull artifacts stay)
+        self._published: list = []
+        if artifact_store is not None:
+            # adopt a previous incarnation's snapshot blobs (the store's
+            # index survives restarts): without this, a restarted
+            # publisher would re-advertise and retain them forever —
+            # the ledger is what keep-last pruning acts on
+            import re as _re
+
+            pat = _re.compile(_re.escape(self.model) + r"-v\d{6}\.npz$")
+            for ref in artifact_store.refs():
+                n, _, d = ref.rpartition("@")
+                if pat.match(n):
+                    self._published.append(
+                        (os.path.join(self.snapshot_dir, n), d)
+                    )
         self.seq = 0
         self.publishes = 0
         self.failures = 0
@@ -134,16 +165,48 @@ class Publisher:
         os.replace(tmp, path)
         return path
 
-    def _prune_snapshots(self) -> None:
+    def _gc(self) -> None:
+        """Keep-last pruning with replication safety: a version beyond
+        ``keep_snapshots`` is deleted only once it is DRAINED and
+        UNADVERTISED — in artifact mode that means the store agreed to
+        ``remove()`` its blob (refused while pinned or mid-pull, so a
+        worker half-way through a ranged fetch, or an operator pin, keeps
+        both the blob and the snapshot file alive). Refused versions are
+        retried at the next publication; pruning is hygiene, never
+        correctness."""
         try:
+            retained: list = []
+            for path, digest in self._published[: -self.keep_snapshots]:
+                if (
+                    digest is not None
+                    and self.artifact_store is not None
+                    and not self.artifact_store.remove(digest)
+                ):
+                    # still pinned or mid-pull: stays advertised AND on
+                    # disk — never yank bytes a puller is reading;
+                    # retried at the next publication
+                    retained.append((path, digest))
+                    continue
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            self._published = retained + self._published[-self.keep_snapshots:]
+            # legacy sweep (shared-fs mode, pre-restart leftovers): prune
+            # by filename, but never a file the ledger says must stay
+            keep_names = {os.path.basename(p) for p, _ in self._published}
             snaps = sorted(
                 f for f in os.listdir(self.snapshot_dir)
                 if f.startswith(f"{self.model}-v") and f.endswith(".npz")
             )
             for f in snaps[: -self.keep_snapshots]:
-                os.remove(os.path.join(self.snapshot_dir, f))
+                if f not in keep_names:
+                    os.remove(os.path.join(self.snapshot_dir, f))
         except OSError:
             pass  # pruning is hygiene, not correctness
+
+    # kept as an alias: pre-artifact callers and docs name the old verb
+    _prune_snapshots = _gc
 
     # -- targets -------------------------------------------------------------
 
@@ -207,7 +270,22 @@ class Publisher:
             faults.inject("online.publish", context={"model": self.model})
             self.seq += 1
             path = self._write_snapshot(trainer)
-            spec = f"vw:{path}"
+            digest = None
+            if self.artifact_store is not None:
+                # artifact mode (no shared fs): workers pull the snapshot
+                # over HTTP by digest — from this process's own ingress
+                # (the spec-embedded hint) or any registry-advertised
+                # peer — hash-verified and resumable
+                ref = self.artifact_store.put(
+                    path, name=os.path.basename(path)
+                )
+                digest = ref.digest
+                spec = f"artifact:vw:{ref.spec}"
+                if self.artifact_url:
+                    spec += f"@{self.artifact_url.rstrip('/')}"
+            else:
+                spec = f"vw:{path}"
+            self._published.append((path, digest))
             targets = 0
             if self.store is not None:
                 targets += self._publish_store(spec)
@@ -234,7 +312,7 @@ class Publisher:
         self.publishes += 1
         _M_PUBLISHES.inc()
         _M_VERSION.set(self.seq)
-        self._prune_snapshots()
+        self._gc()
         return {
             "version": self.seq,
             "path": path,

@@ -213,6 +213,11 @@ class Generation:
     reason: str = "init"
     resume_round: int = 0
     snapshot: Optional[str] = None
+    # content-addressed identity of the resume snapshot (serving/
+    # artifacts.py): a member whose LOCAL disk lacks the snapshot path
+    # pulls these exact bytes over HTTP from any advertising peer —
+    # per-host checkpoint dirs stop being fatal
+    snapshot_digest: Optional[str] = None
     committer: str = ""
     detect_latency_s: float = 0.0
     stamp: float = 0.0          # registry-side registration ts
@@ -270,7 +275,14 @@ class GangMember:
         service: str = "train",
         advertise_host: str = "127.0.0.1",
         heartbeat_s: float = 1.0,
+        artifact_store: Any = None,
     ):
+        """``artifact_store`` (serving/artifacts.py ArtifactStore): when
+        given, this member also runs a tiny artifact ingress (ranged
+        ``GET /artifacts/<digest>``) and advertises the store's contents
+        on every heartbeat — checkpoint snapshots become pullable from
+        any surviving peer, so the gang no longer needs a shared
+        checkpoint directory."""
         from mmlspark_tpu.serving.fleet import split_registry_urls
 
         self.registry_urls = split_registry_urls(registry_urls)
@@ -282,6 +294,20 @@ class GangMember:
         self.heartbeat_s = float(heartbeat_s)
         self.boot = time.time()
         self.ewma_s = 0.0
+        self.artifact_store = artifact_store
+        self._artifact_srv: Any = None
+        self.artifact_port: Optional[int] = None
+        if artifact_store is not None:
+            from mmlspark_tpu.serving import artifacts as artifacts_mod
+            from mmlspark_tpu.serving.server import WorkerServer
+
+            srv = WorkerServer(
+                host="0.0.0.0", port=0, name=f"{service}-artifacts"
+            )
+            artifacts_mod.attach(srv, artifact_store)
+            info = srv.start()
+            self._artifact_srv = srv
+            self.artifact_port = info.port
         self.last_seen: dict = {}       # member -> wall ts last on roster
         self._adopted: Optional[Generation] = None
         self._stop = threading.Event()
@@ -363,7 +389,7 @@ class GangMember:
     # -- registration ---------------------------------------------------------
 
     def _registration(self) -> dict:
-        return {
+        reg = {
             "name": f"{self.service}-gang",
             "host": self.name,
             "port": self.port,
@@ -371,6 +397,30 @@ class GangMember:
             "boot": self.boot,
             "ewma_ms": round(self.ewma_s * 1e3, 3),
         }
+        if self.artifact_store is not None:
+            # advertise name@sha256 refs + the ingress serving them, so
+            # peers resolve checkpoint pulls straight off the roster
+            reg["artifact_port"] = self.artifact_port
+            reg["artifacts"] = self.artifact_store.refs()
+        return reg
+
+    def artifact_peers(self, digest: str) -> list:
+        """Gang members currently advertising ``digest`` -> artifact
+        base URLs (the fetch failover order is sorted-name, matching the
+        rest of the gang's determinism conventions)."""
+        ros = self.roster() or {}
+        suffix = "@" + digest
+        peers = []
+        for name in sorted(ros):
+            if name == self.name:
+                continue
+            e = ros[name]
+            port = e.get("artifact_port")
+            if port and any(
+                a.endswith(suffix) for a in e.get("artifacts") or ()
+            ):
+                peers.append(f"http://{e.get('addr', '127.0.0.1')}:{port}")
+        return peers
 
     def heartbeat(self) -> None:
         """One registration beat to every registry (also refreshes the
@@ -437,6 +487,7 @@ class GangMember:
             "reason": g.reason,
             "resume_round": int(g.resume_round),
             "snapshot": g.snapshot,
+            "snapshot_digest": g.snapshot_digest,
             "committer": g.committer,
             "detect_latency_s": g.detect_latency_s,
             "evicted": dict(g.evicted),
@@ -482,6 +533,7 @@ class GangMember:
                 reason=e.get("reason", ""),
                 resume_round=int(e.get("resume_round", 0)),
                 snapshot=e.get("snapshot"),
+                snapshot_digest=e.get("snapshot_digest"),
                 committer=e.get("committer", ""),
                 detect_latency_s=float(e.get("detect_latency_s", 0.0)),
                 stamp=float(e.get("ts", 0.0)),
@@ -549,6 +601,11 @@ class GangMember:
             self._srv.close()
         except OSError:
             pass
+        if self._artifact_srv is not None:
+            try:
+                self._artifact_srv.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
         from mmlspark_tpu.io.clients import send_request
         from mmlspark_tpu.io.http_schema import HTTPRequestData
 
@@ -749,11 +806,20 @@ class GangContext:
         min_world: int = 1,
         allow_growback: bool = True,
         global_rows: Optional[np.ndarray] = None,
+        ckpt_dir: Optional[str] = None,
+        all_write: bool = False,
     ):
         """``global_rows``: the full global feature matrix when the host
         already has it (the ``fleet train`` data model: every host loads
         the same ``--data``) — :meth:`binning_rows` then avoids
-        allreducing the entire dataset just to re-fit bin bounds."""
+        allreducing the entire dataset just to re-fit bin bounds.
+
+        ``all_write``: every member writes checkpoints to its own (host-
+        local) ``ckpt_dir`` instead of only the coordinator writing a
+        shared one — the artifact-mode data model, where checkpoint
+        bytes replicate by content-addressed pull, not by shared mount.
+        The gather collective still runs on every member either way, so
+        the written state is bit-identical across the gang."""
         self.member = member
         self.generation = generation
         self.members = sorted(generation.members)
@@ -767,6 +833,8 @@ class GangContext:
         self.min_world = max(1, int(min_world))
         self.allow_growback = allow_growback
         self.global_rows = global_rows
+        self.ckpt_dir = ckpt_dir
+        self.all_write = bool(all_write)
         # loss debounce: a peer missing from the roster is only declared
         # dead once its last sighting is older than this — an
         # answering-but-freshly-restarted registry returns an EMPTY
@@ -788,10 +856,20 @@ class GangContext:
     # -- data movement --------------------------------------------------------
 
     @property
-    def is_writer(self) -> bool:
-        """One checkpoint writer per generation: the coordinator. Every
-        member still participates in the gather (it is a collective)."""
+    def is_coordinator(self) -> bool:
+        """The generation coordinator (lowest-named member): runs the
+        grow-back / straggler policy at checkpoint boundaries and is the
+        shared-dir mode's sole checkpoint writer."""
         return self.member.name == self.members[0]
+
+    @property
+    def is_writer(self) -> bool:
+        """Does THIS member persist checkpoints? Shared-dir mode: only
+        the coordinator (two writers on one mount would race). Artifact
+        mode (``all_write``): everyone — each host's dir is its own, and
+        the bytes are bit-identical by the gather-collective contract.
+        Every member participates in the gather either way."""
+        return self.all_write or self.is_coordinator
 
     def allreduce(self, arr: np.ndarray) -> np.ndarray:
         if self.reducer is None or self.world <= 1:
@@ -909,10 +987,31 @@ class GangContext:
             self.world_changed = g.gen
             raise WorldChangedError(g.gen)
         if (
-            it % self.checkpoint_every == 0 and self.is_writer
+            it % self.checkpoint_every == 0 and self.is_coordinator
             and ros is not None
         ):
             self._coordinate(ros, it)
+
+    def _freeze_resume(self, next_gen: int, it: int) -> tuple:
+        """Artifact-mode resume point for a grow/straggler reshard:
+        freeze the latest checkpoint, ``put()`` it as a content-
+        addressed artifact, and return ``(snapshot, digest,
+        resume_round)`` — so a joiner with an empty (host-local) dir can
+        pull the exact agreed bytes over HTTP. Shared-dir mode returns
+        ``(None, None, it)``: members resume from the shared LATEST as
+        before."""
+        store = self.member.artifact_store
+        if store is None or not self.ckpt_dir:
+            return None, None, it
+        snap, resume_round = snapshot_checkpoint(self.ckpt_dir, next_gen)
+        if snap is None:
+            return None, None, it
+        try:
+            ref = store.put(snap, name=os.path.basename(snap))
+        except Exception:  # noqa: BLE001 — a refused put degrades to
+            # shared-dir semantics rather than blocking the resize
+            return snap, None, resume_round
+        return snap, ref.digest, resume_round
 
     def _coordinate(self, ros: dict, it: int) -> None:
         """Checkpoint-boundary duties of the generation coordinator:
@@ -927,11 +1026,16 @@ class GangContext:
         # 0-row member would gang-sum empty-gradient NaNs into everyone
         joiners = joiners[:max(0, self.n_partitions - self.world)]
         if joiners and self.allow_growback and it > 0:
+            snap, digest, resume_round = self._freeze_resume(
+                self.generation.gen + 1, it
+            )
             g = Generation(
                 gen=self.generation.gen + 1,
                 members=sorted(set(self.members) | set(joiners)),
                 reason="grow",
-                resume_round=it,
+                resume_round=resume_round,
+                snapshot=snap,
+                snapshot_digest=digest,
             )
             self.member.commit_generation(g)
             _M_RESHARDS.labels(reason="grow").inc()
@@ -950,11 +1054,16 @@ class GangContext:
                 self.evict_stragglers and evictable
                 and self.world - len(evictable) >= self.min_world
             ):
+                snap, digest, resume_round = self._freeze_resume(
+                    self.generation.gen + 1, it
+                )
                 g = Generation(
                     gen=self.generation.gen + 1,
                     members=[m for m in self.members if m not in evictable],
                     reason="straggler",
-                    resume_round=it,
+                    resume_round=resume_round,
+                    snapshot=snap,
+                    snapshot_digest=digest,
                     evicted={
                         **self.generation.evicted,
                         **{m: ros.get(m, {}).get("boot") for m in evictable},
@@ -1122,7 +1231,15 @@ class ElasticTrainer:
         min_world: int = 1,
         status_file: Optional[str] = None,
         allow_growback: bool = True,
+        artifact_dir: Optional[str] = None,
     ):
+        """``artifact_dir``: enables **artifact mode** — ``ckpt_dir`` is
+        treated as HOST-LOCAL (every member writes its own checkpoints),
+        reshard snapshots are published as content-addressed artifacts
+        out of an :class:`~mmlspark_tpu.serving.artifacts.ArtifactStore`
+        rooted here, and a member whose disk lacks the agreed resume
+        snapshot pulls it over HTTP from any surviving peer. Without it,
+        the original shared-``ckpt_dir`` data model is unchanged."""
         self.registry_urls = registry_urls
         self.name = name
         self.x = np.asarray(x)
@@ -1144,6 +1261,12 @@ class ElasticTrainer:
         self.min_world = min_world
         self.status_file = status_file
         self.allow_growback = allow_growback
+        self.artifact_dir = artifact_dir
+        self._store: Any = None
+        if artifact_dir:
+            from mmlspark_tpu.serving.artifacts import ArtifactStore
+
+            self._store = ArtifactStore(artifact_dir)
         if self.world_size > self.n_partitions:
             # every member must own >= 1 partition (a 0-row member's
             # gang-summed empty gradients would poison the whole gang)
@@ -1158,6 +1281,7 @@ class ElasticTrainer:
             "snapshot": None, "detect_latency_s": None,
             "reshard_to_first_round_s": None, "rounds_per_s_pre": None,
             "rounds_per_s_post": None, "done": False,
+            "artifact_fetches": 0,
         }
 
     # -- status ---------------------------------------------------------------
@@ -1190,8 +1314,10 @@ class ElasticTrainer:
             self.registry_urls, self.name, service=self.service,
             advertise_host=self.advertise_host,
             heartbeat_s=self.heartbeat_s,
+            artifact_store=self._store,
         )
         try:
+            self._resolve_resume_from(member)
             gen = member.await_generation(
                 self.world_size, timeout_s=self.gen_timeout_s
             )
@@ -1250,6 +1376,8 @@ class ElasticTrainer:
             evict_stragglers=self.evict_stragglers,
             min_world=self.min_world,
             allow_growback=self.allow_growback,
+            ckpt_dir=self.ckpt_dir,
+            all_write=self._store is not None,
         )
         self.status.update(gen=gen.gen, members=sorted(gen.members))
         self._write_status()
@@ -1262,7 +1390,8 @@ class ElasticTrainer:
         # no snapshot) must resume from the run's LATEST, not roll the
         # whole gang back to the stale seed
         has_own_ckpt = os.path.exists(os.path.join(self.ckpt_dir, "LATEST"))
-        resume = gen.snapshot or (
+        snap = self._resolve_snapshot(member, gen)
+        resume = snap or (
             self.resume_from if not has_own_ckpt else None
         ) or self.ckpt_dir
         resume_t0 = time.monotonic()
@@ -1316,6 +1445,115 @@ class ElasticTrainer:
         finally:
             gang.close()
 
+    def _resolve_resume_from(self, member: GangMember) -> None:
+        """An ``--resume-from artifact:<name>@<digest>[@peer,...]`` seed
+        is pulled over HTTP (hash-verified) and unpacked into this
+        host's checkpoint dir before the run starts — a fresh host can
+        warm-start from a checkpoint it has never had on disk."""
+        spec = self.resume_from
+        if not spec or not str(spec).startswith("artifact:"):
+            return
+        if self._store is None:
+            raise RuntimeError(
+                "--resume-from artifact:… requires --artifact-dir"
+            )
+        from mmlspark_tpu.serving.artifacts import parse_spec, unpack_dir
+
+        _scheme, name, digest, hints = parse_spec(spec)
+        peers = list(hints) + [
+            p for p in member.artifact_peers(digest) if p not in hints
+        ]
+        if not peers:
+            # no spec-embedded hint and nobody advertising yet: wait out
+            # the heartbeat window before giving up
+            peers = self._await_peers(member, digest)
+        path = self._store.fetch(
+            digest, peers, name=name, timeout_s=self.gen_timeout_s,
+        )
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        local = os.path.join(self.ckpt_dir, f"pulled-{digest[:16]}")
+        unpack_dir(path, local)
+        self.status["artifact_fetches"] += 1
+        self.resume_from = local
+
+    def _resolve_snapshot(
+        self, member: GangMember, gen: Generation
+    ) -> Optional[str]:
+        """The local directory to resume this generation from, or None
+        when the record names no snapshot.
+
+        Shared-dir mode: the recorded path, trusted as before. Artifact
+        mode: a path is only *mine* when it lives under MY ``ckpt_dir``
+        (per-host disks: the committer's path means nothing here even if
+        it happens to be readable); anyone else pulls the content-
+        addressed bytes over HTTP from an advertising peer, verifies,
+        and unpacks into its own checkpoint dir — the grow-back victim's
+        whole recovery story."""
+        if not gen.snapshot and not gen.snapshot_digest:
+            return None
+        if self._store is None:
+            return gen.snapshot
+        own_root = os.path.realpath(self.ckpt_dir) + os.sep
+        if gen.snapshot and os.path.realpath(
+            gen.snapshot
+        ).startswith(own_root) and os.path.isdir(gen.snapshot):
+            return gen.snapshot
+        if not gen.snapshot_digest:
+            return None
+        digest = gen.snapshot_digest
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        local = os.path.join(self.ckpt_dir, f"pulled-{digest[:16]}")
+        if os.path.isdir(local):
+            return local
+        try:
+            # the committer itself advertises the snapshot; so may other
+            # members that pulled it already (replication widens the
+            # fan-in). Its advertisement rides the NEXT heartbeat, so an
+            # empty peer list right after the commit is a race, not an
+            # absence — wait it out before fetching
+            peers = self._await_peers(member, digest)
+            self._store.fetch(
+                digest, peers,
+                name=os.path.basename(gen.snapshot or f"ckpt-{digest[:12]}"),
+                timeout_s=self.gen_timeout_s,
+            )
+        except Exception:
+            # last resort before dying mid-recovery: this member's OWN
+            # checkpoint stream (all_write mode: every member persists)
+            # is bit-identical content — but only the EXACT agreed round
+            # is safe to stand in for the snapshot (a member resuming
+            # from a different round would diverge the gang's sums)
+            own = self._own_ckpt_round()
+            if own is not None and own == int(gen.resume_round):
+                return None  # fall through to resume = self.ckpt_dir
+            raise
+        from mmlspark_tpu.serving.artifacts import unpack_dir
+
+        unpack_dir(self._store.path(digest), local)
+        self.status["artifact_fetches"] += 1
+        self._write_status()
+        return local
+
+    def _await_peers(self, member: GangMember, digest: str) -> list:
+        """Poll the roster until someone advertises ``digest`` (bounded
+        by the generation timeout) — debounces the commit-to-heartbeat
+        advertisement window."""
+        deadline = time.monotonic() + max(
+            10.0 * self.heartbeat_s, 5.0
+        )
+        peers = member.artifact_peers(digest)
+        while not peers and time.monotonic() < deadline:
+            time.sleep(self.heartbeat_s)
+            peers = member.artifact_peers(digest)
+        return peers
+
+    def _own_ckpt_round(self) -> Optional[int]:
+        try:
+            with open(os.path.join(self.ckpt_dir, "LATEST")) as f:
+                return int(f.read().strip().rsplit("-", 1)[-1])
+        except (OSError, ValueError):
+            return None
+
     def _reshard(
         self, member: GangMember, gen: Generation, err: HostLostError
     ) -> None:
@@ -1352,10 +1590,23 @@ class ElasticTrainer:
             snap, resume_round = snapshot_checkpoint(
                 self.ckpt_dir, gen.gen + 1
             )
+            digest = None
+            if snap is not None and self._store is not None:
+                # publish the frozen resume point as a content-addressed
+                # artifact: fellow survivors (and the grow-back victim,
+                # later) pull these exact bytes over HTTP instead of
+                # needing this host's disk mounted
+                try:
+                    ref = self._store.put(snap, name=os.path.basename(snap))
+                    digest = ref.digest
+                except Exception:  # noqa: BLE001 — a refused put degrades
+                    # to shared-dir semantics rather than blocking recovery
+                    digest = None
             self.status.update(snapshot=snap, resume_round=resume_round)
             member.commit_generation(Generation(
                 gen=gen.gen + 1, members=survivors, reason="lost",
                 resume_round=resume_round, snapshot=snap,
+                snapshot_digest=digest,
                 detect_latency_s=round(detect_latency, 3),
             ))
             _M_RESHARDS.labels(reason="lost").inc()

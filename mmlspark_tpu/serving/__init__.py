@@ -25,9 +25,14 @@ id on the same machine, and crashed partitions replay their queue history
   memory under a byte budget, background load+warmup, zero-downtime
   hot-swap, per-model queues with deadline-aware admission control, and
   a ``/models`` control plane (docs/modelstore.md).
+- :class:`ArtifactStore` (``artifacts.py``) — the content-addressed
+  artifact plane: hash-verified, resumable checkpoint/snapshot
+  replication over the same worker ingress, so the fleet recovers
+  without a shared filesystem (docs/artifacts.md).
 - ``make_reply`` / ``request_to_row`` — ServingUDFs analogues.
 """
 
+from mmlspark_tpu.serving.artifacts import ArtifactServer, ArtifactStore
 from mmlspark_tpu.serving.server import CachedRequest, ServiceInfo, WorkerServer
 from mmlspark_tpu.serving.query import ServingQuery, serve_transformer
 from mmlspark_tpu.serving.registry import DriverRegistry
@@ -40,6 +45,8 @@ from mmlspark_tpu.serving.modelstore import (
 from mmlspark_tpu.serving.udfs import make_reply, request_to_json, request_to_text
 
 __all__ = [
+    "ArtifactServer",
+    "ArtifactStore",
     "WorkerServer",
     "CachedRequest",
     "ServiceInfo",

@@ -161,6 +161,7 @@ def run_worker(
     slo_interval_s: float = 15.0,
     admission: bool = True,
     admission_initial_limit: int = 32,
+    artifact_dir: Optional[str] = None,
 ) -> tuple:
     """Start a ModelStore-backed worker, register it, and re-register on a
     heartbeat thread (a restarted registry re-learns live workers within
@@ -191,6 +192,20 @@ def run_worker(
     srv = WorkerServer(host=host, port=port, name=service_name)
     info = srv.start()
     from mmlspark_tpu import obs
+    from mmlspark_tpu.serving import artifacts as artifacts_mod
+
+    # content-addressed artifact plane (serving/artifacts.py): every
+    # worker is both a CONSUMER (``artifact:`` model specs resolve by
+    # digest against the registries) and a PEER (fetched blobs re-serve
+    # off this ingress and are advertised each heartbeat, so replication
+    # fans out instead of hammering the producer)
+    if artifact_dir:
+        art_store = artifacts_mod.ArtifactStore(artifact_dir)
+        artifacts_mod.configure(store=art_store, registry_urls=registry_url)
+    else:
+        artifacts_mod.configure(registry_urls=registry_url)
+        art_store = artifacts_mod.default_store()
+    artifacts_mod.attach(srv, art_store)
 
     # trace-tree hop attribution: spans from this process carry an
     # operator-recognizable label instead of a bare pid
@@ -242,7 +257,8 @@ def run_worker(
             # beat, so the gateway can fail roster refreshes over to any
             # of them; a dead registry is skipped, not fatal
             fresh = dataclasses.replace(
-                info, models=tuple(store.model_names())
+                info, models=tuple(store.model_names()),
+                artifacts=tuple(art_store.refs()),
             )
             for url in registry_urls:
                 try:
@@ -717,6 +733,7 @@ def run_train(
     status_file: Optional[str] = None,
     out_model: Optional[str] = None,
     allow_growback: bool = True,
+    artifact_dir: Optional[str] = None,
 ) -> Any:
     """``fleet train``: one elastic training host (parallel/elastic.py).
 
@@ -755,6 +772,7 @@ def run_train(
         straggler_rounds=straggler_rounds,
         evict_stragglers=evict_stragglers, min_world=min_world,
         status_file=status_file, allow_growback=allow_growback,
+        artifact_dir=artifact_dir,
     )
     booster = trainer.run()
     model = booster.to_model_string()
@@ -790,6 +808,7 @@ def run_supervise(
     util_threshold: float = 0.85,
     gateway_url: Optional[str] = None,
     trains: Optional[list] = None,
+    spawn_cmd: Optional[str] = None,
 ) -> Any:
     """``fleet supervise``: spawn each ``--worker`` charge as a ``fleet
     worker`` process and keep it alive — restart on crash, kill+restart
@@ -848,7 +867,7 @@ def run_supervise(
         probe_s=probe_s, wedge_after=wedge_after, backoff_s=backoff_s,
         backoff_max_s=backoff_max_s, host=host, port=port,
         autoscaler=autoscaler, worker_template=template,
-        signals_fn=signals_fn,
+        signals_fn=signals_fn, spawn_cmd=spawn_cmd,
     ).start()
     obs.set_process_label(
         f"{service_name}-supervisor@{sup._info.host}:{sup._info.port}"
@@ -905,6 +924,7 @@ def run_online(
     features_col: str = "features",
     text_col: Optional[str] = None,
     distributed: bool = False,
+    artifact_dir: Optional[str] = None,
 ) -> tuple:
     """``fleet online``: run the continuous-learning loop as a fleet
     role. Starts the HTTP ingest ingress (``POST /ingest``; ``GET
@@ -915,6 +935,13 @@ def run_online(
     ``--worker-url``\\ s). Registers under ``<service>-online`` so
     ``fleet top`` and the deploy smoke's freshness gate find it; the
     freshness SLO engine runs in-process and exports burn-rate gauges.
+
+    ``--artifact-dir`` switches publication to **artifact mode** (no
+    shared filesystem): snapshots are published as
+    ``artifact:vw:<name>@<sha256>`` specs, served ranged off this
+    process's ingest ingress and advertised on its heartbeats — workers
+    pull the bytes over HTTP, hash-verified and resumable
+    (docs/artifacts.md).
 
     Returns ``(stream, loop, stopper)``."""
     import dataclasses
@@ -940,10 +967,23 @@ def run_online(
         label_col=label_col, features_col=features_col, text_col=text_col,
         distributed=distributed,
     )
+    art_store = None
+    artifact_url = None
+    if artifact_dir:
+        from mmlspark_tpu.serving import artifacts as artifacts_mod
+
+        art_store = artifacts_mod.ArtifactStore(artifact_dir)
+        # snapshots serve ranged off the SAME ingest ingress (the
+        # /metrics contract: inline, never queued or counted)
+        artifacts_mod.attach(stream._ingress, art_store)
+        artifact_url = (
+            f"http://{advertise_host or info.host}:{info.port}"
+        )
     publisher = Publisher(
         model=model, snapshot_dir=snapshot_dir,
         worker_urls=worker_urls, registry_url=registry_url,
         service_name=service_name,
+        artifact_store=art_store, artifact_url=artifact_url,
     )
     loop = OnlineLearningLoop(
         stream, trainer, publisher, publish_every_s=publish_every_s,
@@ -956,10 +996,18 @@ def run_online(
 
     def beat() -> None:
         while not stop.is_set():
+            fresh = info
+            if art_store is not None:
+                # advertise the snapshot artifacts each beat so workers
+                # can also resolve peers from the roster (the spec's
+                # embedded URL hint is merely the fast path)
+                fresh = dataclasses.replace(
+                    info, artifacts=tuple(art_store.refs())
+                )
             for url in registry_urls:
                 try:
                     if not stop.is_set():
-                        DriverRegistry.register(url, info)
+                        DriverRegistry.register(url, fresh)
                 except Exception as e:  # noqa: BLE001 — may be restarting
                     print(
                         f"online: register to {url} failed: {e}",
@@ -1109,6 +1157,12 @@ def main(argv: Optional[list] = None) -> None:
         "--admission-initial-limit", type=int, default=32,
         help="starting in-flight limit for the AIMD controller",
     )
+    w.add_argument(
+        "--artifact-dir", default=None,
+        help="root of this worker's content-addressed artifact cache "
+        "(artifact: model specs fetch into it and re-serve off the "
+        "ingress; default: a private tempdir)",
+    )
 
     def add_slo_flags(p) -> None:
         p.add_argument(
@@ -1218,6 +1272,15 @@ def main(argv: Optional[list] = None) -> None:
         help="gateway base URL scraped for scale signals (backpressure, "
         "breakers, SLO status)",
     )
+    sv.add_argument(
+        "--spawn-cmd", default=None,
+        help="pluggable placement: a command template wrapping every "
+        "spawn (restart AND autoscale-out). A bare {argv} token splices "
+        "the argv (local wrappers, 'kubectl run w --image=i -- {argv}'); "
+        "{argv} embedded in a larger token substitutes the shell-quoted "
+        "line for remote shells (\"ssh worker-7 'exec {argv}'\"). Remote "
+        "charges boot from pulled artifacts — no shared filesystem",
+    )
     on = sub.add_parser(
         "online",
         help="continuous-learning loop: HTTP feedback ingest -> online "
@@ -1259,6 +1322,13 @@ def main(argv: Optional[list] = None) -> None:
         "--distributed", action="store_true",
         help="shard micro-batches over the device mesh with a pmean "
         "allreduce per pass (multi-chip training)",
+    )
+    on.add_argument(
+        "--artifact-dir", default=None,
+        help="publish snapshots as content-addressed artifacts served "
+        "off the ingest ingress (no shared filesystem): workers pull "
+        "artifact:vw:<name>@<sha256> over HTTP, hash-verified + "
+        "resumable (docs/artifacts.md)",
     )
     tn = sub.add_parser(
         "train",
@@ -1307,6 +1377,13 @@ def main(argv: Optional[list] = None) -> None:
     tn.add_argument(
         "--no-growback", action="store_true",
         help="do not admit re-registered hosts at checkpoint boundaries",
+    )
+    tn.add_argument(
+        "--artifact-dir", default=None,
+        help="artifact mode: --ckpt-dir is HOST-LOCAL (every member "
+        "writes its own checkpoints); reshard snapshots replicate as "
+        "content-addressed artifacts pulled over HTTP from surviving "
+        "peers — no shared checkpoint filesystem (docs/artifacts.md)",
     )
     t = sub.add_parser(
         "top", help="scrape /metrics across the fleet, print a summary"
@@ -1436,6 +1513,7 @@ def main(argv: Optional[list] = None) -> None:
             min_world=args.min_world, resume_from=args.resume_from,
             status_file=args.status_file, out_model=args.out_model,
             allow_growback=not args.no_growback,
+            artifact_dir=args.artifact_dir,
         )
     elif args.role == "registry":
         from mmlspark_tpu.obs.flightrec import install_sigusr1
@@ -1461,6 +1539,7 @@ def main(argv: Optional[list] = None) -> None:
             slo_p99_ms=args.slo_p99_ms or None,
             admission=not args.no_admission,
             admission_initial_limit=args.admission_initial_limit,
+            artifact_dir=args.artifact_dir,
         )
         _serve_forever([stop, q, srv])
     elif args.role == "supervise":
@@ -1480,6 +1559,7 @@ def main(argv: Optional[list] = None) -> None:
             idle_after_s=args.idle_after_s,
             util_threshold=args.util_threshold,
             gateway_url=args.gateway,
+            spawn_cmd=args.spawn_cmd,
         )
         _serve_forever([sup])
     elif args.role == "online":
@@ -1498,6 +1578,7 @@ def main(argv: Optional[list] = None) -> None:
             loss=args.loss, lr=args.lr, batch=args.batch,
             label_col=args.label_col, features_col=args.features_col,
             text_col=args.text_col, distributed=args.distributed,
+            artifact_dir=args.artifact_dir,
         )
         _serve_forever([stopper])
     else:

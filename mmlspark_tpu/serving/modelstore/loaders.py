@@ -47,6 +47,14 @@ def model_name_from_spec(spec: str) -> str:
         # exactly the Publisher's -v%06d suffix: a looser \d+ would
         # mangle user-named snapshots like fraud-v2.npz -> "fraud"
         return re.sub(r"-v\d{6}$", "", stem) or "vw"
+    if spec.startswith("artifact:"):
+        # ``artifact:<scheme>:<name>@<digest>[@peers]`` serves under the
+        # name the delegate grammar would give the named file — digests
+        # and peer hints never leak into the serving name
+        from mmlspark_tpu.serving.artifacts import parse_spec
+
+        scheme, name, _digest, _urls = parse_spec(spec)
+        return model_name_from_spec(f"{scheme}:{name}")
     return spec
 
 
@@ -422,7 +430,11 @@ def build_loaded_model(spec: Any) -> LoadedModel:
       compiled (plan+fuse+partition) before ready, with jax-tree byte
       accounting over the fitted stages;
     - ``"vw:<snapshot.npz>"`` — an online-published VW linear model
-      (mmlspark_tpu/online/ Publisher artifact), scored on device.
+      (mmlspark_tpu/online/ Publisher artifact), scored on device;
+    - ``"artifact:<scheme>:<name>@<sha256>[@peer-url,...]"`` — fetch a
+      content-addressed artifact from any advertising peer (hash-
+      verified, resumable; serving/artifacts.py), then delegate to
+      ``<scheme>:<local path>``.
     """
     if isinstance(spec, LoadedModel):
         return spec
@@ -438,6 +450,15 @@ def build_loaded_model(spec: Any) -> LoadedModel:
         return _pipeline_loaded(spec[len("pipeline:"):])
     if spec.startswith("vw:"):
         return _vw_loaded(spec[len("vw:"):])
+    if spec.startswith("artifact:"):
+        # content-addressed spec (serving/artifacts.py): fetch the blob
+        # by digest (spec-embedded peer hints first, then every
+        # registry-advertised peer), hash-verify, then delegate to the
+        # ordinary grammar on the verified local copy — so operators can
+        # push models to workers without shell access to their disks
+        from mmlspark_tpu.serving.artifacts import resolve_spec
+
+        return build_loaded_model(resolve_spec(spec))
     if spec.startswith("module:"):
         import importlib
 

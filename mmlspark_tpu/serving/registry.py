@@ -363,6 +363,11 @@ class DriverRegistry:
             # advertised model names ride the roster entry so the gateway
             # can route model-aware (serving/distributed.py)
             payload["models"] = list(info.models)
+        if info.artifacts is not None:
+            # content-addressed artifact advertisement (name@sha256):
+            # consumers resolve fetch peers from the roster
+            # (serving/artifacts.py registry_peers)
+            payload["artifacts"] = list(info.artifacts)
         if info.boot is not None:
             # process-generation stamp: constant across heartbeats, new
             # per restart — the gateway's restart-detection signal (the

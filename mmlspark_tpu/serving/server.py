@@ -94,6 +94,10 @@ class ServiceInfo:
     # model names this worker serves (ModelStore-backed workers advertise
     # them so the gateway can route model-aware); None = unadvertised
     models: Optional[tuple] = None
+    # content-addressed artifacts this process can serve over GET
+    # /artifacts/<digest> ("name@sha256" strings, serving/artifacts.py);
+    # consumers resolve fetch peers by scanning rosters for a digest
+    artifacts: Optional[tuple] = None
     # process-generation stamp: set once when the server starts, constant
     # across heartbeat re-registrations, new on every restart. Roster
     # consumers use it to tell "new process" from "same process, fresh
@@ -158,6 +162,11 @@ class WorkerServer:
         # Attribute, not constructor arg: the query/dispatcher layer that
         # owns the controller attaches it (ServingQuery/ModelDispatcher)
         self.admission: Any = None
+        # optional ArtifactStore (serving/artifacts.py): when attached,
+        # GET /artifacts[/<digest>] is answered inline off this ingress
+        # (ranged, never queued or counted — the /metrics contract), so
+        # any worker doubles as a content-addressed artifact peer
+        self.artifact_store: Any = None
         self._m_accepted = _M_ACCEPTED.labels(server=name)
         self._m_rej_full = _M_REJECTED.labels(server=name, reason="queue_full")
         self._m_rej_admission = _M_REJECTED.labels(
@@ -313,6 +322,30 @@ class WorkerServer:
                         writer, 200, obs.render_traces(tid).encode(), keep,
                         {"Content-Type": "application/json"},
                     )
+                    if not keep:
+                        return
+                    continue
+                if (
+                    method == "GET"
+                    and self.artifact_store is not None
+                    and (
+                        path_only == "/artifacts"
+                        or path_only.startswith("/artifacts/")
+                    )
+                ):
+                    # content-addressed artifact plane (serving/
+                    # artifacts.py): advertisement + ranged blob reads,
+                    # answered inline like /metrics. Blobs can be many
+                    # MB — drain so backpressure lands here, not in an
+                    # unbounded transport buffer
+                    code, body_out, hdrs = self.artifact_store.handle_http(
+                        path_only, headers
+                    )
+                    self._write_response(writer, code, body_out, keep, hdrs)
+                    try:
+                        await writer.drain()
+                    except ConnectionError:
+                        return
                     if not keep:
                         return
                     continue

@@ -125,13 +125,58 @@ def _probe_health(url: str, timeout_s: float) -> bool:
         return False
 
 
+def spawn_from_template(template: str) -> Any:
+    """``--spawn-cmd`` -> a spawn callable: placement becomes pluggable,
+    so restarts and autoscale-outs can land on REMOTE hosts (SSH- or
+    k8s-shaped) instead of only this machine.
+
+    The template is shell-split once; a bare ``{argv}`` token splices
+    the charge's argv as separate arguments (local exec-style wrappers —
+    ``nice``, ``kubectl run … --``), while ``{argv}`` embedded in a
+    larger token substitutes the SHELL-QUOTED command line — the form a
+    remote shell needs, because ssh joins its arguments with plain
+    spaces and the far side word-splits them. Note shell quoting: in
+    ``'ssh h "{argv}"'`` the inner quotes are consumed by shlex, leaving
+    a bare token again — give the remote form surrounding text, e.g.
+    ``exec {argv}``. Without any ``{argv}`` the argv is appended.
+    Examples::
+
+        --spawn-cmd 'kubectl run trainer --image=mmlspark -- {argv}'
+        --spawn-cmd "ssh worker-7 'exec {argv}'"
+
+    The spawned process must still reach ``--registry`` and serve its
+    own health/artifact endpoints — remote charges boot their models and
+    checkpoints from pulled artifacts (serving/artifacts.py), which is
+    what makes cross-host placement work without a shared filesystem."""
+    base = shlex.split(template)
+
+    def spawn(argv: list) -> subprocess.Popen:
+        out: list = []
+        spliced = False
+        for tok in base:
+            if tok == "{argv}":
+                out.extend(argv)
+                spliced = True
+            elif "{argv}" in tok:
+                out.append(tok.replace("{argv}", shlex.join(argv)))
+                spliced = True
+            else:
+                out.append(tok)
+        if not spliced:
+            out.extend(argv)
+        return subprocess.Popen(out)
+
+    return spawn
+
+
 class FleetSupervisor:
     """Watch charges, restart the dead and the wedged, export status.
 
     ``registry_url``: when set, the supervisor heartbeat-registers its
     own status endpoint under ``<service_name>-supervisor`` so ``fleet
     top`` can find it. ``spawn`` is injectable for tests (defaults to
-    ``subprocess.Popen``)."""
+    ``subprocess.Popen``); ``spawn_cmd`` is the operator-facing template
+    form of the same hook (:func:`spawn_from_template`)."""
 
     def __init__(
         self,
@@ -148,6 +193,7 @@ class FleetSupervisor:
         host: str = "127.0.0.1",
         port: int = 0,
         spawn: Any = None,
+        spawn_cmd: Optional[str] = None,
         autoscaler: Any = None,
         worker_template: Optional[str] = None,
         signals_fn: Any = None,
@@ -172,6 +218,8 @@ class FleetSupervisor:
         self.startup_grace_s = startup_grace_s
         self._host = host
         self._port = port
+        if spawn is None and spawn_cmd:
+            spawn = spawn_from_template(spawn_cmd)
         self._spawn = spawn or (lambda argv: subprocess.Popen(argv))
         self._autoscaler = autoscaler
         self._worker_template = worker_template

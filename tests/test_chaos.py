@@ -831,13 +831,17 @@ def test_chaos_online_worker_kill_mid_training_zero_drop(tmp_path):
     sustained serving traffic for the online model through the gateway
     while the OnlineLearningLoop trains on a live feedback stream and
     publishes every ~0.5 s — and one serving worker is SIGKILLed
-    mid-soak, with the supervisor in AUTOSCALE mode. Gates: the
-    supervisor restarts the victim warm (its ``--load vw:`` seed spec
-    brings the model back before re-registering), publication resumes
-    (>= 3 successful publications AFTER the kill), ZERO dropped or
-    failed requests across every version flip, the freshness burn rate
-    ends green, and the autoscaler never shrank the fleet below its
-    floor."""
+    mid-soak, with the supervisor in AUTOSCALE mode — and the Publisher
+    runs in ARTIFACT mode (docs/artifacts.md): every snapshot reaches
+    the workers as ``artifact:vw:<name>@<sha256>`` pulled over HTTP
+    (hash-verified), never as a filesystem path, so the soak proves the
+    no-shared-filesystem deployment end-to-end. Gates: the supervisor
+    restarts the victim warm (its ``--load artifact:`` seed spec pulls
+    the model back over HTTP before re-registering), publication
+    resumes (>= 3 successful publications AFTER the kill), ZERO dropped
+    or failed requests across every version flip, zero feedback loss,
+    the freshness burn rate ends green, and the autoscaler never shrank
+    the fleet below its floor."""
     import os
     import socket
 
@@ -894,9 +898,17 @@ def test_chaos_online_worker_kill_mid_training_zero_drop(tmp_path):
         worker_urls=["http://127.0.0.1:1/"],  # snapshot only, never reached
     )
     seed_path = seed_pub._write_snapshot(trainer)
+    # ARTIFACT mode: the workers never see a snapshot path — the seed
+    # (and every live publication below) travels as a content-addressed
+    # blob pulled from this process's artifact ingress
+    from mmlspark_tpu.serving.artifacts import ArtifactServer, ArtifactStore
+
+    producer = ArtifactStore(str(tmp_path / "artstore"))
+    seed_ref = producer.put(seed_path, name=os.path.basename(seed_path))
+    art_srv = ArtifactServer(producer)
     worker_args = [
         f"--model echo --host 127.0.0.1 --port {p} --heartbeat-s 0.5 "
-        f"--load vw-online=vw:{seed_path}"
+        f"--load vw-online=artifact:vw:{seed_ref.spec}@{art_srv.url}"
         for p in (free_port(), free_port())
     ]
     autoscaler = Autoscaler(
@@ -928,6 +940,7 @@ def test_chaos_online_worker_kill_mid_training_zero_drop(tmp_path):
     publisher = Publisher(
         model="vw-online", snapshot_dir=str(tmp_path / "snaps"),
         registry_url=reg.url,
+        artifact_store=producer, artifact_url=art_srv.url,
     )
     loop = OnlineLearningLoop(
         stream, trainer, publisher, publish_every_s=0.5, poll_s=0.05,
@@ -1041,6 +1054,7 @@ def test_chaos_online_worker_kill_mid_training_zero_drop(tmp_path):
         stream.close()
         sup.stop()
         gw.stop()
+        art_srv.stop()
         reg.stop()
         # same hygiene as the PR-5 soak: this floods process-global obs
         # state (freshness histograms, online counters, exemplars) that

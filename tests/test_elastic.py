@@ -458,6 +458,182 @@ def test_chaos_elastic_host_loss_mid_round_resumes_bit_identical(tmp_path):
 
 @pytest.mark.chaos
 @pytest.mark.xdist_group("latency")
+def test_chaos_elastic_per_host_ckpt_dirs_artifact_pull_growback(tmp_path):
+    """The no-shared-filesystem acceptance gate (docs/artifacts.md):
+    a 2-host gang where every host owns a PRIVATE checkpoint dir
+    (``--artifact-dir`` mode — every member writes its own checkpoints,
+    reshard snapshots replicate as content-addressed artifacts). One
+    host is SIGKILLed mid-run under a live supervisor: the survivor
+    re-shards from ITS OWN disk, the restarted victim is grown back at
+    the next checkpoint boundary and must PULL the agreed resume
+    snapshot over HTTP (hash-verified) because the generation record
+    names a path on the survivor's disk, not its own. Both hosts finish
+    with identical boosters — and that booster is byte-identical to a
+    plain shared-dir/solo run of the same data+config, the invariance
+    the whole artifact plane must preserve."""
+    from mmlspark_tpu.serving import fleet
+    from mmlspark_tpu.serving.supervisor import (
+        FleetSupervisor,
+        charge_from_train_args,
+    )
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=1.2)
+    out = str(tmp_path)
+    # slow every chunk so the run comfortably outlives the restart
+    fault = json.dumps({"rules": [{"point": "gbdt.round", "delay_s": 0.35}]})
+    env = _child_env()
+
+    def spawn(argv):
+        return subprocess.Popen(
+            argv, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True,
+        )
+
+    def args(name):
+        # PER-HOST dirs: ck-a vs ck-b, art-a vs art-b — nothing shared
+        return (
+            f"--name {name} --data synth:600x8:5 --partitions 4 "
+            f"--world-size 2 --ckpt-dir {out}/ck-{name} "
+            f"--artifact-dir {out}/art-{name} --num-iterations 40 "
+            f"--num-leaves 7 --min-data-in-leaf 5 --seed 3 "
+            f"--checkpoint-every 2 --heartbeat-s 0.25 "
+            f"--out-model {out}/model-{name}.txt "
+            f"--status-file {out}/status-{name}.json"
+        )
+
+    charges = [
+        charge_from_train_args(args(n), reg.url, i)
+        for i, n in enumerate("ab")
+    ]
+    for c in charges:  # arm the chunk-slowdown plan in every trainer
+        c.argv = c.argv[:3] + ["--fault-plan", fault] + c.argv[3:]
+    sup = FleetSupervisor(
+        charges, registry_url=reg.url, probe_s=0.3, backoff_s=0.3,
+        stable_s=30.0, spawn=spawn,
+    ).start()
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if _status(out, "a").get("gen") == 1:
+                break
+            time.sleep(0.2)
+        assert _status(out, "a").get("gen") == 1, "gang never formed"
+        time.sleep(2.0)  # into the run, past the first checkpoints
+        victim = charges[1]
+        victim.proc.kill()
+        deadline = time.monotonic() + 150.0
+        while time.monotonic() < deadline:
+            sa, sb = _status(out, "a"), _status(out, "b")
+            if sa.get("done") and sb.get("done"):
+                break
+            time.sleep(0.4)
+        sa, sb = _status(out, "a"), _status(out, "b")
+        assert sa.get("done") and sb.get("done"), (sa, sb)
+        assert victim.restarts >= 1, "supervisor never restarted the victim"
+        # survivor shrank from its OWN dir, victim grew back
+        assert sa["reshard_reasons"][:1] == ["lost"]
+        assert sa["gen"] >= 3 and sorted(sa["members"]) == ["a", "b"]
+        # the victim's resume point came over HTTP: the generation
+        # record named a snapshot on the SURVIVOR's disk, so the victim
+        # had to pull the content-addressed bytes from a peer
+        assert sb.get("artifact_fetches", 0) >= 1, (
+            "victim never pulled a checkpoint artifact", sb,
+        )
+        with open(os.path.join(out, "model-a.txt")) as f:
+            ma = f.read()
+        with open(os.path.join(out, "model-b.txt")) as f:
+            mb = f.read()
+        assert ma == mb, "grown-back gang disagreed on the final booster"
+    finally:
+        sup.stop()
+        reg.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.xdist_group("latency")
+def test_chaos_elastic_per_host_reshard_bit_identical_via_artifact(tmp_path):
+    """Gate 1's hard bit-identity contract, with the shared filesystem
+    removed: per-host checkpoint dirs, one host SIGKILLed mid-round —
+    the survivor re-shards from ITS OWN disk and publishes the frozen
+    resume snapshot as a content-addressed artifact. A fresh world-1
+    trainer then warm-starts from ``--resume-from artifact:<name>@
+    <digest>@<url>`` — the snapshot bytes travel over HTTP, hash-
+    verified, from the survivor's (restart-surviving) store — and its
+    final booster must equal the survivor's byte-for-byte. Same claim
+    as the shared-dir gate, new transport."""
+    from mmlspark_tpu.serving import fleet
+    from mmlspark_tpu.serving.artifacts import ArtifactServer, ArtifactStore
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=1.2)
+    out = str(tmp_path)
+    try:
+        victim_fault = json.dumps({
+            "rules": [{"point": "gbdt.round", "at": [6], "delay_s": 600}],
+        })
+        art = {n: os.path.join(out, f"art-{n}") for n in "abc"}
+        surv = _spawn_trainer(
+            reg.url, "a", os.path.join(out, "ck-a"), out, world=2,
+            extra=["--no-growback", "--artifact-dir", art["a"]],
+        )
+        vict = _spawn_trainer(
+            reg.url, "b", os.path.join(out, "ck-b"), out, world=2,
+            extra=["--no-growback", "--artifact-dir", art["b"]],
+            fault=victim_fault,
+        )
+        # per-host dirs: watch the SURVIVOR's own checkpoint stream
+        latest = os.path.join(out, "ck-a", "LATEST")
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            try:
+                with open(latest) as f:
+                    if f.read().strip() == "round-0000006":
+                        break
+            except OSError:
+                pass
+            assert vict.poll() is None, vict.communicate()[1][-2000:]
+            time.sleep(0.1)
+        time.sleep(0.6)
+        vict.kill()
+        _, err_a = surv.communicate(timeout=180)
+        assert surv.returncode == 0, err_a[-3000:]
+        sa = _status(out, "a")
+        assert sa["done"] and sa["reshards"] == 1 and sa["gen"] == 2
+        assert sa["snapshot"].startswith(os.path.join(out, "ck-a"))
+        # the survivor advertised the snapshot as an artifact; its store
+        # survives the process (re-indexed from disk) — serve it
+        store = ArtifactStore(art["a"])
+        name = os.path.basename(sa["snapshot"])
+        refs = [r for r in store.refs() if r.startswith(name + "@")]
+        assert refs, (store.refs(), name)
+        srv = ArtifactServer(store)
+        try:
+            fresh = _spawn_trainer(
+                reg.url, "c", os.path.join(out, "ck-c"), out, world=1,
+                extra=[
+                    "--artifact-dir", art["c"],
+                    "--resume-from", f"artifact:{refs[0]}@{srv.url}",
+                ],
+            )
+            _, err_c = fresh.communicate(timeout=180)
+            assert fresh.returncode == 0, err_c[-3000:]
+        finally:
+            srv.stop()
+        sc = _status(out, "c")
+        assert sc.get("artifact_fetches", 0) >= 1, sc
+        with open(os.path.join(out, "model-a.txt")) as f:
+            survivor_model = f.read()
+        with open(os.path.join(out, "model-c.txt")) as f:
+            fresh_model = f.read()
+        assert survivor_model == fresh_model, (
+            "survivor's resumed booster != fresh world-1 run from the "
+            "artifact-pulled snapshot"
+        )
+    finally:
+        reg.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.xdist_group("latency")
 def test_chaos_elastic_supervisor_growback_at_checkpoint_boundary(tmp_path):
     """``fleet supervise`` training charges close the loop: a SIGKILLed
     trainer is restarted with its full argv, auto-resumes from the
