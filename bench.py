@@ -62,7 +62,8 @@ SEGMENT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_SEGMENT_TIMEOUT", "200"))
 # A raised MMLSPARK_BENCH_SEGMENT_TIMEOUT still wins (max() at use); the
 # phase deadline caps everything regardless.
 SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280,
-                    "pipeline": 240, "freshness": 240, "elastic": 240}
+                    "pipeline": 240, "freshness": 240, "elastic": 240,
+                    "throughput": 280}
 
 # Canonical segment set. Two orders, learned the hard way:
 # - On the TPU attempt, spend the chip's uncertain lifetime on the
@@ -73,11 +74,11 @@ SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280,
 #   out of the CPU child identically.
 # - On the CPU fallback, cheap-first so a late death costs least.
 SEGMENTS = ["serving", "modelstore", "tracing", "artifact", "overload",
-            "freshness", "elastic", "pipeline", "hist", "vw", "gbdt",
-            "sklearn", "featurizer"]
+            "throughput", "freshness", "elastic", "pipeline", "hist", "vw",
+            "gbdt", "sklearn", "featurizer"]
 TPU_ORDER = ["sklearn", "gbdt", "hist", "featurizer", "pipeline", "vw",
              "serving", "modelstore", "tracing", "artifact", "overload",
-             "freshness", "elastic"]
+             "throughput", "freshness", "elastic"]
 CPU_ORDER = SEGMENTS
 
 
@@ -1507,12 +1508,309 @@ def _seg_freshness(on_accel: bool, n_dev: int) -> dict:
     return out
 
 
+def _seg_throughput(on_accel: bool, n_dev: int) -> dict:
+    """Data-plane throughput at a fixed p99 bound (ISSUE 12 acceptance):
+    closed-loop keep-alive clients through the FULL rewritten path —
+    multi-reactor gateway ingress -> pooled zero-re-parse forwarding ->
+    multi-reactor worker -> continuous-batching ModelDispatcher — for
+    the echo model AND a 3-stage fused ``pipeline:`` model scored
+    through the columnar array fast path (asserted fallback-free).
+
+    The number to beat is the r09 overload bench's 93 rps 4x-load
+    goodput (a synthetic-capacity bound the old plumbing saturated
+    at); the target is >= 10x that at a p99 under the bound. The
+    overload segment still runs unchanged — it measures containment
+    under a deliberately slow model; this measures the plumbing.
+
+    Deployment shape matters for an honest number: worker, gateway and
+    load generators each run as their OWN subprocess (as in any real
+    fleet) — in-process client threads would fight the serving threads
+    for the GIL and measure the bench, not the data plane."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from mmlspark_tpu import DataFrame, Pipeline
+    from mmlspark_tpu.featurize.featurize import Featurize
+    from mmlspark_tpu.models.linear import LogisticRegression
+    from mmlspark_tpu.stages.basic import UDFTransformer
+
+    P99_BOUND_MS = 50.0
+    R09_GOODPUT = 93.0
+    n_procs, n_threads = 4, 4  # 4 client processes x 4 keep-alive threads
+    dur_s = 3.0
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # serving plumbing is host-side
+
+    def spawn(code: str, *args: str):
+        # payloads travel via a temp FILE path in argv (clients read
+        # sys.argv[5]) — NOT stdin: communicate(input=...) silently
+        # drops input when stdin isn't a pipe, which burned one round
+        # of this bench. stdin=PIPE just detaches children from the
+        # parent's stdin
+        return subprocess.Popen(
+            [sys.executable, "-c", code, *args], env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+
+    def first_line(proc, what: str, timeout_s: float = 120.0) -> dict:
+        line = [None]
+
+        def read():
+            line[0] = proc.stdout.readline()
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if not line[0]:
+            proc.kill()
+            raise RuntimeError(f"{what} did not report in {timeout_s}s: "
+                               f"{proc.stderr.read()[-500:]}")
+        return json.loads(line[0])
+
+    _WORKER_CODE = """
+import json, sys, time
+from mmlspark_tpu.serving.modelstore import ModelDispatcher, ModelStore
+from mmlspark_tpu.serving.server import WorkerServer
+store = ModelStore()
+store.load("echo", "echo", wait=True)
+if sys.argv[1] != "-":
+    store.load("scorer", "pipeline:" + sys.argv[1], wait=True)
+srv = WorkerServer(name="tpbench", num_reactors=2)
+info = srv.start()
+disp = ModelDispatcher(srv, store, default_model="echo",
+                       max_batch_size=64, pipeline_depth=2).start()
+print(json.dumps({"port": info.port}), flush=True)
+time.sleep(600)
+"""
+
+    _GATEWAY_CODE = """
+import json, sys, time
+from mmlspark_tpu.serving.distributed import ServingGateway
+from mmlspark_tpu.serving.server import ServiceInfo
+gw = ServingGateway(
+    workers=[ServiceInfo(name="serving", host="127.0.0.1",
+                         port=int(sys.argv[1]),
+                         models=("echo", "scorer"))],
+    num_dispatchers=4, num_reactors=2, request_timeout_s=30.0,
+)
+info = gw.start()
+print(json.dumps({"port": info.port}), flush=True)
+time.sleep(600)
+"""
+
+    # closed-loop load generator: keep-alive threads hammer as fast as
+    # replies come back; warm window driven but unrecorded
+    _CLIENT_CODE = """
+import http.client, json, sys, threading, time
+port, path, dur_s, n_threads = (int(sys.argv[1]), sys.argv[2],
+                                float(sys.argv[3]), int(sys.argv[4]))
+payload = open(sys.argv[5], "rb").read()
+warm_s = float(sys.argv[6])
+lock = threading.Lock()
+lats, errs = [], [0]
+start_t = time.perf_counter() + 0.05
+warm_t = start_t + warm_s
+stop_t = warm_t + dur_s
+def client():
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    while True:
+        t0 = time.perf_counter()
+        if t0 >= stop_t:
+            break
+        try:
+            conn.request("POST", path, body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            ok = resp.status == 200
+        except Exception:
+            conn.close()
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            ok = False
+        dt = (time.perf_counter() - t0) * 1e3
+        if t0 < warm_t:
+            continue
+        with lock:
+            (lats.append(round(dt, 3)) if ok else errs.__setitem__(
+                0, errs[0] + 1))
+ts = [threading.Thread(target=client) for _ in range(n_threads)]
+[t.start() for t in ts]
+[t.join(dur_s + 40.0) for t in ts]
+print(json.dumps({"lats": lats, "errors": errs[0]}), flush=True)
+"""
+
+    def drive(port: int, path: str, payload: bytes, rows_per_req: int,
+              warm_s: float = 0.8, procs_n: int = n_procs) -> dict:
+        """``warm_s``: driven-but-unrecorded ramp — long enough for every
+        dispatcher-batch bucket the load shape produces to have compiled
+        (the pipeline drive sees row counts 8..512, i.e. 7 buckets)."""
+        pf = os.path.join(tmp, "payload.json")
+        with open(pf, "wb") as f:
+            f.write(payload)
+        # every generator starts at once — their measurement windows
+        # overlap, the merged latencies are one offered-load picture
+        procs = [
+            spawn(_CLIENT_CODE, str(port), path, str(dur_s),
+                  str(n_threads), pf, str(warm_s))
+            for _ in range(procs_n)
+        ]
+        lats: list = []
+        errors = 0
+        for p in procs:
+            out_s, _ = p.communicate(timeout=dur_s + 60.0)
+            res = json.loads(out_s.strip().splitlines()[-1])
+            lats.extend(res["lats"])
+            errors += res["errors"]
+        arr = np.sort(np.asarray(lats)) if lats else np.asarray([0.0])
+        return {
+            "rps": round(len(lats) / dur_s, 1),
+            "rows_per_s": round(len(lats) * rows_per_req / dur_s, 1),
+            "p50_ms": round(float(arr[len(arr) // 2]), 2),
+            "p99_ms": round(float(arr[int((len(arr) - 1) * 0.99)]), 2),
+            "errors": errors,
+        }
+
+    def fallback_count(port: int) -> int:
+        """Worker-side compiler fallbacks, scraped off its /metrics."""
+        import http.client as hc
+        import re as _re
+
+        conn = hc.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        return sum(int(v) for v in _re.findall(
+            r"mmlspark_compiler_fallback_total\{[^}]*\} (\d+)", text
+        ))
+
+    out: dict = {
+        "throughput_p99_bound_ms": P99_BOUND_MS,
+        "throughput_r09_goodput_rps": R09_GOODPUT,
+        "throughput_clients": n_procs * n_threads,
+    }
+
+    # fused 3-stage pipeline: featurize -> jitted UDF -> logistic
+    rng = np.random.default_rng(7)
+    n_fit = 2048
+    cols = {f"x{i}": rng.standard_normal(n_fit) for i in range(8)}
+    cols["vec"] = rng.standard_normal((n_fit, 8)).astype(np.float32)
+    cols["label"] = rng.integers(0, 2, n_fit)
+    fit_df = DataFrame.from_dict(cols, num_partitions=1)
+    pipe = Pipeline([
+        Featurize(input_cols=[f"x{i}" for i in range(8)] + ["vec"],
+                  output_col="features"),
+        UDFTransformer(input_col="features", output_col="features_s",
+                       vector_udf=lambda x: jnp.tanh(x * jnp.float32(0.5)),
+                       jit_compatible=True),
+        LogisticRegression(features_col="features_s", label_col="label",
+                           max_iter=10),
+    ])
+    model = _retry(lambda: pipe.fit(fit_df), "throughput pipeline fit")
+    tmp = tempfile.mkdtemp(prefix="tpbench-")
+    worker = gateway = None
+    try:
+        pdir = os.path.join(tmp, "scorer")
+        model.save(pdir)
+        with open(os.path.join(pdir, "warmup.json"), "w") as f:
+            json.dump(
+                {**{f"x{i}": [0.0] * 8 for i in range(8)},
+                 "vec": [[0.0] * 8] * 8, "label": [0] * 8}, f,
+            )
+        worker = spawn(_WORKER_CODE, pdir)
+        wport = first_line(worker, "throughput worker")["port"]
+        gateway = spawn(_GATEWAY_CODE, str(wport))
+        gport = first_line(gateway, "throughput gateway")["port"]
+
+        echo_payload = json.dumps({"x": [0.1] * 16}).encode()
+        direct = drive(wport, "/", echo_payload, 1)
+        out["throughput_echo_direct_rps"] = direct["rps"]
+        out["throughput_echo_direct_p50_ms"] = direct["p50_ms"]
+        out["throughput_echo_direct_p99_ms"] = direct["p99_ms"]
+        gwres = drive(gport, "/", echo_payload, 1)
+        out["throughput_echo_rps"] = gwres["rps"]
+        out["throughput_echo_p50_ms"] = gwres["p50_ms"]
+        out["throughput_echo_p99_ms"] = gwres["p99_ms"]
+        out["throughput_echo_errors"] = gwres["errors"] + direct["errors"]
+
+        # columnar fast path: 8 rows per request, one fused transform per
+        # dispatcher batch, asserted fallback-free off the worker
+        # metrics. select narrows the reply to the head's outputs —
+        # the full reply would echo every intermediate feature vector,
+        # and at these rates the reply ENCODE becomes the bottleneck,
+        # not the data plane under test
+        rows_n = 8
+        cols_body = json.dumps({
+            "cols": {
+                **{f"x{i}": [round(0.1 * r, 3) for r in range(rows_n)]
+                   for i in range(8)},
+                "vec": [[0.05] * 8 for _ in range(rows_n)],
+                "label": [0] * rows_n,
+            },
+            "select": ["prediction", "probability"],
+        }).encode()
+        fb_before = fallback_count(wport)
+        # Direct first: r09's 93-rps goodput was recorded worker-direct
+        # (the overload bench has no gateway), so the like-for-like
+        # 10x comparison is the worker-direct number; the gateway run
+        # (8 clients — deeper concurrency through the extra hop only
+        # buys batch-queue depth; closed-loop law: rps = concurrency /
+        # latency) prices the distributed hop on top
+        pdirect = drive(wport, "/models/scorer", cols_body, rows_n,
+                        warm_s=3.0, procs_n=3)
+        out["throughput_pipeline_direct_rps"] = pdirect["rps"]
+        out["throughput_pipeline_direct_rows_per_s"] = pdirect["rows_per_s"]
+        out["throughput_pipeline_direct_p50_ms"] = pdirect["p50_ms"]
+        out["throughput_pipeline_direct_p99_ms"] = pdirect["p99_ms"]
+        pres = drive(gport, "/models/scorer", cols_body, rows_n,
+                     warm_s=1.0, procs_n=2)
+        out["throughput_pipeline_rps"] = pres["rps"]
+        out["throughput_pipeline_rows_per_s"] = pres["rows_per_s"]
+        out["throughput_pipeline_p50_ms"] = pres["p50_ms"]
+        out["throughput_pipeline_p99_ms"] = pres["p99_ms"]
+        out["throughput_pipeline_errors"] = pres["errors"] + pdirect["errors"]
+        out["throughput_pipeline_fallback_free"] = (
+            fallback_count(wport) == fb_before
+        )
+    finally:
+        for p in (gateway, worker):
+            if p is not None:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # the acceptance ratios: r09's 93-rps goodput was worker-direct, so
+    # the like-for-like 10x claim is the *_direct numbers; the gateway
+    # ratios price the distributed hop at the same p99 bound
+    out["throughput_echo_vs_r09"] = round(
+        out.get("throughput_echo_direct_rps", 0.0) / R09_GOODPUT, 2
+    )
+    out["throughput_pipeline_vs_r09"] = round(
+        out.get("throughput_pipeline_direct_rps", 0.0) / R09_GOODPUT, 2
+    )
+    out["throughput_gateway_echo_vs_r09"] = round(
+        out.get("throughput_echo_rps", 0.0) / R09_GOODPUT, 2
+    )
+    out["throughput_p99_within_bound"] = bool(
+        max(
+            out.get("throughput_echo_p99_ms", 1e9),
+            out.get("throughput_echo_direct_p99_ms", 1e9),
+            out.get("throughput_pipeline_p99_ms", 1e9),
+            out.get("throughput_pipeline_direct_p99_ms", 1e9),
+        ) <= P99_BOUND_MS
+    )
+    return out
+
+
 SEGMENT_FNS = {
     "serving": _seg_serving,
     "modelstore": _seg_modelstore,
     "tracing": _seg_tracing,
     "artifact": _seg_artifact,
     "overload": _seg_overload,
+    "throughput": _seg_throughput,
     "freshness": _seg_freshness,
     "elastic": _seg_elastic,
     "pipeline": _seg_pipeline,

@@ -34,7 +34,11 @@ id on the same machine, and crashed partitions replay their queue history
 
 from mmlspark_tpu.serving.artifacts import ArtifactServer, ArtifactStore
 from mmlspark_tpu.serving.server import CachedRequest, ServiceInfo, WorkerServer
-from mmlspark_tpu.serving.query import ServingQuery, serve_transformer
+from mmlspark_tpu.serving.query import (
+    ServingQuery,
+    SplitHandler,
+    serve_transformer,
+)
 from mmlspark_tpu.serving.registry import DriverRegistry
 from mmlspark_tpu.serving.distributed import Backend, BackendPool, ServingGateway
 from mmlspark_tpu.serving.modelstore import (
@@ -51,6 +55,7 @@ __all__ = [
     "CachedRequest",
     "ServiceInfo",
     "ServingQuery",
+    "SplitHandler",
     "serve_transformer",
     "DriverRegistry",
     "Backend",

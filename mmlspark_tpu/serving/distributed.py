@@ -27,6 +27,8 @@ import http.client
 import json
 import logging
 import queue as queue_mod
+import select
+import socket
 import threading
 import time
 from collections import deque
@@ -108,6 +110,244 @@ _M_HEDGE_WINS = obs.counter(
     "mmlspark_gateway_hedge_wins_total",
     "Requests answered by the hedge before the primary",
 )
+_M_CONN_REUSE = obs.counter(
+    "mmlspark_gateway_conn_reuse_total",
+    "Forwards sent on an already-open pooled worker connection",
+)
+_M_CONN_OPENED = obs.counter(
+    "mmlspark_gateway_conn_opened_total",
+    "Fresh worker connections opened (pool miss, stale replacement, "
+    "or hedge-pool growth)",
+)
+_M_HEDGE_POOL = obs.gauge(
+    "mmlspark_gateway_hedge_pool_connections_count",
+    "Idle pooled connections reserved for hedged attempts",
+)
+
+
+# -- zero-re-parse wire client ------------------------------------------------
+
+_WIRE_COUNT_LOCK = threading.Lock()
+
+
+class WireConn:
+    """Minimal HTTP/1.1 keep-alive client connection on a raw socket —
+    the gateway's forwarding primitive.
+
+    ``http.client`` re-serializes a header dict and runs a stateful
+    feed-parser over every response; at data-plane rates that work IS the
+    gateway. Here the request goes out as one ``sendall`` of
+    pre-computed bytes (method line + the request's static header block
+    + per-attempt lines, built once in ``_forward``), and the reply is
+    parsed with a single splitting pass over the head — the raw body
+    bytes are relayed to the client untouched.
+
+    ``open_count()`` tracks live connections process-wide so tests can
+    pin the no-socket-leak property of the pools.
+    """
+
+    _open = 0
+
+    __slots__ = ("host", "port", "sock", "_buf", "_closed", "last_resp_bytes")
+
+    def __init__(self, host: str, port: int, timeout: float):
+        self.host = host
+        self.port = port
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+        self._closed = False
+        self.last_resp_bytes = 0  # bytes of the in-progress response seen
+        with _WIRE_COUNT_LOCK:
+            WireConn._open += 1
+        if _M_CONN_OPENED._on:
+            _M_CONN_OPENED.inc()
+
+    @classmethod
+    def open_count(cls) -> int:
+        with _WIRE_COUNT_LOCK:
+            return cls._open
+
+    def send(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def read_response(self) -> "WireResponse":
+        """One response, one splitting pass: read to the blank line,
+        split the head once, then read exactly Content-Length body
+        bytes. Raises OSError subclasses (``socket.timeout`` IS
+        ``TimeoutError``, so the at-most-once post-send logic sees the
+        same exception shape as before)."""
+        self.last_resp_bytes = len(self._buf)
+        buf = self._buf
+        while True:
+            i = buf.find(b"\r\n\r\n")
+            if i >= 0:
+                break
+            if len(buf) > 65536:
+                raise ConnectionError("response head too large")
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionResetError("connection closed mid-response")
+            buf += chunk
+            self.last_resp_bytes = len(buf)
+        head, rest = buf[:i], buf[i + 4:]
+        lines = head.split(b"\r\n")
+        parts = lines[0].split(None, 2)
+        if len(parts) < 2:
+            raise ConnectionError(f"torn status line {lines[0]!r}")
+        try:
+            status = int(parts[1])
+        except ValueError:
+            raise ConnectionError(
+                f"non-numeric status {parts[1]!r}"
+            ) from None
+        hdrs: dict = {}
+        for ln in lines[1:]:
+            k, _, v = ln.partition(b":")
+            hdrs[k.strip().lower().decode("latin1")] = (
+                v.strip().decode("latin1")
+            )
+        try:
+            n = int(hdrs.get("content-length") or 0)
+        except ValueError:
+            raise ConnectionError("bad Content-Length") from None
+        if len(rest) < n:
+            out = [rest]
+            got = len(rest)
+            while got < n:
+                chunk = self.sock.recv(min(65536, n - got))
+                if not chunk:
+                    raise ConnectionResetError("connection closed mid-body")
+                out.append(chunk)
+                got += len(chunk)
+            rest = b"".join(out)
+        body, self._buf = rest[:n], rest[n:]
+        will_close = hdrs.get("connection", "keep-alive").lower() == "close"
+        return WireResponse(status, hdrs, body, will_close)
+
+    def alive(self) -> bool:
+        """Is this idle pooled connection still usable? A dead worker's
+        FIN (or any unread stray bytes) makes the socket readable —
+        reusing it would turn 'worker stopped between requests' from a
+        safe pre-send connect-refused into a send-then-hang 504.
+        poll(), not select(): the gateway ingress holds an fd per
+        client, so pooled fds routinely exceed select's FD_SETSIZE
+        under load."""
+        if self._closed:
+            return False
+        try:
+            p = select.poll()
+            p.register(self.sock, select.POLLIN)
+            return not p.poll(0)
+        except (OSError, ValueError):
+            return False
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with _WIRE_COUNT_LOCK:
+            WireConn._open -= 1
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class WireResponse:
+    """The parsed reply: status + lowercase header dict + raw body bytes.
+    ``getheader`` mirrors http.client's accessor so the routing logic
+    reads unchanged."""
+
+    __slots__ = ("status", "headers", "body", "will_close")
+
+    def __init__(self, status: int, headers: dict, body: bytes,
+                 will_close: bool):
+        self.status = status
+        self.headers = headers
+        self.body = body
+        self.will_close = will_close
+
+    def getheader(self, name: str, default: Optional[str] = None):
+        return self.headers.get(name.lower(), default)
+
+
+def _head_bytes(method: str, target: str, host_line: bytes,
+                static_block: bytes, extra: dict, nbody: int) -> bytes:
+    """Assemble one request's head: the method line and per-attempt
+    headers wrap the request's pre-computed static block — nothing is
+    re-serialized per attempt except what actually changed (remaining
+    deadline, parent span)."""
+    parts = [
+        f"{method} {target} HTTP/1.1\r\n".encode("latin1"),
+        host_line,
+        static_block,
+    ]
+    for k, v in extra.items():
+        parts.append(f"{k}: {v}\r\n".encode("latin1"))
+    parts.append(f"Content-Length: {nbody}\r\n\r\n".encode("latin1"))
+    return b"".join(parts)
+
+
+class HedgeConnPool:
+    """Small shared side pool of :class:`WireConn` per backend for hedged
+    attempts — hedges used to open (and leak under bursts, until GC) a
+    fresh ``HTTPConnection`` per try. Check-out/check-in under one lock;
+    a connection whose response wasn't fully consumed (the cancelled
+    loser) is closed, never pooled."""
+
+    def __init__(self, timeout: float, per_backend: int = 4):
+        self._timeout = timeout
+        self._cap = per_backend
+        self._lock = threading.Lock()
+        self._idle: dict = {}  # (host, port) -> [WireConn]
+
+    def get(self, b: "Backend") -> tuple:
+        key = (b.host, b.port)
+        with self._lock:
+            idle = self._idle.get(key)
+            while idle:
+                conn = idle.pop()
+                self._update_gauge_locked()
+                if conn.alive():
+                    return conn, True
+                conn.close()
+        return WireConn(b.host, b.port, self._timeout), False
+
+    def put(self, b: "Backend", conn: WireConn) -> None:
+        key = (b.host, b.port)
+        with self._lock:
+            idle = self._idle.setdefault(key, [])
+            if len(idle) < self._cap and not conn._closed:
+                idle.append(conn)
+                self._update_gauge_locked()
+                return
+        conn.close()
+
+    def prune(self, members: list) -> None:
+        """Drop pooled connections to backends no longer rostered."""
+        live = {(m.host, m.port) for m in members}
+        with self._lock:
+            for key in [k for k in self._idle if k not in live]:
+                for conn in self._idle.pop(key):
+                    conn.close()
+            self._update_gauge_locked()
+
+    def idle_count(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._idle.values())
+
+    def close_all(self) -> None:
+        with self._lock:
+            for conns in self._idle.values():
+                for conn in conns:
+                    conn.close()
+            self._idle.clear()
+            self._update_gauge_locked()
+
+    def _update_gauge_locked(self) -> None:
+        if _M_HEDGE_POOL._on:
+            _M_HEDGE_POOL.set(sum(len(v) for v in self._idle.values()))
 
 
 # -- circuit breaker ---------------------------------------------------------
@@ -653,6 +893,7 @@ class ServingGateway:
         retry_budget_ratio: float = 0.2,
         retry_budget_window_s: float = 10.0,
         retry_budget_min: int = 3,
+        num_reactors: int = 1,
     ):
         """``hedge_ms``: tail-latency hedging — a request still pending
         after this many ms is duplicated to a second backend, first
@@ -669,7 +910,8 @@ class ServingGateway:
         a storm into the floor."""
         self.service_name = service_name
         self._ingress = WorkerServer(
-            host=host, port=port, name=f"{service_name}-gateway"
+            host=host, port=port, name=f"{service_name}-gateway",
+            num_reactors=num_reactors,
         )
         if evict_after is None:
             # eviction only makes sense with a registry: its refresh is the
@@ -705,8 +947,17 @@ class ServingGateway:
         self._draining = False
         # per-dispatcher-thread persistent connections: the worker server
         # speaks HTTP/1.1 keep-alive, so reusing the TCP connection drops
-        # the per-request handshake from the gateway overhead
+        # the per-request handshake from the gateway overhead. The flat
+        # registry mirrors every cached conn so stop() can close them
+        # promptly (thread-local caches are unreachable from stop; a
+        # GC'd socket also never decrements WireConn.open_count)
         self._conns = threading.local()
+        self._conn_registry: set = set()
+        self._conn_registry_lock = threading.Lock()
+        # hedged attempts ride a small shared side pool instead of a
+        # fresh connection per try (they run on short-lived helper
+        # threads, so the per-thread cache can't serve them)
+        self._hedge_pool = HedgeConnPool(request_timeout_s)
         self.forwarded = 0
         self.retried = 0
         self.failed = 0
@@ -775,6 +1026,14 @@ class ServingGateway:
         for t in self._threads:
             t.join(5.0)
         self._ingress.stop()
+        self._hedge_pool.close_all()
+        # dispatchers are joined: their thread-local caches are idle —
+        # close every pooled worker connection now (FIN at stop time,
+        # not at GC time; keeps WireConn.open_count honest)
+        with self._conn_registry_lock:
+            conns, self._conn_registry = list(self._conn_registry), set()
+        for conn in conns:
+            conn.close()
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Graceful shutdown for fleet rolls: flip ``/health`` to 503 (so a
@@ -853,6 +1112,8 @@ class ServingGateway:
                     if i.get("models")
                 },
             )
+            # hedge connections to departed backends are dead weight
+            self._hedge_pool.prune(self._pool.members())
 
     def _refresh_loop(self) -> None:
         while not self._stop.wait(self._refresh_s):
@@ -915,29 +1176,9 @@ class ServingGateway:
         for r in self._ingress.get_next_batch(max_n=1_000_000, timeout_s=0.0):
             self._ingress.reply_to(r.id, b"gateway stopping", 503)
 
-    @staticmethod
-    def _conn_alive(conn) -> bool:
-        """Is an idle pooled connection still usable? A dead worker's FIN
-        (or any unread stray bytes) makes the socket readable — reusing
-        it would turn 'worker stopped between requests' from a safe
-        pre-send connect-refused into a send-then-hang 504. poll(), not
-        select(): the gateway ingress holds an fd per client, so pooled
-        fds routinely exceed select's FD_SETSIZE under load."""
-        import select
-
-        sock = getattr(conn, "sock", None)
-        if sock is None:
-            return False
-        try:
-            p = select.poll()
-            p.register(sock, select.POLLIN)
-            return not p.poll(0)
-        except (OSError, ValueError):
-            return False
-
     def _conn_for(self, b) -> tuple:
-        """(conn, cached): this dispatcher thread's persistent connection
-        to backend ``b``, or a fresh one."""
+        """(conn, cached): this dispatcher thread's persistent
+        :class:`WireConn` to backend ``b``, or a fresh one."""
         cache = getattr(self._conns, "by_backend", None)
         if cache is None:
             cache = self._conns.by_backend = {}
@@ -947,28 +1188,31 @@ class ServingGateway:
         if len(cache) > self._pool.size():
             live = {(m.host, m.port) for m in self._pool.members()}
             for key in [k for k in cache if k not in live]:
-                try:
-                    cache.pop(key).close()
-                except OSError:
-                    pass
+                dropped = cache.pop(key)
+                dropped.close()
+                with self._conn_registry_lock:
+                    self._conn_registry.discard(dropped)
         key = (b.host, b.port)
         conn = cache.get(key)
         if conn is not None:
-            if self._conn_alive(conn):
+            if conn.alive():
+                if _M_CONN_REUSE._on:
+                    _M_CONN_REUSE.inc()
                 return conn, True
             self._drop_conn(b)
-        conn = http.client.HTTPConnection(b.host, b.port, timeout=self._timeout)
+        conn = WireConn(b.host, b.port, self._timeout)
         cache[key] = conn
+        with self._conn_registry_lock:
+            self._conn_registry.add(conn)
         return conn, False
 
     def _drop_conn(self, b) -> None:
         cache = getattr(self._conns, "by_backend", None)
         conn = cache.pop((b.host, b.port), None) if cache else None
         if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            conn.close()
+            with self._conn_registry_lock:
+                self._conn_registry.discard(conn)
 
     # stash key for the pre-minted gateway.request span id (_forward sets
     # it; _reply records the span under it so forward spans, minted
@@ -1077,6 +1321,13 @@ class ServingGateway:
         trace_id = req.headers.get(obs.TRACE_HEADER) or obs.new_trace_id()
         headers[obs.TRACE_HEADER] = trace_id
         req.headers[obs.TRACE_HEADER] = trace_id
+        # zero-re-parse forwarding: the client's headers serialize ONCE
+        # per request; each attempt prepends only the method line and the
+        # headers that genuinely vary per hop (remaining deadline, parent
+        # span id, Host)
+        static_block = "".join(
+            f"{k}: {v}\r\n" for k, v in headers.items()
+        ).encode("latin1")
         # pre-mint the gateway.request span id (recorded at _reply time):
         # each forward span parents under it NOW, and the worker parents
         # under the forward span via PARENT_HEADER — the assembled tree
@@ -1116,6 +1367,7 @@ class ServingGateway:
                     self.retried += 1
                     _M_GW_RETRIES.inc()
         for attempt in range(attempts):
+            extra: dict = {}  # per-attempt headers (deadline, parent span)
             remaining_ms = self._remaining_ms(req, deadline_ms)
             if remaining_ms is not None and remaining_ms <= 0:
                 # the budget is already burned (dead backend attempts,
@@ -1147,7 +1399,7 @@ class ServingGateway:
                         return
                 # true deadline propagation: the worker sees what is
                 # LEFT, not the client's original budget
-                headers[DEADLINE_HEADER] = f"{remaining_ms:.1f}"
+                extra[DEADLINE_HEADER] = f"{remaining_ms:.1f}"
             target = self._target_for(req, b)
             sent = False
             t_attempt = time.perf_counter()
@@ -1175,16 +1427,19 @@ class ServingGateway:
                     # the worker parents its spans under THIS hop's span
                     # (fsp is None only when telemetry is disabled)
                     if fsp is not None:
-                        headers[obs.PARENT_HEADER] = fsp.span_id
+                        extra[obs.PARENT_HEADER] = fsp.span_id
                     conn, cached = self._conn_for(b)
-                    # request() returning means the body was fully flushed;
+                    data = _head_bytes(
+                        req.method, target,
+                        f"Host: {b.host}:{b.port}\r\n".encode("latin1"),
+                        static_block, extra, len(req.body),
+                    ) + req.body
+                    # sendall returning means the body was fully flushed;
                     # an exception DURING it leaves an incomplete body the
-                    # worker will never execute (Content-Length mismatch) —
-                    # safe to re-dispatch
+                    # worker will never execute (Content-Length mismatch)
+                    # — safe to re-dispatch
                     try:
-                        conn.request(
-                            req.method, target, body=req.body, headers=headers
-                        )
+                        conn.send(data)
                     except (OSError, http.client.HTTPException):
                         if not cached:
                             raise
@@ -1193,10 +1448,8 @@ class ServingGateway:
                         # worker failure: retry ONCE on a fresh connection
                         # before blaming the backend
                         self._drop_conn(b)
-                        conn, _ = self._conn_for(b)
-                        conn.request(
-                            req.method, target, body=req.body, headers=headers
-                        )
+                        conn, cached = self._conn_for(b)
+                        conn.send(data)
                     sent = True
                     # fault point gateway.response: an injected TimeoutError
                     # here is a worker hanging mid-execution after the body
@@ -1205,8 +1458,28 @@ class ServingGateway:
                         "gateway.response",
                         context={"backend": (b.host, b.port), "attempt": attempt},
                     )
-                    resp = conn.getresponse()
-                    body = resp.read()
+                    try:
+                        resp = conn.read_response()
+                    except OSError as e:
+                        if (
+                            cached
+                            and conn.last_resp_bytes == 0
+                            and not isinstance(e, TimeoutError)
+                        ):
+                            # the OTHER stale-keep-alive shape: the worker
+                            # closed the idle connection while our bytes
+                            # were in flight — zero response bytes + a
+                            # closed/reset socket. One transparent retry
+                            # on a fresh connection, not a backend
+                            # failure (a genuinely dead worker fails the
+                            # reconnect and takes the normal blame path)
+                            self._drop_conn(b)
+                            conn, cached = self._conn_for(b)
+                            conn.send(data)
+                            resp = conn.read_response()
+                        else:
+                            raise
+                    body = resp.body
                 if resp.will_close:
                     self._drop_conn(b)
             except (OSError, http.client.HTTPException) as e:
@@ -1352,8 +1625,11 @@ class ServingGateway:
         failed, shed, or model-not-ready) seeds the standard retry
         loop's exclusion set, and the stashed ``not_ready`` /
         ``backpressured`` worker answers seed its relay fallbacks.
-        Hedged attempts use fresh connections (not the per-thread
-        keep-alive cache — they run on short-lived helper threads)."""
+        Hedged attempts ride the gateway's shared :class:`HedgeConnPool`
+        (they run on short-lived helper threads, so the per-dispatcher
+        keep-alive cache can't serve them): a clean winner's connection
+        returns to the pool, a cancelled loser's is closed — a hedge
+        burst can never leak sockets (pinned by test)."""
         if self._pool.size() < 2:
             return False, set(), None, None  # nothing to hedge against
         b1 = self._pool.next(model=model)
@@ -1372,7 +1648,13 @@ class ServingGateway:
                 return True, set(), None, None
             headers = dict(headers)
             headers[DEADLINE_HEADER] = f"{remaining_ms:.1f}"
+        static_block = "".join(
+            f"{k}: {v}\r\n" for k, v in headers.items()
+        ).encode("latin1")
         results: Any = queue_mod.Queue()
+        # tag -> in-flight WireConn; whoever pops an entry disposes it
+        # (the attempt thread pools/closes it after a full read, the
+        # cancel sweep closes whatever is still blocked reading)
         conns: dict = {}
 
         def attempt(tag: str, b) -> None:
@@ -1382,11 +1664,9 @@ class ServingGateway:
                     "gateway.forward",
                     context={"backend": (b.host, b.port), "attempt": tag},
                 )
-                conn = http.client.HTTPConnection(
-                    b.host, b.port, timeout=self._timeout
-                )
+                conn, cached = self._hedge_pool.get(b)
                 conns[tag] = conn
-                hdrs = dict(headers)
+                extra: dict = {}
                 ctx = (
                     obs.span(
                         "gateway.forward", trace_id=trace_id,
@@ -1399,22 +1679,64 @@ class ServingGateway:
                 )
                 with ctx as fsp:
                     if fsp is not None:
-                        hdrs[obs.PARENT_HEADER] = fsp.span_id
-                    conn.request(
+                        extra[obs.PARENT_HEADER] = fsp.span_id
+                    data = _head_bytes(
                         req.method, self._target_for(req, b),
-                        body=req.body, headers=hdrs,
-                    )
+                        f"Host: {b.host}:{b.port}\r\n".encode("latin1"),
+                        static_block, extra, len(req.body),
+                    ) + req.body
+                    try:
+                        conn.send(data)
+                    except OSError:
+                        if not cached:
+                            raise
+                        # stale pooled hedge connection: one transparent
+                        # retry on a fresh one, same as the main path
+                        if conns.pop(tag, None) is conn:
+                            conn.close()
+                        conn = WireConn(b.host, b.port, self._timeout)
+                        conns[tag] = conn
+                        conn.send(data)
                     faults.inject(
                         "gateway.response",
                         context={"backend": (b.host, b.port),
                                  "attempt": tag},
                     )
-                    resp = conn.getresponse()
-                    body = resp.read()
+                    try:
+                        resp = conn.read_response()
+                    except OSError as e:
+                        if (
+                            cached
+                            and conn.last_resp_bytes == 0
+                            and not isinstance(e, TimeoutError)
+                        ):
+                            # read-side stale keep-alive (same shape the
+                            # main path retries): the pooled conn's FIN
+                            # landed after alive() — one transparent
+                            # retry, not a report_failure against a
+                            # healthy backend
+                            if conns.pop(tag, None) is conn:
+                                conn.close()
+                            conn = WireConn(b.host, b.port, self._timeout)
+                            conns[tag] = conn
+                            conn.send(data)
+                            resp = conn.read_response()
+                        else:
+                            raise
+                if conns.pop(tag, None) is conn:
+                    # the response was fully consumed: the connection is
+                    # clean — back to the side pool for the next hedge
+                    if resp.will_close:
+                        conn.close()
+                    else:
+                        self._hedge_pool.put(b, conn)
                 results.put(
-                    (tag, b, resp, body, time.perf_counter() - t0, None)
+                    (tag, b, resp, resp.body, time.perf_counter() - t0, None)
                 )
             except Exception as e:  # noqa: BLE001 — relayed via the queue
+                stale = conns.pop(tag, None)
+                if stale is not None:
+                    stale.close()
                 results.put(
                     (tag, b, None, None, time.perf_counter() - t0, e)
                 )
@@ -1519,10 +1841,15 @@ class ServingGateway:
         # raises when its socket closes; its queued result is ignored
         # and never reported against the backend) — and return the
         # half-open probe slot of any attempt that got no outcome report,
-        # or its breaker waits forever for a probe that never concludes
-        for conn in conns.values():
-            with contextlib.suppress(OSError):
-                conn.close()
+        # or its breaker waits forever for a probe that never concludes.
+        # Cleanly-concluded attempts already disposed of their own
+        # connections (pool return), so only the still-reading losers
+        # remain here — closed, never pooled
+        for tag in list(conns):
+            loser = conns.pop(tag, None)
+            if loser is not None:
+                with contextlib.suppress(OSError):
+                    loser.close()
         for b in launched.values():
             if b not in reported:
                 self._pool.report_abandoned(b)

@@ -162,6 +162,7 @@ def run_worker(
     admission: bool = True,
     admission_initial_limit: int = 32,
     artifact_dir: Optional[str] = None,
+    reactors: int = 2,
 ) -> tuple:
     """Start a ModelStore-backed worker, register it, and re-register on a
     heartbeat thread (a restarted registry re-learns live workers within
@@ -189,7 +190,12 @@ def run_worker(
     from mmlspark_tpu.serving.registry import DriverRegistry
     from mmlspark_tpu.serving.server import WorkerServer
 
-    srv = WorkerServer(host=host, port=port, name=service_name)
+    # multi-reactor ingress (serving/server.py): fleet workers default to
+    # 2 so one slow client or a multi-MB /artifacts window can't stall
+    # request intake; unit-level WorkerServer keeps the single loop
+    srv = WorkerServer(
+        host=host, port=port, name=service_name, num_reactors=reactors,
+    )
     info = srv.start()
     from mmlspark_tpu import obs
     from mmlspark_tpu.serving import artifacts as artifacts_mod
@@ -683,6 +689,8 @@ def run_gateway(
     hedge_ms: Optional[float] = None,
     retry_budget_ratio: float = 0.2,
     breaker_cooldown_s: float = 5.0,
+    reactors: int = 2,
+    num_dispatchers: int = 4,
 ) -> Any:
     from mmlspark_tpu import obs
     from mmlspark_tpu.serving.distributed import ServingGateway
@@ -692,6 +700,7 @@ def run_gateway(
         host=host, port=port, hedge_ms=hedge_ms,
         retry_budget_ratio=retry_budget_ratio,
         cooldown_s=breaker_cooldown_s,
+        num_reactors=reactors, num_dispatchers=num_dispatchers,
     )
     ginfo = gw.start()
     obs.set_process_label(
@@ -1163,6 +1172,11 @@ def main(argv: Optional[list] = None) -> None:
         "(artifact: model specs fetch into it and re-serve off the "
         "ingress; default: a private tempdir)",
     )
+    w.add_argument(
+        "--reactors", type=int, default=2,
+        help="ingress event loops sharing the listening socket (one slow "
+        "client stalls only its own reactor; docs/serving.md)",
+    )
 
     def add_slo_flags(p) -> None:
         p.add_argument(
@@ -1206,6 +1220,15 @@ def main(argv: Optional[list] = None) -> None:
         "--breaker-cooldown-s", type=float, default=5.0,
         help="circuit-breaker open period (doubles per consecutive "
         "open, capped; half-open probe after it elapses)",
+    )
+    g.add_argument(
+        "--reactors", type=int, default=2,
+        help="gateway-ingress event loops sharing the listening socket",
+    )
+    g.add_argument(
+        "--dispatchers", type=int, default=4,
+        help="forwarding threads (each keeps its own keep-alive "
+        "connection per backend)",
     )
     add_slo_flags(g)
     sv = sub.add_parser(
@@ -1540,6 +1563,7 @@ def main(argv: Optional[list] = None) -> None:
             admission=not args.no_admission,
             admission_initial_limit=args.admission_initial_limit,
             artifact_dir=args.artifact_dir,
+            reactors=args.reactors,
         )
         _serve_forever([stop, q, srv])
     elif args.role == "supervise":
@@ -1593,6 +1617,8 @@ def main(argv: Optional[list] = None) -> None:
             hedge_ms=args.hedge_ms,
             retry_budget_ratio=args.retry_budget_ratio,
             breaker_cooldown_s=args.breaker_cooldown_s,
+            reactors=args.reactors,
+            num_dispatchers=args.dispatchers,
         )
         _serve_forever([gw], drain_s=args.drain_s)
 

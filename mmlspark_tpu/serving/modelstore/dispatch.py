@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import queue as queue_mod
 import threading
 import time
 from collections import deque
@@ -65,7 +66,9 @@ from mmlspark_tpu.serving.query import (
     _M_DEADLINE_EXPIRED as _M_SRV_DEADLINE,
     _M_HANDLER_ERRS as _M_SRV_ERRS,
     _M_LATENCY as _M_SRV_LATENCY,
+    _M_OVERLAP as _M_SRV_OVERLAP,
     LatencyRing,
+    handler_stages,
 )
 from mmlspark_tpu.serving.server import WorkerServer
 
@@ -99,7 +102,17 @@ _M_QDEPTH = obs.gauge(
 
 
 class _ModelQueue:
-    """One model's queue + batcher thread + service-time EWMA."""
+    """One model's queue + batcher/executor thread pair + service EWMA.
+
+    Continuous batching (``disp.pipeline_depth >= 2``, the default): the
+    *batcher* thread admits queued requests into the next dispatch slot
+    — deadline shed, ``ModelStore.acquire()`` (the refcount that lets
+    hot-swap drain), and the handler's host-side ``prepare`` — while the
+    *executor* thread still runs the previous batch's model call. The
+    version refcount is held from acquire (batcher) to release
+    (executor), so a swap drains both the executing AND the staged batch
+    before the old version evicts. ``pipeline_depth=1`` runs everything
+    inline on the batcher thread (the pre-rewrite barrier loop)."""
 
     def __init__(self, disp: "ModelDispatcher", name: str):
         self.disp = disp
@@ -114,6 +127,24 @@ class _ModelQueue:
         self._m_srv_lat = _M_SRV_LATENCY.labels(server=disp.server.name)
         self._m_srv_errs = _M_SRV_ERRS.labels(server=disp.server.name)
         self._m_srv_deadline = _M_SRV_DEADLINE.labels(server=disp.server.name)
+        self._m_srv_overlap = _M_SRV_OVERLAP.labels(server=disp.server.name)
+        self._exec_busy = False
+        # double-buffering pays only when the handler has a host-side
+        # prepare stage to overlap; plain handlers execute inline on this
+        # thread (no cross-thread hop on their latency). Sticky: once a
+        # split-handler batch has ridden the handoff, every later batch
+        # does too — an inline execute racing a still-staged batch would
+        # reorder replies and overlap two versions mid-swap
+        self._use_handoff = False
+        self.exec_thread: Optional[threading.Thread] = None
+        self._handoff: Optional[queue_mod.Queue] = None
+        if disp.pipeline_depth > 1:
+            self._handoff = queue_mod.Queue(maxsize=disp.pipeline_depth - 1)
+            self.exec_thread = threading.Thread(
+                target=self._exec_loop,
+                name=f"modelstore-execute-{name}", daemon=True,
+            )
+            self.exec_thread.start()
         self.thread = threading.Thread(
             target=self._loop, name=f"modelstore-dispatch-{name}", daemon=True
         )
@@ -149,6 +180,10 @@ class _ModelQueue:
     def _pop_batch(self) -> list:
         max_n = self.disp.max_batch_size
         acc_s = self.disp.max_wait_ms / 1000.0
+        if self._use_handoff and not self._exec_busy:
+            # accumulation amortizes a BUSY executor; while it is idle,
+            # holding the batch open is pure added latency (query.py)
+            acc_s = 0.0
         with self.cond:
             if not self.q:
                 self.cond.wait(0.25)
@@ -215,6 +250,8 @@ class _ModelQueue:
             batch = self._pop_batch()
             if not batch:
                 if self._reap_if_orphaned():
+                    if self._handoff is not None:
+                        self._handoff.put(None)  # executor: exit too
                     return
                 continue
             batch = self._shed_expired(batch)
@@ -226,99 +263,155 @@ class _ModelQueue:
                 # admission and dispatch — tell the router's 503 story
                 disp._reply_not_ready(batch, self.name)
                 continue
-            obs_on = self._m_lat._on
-            dispatch_ns = time.perf_counter_ns()
-            # pre-minted per-request span AND trace ids: same tree shape
-            # as ServingQuery (request span parenting queue + batch
-            # spans, itself parented under the gateway's forward span;
-            # headerless direct traffic mints its trace ids here)
-            req_sids = req_tids = None
-            if obs_on:
-                req_sids = {r.id: obs.new_span_id() for r in batch}
-                req_tids = {
-                    r.id: r.headers.get(obs.TRACE_HEADER)
-                    or obs.new_trace_id()
-                    for r in batch
-                }
-            t0 = time.perf_counter()
-            try:
-                ctx = (
-                    obs.span(
-                        "modelstore.dispatch",
-                        trace_id=req_tids[batch[0].id],
-                        parent_id=req_sids[batch[0].id],
-                        attrs={"model": self.name, "batch": len(batch)},
-                    )
-                    if obs_on
-                    else contextlib.nullcontext()
-                )
-                with ctx:
-                    replies = mv.loaded.handler(batch)
-            except Exception as e:  # handler crash -> 500s, keep serving
-                disp.errors += 1
-                self._m_errs.inc()
-                self._m_srv_errs.inc()
-                msg = f"handler error: {type(e).__name__}: {e}".encode()
-                replies = {r.id: (500, msg, {}) for r in batch}
-            finally:
-                disp.store.release(mv)
-            svc = time.perf_counter() - t0
-            self.svc_s = svc if self.svc_s <= 0 else (
-                0.8 * self.svc_s + 0.2 * svc
-            )
-            done_ns = time.perf_counter_ns()
-            # replies first, telemetry second: this batcher thread is the
-            # model's pipeline bottleneck — recording before replying
-            # would tax every queued request's latency (see query.py)
-            codes = {}
-            for r in batch:
-                code, body, headers = replies.get(
-                    r.id, (500, b"no reply produced", {})
-                )
-                disp.server.reply_to(r.id, body, code, headers)
-                codes[r.id] = code
-            for r in batch:
-                if obs_on:
-                    code = codes[r.id]
-                    sid = req_sids[r.id]
-                    tid = req_tids[r.id]
-                    obs.record_span(
-                        "serving.request", r.arrival_ns, done_ns,
-                        trace_id=tid,
-                        span_id=sid,
-                        parent_id=r.headers.get(obs.PARENT_HEADER),
-                        attrs={"status": code, "model": self.name},
-                    )
-                    obs.record_span(
-                        "serving.queue", r.arrival_ns, dispatch_ns,
-                        trace_id=tid, parent_id=sid,
-                    )
-                    lat_s = (done_ns - r.arrival_ns) / 1e9
-                    self._m_lat.observe(lat_s, trace_id=tid)
-                    self._m_srv_lat.observe(lat_s, trace_id=tid)
-                    FLIGHT.record(
-                        "ok" if code < 500 else "error",
-                        status=code,
-                        trace_id=tid,
-                        model=self.name,
-                        path=r.path,
-                        latency_ms=lat_s * 1e3,
-                        queue_wait_ms=(dispatch_ns - r.arrival_ns) / 1e6,
-                    )
-                disp._lat.record(done_ns - r.arrival_ns)
-            if disp.admission is not None:
-                # AIMD signal: worst queue wait in the batch (FIFO: the
-                # first request waited longest) + per-request service
-                disp.admission.observe(
-                    (dispatch_ns - batch[0].arrival_ns) / 1e9,
-                    svc / len(batch),
-                )
-            disp.batches += 1
+            # continuous batching: run the handler's host-side prepare on
+            # THIS thread while the executor still runs the previous
+            # batch's model call — the acquire above already holds the
+            # version against a concurrent swap's drain
+            split = handler_stages(mv.loaded.handler)
+            staged = err = None
+            if split is not None:
+                try:
+                    staged = split[0](batch)
+                except Exception as e:  # noqa: BLE001 — a 500 batch
+                    err = e
+            if self._handoff is not None and (
+                self._use_handoff or split is not None
+            ):
+                self._use_handoff = True
+                if self._exec_busy:
+                    self._m_srv_overlap.inc()
+                self._handoff.put((batch, mv, staged, err))
+            else:
+                self._execute(batch, mv, staged, err)
         # stopped: nothing queued here gets a handler anymore
+        if self._handoff is not None:
+            self._handoff.put(None)
         with self.cond:
             leftovers, self.q = list(self.q), deque()
         for r in leftovers:
             disp.server.reply_to(r.id, b"worker stopping", 503)
+
+    def _exec_loop(self) -> None:
+        """Executor half: model call + replies + telemetry. Exits on the
+        batcher's sentinel so staged batches are never stranded — and,
+        as a backstop, when the batcher thread itself is gone (a crashed
+        batcher never reaches its sentinel put; blocking forever would
+        strand staged work and wedge stop()'s join)."""
+        while True:
+            try:
+                item = self._handoff.get(timeout=0.25)
+            except queue_mod.Empty:
+                batcher = getattr(self, "thread", None)
+                if batcher is not None and not batcher.is_alive():
+                    return  # builder dead, queue drained
+                continue
+            if item is None:
+                return
+            self._exec_busy = True
+            try:
+                self._execute(*item)
+            finally:
+                self._exec_busy = False
+
+    def _execute(self, batch: list, mv, staged, prep_err) -> None:
+        disp = self.disp
+        split = handler_stages(mv.loaded.handler)
+        obs_on = self._m_lat._on
+        dispatch_ns = time.perf_counter_ns()
+        # pre-minted per-request span AND trace ids: same tree shape
+        # as ServingQuery (request span parenting queue + batch
+        # spans, itself parented under the gateway's forward span;
+        # headerless direct traffic mints its trace ids here)
+        req_sids = req_tids = None
+        if obs_on:
+            req_sids = {r.id: obs.new_span_id() for r in batch}
+            req_tids = {
+                r.id: r.headers.get(obs.TRACE_HEADER)
+                or obs.new_trace_id()
+                for r in batch
+            }
+        t0 = time.perf_counter()
+        try:
+            if prep_err is not None:
+                raise prep_err
+            ctx = (
+                obs.span(
+                    "modelstore.dispatch",
+                    trace_id=req_tids[batch[0].id],
+                    parent_id=req_sids[batch[0].id],
+                    attrs={"model": self.name, "batch": len(batch)},
+                )
+                if obs_on
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                replies = (
+                    split[1](staged) if split is not None
+                    else mv.loaded.handler(batch)
+                )
+        except Exception as e:  # handler crash -> 500s, keep serving
+            disp.errors += 1
+            self._m_errs.inc()
+            self._m_srv_errs.inc()
+            msg = f"handler error: {type(e).__name__}: {e}".encode()
+            replies = {r.id: (500, msg, {}) for r in batch}
+        finally:
+            disp.store.release(mv)
+        svc = time.perf_counter() - t0
+        self.svc_s = svc if self.svc_s <= 0 else (
+            0.8 * self.svc_s + 0.2 * svc
+        )
+        done_ns = time.perf_counter_ns()
+        # replies first, telemetry second: this executor thread is the
+        # model's pipeline bottleneck — recording before replying
+        # would tax every queued request's latency (see query.py).
+        # reply_many: one loop wakeup per reactor for the whole batch
+        codes = {}
+        batch_out = []
+        for r in batch:
+            code, body, headers = replies.get(
+                r.id, (500, b"no reply produced", {})
+            )
+            batch_out.append((r.id, body, code, headers))
+            codes[r.id] = code
+        disp.server.reply_many(batch_out)
+        for r in batch:
+            if obs_on:
+                code = codes[r.id]
+                sid = req_sids[r.id]
+                tid = req_tids[r.id]
+                obs.record_span(
+                    "serving.request", r.arrival_ns, done_ns,
+                    trace_id=tid,
+                    span_id=sid,
+                    parent_id=r.headers.get(obs.PARENT_HEADER),
+                    attrs={"status": code, "model": self.name},
+                )
+                obs.record_span(
+                    "serving.queue", r.arrival_ns, dispatch_ns,
+                    trace_id=tid, parent_id=sid,
+                )
+                lat_s = (done_ns - r.arrival_ns) / 1e9
+                self._m_lat.observe(lat_s, trace_id=tid)
+                self._m_srv_lat.observe(lat_s, trace_id=tid)
+                FLIGHT.record(
+                    "ok" if code < 500 else "error",
+                    status=code,
+                    trace_id=tid,
+                    model=self.name,
+                    path=r.path,
+                    latency_ms=lat_s * 1e3,
+                    queue_wait_ms=(dispatch_ns - r.arrival_ns) / 1e6,
+                )
+            disp._lat.record(done_ns - r.arrival_ns)
+        if disp.admission is not None:
+            # AIMD signal: worst queue wait in the batch (FIFO: the
+            # first request waited longest) + per-request service
+            disp.admission.observe(
+                (dispatch_ns - batch[0].arrival_ns) / 1e9,
+                svc / len(batch),
+            )
+        disp.batches += 1
 
 
 class ModelDispatcher:
@@ -337,6 +430,7 @@ class ModelDispatcher:
         max_wait_ms: float = 0.0,
         default_deadline_ms: Optional[float] = None,
         admission: Optional[object] = None,
+        pipeline_depth: int = 2,
     ):
         self.server = server
         self.store = store
@@ -344,6 +438,9 @@ class ModelDispatcher:
         self.max_batch_size = max_batch_size
         self.max_wait_ms = max_wait_ms
         self.default_deadline_ms = default_deadline_ms
+        # continuous-batching depth per model queue (>= 2 double-buffers
+        # build/execute; 1 = the pre-rewrite barrier loop)
+        self.pipeline_depth = max(1, int(pipeline_depth))
         # adaptive-concurrency limit (serving/admission.py): attached to
         # the ingress so sheds happen before routing; fed per-batch by
         # every model queue's wait/service samples
@@ -380,6 +477,8 @@ class ModelDispatcher:
             with mq.cond:
                 mq.cond.notify_all()
             mq.thread.join(5.0)
+            if mq.exec_thread is not None:
+                mq.exec_thread.join(5.0)
 
     def latency_quantiles_ms(self) -> dict:
         return self._lat.quantiles_ms()

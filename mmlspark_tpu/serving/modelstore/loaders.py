@@ -161,9 +161,23 @@ def _pipeline_loaded(path: str) -> LoadedModel:
     Byte accounting sums array leaves across the fitted stages' params
     (same jax-tree walk as ``zoo:``), so the HBM budget sees real weights.
 
-    Wire contract (documented in docs/modelstore.md): POST body is either
-    one JSON row ({column: value}) or {"rows": [{column: value}, ...]};
-    the reply carries only the pipeline's *output* columns per row.
+    Wire contract (documented in docs/modelstore.md): POST body is one
+    JSON row ({column: value}), {"rows": [{column: value}, ...]}, or the
+    columnar fast path {"cols": {column: [value, ...]}} — column-major
+    arrays decoded ONCE per batch instead of dict-per-row, the
+    data-plane shape for throughput clients; the reply carries only the
+    pipeline's *output* columns per row. An optional ``"select":
+    [column, ...]`` narrows the reply further (a featurize->head
+    pipeline's full output echoes every intermediate vector — at
+    data-plane rates the reply encode, not the model, becomes the
+    bottleneck).
+
+    The handler implements the serving/query.py SplitHandler protocol:
+    ``prepare`` (JSON decode + column stacking across the whole
+    dispatcher batch) runs on the batcher thread while ``execute`` (ONE
+    fused transform at the bucket shape, split back per request) still
+    runs the previous batch — so the fused program's device time is the
+    only thing on the model queue's critical path.
     """
     import json as _json
     import os
@@ -206,26 +220,43 @@ def _pipeline_loaded(path: str) -> LoadedModel:
                 pass
         return values
 
-    def _score_rows(rows: list) -> list:
-        # union of keys: first-row keys would silently drop a column only
-        # later rows carry; a row missing a key raises (isolated per
-        # request by the handler's fallback)
-        names = list(dict.fromkeys(k for r in rows for k in r.keys()))
-        cols = {k: _dense([r[k] for r in rows]) for k in names}
+    def _dense_col(values: Any) -> Any:
+        """Decode one column-major JSON column in ONE numpy call: numeric
+        scalar columns become f64 vectors, uniform list cells a stacked
+        f64 matrix (same precision contract as ``_dense``); anything
+        else stays a python list (object column)."""
+        if not isinstance(values, list) or not values:
+            raise ValueError("each cols entry must be a non-empty list")
+        try:
+            arr = np.asarray(values, dtype=np.float64)
+        except (TypeError, ValueError):
+            return values
+        if arr.ndim >= 1 and arr.shape[0] == len(values):
+            return arr
+        return values
+
+    def _score_cols(cols: dict, n_rows: int,
+                    select: Any = None) -> list:
+        """ONE fused transform over pre-stacked columns, split back into
+        row dicts. Every wire form funnels here, so the fused program
+        always runs at a dispatcher-batch bucket shape. ``select``
+        narrows the reply columns BEFORE the per-row dict/JSON build —
+        the encode cost is proportional to what the client asked for."""
         df = DataFrame.from_dict(cols)
         res = compiled.transform(df)
         if has_opaque or not out_cols:
-            sent = set().union(*(r.keys() for r in rows))
-            keep = [c for c in res.columns if c not in sent]
+            keep = [c for c in res.columns if c not in cols]
         else:
             keep = [c for c in out_cols if c in res.columns]
+        if select is not None:
+            keep = [c for c in keep if c in select]
         mats = {c: res[c] for c in keep}
         n = res.count()
-        if n != len(rows):
+        if n != n_rows:
             # a row-dropping stage (drop_na) broke the 1:1 reply
             # correspondence — a 400 beats silently mis-attributed scores
             raise ValueError(
-                f"pipeline dropped {len(rows) - n} of {len(rows)} rows; "
+                f"pipeline dropped {n_rows - n} of {n_rows} rows; "
                 "per-row replies would misalign"
             )
         return [
@@ -236,53 +267,145 @@ def _pipeline_loaded(path: str) -> LoadedModel:
             for i in range(n)
         ]
 
-    def _reply(body: Any, scored: list) -> tuple:
+    def _rows_to_cols(rows: list) -> dict:
+        # union of keys: first-row keys would silently drop a column only
+        # later rows carry; a row missing a key raises (isolated per
+        # request by the batch fallback)
+        names = list(dict.fromkeys(k for r in rows for k in r.keys()))
+        return {k: _dense([r[k] for r in rows]) for k in names}
+
+    def _score_rows(rows: list) -> list:
+        return _score_cols(_rows_to_cols(rows), len(rows))
+
+    def _select_of(body: Any) -> Any:
+        if not isinstance(body, dict) or "select" not in body:
+            return None
+        sel = body["select"]
+        if not isinstance(sel, list) or not all(
+            isinstance(c, str) for c in sel
+        ):
+            raise ValueError("select must be a list of column names")
+        return frozenset(sel)
+
+    def _parse_one(r: Any) -> tuple:
+        """-> (body, cols, n_rows, select). ``cols``: column name ->
+        stacked array or python list, decoded once — the array fast path
+        decodes the columnar body straight to f64 arrays with zero row
+        dicts."""
+        body = _json.loads(r.body) if r.body else {}
+        sel = _select_of(body)
+        if isinstance(body, dict) and "cols" in body:
+            raw = body["cols"]
+            if not isinstance(raw, dict) or not raw:
+                raise ValueError("cols must be a non-empty object")
+            cols = {k: _dense_col(v) for k, v in raw.items()}
+            lens = {len(v) for v in cols.values()}
+            if len(lens) != 1:
+                raise ValueError(f"ragged cols lengths {sorted(lens)}")
+            return body, cols, lens.pop(), sel
+        rows = (
+            body["rows"]
+            if isinstance(body, dict) and "rows" in body else [body]
+        )
+        if (
+            not isinstance(rows, list)
+            or not rows
+            or not all(isinstance(x, dict) for x in rows)
+        ):
+            raise ValueError("rows must be a non-empty list of objects")
+        return body, _rows_to_cols(rows), len(rows), sel
+
+    def _merge(parsed: list) -> dict:
+        """Stack every request's columns into one batch column set. A
+        column missing from some request (or shape-mismatched) raises —
+        the executor then isolates per request."""
+        names = list(dict.fromkeys(
+            k for _, _, cols, _, _ in parsed for k in cols
+        ))
+        merged: dict = {}
+        for k in names:
+            parts = [cols[k] for _, _, cols, _, _ in parsed]
+            if all(isinstance(p, np.ndarray) for p in parts):
+                merged[k] = np.concatenate(parts, axis=0)
+            else:
+                flat: list = []
+                for p in parts:
+                    flat.extend(p.tolist() if isinstance(p, np.ndarray) else p)
+                merged[k] = flat
+        return merged
+
+    def _reply(body: Any, scored: list, sel: Any = None) -> tuple:
+        if sel is not None:
+            scored = [
+                {k: v for k, v in row.items() if k in sel}
+                for row in scored
+            ]
         payload = (
             {"rows": scored}
-            if isinstance(body, dict) and "rows" in body else scored[0]
+            if isinstance(body, dict) and ("rows" in body or "cols" in body)
+            else scored[0]
         )
         return (200, _json.dumps(payload).encode(), {})
 
     def _err(e: Exception) -> tuple:
         return (400, _json.dumps({"error": str(e)[:300]}).encode(), {})
 
-    def handler(reqs: list) -> dict:
-        out = {}
-        parsed: list = []  # (request, body, rows)
+    def prepare(reqs: list) -> tuple:
+        """Host half (overlaps the previous batch's fused transform):
+        parse every request, decode columns once, stack the whole
+        dispatcher batch into one column set."""
+        out: dict = {}
+        parsed: list = []  # (request, body, cols, n_rows, select)
         for r in reqs:
             try:
-                body = _json.loads(r.body) if r.body else {}
-                rows = (
-                    body["rows"]
-                    if isinstance(body, dict) and "rows" in body else [body]
-                )
-                if (
-                    not isinstance(rows, list)
-                    or not rows
-                    or not all(isinstance(x, dict) for x in rows)
-                ):
-                    raise ValueError("rows must be a non-empty list of objects")
-                parsed.append((r, body, rows))
+                body, cols, n, sel = _parse_one(r)
+                parsed.append((r, body, cols, n, sel))
             except Exception as e:  # noqa: BLE001 — bad row must not kill the batch
                 out[r.id] = _err(e)
+        merged = None
+        if parsed:
+            try:
+                merged = _merge(parsed)
+            except Exception:  # noqa: BLE001 — executor isolates per request
+                merged = None
+        return out, parsed, merged
+
+    def execute(staged: tuple) -> dict:
+        out, parsed, merged = staged
         if not parsed:
             return out
+        # batch-level select: only when EVERY request narrowed its reply
+        # can the expensive row-dict build skip the unselected columns;
+        # mixed batches build the union and filter per request
+        sels = [sel for *_, sel in parsed]
+        batch_sel = (
+            frozenset().union(*sels) if all(s is not None for s in sels)
+            else None
+        )
         try:
-            # one transform for the whole dispatcher batch (the batching
-            # the dispatcher exists to provide), split back by row spans
-            flat = [row for _, _, rows in parsed for row in rows]
-            scored = _score_rows(flat)
+            if merged is None:
+                raise ValueError("batch column merge failed")
+            # one fused transform for the whole dispatcher batch (the
+            # batching the dispatcher exists to provide), split back by
+            # row spans
+            scored = _score_cols(
+                merged, sum(n for _, _, _, n, _ in parsed), batch_sel
+            )
             pos = 0
-            for r, body, rows in parsed:
-                out[r.id] = _reply(body, scored[pos:pos + len(rows)])
-                pos += len(rows)
+            for r, body, _cols, n, sel in parsed:
+                out[r.id] = _reply(body, scored[pos:pos + n], sel)
+                pos += n
         except Exception:  # noqa: BLE001 — isolate the poisoned request
-            for r, body, rows in parsed:
+            for r, body, cols, n, sel in parsed:
                 try:
-                    out[r.id] = _reply(body, _score_rows(rows))
+                    out[r.id] = _reply(body, _score_cols(cols, n, sel), sel)
                 except Exception as e:  # noqa: BLE001
                     out[r.id] = _err(e)
         return out
+
+    from mmlspark_tpu.serving.query import SplitHandler
+
+    handler = SplitHandler(prepare, execute)
 
     warmup_path = os.path.join(path, "warmup.json")
 

@@ -639,8 +639,10 @@ def test_gateway_conn_cache_prunes_departed_backends():
         c1, cached1 = gw._conn_for(b1)
         c2, _ = gw._conn_for(b2)
         assert not cached1
-        c1.request("POST", b1.path, body=b'{"x": 1}')
-        assert c1.getresponse().read()
+        c1.send(
+            b"POST / HTTP/1.1\r\nContent-Length: 8\r\n\r\n" + b'{"x": 1}'
+        )
+        assert c1.read_response().body
         assert set(gw._conns.by_backend) == {
             (b1.host, b1.port), (b2.host, b2.port)
         }
@@ -649,7 +651,7 @@ def test_gateway_conn_cache_prunes_departed_backends():
         c1b, cached = gw._conn_for(b1)
         assert cached and c1b is c1  # live entry survives, still pooled
         assert set(gw._conns.by_backend) == {(b1.host, b1.port)}
-        assert c2.sock is None  # pruned connection was closed
+        assert c2._closed  # pruned connection was closed
     finally:
         for s, q in ((s1, q1), (s2, q2)):
             q.stop()

@@ -1,0 +1,645 @@
+"""Serving data-plane throughput rewrite tests (PR 12).
+
+Pins the four coordinated changes:
+
+- continuous batching (ServingQuery/ModelDispatcher builder+executor
+  pipeline): bit-identical results vs barrier-per-batch on the same
+  request stream, deadline sheds still firing at the new admission
+  point, drain-on-swap refcounts held across the staged batch;
+- multi-reactor ingress: a stalled slow client can't stop request
+  intake, connections spread over reactors, /metrics stays inline;
+- pooled zero-re-parse gateway forwarding: WireConn single-pass
+  parsing, stale-keep-alive transparent retry with NO breaker count,
+  hedge bursts that cannot leak sockets;
+- the pipeline: columnar array fast path scoring fallback-free.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import re
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.serving.query import ServingQuery, SplitHandler
+from mmlspark_tpu.serving.server import CachedRequest, WorkerServer
+
+
+def _post(port: int, obj, conn=None, path: str = "/", headers=None):
+    c = conn or http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    c.request("POST", path, body=json.dumps(obj), headers=hdrs)
+    r = c.getresponse()
+    data = r.read()
+    if conn is None:
+        c.close()
+    return r.status, data
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: semantics
+# ---------------------------------------------------------------------------
+
+
+def _matmul_split_handler(w: np.ndarray) -> SplitHandler:
+    def prepare(reqs):
+        staged = []
+        for r in reqs:
+            x = np.asarray(json.loads(r.body)["x"], np.float32)
+            staged.append((r.id, x))
+        return staged
+
+    def execute(staged):
+        out = {}
+        for rid, x in staged:
+            y = (x @ w).tolist()
+            out[rid] = (200, json.dumps({"y": y}).encode(), {})
+        return out
+
+    return SplitHandler(prepare, execute)
+
+
+def _drive(depth: int, payloads: list) -> dict:
+    """One fixed request stream through a ServingQuery at the given
+    pipeline depth; returns {payload index: (status, parsed body)}."""
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((4, 3)).astype(np.float32)
+    srv = WorkerServer()
+    info = srv.start()
+    q = ServingQuery(
+        srv, _matmul_split_handler(w), max_batch_size=8,
+        max_wait_ms=2.0, pipeline_depth=depth,
+    ).start()
+    results: dict = {}
+    try:
+        def client(k):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", info.port, timeout=10
+            )
+            for i in range(k, len(payloads), 4):
+                s, d = _post(info.port, payloads[i], conn=conn)
+                results[i] = (s, json.loads(d))
+            conn.close()
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+    finally:
+        q.stop()
+        srv.stop()
+    return results
+
+
+def test_continuous_batching_bit_identical_to_barrier():
+    """The tentpole contract: double-buffered build/execute changes WHEN
+    work happens, never WHAT comes back — same stream, same bytes."""
+    rng = np.random.default_rng(11)
+    payloads = [{"x": rng.standard_normal(4).round(4).tolist()}
+                for _ in range(64)]
+    barrier = _drive(1, payloads)
+    pipelined = _drive(2, payloads)
+    assert set(barrier) == set(pipelined) == set(range(64))
+    for i in range(64):
+        assert barrier[i] == pipelined[i], f"payload {i} diverged"
+
+
+def test_continuous_batching_overlaps_build_and_execute():
+    """With a slow execute and a steady request stream, the builder must
+    stage batch N+1 while batch N runs — observable via the overlap
+    counter (and by the run not serializing prepare+execute)."""
+    def prepare(reqs):
+        return [(r.id, json.loads(r.body)) for r in reqs]
+
+    def execute(staged):
+        time.sleep(0.05)  # the "XLA call"
+        return {
+            rid: (200, json.dumps({"echo": body}).encode(), {})
+            for rid, body in staged
+        }
+
+    srv = WorkerServer()
+    info = srv.start()
+    q = ServingQuery(
+        srv, SplitHandler(prepare, execute), max_batch_size=4,
+        max_wait_ms=0.0, pipeline_depth=2,
+    ).start()
+    try:
+        errs = []
+
+        def client(k):
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", info.port, timeout=10
+                )
+                for i in range(6):
+                    s, d = _post(info.port, {"k": k, "i": i}, conn=conn)
+                    assert s == 200 and json.loads(d)["echo"]["i"] == i
+                conn.close()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert not errs
+        assert q.overlapped > 0, "no batch ever overlapped an execute"
+    finally:
+        q.stop()
+        srv.stop()
+
+
+def test_deadline_sheds_fire_under_continuous_batching():
+    """Work whose deadline expired while queued is still shed 504 at the
+    builder's admission point — the rewrite must not bypass deadline
+    propagation."""
+    def prepare(reqs):
+        return [r.id for r in reqs]
+
+    def execute(staged):
+        time.sleep(0.15)  # slow model: the queue outlives short deadlines
+        return {rid: (200, b'{"ok": true}', {}) for rid in staged}
+
+    srv = WorkerServer()
+    info = srv.start()
+    q = ServingQuery(
+        srv, SplitHandler(prepare, execute), max_batch_size=1,
+        max_wait_ms=0.0, pipeline_depth=2, default_deadline_ms=120.0,
+    ).start()
+    try:
+        statuses: list = []
+        lock = threading.Lock()
+
+        def client(k):
+            s, d = _post(info.port, {"k": k})
+            with lock:
+                statuses.append((s, d))
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        codes = [s for s, _ in statuses]
+        assert codes.count(200) >= 1
+        assert codes.count(504) >= 1, codes  # sheds still fire
+        assert q.deadline_expired == codes.count(504)
+        shed_bodies = [d for s, d in statuses if s == 504]
+        assert all(b"deadline" in d for d in shed_bodies)
+    finally:
+        q.stop()
+        srv.stop()
+
+
+def test_drain_on_swap_holds_staged_batch_refcount():
+    """Hot-swap mid-continuous-batch: the staged (prepared but not yet
+    executed) batch holds its version's refcount, so the old version
+    drains only after BOTH the executing and the staged batch finish —
+    and zero requests drop across the flip."""
+    from mmlspark_tpu.serving.modelstore import (
+        LoadedModel,
+        ModelDispatcher,
+        ModelStore,
+    )
+
+    release_order: list = []
+
+    def make_loaded(tag: str, slow_s: float) -> LoadedModel:
+        def prepare(reqs):
+            return [r.id for r in reqs]
+
+        def execute(staged):
+            time.sleep(slow_s)
+            return {
+                rid: (200, json.dumps({"v": tag}).encode(), {})
+                for rid in staged
+            }
+
+        return LoadedModel(
+            handler=SplitHandler(prepare, execute),
+            release=lambda: release_order.append(tag),
+        )
+
+    store = ModelStore()
+    v1 = store.load("m", make_loaded("v1", 0.25), wait=True)
+    srv = WorkerServer()
+    info = srv.start()
+    disp = ModelDispatcher(
+        srv, store, default_model="m", max_batch_size=1, pipeline_depth=2,
+    ).start()
+    try:
+        results: list = []
+        lock = threading.Lock()
+
+        def client(i):
+            s, d = _post(info.port, {"i": i})
+            with lock:
+                results.append((s, json.loads(d)))
+
+        # A executes (0.25s), B stages behind it — BOTH acquired v1
+        ta = threading.Thread(target=client, args=(0,))
+        ta.start()
+        time.sleep(0.08)
+        tb = threading.Thread(target=client, args=(1,))
+        tb.start()
+        time.sleep(0.08)
+        v2 = store.load("m", make_loaded("v2", 0.0), wait=True)
+        store.swap("m", v2)  # drains v1: refcounts still held by A and B
+        # immediately post-swap the staged batch must not have been
+        # cancelled nor v1 released out from under it
+        ta.join(10.0)
+        tb.join(10.0)
+        assert [s for s, _ in results] == [200, 200]
+        assert all(d == {"v": "v1"} for _, d in results), results
+        # v1 fully drained -> released; later traffic rides v2
+        deadline = time.monotonic() + 5.0
+        while "v1" not in release_order and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert release_order == ["v1"]
+        s, d = _post(info.port, {"i": 2})
+        assert s == 200 and json.loads(d) == {"v": "v2"}
+        assert store.serving_version("m") == v2
+    finally:
+        disp.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-reactor ingress
+# ---------------------------------------------------------------------------
+
+
+def test_multi_reactor_slow_client_does_not_stall_intake():
+    """One client stalled mid-request-head must not stop other clients'
+    requests from being admitted and answered; connections land on more
+    than one reactor; /metrics stays inline on the shared port."""
+    def handler(reqs):
+        return {r.id: (200, b'{"ok": true}', {}) for r in reqs}
+
+    srv = WorkerServer(num_reactors=2, name="reactorbench")
+    info = srv.start()
+    q = ServingQuery(srv, handler).start()
+    stall = socket.create_connection(("127.0.0.1", info.port), timeout=10)
+    try:
+        # a slow client: partial request head, never finished
+        stall.sendall(b"POST / HTTP/1.1\r\nContent-Le")
+        time.sleep(0.05)
+        errs: list = []
+
+        def client(k):
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", info.port, timeout=5
+                )
+                for _ in range(10):
+                    s, _ = _post(info.port, {"k": k}, conn=conn)
+                    assert s == 200
+                conn.close()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(6)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20.0)
+        assert not errs
+        assert time.perf_counter() - t0 < 15.0
+        # both reactors export accept counters and every connection was
+        # accounted. (Which reactor wins each accept race is the
+        # kernel's choice — a loaded single-core box can legally hand
+        # one loop every connection, so per-reactor > 0 is NOT asserted)
+        text = obs.render()
+        counts = [
+            int(m)
+            for m in re.findall(
+                r'mmlspark_serving_reactor_connections_total\{'
+                r'server="reactorbench",reactor="\d+"\} (\d+)', text)
+        ]
+        assert len(counts) == 2, counts
+        assert sum(counts) >= 7, counts  # 6 clients + the stalled one
+        # /metrics answered inline (never queued/counted) on the same port
+        conn = http.client.HTTPConnection("127.0.0.1", info.port, timeout=5)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert b"mmlspark_serving_requests_total" in resp.read()
+        conn.close()
+    finally:
+        stall.close()
+        q.stop()
+        srv.stop()
+
+
+def test_bare_lf_request_head_still_parses():
+    """The ingress has always tolerated LF-only request heads; the
+    parse-path rewrite must not turn them into indefinite hangs."""
+    def handler(reqs):
+        return {r.id: (200, b"ok", {}) for r in reqs}
+
+    srv = WorkerServer(num_reactors=2)
+    info = srv.start()
+    q = ServingQuery(srv, handler).start()
+    try:
+        s = socket.create_connection(("127.0.0.1", info.port), timeout=5)
+        s.sendall(b"POST / HTTP/1.1\nContent-Length: 2\n\n{}")
+        data = s.recv(65536)
+        assert data.startswith(b"HTTP/1.1 200")
+        s.close()
+    finally:
+        q.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# pooled zero-re-parse forwarding
+# ---------------------------------------------------------------------------
+
+
+def _echo_worker(name: str):
+    def handler(reqs):
+        return {
+            r.id: (200, json.dumps({"who": name}).encode(),
+                   {"Content-Type": "application/json"})
+            for r in reqs
+        }
+
+    srv = WorkerServer(name=name)
+    info = srv.start()
+    q = ServingQuery(srv, handler).start()
+    return srv, q, info
+
+
+def test_wireconn_single_pass_parse_roundtrip():
+    from mmlspark_tpu.serving.distributed import WireConn, _head_bytes
+
+    srv, q, info = _echo_worker("wire")
+    try:
+        conn = WireConn("127.0.0.1", info.port, timeout=5.0)
+        body = b'{"x": 1}'
+        head = _head_bytes(
+            "POST", "/", b"Host: t\r\n",
+            b"x-custom: yes\r\n", {"x-extra": "1"}, len(body),
+        )
+        conn.send(head + body)
+        resp = conn.read_response()
+        assert resp.status == 200
+        assert json.loads(resp.body) == {"who": "wire"}
+        assert resp.getheader("Content-Type") == "application/json"
+        assert not resp.will_close
+        # keep-alive: a second request rides the same socket
+        conn.send(head + body)
+        assert conn.read_response().status == 200
+        conn.close()
+        conn.close()  # idempotent: the open-count must not go negative
+        assert WireConn.open_count() >= 0
+    finally:
+        q.stop()
+        srv.stop()
+
+
+class _StaleKeepAliveBackend:
+    """A worker that promises keep-alive but closes the connection after
+    every response — the stale-pooled-connection scenario."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.served = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            try:
+                c, _ = self.sock.accept()
+            except OSError:
+                return
+            try:
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    d = c.recv(65536)
+                    if not d:
+                        raise ConnectionError
+                    buf += d
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                m = re.search(rb"content-length:\s*(\d+)", head.lower())
+                n = int(m.group(1)) if m else 0
+                while len(rest) < n:
+                    rest += c.recv(65536)
+                c.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+                    b"Connection: keep-alive\r\n\r\nok"
+                )
+                self.served += 1
+            except Exception:
+                pass
+            finally:
+                c.close()  # stale: keep-alive promised, not kept
+
+    def stop(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def test_stale_keepalive_transparent_retry_no_breaker_count(monkeypatch):
+    """Reusing a pooled connection the worker closed must cost ONE
+    transparent retry on a fresh connection — never a breaker outcome,
+    never a cross-worker re-dispatch. alive() normally catches the FIN;
+    patching it True simulates the close-racing-the-send window."""
+    from mmlspark_tpu.serving.distributed import ServingGateway, WireConn
+
+    be = _StaleKeepAliveBackend()
+    gw = ServingGateway(
+        workers=[{"host": "127.0.0.1", "port": be.port}],
+        request_timeout_s=3.0,
+    )
+    gw.start()
+    monkeypatch.setattr(WireConn, "alive", lambda self: not self._closed)
+    try:
+        for i in range(4):
+            s, d = _post(gw._ingress.port, {"i": i})
+            assert (s, d) == (200, b"ok")
+        assert be.served == 4
+        # transparent means invisible to failure containment: no retry
+        # counted, no backend failure, no breaker movement
+        assert gw.retried == 0
+        assert gw.failed == 0
+        for br in gw.pool._breakers.values():
+            assert br.fails == 0
+    finally:
+        gw.stop()
+        be.stop()
+
+
+def test_hedge_burst_does_not_leak_sockets():
+    """Hedged attempts ride the shared side pool: a burst of hedges must
+    not grow the process's open wire-connection count without bound, and
+    losers' sockets are closed, never pooled."""
+    from mmlspark_tpu.serving.distributed import ServingGateway, WireConn
+
+    def slow_handler(reqs):
+        time.sleep(0.15)
+        return {r.id: (200, b'{"who": "slow"}', {}) for r in reqs}
+
+    def fast_handler(reqs):
+        return {r.id: (200, b'{"who": "fast"}', {}) for r in reqs}
+
+    s1 = WorkerServer(name="hedge-slow")
+    i1 = s1.start()
+    q1 = ServingQuery(s1, slow_handler, max_batch_size=1).start()
+    s2 = WorkerServer(name="hedge-fast")
+    i2 = s2.start()
+    q2 = ServingQuery(s2, fast_handler, max_batch_size=1).start()
+    gw = ServingGateway(
+        workers=[i1, i2], hedge_ms=30.0, request_timeout_s=5.0,
+        retry_budget_ratio=1.0, retry_budget_min=100,
+    )
+    gw.start()
+    try:
+        def burst(n):
+            for i in range(n):
+                s, _ = _post(gw._ingress.port, {"i": i})
+                assert s == 200
+
+        burst(8)
+        assert gw.hedged > 0  # the slow primary genuinely forced hedges
+        count_after_warm = WireConn.open_count()
+        burst(12)
+        # steady state: more hedge traffic, zero net socket growth
+        assert WireConn.open_count() <= count_after_warm
+        assert gw._hedge_pool.idle_count() <= 2 * 4  # cap per backend
+    finally:
+        gw.stop()
+        for s, q in ((s1, q1), (s2, q2)):
+            q.stop()
+            s.stop()
+        assert gw._hedge_pool.idle_count() == 0  # close_all drained it
+
+
+# ---------------------------------------------------------------------------
+# pipeline: columnar array fast path
+# ---------------------------------------------------------------------------
+
+
+def _fallback_sum() -> int:
+    return sum(
+        int(v) for v in re.findall(
+            r"mmlspark_compiler_fallback_total\{[^}]*\} (\d+)", obs.render()
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def pipeline_lm(tmp_path_factory):
+    from mmlspark_tpu import DataFrame, Pipeline
+    from mmlspark_tpu.featurize.featurize import Featurize
+    from mmlspark_tpu.models.linear import LogisticRegression
+    from mmlspark_tpu.serving.modelstore.loaders import build_loaded_model
+
+    rng = np.random.default_rng(0)
+    df = DataFrame.from_dict({
+        "a": rng.standard_normal(48),
+        "v": rng.standard_normal((48, 5)).astype(np.float32),
+        "label": rng.integers(0, 2, 48),
+    })
+    model = Pipeline([
+        Featurize(input_cols=["a", "v"], output_col="features"),
+        LogisticRegression(features_col="features", label_col="label",
+                           max_iter=10),
+    ]).fit(df)
+    path = os.path.join(str(tmp_path_factory.mktemp("pipe")), "scorer")
+    model.save(path)
+    lm = build_loaded_model(f"pipeline:{path}")
+    lm.warmup()
+    yield lm
+    lm.release()
+
+
+def _preq(rid: str, obj) -> CachedRequest:
+    return CachedRequest(id=rid, epoch=0, method="POST", path="/",
+                        headers={}, body=json.dumps(obj).encode())
+
+
+def test_pipeline_columnar_fast_path_fallback_free(pipeline_lm):
+    """The array fast path: columns decoded once per batch, scored by the
+    FUSED program (no staged fallback), replies identical to the
+    row-oriented wire form."""
+    lm = pipeline_lm
+    rows = [{"a": 0.1 * i, "v": [0.01 * i] * 5, "label": 0}
+            for i in range(6)]
+    cols = {
+        "a": [r["a"] for r in rows],
+        "v": [r["v"] for r in rows],
+        "label": [r["label"] for r in rows],
+    }
+    before = _fallback_sum()
+    out_rows = lm.handler([_preq("r", {"rows": rows})])["r"]
+    out_cols = lm.handler([_preq("c", {"cols": cols})])["c"]
+    assert out_rows[0] == out_cols[0] == 200
+    assert json.loads(out_rows[1]) == json.loads(out_cols[1])
+    # asserted fallback-free: the fused program ran at the bucket shape
+    assert _fallback_sum() == before, "columnar path fell back to staged"
+    # prepare/execute split: the dispatcher can overlap this handler
+    from mmlspark_tpu.serving.query import handler_stages
+
+    assert handler_stages(lm.handler) is not None
+
+
+def test_pipeline_select_narrows_reply(pipeline_lm):
+    """``select`` returns exactly the requested output columns — and an
+    unselected request in the same batch still gets its full reply."""
+    lm = pipeline_lm
+    row = {"a": 0.7, "v": [0.3] * 5, "label": 1}
+    replies = lm.handler([
+        _preq("sel", {"rows": [row], "select": ["prediction"]}),
+        _preq("full", {"rows": [row]}),
+        _preq("bad", {"rows": [row], "select": "prediction"}),
+    ])
+    assert replies["bad"][0] == 400  # select must be a list
+    assert replies["sel"][0] == replies["full"][0] == 200
+    sel_row = json.loads(replies["sel"][1])["rows"][0]
+    full_row = json.loads(replies["full"][1])["rows"][0]
+    assert set(sel_row) == {"prediction"}
+    assert len(full_row) > 1 and "features" in full_row
+    assert sel_row["prediction"] == full_row["prediction"]
+
+
+def test_pipeline_columnar_mixed_batch_and_errors(pipeline_lm):
+    """Columnar + row-form requests merge into ONE batch transform; a
+    ragged columnar request 400s alone."""
+    lm = pipeline_lm
+    good_cols = {"a": [0.5, 0.25], "v": [[0.1] * 5, [0.2] * 5],
+                 "label": [0, 0]}
+    ragged = {"a": [0.5], "v": [[0.1] * 5, [0.2] * 5], "label": [0]}
+    replies = lm.handler([
+        _preq("cols", {"cols": good_cols}),
+        _preq("row", {"a": 0.5, "v": [0.1] * 5, "label": 0}),
+        _preq("bad", {"cols": ragged}),
+    ])
+    assert replies["bad"][0] == 400
+    assert b"ragged" in replies["bad"][1]
+    assert replies["cols"][0] == 200 and replies["row"][0] == 200
+    first_col_row = json.loads(replies["cols"][1])["rows"][0]
+    single = json.loads(replies["row"][1])
+    assert first_col_row == single  # same row, either wire form

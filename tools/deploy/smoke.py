@@ -564,6 +564,99 @@ def _verify_freshness(url: str, registry_url, service: str) -> bool:
     return all(_freshness_ok(p, u) for u, p in live.items())
 
 
+def _throughput_floor_rps(base_floor: float = 50.0) -> float:
+    """Box-speed-scaled rps floor: the reference box (24-core dev
+    machine) clears ~500+ rps through the gateway, so a 50-rps floor is
+    ~10x margin there; a slower box scales the floor down by its
+    measured JSON-encode speed rather than flaking the gate."""
+    payload = {"x": list(range(16)), "k": "calibration"}
+    t0 = time.perf_counter()
+    for _ in range(2000):
+        json.dumps(payload)
+    spin_s = max(time.perf_counter() - t0, 1e-6)
+    REF_SPIN_S = 0.0065  # ~2000 dumps on the reference box
+    return max(5.0, base_floor * min(1.0, REF_SPIN_S / spin_s))
+
+
+def _verify_throughput(url: str, n: int = 120, threads: int = 4) -> bool:
+    """Throughput sanity gate (default on): ``n`` keep-alive requests
+    from ``threads`` concurrent pipelined clients through the gateway,
+    with a floor on achieved rps scaled to box speed — a data-plane
+    regression (lost keep-alive, serialized dispatch, a stalled
+    reactor) fails smoke instead of waiting for the next bench run.
+    Skips when the target isn't a gateway (worker-direct smokes measure
+    the model, not the data plane). Runs AFTER the counter-gate
+    scrapes, so its traffic never skews the forwarded==successes
+    equality."""
+    _ensure_repo_path()
+    import http.client
+    import threading as threading_mod
+
+    from mmlspark_tpu.serving.fleet import scrape_metrics
+
+    parsed = scrape_metrics(url)
+    has_gw = parsed is not None and any(
+        name == "mmlspark_serving_requests_total"
+        and any(k == "server" and v.endswith("-gateway") for k, v in labels)
+        for (name, labels) in parsed
+    )
+    if not has_gw:
+        print("smoke: target exposes no gateway metrics; "
+              "skipping throughput gate")
+        return True
+    u = urllib.parse.urlparse(url)
+    port = u.port or 80
+    per_thread = max(1, n // threads)
+    lock = threading_mod.Lock()
+    counts = {"done": 0, "err": 0, "fail5xx": 0}
+
+    def client(k: int) -> None:
+        conn = http.client.HTTPConnection(u.hostname, port, timeout=15)
+        for i in range(per_thread):
+            try:
+                conn.request(
+                    "POST", "/", body=json.dumps({"x": i}),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                with lock:
+                    counts["done"] += 1
+                    if resp.status >= 500:
+                        counts["fail5xx"] += 1
+            except Exception:  # noqa: BLE001 — transport error = gate evidence
+                with lock:
+                    counts["err"] += 1
+                conn.close()
+                conn = http.client.HTTPConnection(u.hostname, port, timeout=15)
+        conn.close()
+
+    floor = _throughput_floor_rps()
+    t0 = time.perf_counter()
+    ts = [threading_mod.Thread(target=client, args=(k,))
+          for k in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120.0)
+    elapsed = max(time.perf_counter() - t0, 1e-6)
+    total = threads * per_thread
+    rps = counts["done"] / elapsed
+    ok = (
+        counts["done"] == total
+        and counts["err"] == 0
+        and counts["fail5xx"] <= total * 0.1
+        and rps >= floor
+    )
+    print(
+        f"smoke: throughput — {counts['done']}/{total} replies from "
+        f"{threads} pipelined clients in {elapsed:.2f}s = {rps:.0f} rps "
+        f"(floor {floor:.0f}), {counts['err']} transport error(s), "
+        f"{counts['fail5xx']} 5xx — {'ok' if ok else 'MISMATCH'}"
+    )
+    return ok
+
+
 def _count_fault_records() -> int:
     _ensure_repo_path()
     from mmlspark_tpu.obs.flightrec import FLIGHT
@@ -639,6 +732,11 @@ def main(argv=None) -> int:
         "a gateway hop AND a worker hop)",
     )
     ap.add_argument(
+        "--no-verify-throughput", action="store_true",
+        help="skip the throughput sanity gate (pipelined keep-alive "
+        "requests through the gateway with a box-speed-scaled rps floor)",
+    )
+    ap.add_argument(
         "--swap", action="store_true",
         help="hot-swap drill: load a new model version on every backend "
         "and swap it in while the request phase runs; the gate then "
@@ -691,6 +789,11 @@ def main(argv=None) -> int:
         metrics_ok = _verify_freshness(
             args.url, args.registry, args.service_name
         ) and metrics_ok
+    throughput_ok = True
+    if not args.no_verify_throughput and not args.fault_plan:
+        # chaos smokes measure fault handling, not clean-path rps — an
+        # armed fault plan would fail the floor by design
+        throughput_ok = _verify_throughput(args.url)
     trace_ok = True
     if not args.no_verify_trace:
         trace_ok = _verify_trace(args.url, args.registry, args.service_name)
@@ -699,6 +802,7 @@ def main(argv=None) -> int:
         flight_ok = _verify_flightrec(plan, faults_before)
     return 0 if (
         ok == n and metrics_ok and swap_ok and trace_ok and flight_ok
+        and throughput_ok
     ) else 1
 
 
