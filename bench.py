@@ -74,11 +74,11 @@ SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280,
 #   out of the CPU child identically.
 # - On the CPU fallback, cheap-first so a late death costs least.
 SEGMENTS = ["serving", "modelstore", "tracing", "artifact", "overload",
-            "throughput", "freshness", "elastic", "pipeline", "hist", "vw",
-            "gbdt", "sklearn", "featurizer"]
+            "throughput", "chaos", "freshness", "elastic", "pipeline",
+            "hist", "vw", "gbdt", "sklearn", "featurizer"]
 TPU_ORDER = ["sklearn", "gbdt", "hist", "featurizer", "pipeline", "vw",
              "serving", "modelstore", "tracing", "artifact", "overload",
-             "throughput", "freshness", "elastic"]
+             "throughput", "chaos", "freshness", "elastic"]
 CPU_ORDER = SEGMENTS
 
 
@@ -1804,6 +1804,208 @@ print(json.dumps({"lats": lats, "errors": errs[0]}), flush=True)
     return out
 
 
+def _seg_chaos(on_accel: bool, n_dev: int) -> dict:
+    """Hostile-wire survival (ISSUE 13): goodput retained and p99 under
+    a standard hostile schedule — throttle + byte-flip + asymmetric
+    partition via a seeded ChaosProxy (mmlspark_tpu/chaos/wire.py) —
+    vs the clean baseline on the same in-process gateway + 2-worker
+    fleet, plus the allreduce CRC corruption-detect-to-recovery time
+    (flip -> NACK -> retransmit -> correct sum). Client threads share
+    the GIL with the serving threads, so the honest claim is the
+    RATIO, not the absolute rps."""
+    import http.client as http_client
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.chaos.wire import ChaosProxy, WireRule
+    from mmlspark_tpu.serving.distributed import ServingGateway
+    from mmlspark_tpu.serving.modelstore import ModelDispatcher, ModelStore
+    from mmlspark_tpu.serving.server import ServiceInfo, WorkerServer
+
+    out: dict = {}
+    obs.reset()
+    workers = []
+    for _ in range(2):
+        srv = WorkerServer(name="chbench")
+        info = srv.start()
+        store = ModelStore()
+        store.load("echo", "echo", wait=True)
+        disp = ModelDispatcher(srv, store, default_model="echo").start()
+        workers.append((srv, disp, info))
+    # each worker link rides its own proxy so the partition window can
+    # blackhole one of them without touching the other
+    w_proxies = [
+        ChaosProxy("127.0.0.1", w[2].port, seed=11, name=f"bw{i}").start()
+        for i, w in enumerate(workers)
+    ]
+    gw = ServingGateway(
+        workers=[
+            ServiceInfo("chbench", "127.0.0.1", p.port) for p in w_proxies
+        ],
+        num_dispatchers=4, request_timeout_s=2.0, retry_after_send=True,
+    )
+    ginfo = gw.start()
+    client_proxy = ChaosProxy(
+        "127.0.0.1", ginfo.port, seed=11, name="bclient"
+    ).start()
+
+    def measure(dur_s: float) -> tuple:
+        stop = threading.Event()
+        lats: list = []
+        errs = [0]
+        lock = threading.Lock()
+
+        def client():
+            conn = http_client.HTTPConnection(
+                "127.0.0.1", client_proxy.port, timeout=10.0
+            )
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", "/", b'{"x": 1}')
+                    r = conn.getresponse()
+                    r.read()
+                    ok = r.status == 200
+                except OSError:
+                    conn.close()
+                    conn = http_client.HTTPConnection(
+                        "127.0.0.1", client_proxy.port, timeout=10.0
+                    )
+                    ok = False
+                dt = time.perf_counter() - t0
+                with lock:
+                    if ok:
+                        lats.append(dt)
+                    else:
+                        errs[0] += 1
+            conn.close()
+
+        threads = [
+            threading.Thread(target=client, daemon=True) for _ in range(4)
+        ]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(dur_s)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        wall = time.perf_counter() - t_start
+        lats.sort()
+        p99 = lats[int(0.99 * (len(lats) - 1))] * 1e3 if lats else 0.0
+        return len(lats) / wall, p99, errs[0]
+
+    try:
+        clean_rps, clean_p99, _ = measure(2.5)
+        # the standard hostile schedule: throttle + jitter + a byte
+        # flipped into the request stream every 64 KiB, and worker 0's
+        # link blackholed for the middle of the window (asymmetric
+        # partition -> idempotent failover)
+        client_proxy.set_rules([
+            WireRule("latency", delay_ms=0.5, jitter_ms=2.0),
+            WireRule("throttle", direction="c2s", bytes_per_s=512 * 1024),
+            WireRule("flip", direction="c2s", at_offset=4096,
+                     every_bytes=65536),
+        ])
+
+        def partition_window():
+            time.sleep(0.8)
+            w_proxies[0].set_rules(
+                [WireRule("blackhole", direction="c2s")]
+            )
+            time.sleep(1.0)
+            w_proxies[0].clear_rules()
+
+        pt = threading.Thread(target=partition_window, daemon=True)
+        pt.start()
+        hostile_rps, hostile_p99, hostile_errs = measure(2.5)
+        pt.join(5)
+        out["chaos_clean_rps"] = round(clean_rps, 1)
+        out["chaos_clean_p99_ms"] = round(clean_p99, 2)
+        out["chaos_hostile_rps"] = round(hostile_rps, 1)
+        out["chaos_hostile_p99_ms"] = round(hostile_p99, 2)
+        out["chaos_hostile_errors"] = hostile_errs
+        out["chaos_goodput_retained"] = round(
+            hostile_rps / clean_rps, 3
+        ) if clean_rps else 0.0
+        faults = sum(len(p.journal()) for p in (client_proxy, *w_proxies))
+        out["chaos_wire_faults_applied"] = faults
+    finally:
+        client_proxy.set_rules([])
+        gw.stop()
+        for p in w_proxies:
+            p.stop()
+        client_proxy.stop()
+        for srv, disp, _ in workers:
+            disp.stop()
+            srv.stop()
+
+    # -- allreduce CRC: corruption-detect-to-recovery ------------------------
+    from mmlspark_tpu.parallel.elastic import (
+        GangMember,
+        Generation,
+        TcpReducer,
+    )
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    reg = DriverRegistry(ttl_s=10.0)
+    # pre-bind b's allreduce port so the proxy fronts it BEFORE the
+    # member's first heartbeat advertises anything — a post-construction
+    # advertise_port assignment can lose that race, letting peer a dial
+    # b direct and skip the fault schedule entirely
+    import socket as socket_mod
+
+    _ls = socket_mod.create_server(("127.0.0.1", 0))
+    b_port = _ls.getsockname()[1]
+    _ls.close()
+    ab = ChaosProxy("127.0.0.1", b_port, seed=11, name="bab").start()
+    b = GangMember(
+        reg.url, "b", heartbeat_s=0.2,
+        listen_port=b_port, advertise_port=ab.port,
+    )
+    a = GangMember(reg.url, "a", heartbeat_s=0.2)
+    time.sleep(0.6)
+    gen = Generation(gen=1, members=["a", "b"])
+    ra = TcpReducer(a, gen, timeout_s=20.0)
+    rb = TcpReducer(b, gen, timeout_s=20.0)
+    try:
+        payload = np.arange(4096, dtype=np.float64)
+
+        def timed_allreduce() -> float:
+            res = {}
+            t0 = time.perf_counter()
+            ta = threading.Thread(target=lambda: res.__setitem__(
+                "a", ra.allreduce(payload)))
+            tb = threading.Thread(target=lambda: res.__setitem__(
+                "b", rb.allreduce(payload)))
+            ta.start(); tb.start(); ta.join(25); tb.join(25)
+            dt = (time.perf_counter() - t0) * 1e3
+            assert np.array_equal(res["a"], 2 * payload)
+            assert np.array_equal(res["b"], 2 * payload)
+            return dt
+
+        clean_ms = min(timed_allreduce() for _ in range(3))
+        # flip one byte inside the NEXT a->b frame's payload: the whole
+        # detect -> NACK -> retransmit -> correct-sum turnaround is the
+        # recovery time. Offset = frames already sent x frame length
+        # (32-byte head + 1-byte name + payload), plus 1000 into the
+        # next frame's payload
+        frame_len = 32 + 1 + payload.nbytes
+        ab.set_rules([WireRule(
+            "flip", direction="c2s", at_offset=ra.seq * frame_len + 1000,
+        )])
+        drops_before = b.crc_drops
+        corrupt_ms = timed_allreduce()
+        out["chaos_crc_detected"] = int(b.crc_drops - drops_before)
+        out["chaos_crc_retransmits"] = ra.retransmits
+        out["chaos_crc_clean_allreduce_ms"] = round(clean_ms, 2)
+        out["chaos_crc_detect_to_recover_ms"] = round(corrupt_ms, 2)
+    finally:
+        ra.close(); rb.close(); a.close(); b.close()
+        ab.stop(); reg.stop()
+        obs.reset()
+    return out
+
+
 SEGMENT_FNS = {
     "serving": _seg_serving,
     "modelstore": _seg_modelstore,
@@ -1811,6 +2013,7 @@ SEGMENT_FNS = {
     "artifact": _seg_artifact,
     "overload": _seg_overload,
     "throughput": _seg_throughput,
+    "chaos": _seg_chaos,
     "freshness": _seg_freshness,
     "elastic": _seg_elastic,
     "pipeline": _seg_pipeline,

@@ -1,0 +1,30 @@
+"""Hostile-wire chaos engineering: the fabric itself as the adversary.
+
+Three layers (docs/chaos.md):
+
+- :mod:`mmlspark_tpu.chaos.wire` — :class:`ChaosProxy`, a transparent
+  seeded TCP proxy any fleet link can be pointed through, with per-link
+  :class:`WireRule` fault schedules (latency/jitter, bandwidth throttle,
+  byte-flip at offset, truncate-then-RST, slowloris drip, asymmetric
+  blackhole). Same seed => byte-identical fault schedule.
+- :mod:`mmlspark_tpu.chaos.conductor` — :class:`ChaosConductor`, a timed
+  scenario runner driving wire faults + process signals against a live
+  fleet, journaling every action (``fleet chaos``).
+- :mod:`mmlspark_tpu.chaos.invariants` — :class:`InvariantChecker`, a
+  conservation-law checker over every role's ``/metrics``: nothing the
+  fleet accepted may go unaccounted, no matter what the wire did.
+"""
+
+from mmlspark_tpu.chaos.conductor import ChaosConductor, Scenario
+from mmlspark_tpu.chaos.invariants import InvariantChecker, Violation
+from mmlspark_tpu.chaos.wire import RULE_KINDS, ChaosProxy, WireRule
+
+__all__ = [
+    "ChaosConductor",
+    "ChaosProxy",
+    "InvariantChecker",
+    "RULE_KINDS",
+    "Scenario",
+    "Violation",
+    "WireRule",
+]

@@ -1,0 +1,568 @@
+"""ChaosProxy: a transparent, seeded, deterministic TCP chaos proxy.
+
+Every fault-injection point so far (core/faults.py) fires *inside* our
+own functions; a production network lies at a layer none of them reach —
+flipped bytes, slow-dripped headers, asymmetric partitions, mid-frame
+resets. This proxy makes the fabric itself the adversary: point any
+fleet link (client->gateway, gateway->worker, gang member<->member,
+artifact fetch, registry heartbeats) at a :class:`ChaosProxy` and give
+it :class:`WireRule` schedules.
+
+Rule kinds (:data:`RULE_KINDS`; docs/chaos.md has the full table):
+
+==============  ==============================================================
+``latency``     delay each stream window by ``delay_ms`` plus a seeded
+                jitter draw in ``[0, jitter_ms]``
+``throttle``    cap the direction's forwarding rate at ``bytes_per_s``
+``flip``        XOR the byte at absolute stream ``at_offset`` with
+                ``xor_mask`` (``every_bytes`` > 0 repeats the flip at
+                ``at_offset + k*every_bytes``)
+``truncate_rst``  forward the stream up to ``at_offset`` bytes, then RST
+                both sides of the connection (SO_LINGER 0)
+``slowdrip``    forward in ``drip_bytes`` chunks with
+                ``drip_interval_ms`` sleeps — the proxy *becomes* a
+                slowloris client toward the upstream
+``blackhole``   silently swallow the direction's bytes (the peer's sends
+                succeed; nothing arrives). One direction only =
+                asymmetric partition: A->B dead while B->A lives
+==============  ==============================================================
+
+**Determinism contract.** The fault *schedule* is a pure function of
+``(seed, link name, connection index, direction, stream byte offset)``
+— never of wall-clock time or TCP chunk boundaries. Byte-positioned
+rules (flip, truncate) land on exact offsets; latency jitter draws per
+fixed 64 KiB stream window. Every applied fault is journaled as a
+``(conn, direction, kind, offset, value)`` tuple and
+:meth:`ChaosProxy.schedule_digest` hashes the sorted journal: replaying
+the same seed against the same byte streams (and connection arrival
+order) reproduces the identical digest — chaos tests are reproducible,
+bit-for-bit, the same property core/faults.py gives code-level plans.
+
+Rules can be swapped live with :meth:`ChaosProxy.set_rules` (the
+conductor's timed-scenario hook); in-flight connections pick the new
+rules up at their next chunk.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from mmlspark_tpu import obs
+
+_M_CONNS = obs.counter(
+    "mmlspark_chaos_conns_total",
+    "Connections accepted by a chaos proxy, per link",
+    labels=("link",),
+)
+_M_FAULTS = obs.counter(
+    "mmlspark_chaos_faults_total",
+    "Wire faults applied by a chaos proxy, per link and rule kind",
+    labels=("link", "kind"),
+)
+_M_BYTES = obs.counter(
+    "mmlspark_chaos_bytes_total",
+    "Bytes forwarded through a chaos proxy, per link and direction",
+    labels=("link", "direction"),
+)
+_M_DROPPED = obs.counter(
+    "mmlspark_chaos_dropped_bytes_total",
+    "Bytes swallowed by blackhole rules, per link",
+    labels=("link",),
+)
+
+# the rule vocabulary; tools/lint_fault_points.py greps this tuple and
+# requires every kind to be named by at least one test (an untested wire
+# fault is an adversary nobody has ever watched the fleet survive)
+RULE_KINDS = (
+    "latency",
+    "throttle",
+    "flip",
+    "truncate_rst",
+    "slowdrip",
+    "blackhole",
+)
+
+DIRECTIONS = ("c2s", "s2c", "both")
+
+# latency jitter draws once per this many stream bytes (schedule keyed on
+# the window index, so TCP chunking cannot perturb the draw sequence)
+LAT_WINDOW = 65536
+
+_BUFSIZE = 65536
+
+
+class _Truncated(Exception):
+    """Internal: a truncate_rst rule fired — RST and stop pumping."""
+
+
+@dataclass(frozen=True)
+class WireRule:
+    """One scheduled wire fault on one link direction.
+
+    ``direction``: ``c2s`` (client->server bytes), ``s2c``, or ``both``.
+    ``conns``: restrict to these connection indices (accept order,
+    0-based); ``after_conn``: apply only from that index on. Offsets are
+    absolute per-connection per-direction stream byte offsets."""
+
+    kind: str
+    direction: str = "both"
+    delay_ms: float = 0.0          # latency: base added delay per window
+    jitter_ms: float = 0.0         # latency: seeded uniform extra
+    bytes_per_s: float = 0.0       # throttle
+    at_offset: int = 0             # flip / truncate_rst
+    xor_mask: int = 0xFF           # flip
+    every_bytes: int = 0           # flip: 0 = once, else repeat stride
+    drip_bytes: int = 1            # slowdrip chunk size
+    drip_interval_ms: float = 20.0  # slowdrip inter-chunk sleep
+    conns: Optional[frozenset] = None
+    after_conn: int = 0
+
+    def __post_init__(self):
+        if self.kind not in RULE_KINDS:
+            raise ValueError(
+                f"unknown wire rule kind {self.kind!r}; known: {RULE_KINDS}"
+            )
+        if self.direction not in DIRECTIONS:
+            raise ValueError(
+                f"unknown direction {self.direction!r}; known: {DIRECTIONS}"
+            )
+
+    def applies(self, conn: int, direction: str) -> bool:
+        if self.direction != "both" and self.direction != direction:
+            return False
+        if conn < self.after_conn:
+            return False
+        return self.conns is None or conn in self.conns
+
+    @staticmethod
+    def from_dict(d: dict) -> "WireRule":
+        d = dict(d)
+        if "conns" in d and d["conns"] is not None:
+            d["conns"] = frozenset(d["conns"])
+        return WireRule(**d)
+
+
+@dataclass
+class JournalEntry:
+    """One applied fault — the deterministic schedule record. ``value``
+    is the fault's drawn/derived parameter (jitter ms, flipped mask, RST
+    offset, ...), never a wall-clock time."""
+
+    conn: int
+    direction: str
+    kind: str
+    offset: int
+    value: Any = None
+
+    def key(self) -> tuple:
+        return (self.conn, self.direction, self.kind, self.offset,
+                repr(self.value))
+
+
+class ChaosProxy:
+    """Transparent TCP proxy applying a seeded :class:`WireRule` schedule.
+
+    >>> proxy = ChaosProxy("127.0.0.1", worker_port, seed=7, name="gw-w1",
+    ...                    rules=[WireRule("flip", at_offset=100)])
+    >>> proxy.start()
+    >>> # point the client at ("127.0.0.1", proxy.port) instead
+    """
+
+    def __init__(
+        self,
+        target_host: str,
+        target_port: int,
+        listen_host: str = "127.0.0.1",
+        listen_port: int = 0,
+        rules: Any = (),
+        seed: int = 0,
+        name: str = "link",
+        connect_timeout_s: float = 10.0,
+    ):
+        self.target = (target_host, int(target_port))
+        self.listen_host = listen_host
+        self._listen_port = int(listen_port)
+        self.seed = int(seed)
+        self.name = name
+        self.connect_timeout_s = connect_timeout_s
+        self._rules: tuple = tuple(
+            r if isinstance(r, WireRule) else WireRule.from_dict(r)
+            for r in rules
+        )
+        self._lock = threading.Lock()
+        self._journal: list = []
+        self._conn_counter = 0
+        self._stop = threading.Event()
+        self._lsock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._open_socks: set = set()
+        self.port: int = 0
+        self._m_conns = _M_CONNS.labels(link=name)
+        self._m_bytes = {
+            d: _M_BYTES.labels(link=name, direction=d) for d in ("c2s", "s2c")
+        }
+        self._m_dropped = _M_DROPPED.labels(link=name)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "ChaosProxy":
+        self._lsock = socket.create_server(
+            (self.listen_host, self._listen_port)
+        )
+        self._lsock.settimeout(0.25)
+        self.port = self._lsock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"chaos-{self.name}", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+        with self._lock:
+            socks = list(self._open_socks)
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(2.0)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.listen_host}:{self.port}"
+
+    # -- rule management (live-swappable by the conductor) --------------------
+
+    def set_rules(self, rules: Any) -> None:
+        with self._lock:
+            self._rules = tuple(
+                r if isinstance(r, WireRule) else WireRule.from_dict(r)
+                for r in rules
+            )
+
+    def clear_rules(self) -> None:
+        self.set_rules(())
+
+    def rules(self) -> tuple:
+        with self._lock:
+            return self._rules
+
+    # -- the deterministic schedule record ------------------------------------
+
+    def journal(self) -> list:
+        with self._lock:
+            return list(self._journal)
+
+    def schedule_digest(self) -> str:
+        """sha256 over the sorted journal keys — identical for identical
+        (seed, byte streams, connection order); the determinism pin."""
+        entries = sorted(e.key() for e in self.journal())
+        h = hashlib.sha256()
+        for e in entries:
+            h.update(repr(e).encode())
+        return h.hexdigest()
+
+    def _record(self, entry: JournalEntry) -> None:
+        with self._lock:
+            self._journal.append(entry)
+        if _M_FAULTS._on:
+            _M_FAULTS.labels(link=self.name, kind=entry.kind).inc()
+
+    def _rng(self, conn: int, direction: str, kind: str, idx: int):
+        return random.Random(
+            f"{self.seed}:{self.name}:{conn}:{direction}:{kind}:{idx}"
+        )
+
+    # -- data plane -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._lock:
+                conn_id = self._conn_counter
+                self._conn_counter += 1
+            if self._m_conns._on:
+                self._m_conns.inc()
+            threading.Thread(
+                target=self._serve_conn, args=(conn_id, client),
+                name=f"chaos-{self.name}-{conn_id}", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn_id: int, client: socket.socket) -> None:
+        try:
+            upstream = socket.create_connection(
+                self.target, timeout=self.connect_timeout_s
+            )
+        except OSError:
+            try:
+                client.close()
+            except OSError:
+                pass
+            return
+        upstream.settimeout(None)
+        client.settimeout(None)
+        for s in (client, upstream):
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        with self._lock:
+            self._open_socks.update((client, upstream))
+        t1 = threading.Thread(
+            target=self._pump, args=(conn_id, client, upstream, "c2s"),
+            daemon=True,
+        )
+        t2 = threading.Thread(
+            target=self._pump, args=(conn_id, upstream, client, "s2c"),
+            daemon=True,
+        )
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        with self._lock:
+            self._open_socks.discard(client)
+            self._open_socks.discard(upstream)
+        for s in (client, upstream):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _rst(sock: socket.socket) -> None:
+        """Close with SO_LINGER 0 so the peer sees ECONNRESET, not FIN —
+        the mid-frame reset a dying kernel or middlebox produces. The
+        SHUT_RD first unblocks the sibling pump's recv on this socket:
+        close() alone would leave that thread parked in the syscall and
+        the kernel would never actually tear the connection down (no
+        RST ever leaves — measured, not theory)."""
+        try:
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                struct.pack("ii", 1, 0),
+            )
+        except OSError:
+            pass
+        try:
+            sock.shutdown(socket.SHUT_RD)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _pump(self, conn_id: int, src: socket.socket, dst: socket.socket,
+              direction: str) -> None:
+        offset = 0
+        # per-connection one-shot journal flags (throttle/blackhole/
+        # slowdrip are stream-wide modes, journaled once at first byte;
+        # latency is journaled once per stream window per rule)
+        noted: set = set()
+        m_bytes = self._m_bytes[direction]
+        try:
+            while not self._stop.is_set():
+                drip = next(
+                    (
+                        r for r in self.rules()
+                        if r.kind == "slowdrip"
+                        and r.applies(conn_id, direction)
+                    ),
+                    None,
+                )
+                bufsize = max(1, drip.drip_bytes) if drip else _BUFSIZE
+                try:
+                    data = src.recv(bufsize)
+                except OSError:
+                    break
+                # the rule snapshot is taken AFTER recv returns: the
+                # pump parks in recv for arbitrarily long, and a rule
+                # set swapped in meanwhile (the conductor's timed
+                # scenario) must apply to THIS chunk, not the next one
+                rules = [
+                    r for r in self.rules() if r.applies(conn_id, direction)
+                ]
+                if not data:
+                    # half-close: propagate the FIN but keep the reverse
+                    # pump alive (a one-sided shutdown is not a teardown
+                    # — the response may still be in flight), and do NOT
+                    # close src: the reverse pump writes to it
+                    try:
+                        dst.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+                    return
+                data, offset = self._apply(
+                    conn_id, direction, rules, data, offset, noted, dst,
+                )
+                if data is None:
+                    continue  # blackholed: swallowed, keep reading
+                try:
+                    dst.sendall(data)
+                except OSError:
+                    break
+                if m_bytes._on:
+                    m_bytes.inc(len(data))
+        except _Truncated:
+            # the mid-frame reset must be visible on BOTH sides: _apply
+            # already RST the destination; reset the source too
+            self._rst(src)
+            return
+        # error teardown (dead socket either side): close both so the
+        # reverse pump unblocks instead of waiting on a zombie stream
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _apply(
+        self, conn_id: int, direction: str, rules: list, data: bytes,
+        offset: int, noted: set, dst: socket.socket,
+    ) -> tuple:
+        """Run one chunk through the rule set; returns ``(bytes-or-None,
+        new_offset)``. Raises :class:`_Truncated` after a truncate_rst.
+        Offsets advance by the bytes CONSUMED from the source stream, so
+        byte-positioned schedules stay exact under any TCP chunking."""
+        n = len(data)
+        for r in rules:
+            if r.kind == "blackhole":
+                if "blackhole" not in noted:
+                    noted.add("blackhole")
+                    self._record(JournalEntry(
+                        conn_id, direction, "blackhole", offset
+                    ))
+                if self._m_dropped._on:
+                    self._m_dropped.inc(n)
+                return None, offset + n
+        # the earliest truncate point in this chunk bounds which flips
+        # exist AT ALL: flips strictly before it still mutate the
+        # forwarded prefix, flips at/after it target bytes that are
+        # never delivered. Resolving the bound FIRST keeps the applied
+        # schedule identical under any TCP chunking — checking
+        # truncate_rst before flipping used to silently skip a flip
+        # whose offset shared a recv chunk with the cut
+        rst_at = None
+        for r in rules:
+            if r.kind != "truncate_rst":
+                continue
+            if offset <= r.at_offset < offset + n and (
+                rst_at is None or r.at_offset < rst_at
+            ):
+                rst_at = r.at_offset
+        end = offset + n if rst_at is None else rst_at
+        if end > offset:
+            out = bytearray(data)
+            mutated = False
+            for r in rules:
+                if r.kind != "flip":
+                    continue
+                # normalize ONCE so the journal records exactly the mask
+                # applied (a multiple-of-256 xor_mask falls back to 0xFF,
+                # and the entry must say so or the digest lies)
+                mask = (r.xor_mask & 0xFF) or 0xFF
+                for fo in self._flip_offsets(r, offset, end):
+                    out[fo - offset] ^= mask
+                    mutated = True
+                    self._record(JournalEntry(
+                        conn_id, direction, "flip", fo, value=mask,
+                    ))
+            if mutated:
+                data = bytes(out)
+        if rst_at is not None:
+            keep = rst_at - offset
+            if keep:
+                try:
+                    dst.sendall(data[:keep])
+                except OSError:
+                    pass
+            self._record(JournalEntry(
+                conn_id, direction, "truncate_rst", rst_at
+            ))
+            self._rst(dst)
+            raise _Truncated()
+        for r in rules:
+            if r.kind == "latency":
+                # one draw per fixed stream window per rule: chunk
+                # boundaries cannot perturb the schedule (a chunk that
+                # spans K windows pays all K entries)
+                for w in range(
+                    offset // LAT_WINDOW, (offset + n - 1) // LAT_WINDOW + 1
+                ):
+                    key = ("latency", r, w)
+                    if key in noted:
+                        continue
+                    noted.add(key)
+                    jitter = (
+                        self._rng(conn_id, direction, "latency", w).random()
+                        * r.jitter_ms
+                        if r.jitter_ms > 0 else 0.0
+                    )
+                    delay = (r.delay_ms + jitter) / 1e3
+                    self._record(JournalEntry(
+                        conn_id, direction, "latency", w * LAT_WINDOW,
+                        value=round(r.delay_ms + jitter, 3),
+                    ))
+                    if delay > 0:
+                        time.sleep(delay)
+            elif r.kind == "throttle" and r.bytes_per_s > 0:
+                if "throttle" not in noted:
+                    noted.add("throttle")
+                    self._record(JournalEntry(
+                        conn_id, direction, "throttle", offset,
+                        value=r.bytes_per_s,
+                    ))
+                time.sleep(n / r.bytes_per_s)
+            elif r.kind == "slowdrip":
+                if "slowdrip" not in noted:
+                    noted.add("slowdrip")
+                    self._record(JournalEntry(
+                        conn_id, direction, "slowdrip", offset,
+                        value=r.drip_bytes,
+                    ))
+                time.sleep(r.drip_interval_ms / 1e3)
+        return data, offset + n
+
+    @staticmethod
+    def _flip_offsets(r: WireRule, lo: int, hi: int) -> list:
+        """Absolute flip offsets of rule ``r`` within ``[lo, hi)``."""
+        if r.every_bytes and r.every_bytes > 0:
+            first_k = max(0, -(-(lo - r.at_offset) // r.every_bytes))
+            out = []
+            fo = r.at_offset + first_k * r.every_bytes
+            while fo < hi:
+                if fo >= lo:
+                    out.append(fo)
+                fo += r.every_bytes
+            return out
+        return [r.at_offset] if lo <= r.at_offset < hi else []
+
+
+__all__ = [
+    "ChaosProxy",
+    "DIRECTIONS",
+    "JournalEntry",
+    "LAT_WINDOW",
+    "RULE_KINDS",
+    "WireRule",
+]

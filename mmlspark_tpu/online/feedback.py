@@ -54,6 +54,17 @@ _M_DEPTH = obs.gauge(
     "mmlspark_online_buffer_depth_count",
     "Feedback micro-batches buffered awaiting training",
 )
+_M_BUF_EXAMPLES = obs.gauge(
+    "mmlspark_online_buffered_examples_count",
+    "Feedback examples buffered awaiting training — a term of the "
+    "conservation law ingested == trained + buffered + shed + poisoned "
+    "(chaos/invariants.py)",
+)
+_M_SHED_EXAMPLES = obs.counter(
+    "mmlspark_online_shed_examples_total",
+    "Feedback examples in chunks deliberately shed by the bounded "
+    "buffer (freshest-wins) — accounted, never silently lost",
+)
 _M_REFUSED = obs.counter(
     "mmlspark_online_ingest_refused_total",
     "Ingest requests refused (injected fault or malformed rows)",
@@ -220,6 +231,7 @@ class FeedbackStream:
         freshest-wins policy, counted), so only genuinely-untrained
         pushes ever replay."""
         self._buf: deque = deque()  # (ingest_ts, DataFrame, seq-or-None)
+        self._buf_examples = 0      # running sum of len() over _buf
         self._cond = threading.Condition()
         self._max_chunks = max(1, int(max_chunks))
         self._now = time_fn
@@ -254,6 +266,7 @@ class FeedbackStream:
                 # restarts): clamp to "now", so a replayed chunk's age
                 # counts from replay — conservative, never garbage
                 self._buf.append((min(ts, now), chunk, seq))
+                self._buf_examples += len(chunk)
                 self._seq = max(self._seq, seq + 1)
                 self.replayed += len(chunk)
                 _M_SPILL_REPLAYED.inc(len(chunk))
@@ -261,14 +274,9 @@ class FeedbackStream:
             # oldest past max_chunks (freshest-wins holds across a
             # crash; the sheds are acked so they never replay again)
             while len(self._buf) > self._max_chunks:
-                _, shed, shed_seq = self._buf.popleft()
-                self.dropped += 1
-                self.dropped_examples += len(shed)
-                _M_DROPPED.inc()
-                if shed_seq is not None:
-                    self._mark_done_locked(shed_seq)
+                self._shed_oldest_locked()
             _M_SPILL_PENDING.set(self._spill_pending_locked())
-            _M_DEPTH.set(len(self._buf))
+            self._export_buf_locked()
 
     # -- construction --------------------------------------------------------
 
@@ -332,23 +340,33 @@ class FeedbackStream:
             if seq is not None:
                 _M_SPILL_PENDING.set(self._spill_pending_locked())
             self._buf.append((ts, chunk, seq))
+            self._buf_examples += len(chunk)
             if len(self._buf) > self._max_chunks:
-                _, shed, shed_seq = self._buf.popleft()
-                self.dropped += 1  # freshest-wins: shed the oldest
-                self.dropped_examples += len(shed)
-                _M_DROPPED.inc()
-                if shed_seq is not None:
-                    # a deliberate shed is HANDLED, not lost: ack it so
-                    # the spill does not resurrect rejected backlog
-                    self._mark_done_locked(shed_seq)
+                self._shed_oldest_locked()  # freshest-wins
             self.ingested += len(chunk)
-            _M_DEPTH.set(len(self._buf))
+            self._export_buf_locked()
             self._cond.notify()
         _M_INGESTED.inc(len(chunk))
         _M_CHUNKS.inc()
         return len(chunk)
 
     # -- spill acknowledgement -------------------------------------------------
+
+    def _shed_oldest_locked(self) -> None:
+        """Drop the oldest buffered chunk, keeping every term of the
+        conservation law (ingested == trained+buffered+shed+poisoned,
+        chaos/invariants.py) in one place for BOTH shed sites: live
+        overflow in push() and replayed-backlog overflow on restart. A
+        deliberate shed is HANDLED, not lost: acking it keeps the spill
+        from resurrecting rejected backlog."""
+        _, shed, shed_seq = self._buf.popleft()
+        self._buf_examples -= len(shed)
+        self.dropped += 1
+        self.dropped_examples += len(shed)
+        _M_DROPPED.inc()
+        _M_SHED_EXAMPLES.inc(len(shed))
+        if shed_seq is not None:
+            self._mark_done_locked(shed_seq)
 
     def _spill_pending_locked(self) -> int:
         return max(
@@ -389,7 +407,8 @@ class FeedbackStream:
             handed, self._handed = self._handed, []
             for seq, ts, chunk in reversed(handed):
                 self._buf.appendleft((ts, chunk, seq))
-            _M_DEPTH.set(len(self._buf))
+                self._buf_examples += len(chunk)
+            self._export_buf_locked()
 
     def spill_pending(self) -> int:
         """Spilled chunks not yet confirmed trained (0 without a spill)."""
@@ -397,6 +416,15 @@ class FeedbackStream:
             return 0
         with self._cond:
             return self._spill_pending_locked()
+
+    def _export_buf_locked(self) -> None:
+        """Export buffer depth in chunks AND examples (the latter is a
+        term of the invariant checker's conservation law). The example
+        count is an incrementally-maintained integer — recomputing the
+        sum under the condition lock would cost O(max_chunks) on every
+        ingest/pop and serialize producers against the consumer."""
+        _M_DEPTH.set(len(self._buf))
+        _M_BUF_EXAMPLES.set(self._buf_examples)
 
     # -- consumption ---------------------------------------------------------
 
@@ -413,11 +441,12 @@ class FeedbackStream:
         with self._cond:
             if self._buf:
                 ts0, chunk0, seq0 = self._buf.popleft()
+                self._buf_examples -= len(chunk0)
                 # seq may be None (no spill): still tracked, so
                 # nack_failed() can requeue a transiently-failed chunk
                 # on ANY stream, not only disk-backed ones
                 self._handed.append((seq0, ts0, chunk0))
-                _M_DEPTH.set(len(self._buf))
+                self._export_buf_locked()
                 return (ts0, chunk0)
         if self._source is not None and not self._exhausted:
             if self._iter is None:
@@ -441,8 +470,9 @@ class FeedbackStream:
                 self._cond.wait(timeout_s)
             if self._buf:
                 ts0, chunk0, seq0 = self._buf.popleft()
+                self._buf_examples -= len(chunk0)
                 self._handed.append((seq0, ts0, chunk0))
-                _M_DEPTH.set(len(self._buf))
+                self._export_buf_locked()
                 return (ts0, chunk0)
         return None
 

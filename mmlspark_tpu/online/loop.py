@@ -39,6 +39,11 @@ _M_PENDING = obs.gauge(
     "mmlspark_online_pending_examples_count",
     "Examples trained but not yet covered by a successful publication",
 )
+_M_POISONED = obs.counter(
+    "mmlspark_online_poisoned_examples_total",
+    "Examples in poison chunks discarded after repeated train-step "
+    "failures — accounted, never silently lost (chaos/invariants.py)",
+)
 
 
 class OnlineLearningLoop:
@@ -138,6 +143,7 @@ class OnlineLearningLoop:
                     # behind it goes stale
                     self._step_failures = 0
                     self.poisoned_chunks += 1
+                    _M_POISONED.inc(len(chunk))
                     ack = getattr(self.stream, "ack_trained", None)
                     if ack is not None:
                         ack()

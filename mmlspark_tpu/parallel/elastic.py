@@ -63,6 +63,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
 
@@ -105,6 +106,38 @@ _M_ALLREDUCE = obs.histogram(
     "mmlspark_elastic_allreduce_seconds",
     "Gang histogram-allreduce wall time (TCP full mesh)",
 )
+_M_CRC_DROPS = obs.counter(
+    "mmlspark_elastic_crc_failures_total",
+    "Allreduce frames dropped because their payload CRC32 did not match "
+    "— wire corruption detected instead of silently summed",
+)
+_M_RETRANSMITS = obs.counter(
+    "mmlspark_elastic_retransmits_total",
+    "Allreduce frames re-sent after a peer's corruption NACK",
+)
+
+
+# -- the allreduce wire frame --------------------------------------------------
+#
+# v2 head (32 bytes): gen(q) seq(q) nonce(I) crc(I) name_len(i) nbytes(i).
+# ``crc`` is the payload's CRC32 — v1 (`<qqIii`) carried NO checksum, so
+# one flipped bit on the wire was silently summed into every member's
+# identical histograms (the worst possible failure: bit-identical and
+# wrong everywhere). A receiver that sees a CRC mismatch DROPS the frame,
+# counts it, and answers with a NACK control frame (nbytes == -1, no
+# payload); the sender retransmits from its recent-frame cache. A frame
+# that stays missing past the allreduce timeout is the ordinary peer-loss
+# path — corruption can delay a round or evict a peer, never corrupt a sum.
+_FRAME_HEAD = "<qqIIii"
+_FRAME_HEAD_LEN = struct.calcsize(_FRAME_HEAD)
+_NACK_NBYTES = -1
+# sanity bounds: a bit-flip inside the HEAD desyncs the stream — refuse
+# to interpret absurd lengths and drop the connection instead (the
+# sender reconnects; the frame re-requests or times out into peer-loss).
+# 1 GiB is far above any real histogram frame but well below int32 max,
+# so a high-bit flip in nbytes cannot command a giant blocking read
+_MAX_NAME_LEN = 256
+_MAX_FRAME_BYTES = 1 << 30
 
 
 class HostLostError(RuntimeError):
@@ -276,13 +309,20 @@ class GangMember:
         advertise_host: str = "127.0.0.1",
         heartbeat_s: float = 1.0,
         artifact_store: Any = None,
+        listen_port: int = 0,
+        advertise_port: Optional[int] = None,
     ):
         """``artifact_store`` (serving/artifacts.py ArtifactStore): when
         given, this member also runs a tiny artifact ingress (ranged
         ``GET /artifacts/<digest>``) and advertises the store's contents
         on every heartbeat — checkpoint snapshots become pullable from
         any surviving peer, so the gang no longer needs a shared
-        checkpoint directory."""
+        checkpoint directory.
+
+        ``listen_port``/``advertise_port``: fix the allreduce listener
+        port and/or advertise a DIFFERENT port on the roster — how a
+        member's allreduce link is pointed through a chaos proxy (peers
+        dial the advertised port; chaos/wire.py) or through real NAT."""
         from mmlspark_tpu.serving.fleet import split_registry_urls
 
         self.registry_urls = split_registry_urls(registry_urls)
@@ -313,11 +353,22 @@ class GangMember:
         self._stop = threading.Event()
         # allreduce frame listener (one across generations; the port is
         # what peers learn from the roster)
-        self._inbox: dict = {}          # (gen, seq, sender) -> bytes
+        self._inbox: dict = {}          # (gen, nonce, seq, sender) -> bytes
         self._inbox_cond = threading.Condition()
-        self._srv = socket.create_server(("0.0.0.0", 0))
+        # CRC accounting: frames dropped for checksum mismatch; the keys
+        # stay recorded so the waiting allreduce re-NACKs until the
+        # retransmit lands (a lost NACK must not strand the round)
+        self.crc_drops = 0
+        self._crc_dropped: set = set()
+        # the active TcpReducer (if any): the read loop's back-channel
+        # for NACK-triggered retransmits
+        self._reducer: Any = None
+        self._srv = socket.create_server(("0.0.0.0", int(listen_port)))
         self._srv.settimeout(0.5)
         self.port = self._srv.getsockname()[1]
+        self.advertise_port = (
+            int(advertise_port) if advertise_port else self.port
+        )
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name=f"gang-listen-{name}", daemon=True
         )
@@ -346,18 +397,49 @@ class GangMember:
             conn.settimeout(None)
             f = conn.makefile("rb")
             while not self._stop.is_set():
-                head = f.read(28)
-                if len(head) < 28:
+                head = f.read(_FRAME_HEAD_LEN)
+                if len(head) < _FRAME_HEAD_LEN:
                     return
-                gen, seq, nonce, name_len, nbytes = struct.unpack(
-                    "<qqIii", head
+                gen, seq, nonce, crc, name_len, nbytes = struct.unpack(
+                    _FRAME_HEAD, head
                 )
-                sender = f.read(name_len).decode("utf-8")
+                if not 0 < name_len <= _MAX_NAME_LEN or nbytes > \
+                        _MAX_FRAME_BYTES or (
+                            nbytes < 0 and nbytes != _NACK_NBYTES
+                        ):
+                    # a bit-flip inside the HEAD desyncs the stream:
+                    # refuse to interpret garbage lengths — drop the
+                    # connection (the sender reconnects; the missing
+                    # frame re-requests or times out into peer-loss)
+                    self.crc_drops += 1
+                    _M_CRC_DROPS.inc()
+                    return
+                sender = f.read(name_len).decode("utf-8", "replace")
+                if nbytes == _NACK_NBYTES:
+                    # corruption NACK: the peer received our (gen, seq)
+                    # frame torn — retransmit from the reducer's cache
+                    red = self._reducer
+                    if red is not None:
+                        red.handle_nack(sender, gen, nonce, seq)
+                    continue
                 payload = f.read(nbytes)
                 if len(payload) < nbytes:
                     return
+                key = (gen, nonce, seq, sender)
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    # detected wire corruption: a dropped frame (and a
+                    # NACK back), NEVER a silently wrong sum
+                    self.crc_drops += 1
+                    _M_CRC_DROPS.inc()
+                    with self._inbox_cond:
+                        self._crc_dropped.add(key)
+                    red = self._reducer
+                    if red is not None:
+                        red.send_nack(sender, gen, nonce, seq)
+                    continue
                 with self._inbox_cond:
-                    self._inbox[(gen, nonce, seq, sender)] = payload
+                    self._inbox[key] = payload
+                    self._crc_dropped.discard(key)
                     self._inbox_cond.notify_all()
         except Exception:  # noqa: BLE001 — a dead peer's conn just ends
             pass
@@ -385,6 +467,23 @@ class GangMember:
         with self._inbox_cond:
             for key in [k for k in self._inbox if k[0] < current_gen]:
                 del self._inbox[key]
+            for key in [k for k in self._crc_dropped if k[0] < current_gen]:
+                self._crc_dropped.discard(key)
+
+    def crc_dropped(self, key: tuple) -> bool:
+        """Was ``(gen, nonce, seq, sender)`` dropped for a bad CRC (and
+        not yet replaced by a clean retransmit)? The allreduce waiter
+        re-NACKs such keys each roster check — a lost NACK must not
+        strand the round until the timeout."""
+        with self._inbox_cond:
+            return key in self._crc_dropped
+
+    def _attach_reducer(self, reducer: Any) -> None:
+        self._reducer = reducer
+
+    def _detach_reducer(self, reducer: Any) -> None:
+        if self._reducer is reducer:
+            self._reducer = None
 
     # -- registration ---------------------------------------------------------
 
@@ -392,7 +491,7 @@ class GangMember:
         reg = {
             "name": f"{self.service}-gang",
             "host": self.name,
-            "port": self.port,
+            "port": self.advertise_port,
             "addr": self.advertise_host,
             "boot": self.boot,
             "ewma_ms": round(self.ewma_s * 1e3, 3),
@@ -441,11 +540,17 @@ class GangMember:
                 or sorted(cur.members) != sorted(gen.members)
             ):
                 self._adopted = gen = cur
+        # explicit short per-call budget: a blackholed registry must cost
+        # a bounded slice of the beat, never park the heartbeat thread
+        # (pinned by the chaos-proxy blackhole test)
+        from mmlspark_tpu.serving.fleet import beat_timeout
+
+        timeout = beat_timeout(self.heartbeat_s, factor=2.0)
         for url in self.registry_urls:
             try:
-                _post_json(url, self._registration())
+                _post_json(url, self._registration(), timeout=timeout)
                 if gen is not None:
-                    _post_json(url, self._gen_payload(gen))
+                    _post_json(url, self._gen_payload(gen), timeout=timeout)
             except Exception:  # noqa: BLE001 — registry may be restarting
                 pass
 
@@ -616,7 +721,7 @@ class GangMember:
                         url, "DELETE", {"Content-Type": "application/json"},
                         json.dumps({
                             "name": f"{self.service}-gang",
-                            "host": self.name, "port": self.port,
+                            "host": self.name, "port": self.advertise_port,
                         }),
                     ),
                     timeout=5.0,
@@ -662,8 +767,6 @@ class TcpReducer:
         # frames from an aborted same-gen-number incarnation (the
         # membership-conflict path) key differently and can never be
         # consumed as this incarnation's sums
-        import zlib
-
         self.nonce = zlib.crc32(json.dumps(
             [generation.gen, sorted(generation.members),
              generation.resume_round, generation.committer],
@@ -671,8 +774,15 @@ class TcpReducer:
         self.seq = 0
         self._conns: dict = {}
         self._send_lock = threading.Lock()
+        # recent outgoing frames, keyed (gen, nonce, seq): the
+        # retransmit source when a peer NACKs a CRC-torn frame. The gang
+        # is SPMD-lockstep, so peers only ever NACK the last few seqs
+        self._sent_frames: dict = {}
+        self._sent_cap = 4
+        self.retransmits = 0
         self.world = len(self.members)
         member.drop_stale_frames(self.gen)
+        member._attach_reducer(self)
 
     def _conn(self, peer: str) -> socket.socket:
         c = self._conns.get(peer)
@@ -702,11 +812,17 @@ class TcpReducer:
         x = np.ascontiguousarray(np.asarray(arr, np.float64))
         seq = self.seq
         self.seq += 1
+        payload = x.tobytes()
         head = struct.pack(
-            "<qqIii", self.gen, seq, self.nonce,
+            _FRAME_HEAD, self.gen, seq, self.nonce,
+            zlib.crc32(payload) & 0xFFFFFFFF,
             len(self.me.encode()), x.nbytes,
         )
-        frame = head + self.me.encode() + x.tobytes()
+        frame = head + self.me.encode() + payload
+        with self._send_lock:
+            self._sent_frames[(self.gen, self.nonce, seq)] = frame
+            while len(self._sent_frames) > self._sent_cap:
+                del self._sent_frames[next(iter(self._sent_frames))]
         peers = [m for m in self.members if m != self.me]
 
         def send_to(targets: list) -> list:
@@ -743,6 +859,14 @@ class TcpReducer:
                 next_roster_check = now + 0.5
                 if unsent:
                     unsent = send_to(unsent)
+                for p in missing:
+                    # a frame we dropped for bad CRC: re-NACK until the
+                    # clean retransmit lands (the first NACK — sent by
+                    # the read loop — may itself have been lost)
+                    if self.member.crc_dropped(
+                        (self.gen, self.nonce, seq, p)
+                    ):
+                        self.send_nack(p, self.gen, self.nonce, seq)
                 # one shared loss policy with on_round (blindness is
                 # not death; grace debounces): GangMember.declared_dead
                 dead = self.member.declared_dead(
@@ -776,7 +900,40 @@ class TcpReducer:
         _M_ALLREDUCE.observe(time.perf_counter() - t0)
         return total.reshape(x.shape).astype(np.asarray(arr).dtype)
 
+    def send_nack(self, peer: str, gen: int, nonce: int, seq: int) -> None:
+        """Tell ``peer`` its (gen, seq) frame arrived torn — control
+        frame with ``nbytes == -1``; the peer retransmits from its
+        recent-frame cache. Best-effort: a lost NACK is re-sent by the
+        waiting allreduce at its next roster check."""
+        head = struct.pack(
+            _FRAME_HEAD, gen, seq, nonce, 0,
+            len(self.me.encode()), _NACK_NBYTES,
+        )
+        with self._send_lock:
+            try:
+                self._conn(peer).sendall(head + self.me.encode())
+            except (OSError, HostLostError):
+                self._conns.pop(peer, None)
+
+    def handle_nack(self, peer: str, gen: int, nonce: int, seq: int) -> None:
+        """A peer reported our frame corrupt: retransmit it. Called from
+        the member's read loop thread; a frame no longer cached (ancient
+        seq, different incarnation) is ignored — the peer's timeout path
+        handles it as peer-loss."""
+        with self._send_lock:
+            frame = self._sent_frames.get((gen, nonce, seq))
+            if frame is None:
+                return
+            try:
+                self._conn(peer).sendall(frame)
+            except (OSError, HostLostError):
+                self._conns.pop(peer, None)
+                return
+        self.retransmits += 1
+        _M_RETRANSMITS.inc()
+
     def close(self) -> None:
+        self.member._detach_reducer(self)
         for c in self._conns.values():
             try:
                 c.close()
@@ -1232,6 +1389,8 @@ class ElasticTrainer:
         status_file: Optional[str] = None,
         allow_growback: bool = True,
         artifact_dir: Optional[str] = None,
+        allreduce_port: int = 0,
+        advertise_allreduce_port: Optional[int] = None,
     ):
         """``artifact_dir``: enables **artifact mode** — ``ckpt_dir`` is
         treated as HOST-LOCAL (every member writes its own checkpoints),
@@ -1262,6 +1421,12 @@ class ElasticTrainer:
         self.status_file = status_file
         self.allow_growback = allow_growback
         self.artifact_dir = artifact_dir
+        # chaos-proxy/NAT support: bind the allreduce listener to a fixed
+        # port and/or advertise a different one on the roster (peers dial
+        # the advertised port — e.g. a ChaosProxy in front of this host)
+        self.allreduce_port = int(allreduce_port)
+        self.advertise_allreduce_port = advertise_allreduce_port
+        self._member: Any = None
         self._store: Any = None
         if artifact_dir:
             from mmlspark_tpu.serving.artifacts import ArtifactStore
@@ -1281,7 +1446,7 @@ class ElasticTrainer:
             "snapshot": None, "detect_latency_s": None,
             "reshard_to_first_round_s": None, "rounds_per_s_pre": None,
             "rounds_per_s_post": None, "done": False,
-            "artifact_fetches": 0,
+            "artifact_fetches": 0, "crc_drops": 0, "retransmits": 0,
         }
 
     # -- status ---------------------------------------------------------------
@@ -1289,6 +1454,8 @@ class ElasticTrainer:
     def _write_status(self) -> None:
         if not self.status_file:
             return
+        if self._member is not None:
+            self.status["crc_drops"] = self._member.crc_drops
         tmp = self.status_file + f".tmp-{os.getpid()}"
         try:
             with open(tmp, "w") as f:
@@ -1315,7 +1482,10 @@ class ElasticTrainer:
             advertise_host=self.advertise_host,
             heartbeat_s=self.heartbeat_s,
             artifact_store=self._store,
+            listen_port=self.allreduce_port,
+            advertise_port=self.advertise_allreduce_port,
         )
+        self._member = member
         try:
             self._resolve_resume_from(member)
             gen = member.await_generation(
@@ -1443,6 +1613,8 @@ class ElasticTrainer:
                 self._reshard(member, gen, abort)
             return None
         finally:
+            if reducer is not None:
+                self.status["retransmits"] += reducer.retransmits
             gang.close()
 
     def _resolve_resume_from(self, member: GangMember) -> None:

@@ -727,7 +727,15 @@ class ArtifactStore:
                 received = 0
                 with open(part, "ab" if start else "wb") as out:
                     while True:
-                        b = resp.read(_CHUNK)
+                        # read1, NOT read: read(n) blocks until n bytes
+                        # accumulate inside the BufferedReader, and a
+                        # reset mid-chunk throws that buffer away — on a
+                        # slow link a mid-frame RST lost every byte of a
+                        # 64 KiB chunk in flight, leaving NOTHING for the
+                        # Range resume (measured via the chaos proxy's
+                        # truncate_rst rule). read1 surfaces each arrived
+                        # chunk immediately, so progress hits the disk
+                        b = resp.read1(_CHUNK)
                         if not b:
                             break
                         out.write(b)

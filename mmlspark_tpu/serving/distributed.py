@@ -894,6 +894,7 @@ class ServingGateway:
         retry_budget_window_s: float = 10.0,
         retry_budget_min: int = 3,
         num_reactors: int = 1,
+        header_deadline_s: Optional[float] = 30.0,
     ):
         """``hedge_ms``: tail-latency hedging — a request still pending
         after this many ms is duplicated to a second backend, first
@@ -912,6 +913,9 @@ class ServingGateway:
         self._ingress = WorkerServer(
             host=host, port=port, name=f"{service_name}-gateway",
             num_reactors=num_reactors,
+            # slowloris defense at the front door (serving/server.py):
+            # a dripped head is shed 408 at this deadline
+            header_deadline_s=header_deadline_s,
         )
         if evict_after is None:
             # eviction only makes sense with a registry: its refresh is the
@@ -1402,6 +1406,7 @@ class ServingGateway:
                 extra[DEADLINE_HEADER] = f"{remaining_ms:.1f}"
             target = self._target_for(req, b)
             sent = False
+            read_started = False
             t_attempt = time.perf_counter()
             try:
                 # fault point gateway.forward: an injected OSError here is
@@ -1458,6 +1463,7 @@ class ServingGateway:
                         "gateway.response",
                         context={"backend": (b.host, b.port), "attempt": attempt},
                     )
+                    read_started = True
                     try:
                         resp = conn.read_response()
                     except OSError as e:
@@ -1485,6 +1491,19 @@ class ServingGateway:
             except (OSError, http.client.HTTPException) as e:
                 self._drop_conn(b)
                 timed_out_after_send = sent and isinstance(e, TimeoutError)
+                # a response that STARTED and then died (reset/close with
+                # partial bytes seen) proves the worker executed the
+                # request — only the reply was torn on the wire. Like the
+                # post-send timeout, re-dispatching would double-process
+                # a non-idempotent POST; unlike it, the evidence here is
+                # positive (bytes arrived), so this holds even for
+                # non-timeout errors (a chaos proxy's truncate-then-RST
+                # mid-frame, a dying NIC). retry_after_send opts
+                # idempotent handlers back into re-dispatch.
+                truncated_response = (
+                    read_started and conn.last_resp_bytes > 0
+                    and not isinstance(e, TimeoutError)
+                )
                 if timed_out_after_send and not self._retry_after_send:
                     # the worker may be mid-execution (slow, not dead):
                     # re-dispatching would double-process a non-idempotent
@@ -1499,11 +1518,24 @@ class ServingGateway:
                         b'sent"}',
                     )
                     return
+                if truncated_response and not self._retry_after_send:
+                    # the connection-level failure is still real evidence
+                    # against the path — count it (repeats open the
+                    # breaker and traffic routes around the torn link)
+                    self._pool.report_failure(b)
+                    self._fail(
+                        req, "truncated_response", 502,
+                        b'{"error": "worker response truncated after '
+                        b'execution"}',
+                    )
+                    return
                 # the cross-worker replay: this worker is down or died
-                # mid-request (refused connect OR a half-written response
-                # — IncompleteRead/BadStatusLine are HTTPException, not
-                # OSError); cool it down and re-dispatch elsewhere — IF
-                # the retry budget still has tokens. An exhausted budget
+                # before sending any reply byte (refused connect, or a
+                # zero-byte failure — the truncated_response guard above
+                # already intercepted half-written responses unless
+                # retry_after_send opted in); cool it down and
+                # re-dispatch elsewhere — IF the retry budget still has
+                # tokens. An exhausted budget
                 # fails fast: under a brownout, every request retrying
                 # its full attempt tab multiplies the offered load
                 # exactly when capacity is lowest

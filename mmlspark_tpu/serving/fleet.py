@@ -77,19 +77,38 @@ def split_registry_urls(registry_url: Any) -> list:
     return list(registry_url)
 
 
+def beat_timeout(heartbeat_s: float, factor: float = 1.0) -> float:
+    """Socket timeout for one registry heartbeat/deregister call: short
+    and explicit — a blackholed registry (asymmetric partition, chaos
+    proxy) must cost a bounded slice of the beat period, never the
+    transport default. ONE clamp for every role's beat policy."""
+    return max(1.0, min(3.0, factor * float(heartbeat_s)))
+
+
 class _WorkerStopper:
     """Shutdown handle for a fleet worker: stops the heartbeat AND
     deregisters from every registry, so a clean SIGTERM removes the
     roster entries immediately instead of leaving them stale until TTL
     expiry or gateway-failure eviction. Keeps the Event surface (``set``/
-    ``is_set``/``wait``) callers and tests already use."""
+    ``is_set``/``wait``) callers and tests already use.
 
-    def __init__(self, ev: threading.Event, registry_url: str, info: Any):
+    Every registry HTTP call carries an explicit SHORT socket timeout
+    (``beat_timeout_s``): a blackholed registry (asymmetric partition,
+    chaos proxy) costs one bounded beat, never parks the heartbeat
+    thread — and can never hang a clean SIGTERM shutdown (the TTL covers
+    a goodbye the registry never heard)."""
+
+    def __init__(self, ev: threading.Event, registry_url: str, info: Any,
+                 beat_timeout_s: float = 3.0):
         self._ev = ev
         self._registry_urls = split_registry_urls(registry_url)
         self._info = info
         self._beat: Optional[threading.Thread] = None
+        self.beat_timeout_s = float(beat_timeout_s)
         self.slo_engine: Any = None
+        # the serving pieces a graceful drain sequences (run_worker sets
+        # them); None leaves drain() equivalent to set()
+        self._srv: Any = None
 
     def set(self) -> None:
         from mmlspark_tpu.serving.registry import DriverRegistry
@@ -101,12 +120,16 @@ class _WorkerStopper:
             self.slo_engine.stop()
         if self._beat is not None:
             # no heartbeat may land AFTER the goodbye, or the entry would
-            # resurrect until the next expiry — so outwait even a register
-            # POST stuck at its full 10 s send_request timeout
-            self._beat.join(12.0)
+            # resurrect until the next expiry — outwait a beat stuck at
+            # its full (short, explicit) timeout against every registry
+            self._beat.join(
+                2.0 + self.beat_timeout_s * max(1, len(self._registry_urls))
+            )
         for url in self._registry_urls:
             try:
-                DriverRegistry.deregister(url, self._info)
+                DriverRegistry.deregister(
+                    url, self._info, timeout=self.beat_timeout_s
+                )
             except Exception as e:  # noqa: BLE001 — registry may already be gone
                 print(
                     f"worker: deregister from {url} failed: {e}",
@@ -114,6 +137,35 @@ class _WorkerStopper:
                 )
 
     stop = set
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Graceful-drain lifecycle for a fleet roll (SIGTERM path):
+        deregister (gateways stop routing within one roster refresh) ->
+        stop accepting new connections -> wait until every accepted
+        request AND staged continuous batch has been replied to. The
+        caller then stops the dispatcher and ingress as usual — with
+        zero dropped requests (pinned by the rolling-restart drill)."""
+        self.set()
+        # the goodbye above is separately bounded (every registry call
+        # carries beat_timeout_s); the drain budget starts AFTER it, or
+        # a blackholed registry would eat the whole timeout and starve
+        # the in-flight wait down to its 0.5 s floor — dropping exactly
+        # the requests the drain exists to protect
+        t0 = time.monotonic()
+        if self._srv is None:
+            return True
+        # the deregistration must propagate: gateways refresh their
+        # roster every ~1 s and prune pooled connections on the refresh
+        time.sleep(min(2.0, timeout_s / 3))
+        self._srv.pause_accepting()
+        remaining = timeout_s - (time.monotonic() - t0)
+        drained = self._srv.drain_inflight(max(0.5, remaining))
+        if not drained:
+            print(
+                "worker: drain timed out with requests still in flight",
+                file=sys.stderr, flush=True,
+            )
+        return drained
 
     def is_set(self) -> bool:
         return self._ev.is_set()
@@ -163,6 +215,7 @@ def run_worker(
     admission_initial_limit: int = 32,
     artifact_dir: Optional[str] = None,
     reactors: int = 2,
+    header_deadline_s: Optional[float] = 15.0,
 ) -> tuple:
     """Start a ModelStore-backed worker, register it, and re-register on a
     heartbeat thread (a restarted registry re-learns live workers within
@@ -195,6 +248,10 @@ def run_worker(
     # request intake; unit-level WorkerServer keeps the single loop
     srv = WorkerServer(
         host=host, port=port, name=service_name, num_reactors=reactors,
+        # hostile-client hardening (docs/chaos.md): fleet workers face
+        # real networks, so the slowloris deadline defaults tighter
+        # than the unit-level WorkerServer's
+        header_deadline_s=header_deadline_s,
     )
     info = srv.start()
     from mmlspark_tpu import obs
@@ -249,7 +306,11 @@ def run_worker(
         info = dataclasses.replace(info, host=advertise_host)
     info = dataclasses.replace(info, models=tuple(n for n, _ in specs))
     stop = threading.Event()
-    stopper = _WorkerStopper(stop, registry_url, info)
+    beat_timeout_s = beat_timeout(heartbeat_s)
+    stopper = _WorkerStopper(
+        stop, registry_url, info, beat_timeout_s=beat_timeout_s
+    )
+    stopper._srv = srv
     stopper.slo_engine = _start_slo_engine(
         service_name, slo_targets, slo_availability, slo_p99_ms,
         slo_interval_s,
@@ -274,7 +335,9 @@ def run_worker(
                         # re-advertise the store's CURRENT models each beat:
                         # a model loaded at runtime through the control plane
                         # becomes gateway-routable within one heartbeat
-                        DriverRegistry.register(url, fresh)
+                        DriverRegistry.register(
+                            url, fresh, timeout=beat_timeout_s
+                        )
                 except Exception as e:  # noqa: BLE001 — may be restarting
                     print(
                         f"worker: register to {url} failed: {e}",
@@ -360,13 +423,13 @@ def scrape_metrics(url: str, timeout: float = 5.0) -> Optional[dict]:
     return obs.parse_text(body)
 
 
-def worker_urls_from_registry(
+def roster_entries_from_registry(
     registry_url: str, service_name: str = "serving", timeout: float = 5.0
 ) -> list:
-    """Roster -> worker base URLs (preferring forwarded endpoints).
-    ``registry_url`` may be comma-separated (registry HA): the first
-    live registry answers. Raises when EVERY registry is unreachable —
-    callers decide how to degrade."""
+    """Roster -> raw entry dicts for one service (host/port plus any
+    forwarded endpoint). ``registry_url`` may be comma-separated
+    (registry HA): the first live registry answers. Raises when EVERY
+    registry is unreachable — callers decide how to degrade."""
     from mmlspark_tpu.io.clients import send_request
     from mmlspark_tpu.io.http_schema import HTTPRequestData
 
@@ -382,16 +445,25 @@ def worker_urls_from_registry(
                     f"registry {url} answered {resp['status_code']}"
                 )
             roster = json.loads(resp["entity"])
-            return [
-                f"http://{i.get('forwarded_host') or i['host']}"
-                f":{i.get('forwarded_port') or i['port']}"
-                for i in roster.get(service_name, [])
-            ]
+            return list(roster.get(service_name, []))
         except Exception as e:  # noqa: BLE001 — try the next registry
             last_err = e
     raise ConnectionError(
         f"no live registry among {registry_url!r}: {last_err}"
     )
+
+
+def worker_urls_from_registry(
+    registry_url: str, service_name: str = "serving", timeout: float = 5.0
+) -> list:
+    """Roster -> worker base URLs (preferring forwarded endpoints)."""
+    return [
+        f"http://{i.get('forwarded_host') or i['host']}"
+        f":{i.get('forwarded_port') or i['port']}"
+        for i in roster_entries_from_registry(
+            registry_url, service_name, timeout
+        )
+    ]
 
 
 def _hist_stats(parsed: dict, name: str, match: Optional[dict] = None) -> tuple:
@@ -691,6 +763,7 @@ def run_gateway(
     breaker_cooldown_s: float = 5.0,
     reactors: int = 2,
     num_dispatchers: int = 4,
+    header_deadline_s: Optional[float] = 15.0,
 ) -> Any:
     from mmlspark_tpu import obs
     from mmlspark_tpu.serving.distributed import ServingGateway
@@ -701,6 +774,7 @@ def run_gateway(
         retry_budget_ratio=retry_budget_ratio,
         cooldown_s=breaker_cooldown_s,
         num_reactors=reactors, num_dispatchers=num_dispatchers,
+        header_deadline_s=header_deadline_s,
     )
     ginfo = gw.start()
     obs.set_process_label(
@@ -743,6 +817,8 @@ def run_train(
     out_model: Optional[str] = None,
     allow_growback: bool = True,
     artifact_dir: Optional[str] = None,
+    allreduce_port: int = 0,
+    advertise_allreduce_port: Optional[int] = None,
 ) -> Any:
     """``fleet train``: one elastic training host (parallel/elastic.py).
 
@@ -782,6 +858,8 @@ def run_train(
         evict_stragglers=evict_stragglers, min_world=min_world,
         status_file=status_file, allow_growback=allow_growback,
         artifact_dir=artifact_dir,
+        allreduce_port=allreduce_port,
+        advertise_allreduce_port=advertise_allreduce_port,
     )
     booster = trainer.run()
     model = booster.to_model_string()
@@ -1002,6 +1080,7 @@ def run_online(
         info = dataclasses.replace(info, host=advertise_host)
     stop = threading.Event()
     registry_urls = split_registry_urls(registry_url)
+    beat_timeout_s = beat_timeout(heartbeat_s)
 
     def beat() -> None:
         while not stop.is_set():
@@ -1016,7 +1095,11 @@ def run_online(
             for url in registry_urls:
                 try:
                     if not stop.is_set():
-                        DriverRegistry.register(url, fresh)
+                        # explicit short timeout: a blackholed registry
+                        # must not park the heartbeat thread
+                        DriverRegistry.register(
+                            url, fresh, timeout=beat_timeout_s,
+                        )
                 except Exception as e:  # noqa: BLE001 — may be restarting
                     print(
                         f"online: register to {url} failed: {e}",
@@ -1177,6 +1260,18 @@ def main(argv: Optional[list] = None) -> None:
         help="ingress event loops sharing the listening socket (one slow "
         "client stalls only its own reactor; docs/serving.md)",
     )
+    w.add_argument(
+        "--header-deadline-s", type=float, default=15.0,
+        help="slowloris shed: a request's full head (and body, floored "
+        "at 256 KiB/s) must arrive within this budget of its first byte "
+        "or the connection is answered 408 and closed (docs/chaos.md)",
+    )
+    w.add_argument(
+        "--drain-s", type=float, default=10.0,
+        help="on SIGTERM: deregister, stop accepting, and finish every "
+        "accepted request (incl. staged continuous batches) for up to "
+        "this long before exiting (0 = stop immediately; docs/chaos.md)",
+    )
 
     def add_slo_flags(p) -> None:
         p.add_argument(
@@ -1229,6 +1324,12 @@ def main(argv: Optional[list] = None) -> None:
         "--dispatchers", type=int, default=4,
         help="forwarding threads (each keeps its own keep-alive "
         "connection per backend)",
+    )
+    g.add_argument(
+        "--header-deadline-s", type=float, default=15.0,
+        help="slowloris shed at the gateway front door: a request's "
+        "full head must arrive within this budget of its first byte "
+        "(408 + close; docs/chaos.md)",
     )
     add_slo_flags(g)
     sv = sub.add_parser(
@@ -1408,6 +1509,16 @@ def main(argv: Optional[list] = None) -> None:
         "content-addressed artifacts pulled over HTTP from surviving "
         "peers — no shared checkpoint filesystem (docs/artifacts.md)",
     )
+    tn.add_argument(
+        "--allreduce-port", type=int, default=0,
+        help="fix the allreduce listener port (default: ephemeral)",
+    )
+    tn.add_argument(
+        "--advertise-allreduce-port", type=int, default=None,
+        help="advertise THIS port on the roster instead of the bound "
+        "one — peers dial it, so the member's allreduce link can be "
+        "pointed through a chaos proxy or NAT (docs/chaos.md)",
+    )
     t = sub.add_parser(
         "top", help="scrape /metrics across the fleet, print a summary"
     )
@@ -1448,6 +1559,36 @@ def main(argv: Optional[list] = None) -> None:
         help="how many traces to render, worst first",
     )
     add_trace_endpoint_flags(trs)
+    ch = sub.add_parser(
+        "chaos",
+        help="drive a timed hostile-wire scenario against a live fleet: "
+        "seeded TCP chaos proxies + process signals + the invariant "
+        "checker (mmlspark_tpu/chaos/; docs/chaos.md)",
+    )
+    ch.add_argument(
+        "--scenario", required=True,
+        help="scenario JSON (inline or a file path): seed + timed steps "
+        "(rules / clear / signal / check / sleep / mark)",
+    )
+    ch.add_argument(
+        "--proxy", action="append", default=[],
+        metavar="NAME=LISTEN_PORT:TARGET_HOST:TARGET_PORT",
+        help="one chaos proxy the scenario's rules/clear steps address "
+        "by NAME (repeatable); point the fleet link at LISTEN_PORT",
+    )
+    ch.add_argument(
+        "--pid", action="append", default=[], metavar="NAME=PID",
+        help="one process the scenario's signal steps address by NAME "
+        "(repeatable)",
+    )
+    ch.add_argument("--gateway", default=None,
+                    help="gateway base URL for the check step's invariants")
+    ch.add_argument("--registry", default=None,
+                    help="registry base URL (resolves worker /metrics "
+                    "endpoints for the invariant checker)")
+    ch.add_argument("--service-name", default="serving")
+    ch.add_argument("--seed", type=int, default=None,
+                    help="override the scenario's seed")
     m = sub.add_parser(
         "model",
         help="model lifecycle control against a worker or gateway "
@@ -1483,6 +1624,14 @@ def main(argv: Optional[list] = None) -> None:
 
         FaultPlan.from_spec(args.fault_plan).install()
         print(f"fleet: fault plan armed ({args.fault_plan})", flush=True)
+    if args.role == "chaos":
+        from mmlspark_tpu.chaos.conductor import run_chaos_cli
+
+        raise SystemExit(run_chaos_cli(
+            args.scenario, args.proxy, args.pid,
+            gateway_url=args.gateway, registry_url=args.registry,
+            service_name=args.service_name, seed=args.seed,
+        ))
     if args.role == "model":
         raise SystemExit(run_model_verb(
             args.action, args.url, name=args.name, spec=args.spec,
@@ -1537,6 +1686,8 @@ def main(argv: Optional[list] = None) -> None:
             status_file=args.status_file, out_model=args.out_model,
             allow_growback=not args.no_growback,
             artifact_dir=args.artifact_dir,
+            allreduce_port=args.allreduce_port,
+            advertise_allreduce_port=args.advertise_allreduce_port,
         )
     elif args.role == "registry":
         from mmlspark_tpu.obs.flightrec import install_sigusr1
@@ -1564,8 +1715,12 @@ def main(argv: Optional[list] = None) -> None:
             admission_initial_limit=args.admission_initial_limit,
             artifact_dir=args.artifact_dir,
             reactors=args.reactors,
+            header_deadline_s=args.header_deadline_s or None,
         )
-        _serve_forever([stop, q, srv])
+        # SIGTERM with --drain-s: stop.drain() deregisters, pauses
+        # accepting and waits out in-flight work; then q/srv stop as
+        # usual — the graceful-drain lifecycle (docs/chaos.md)
+        _serve_forever([stop, q, srv], drain_s=args.drain_s)
     elif args.role == "supervise":
         if not args.worker and not args.train:
             ap.error("supervise needs at least one --worker or --train")
@@ -1619,6 +1774,7 @@ def main(argv: Optional[list] = None) -> None:
             breaker_cooldown_s=args.breaker_cooldown_s,
             reactors=args.reactors,
             num_dispatchers=args.dispatchers,
+            header_deadline_s=args.header_deadline_s or None,
         )
         _serve_forever([gw], drain_s=args.drain_s)
 

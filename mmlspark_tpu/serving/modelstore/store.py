@@ -55,6 +55,13 @@ _M_RESIDENT_N = obs.gauge(
     "mmlspark_modelstore_resident_models_count",
     "Model versions currently resident (warming or ready)",
 )
+_M_REFS = obs.gauge(
+    "mmlspark_modelstore_version_refs_count",
+    "In-flight batch references held on model versions (acquire minus "
+    "release). MUST drain to zero after traffic stops — a stuck "
+    "refcount pins swapped-out versions forever; the invariant "
+    "checker's drain law (chaos/invariants.py)",
+)
 _M_LOADS = obs.counter(
     "mmlspark_modelstore_loads_total",
     "Model versions loaded to ready", labels=("model",),
@@ -191,6 +198,7 @@ class ModelStore:
         self._alias: dict[str, int] = {}
         self._resident_bytes = 0
         self._resident_count = 0
+        self._refs_total = 0  # acquire minus release, store-wide
 
     # -- introspection -------------------------------------------------------
 
@@ -556,11 +564,17 @@ class ModelStore:
                 return None
             mv.inflight += 1
             mv.last_used = time.monotonic()
+            self._refs_total += 1
+            if _M_REFS._on:
+                _M_REFS.set(self._refs_total)
             return mv
 
     def release(self, mv: ModelVersion) -> None:
         with self._lock:
             mv.inflight -= 1
+            self._refs_total -= 1
+            if _M_REFS._on:
+                _M_REFS.set(self._refs_total)
             if (
                 mv.retiring and mv.inflight <= 0 and mv.resident
                 and not mv.pinned

@@ -353,8 +353,15 @@ class DriverRegistry:
         self._httpd.server_close()
 
     @staticmethod
-    def register(registry_url: str, info: ServiceInfo) -> bool:
-        """Worker-side: report a ServiceInfo to the driver registry."""
+    def register(
+        registry_url: str, info: ServiceInfo, timeout: float = 10.0,
+    ) -> bool:
+        """Worker-side: report a ServiceInfo to the driver registry.
+
+        ``timeout`` is the explicit socket budget for the POST —
+        heartbeat loops pass a SHORT one (a blackholed registry must
+        cost a beat, not park the heartbeat thread for the transport
+        default; pinned by the chaos-proxy blackhole test)."""
         payload = {
             "name": info.name, "host": info.host,
             "port": info.port, "path": info.path,
@@ -378,14 +385,18 @@ class DriverRegistry:
                 registry_url, "POST", {"Content-Type": "application/json"},
                 json.dumps(payload),
             ),
-            timeout=10.0,
+            timeout=timeout,
         )
         return resp["status_code"] == 200
 
     @staticmethod
-    def deregister(registry_url: str, info: ServiceInfo) -> bool:
+    def deregister(
+        registry_url: str, info: ServiceInfo, timeout: float = 5.0,
+    ) -> bool:
         """Worker-side: remove this worker's roster entry (clean SIGTERM
-        path — the TTL handles workers that die without saying goodbye)."""
+        path — the TTL handles workers that die without saying goodbye).
+        Short explicit ``timeout``: a blackholed registry must not hang
+        a clean shutdown (the TTL covers the missed goodbye anyway)."""
         resp = send_request(
             HTTPRequestData(
                 registry_url, "DELETE", {"Content-Type": "application/json"},
@@ -393,6 +404,6 @@ class DriverRegistry:
                     "name": info.name, "host": info.host, "port": info.port,
                 }),
             ),
-            timeout=10.0,
+            timeout=timeout,
         )
         return resp["status_code"] == 200

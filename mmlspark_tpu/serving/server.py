@@ -42,7 +42,9 @@ from mmlspark_tpu.core import faults
 from mmlspark_tpu.obs.registry import SIZE_BUCKETS
 
 _REASONS = {200: "OK", 202: "Accepted", 400: "Bad Request", 404: "Not Found",
-             429: "Too Many Requests", 500: "Internal Server Error",
+             408: "Request Timeout", 413: "Payload Too Large",
+             429: "Too Many Requests", 431: "Request Header Fields Too Large",
+             500: "Internal Server Error", 502: "Bad Gateway",
              503: "Service Unavailable", 504: "Gateway Timeout",
              507: "Insufficient Storage"}
 
@@ -79,6 +81,13 @@ _M_REACTOR_CONNS = obs.counter(
     "mmlspark_serving_reactor_connections_total",
     "Client connections accepted, per ingress reactor",
     labels=("server", "reactor"),
+)
+_M_INFLIGHT = obs.gauge(
+    "mmlspark_serving_inflight_requests",
+    "Accepted (non-probe) requests not yet replied to — the ingress "
+    "routing table. MUST drain to zero after traffic stops; the "
+    "invariant checker's nothing-lost gauge (chaos/invariants.py)",
+    labels=("server",),
 )
 _METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -142,6 +151,10 @@ class WorkerServer:
         max_queue: int = 100_000,
         forwarding: Optional[dict] = None,
         num_reactors: int = 1,
+        header_deadline_s: Optional[float] = 30.0,
+        max_header_bytes: int = 65536,
+        max_body_bytes: int = 256 << 20,
+        max_conns_per_reactor: int = 4096,
     ):
         """``forwarding``: kwargs for io.port_forwarding.PortForwarding
         (remote_host, remote_port, user, key_file, ...) — when given,
@@ -151,7 +164,25 @@ class WorkerServer:
 
         ``num_reactors``: ingress event loops sharing the listening
         socket (module docstring). 1 keeps the classic single-loop
-        ingress; fleet workers and gateways default higher."""
+        ingress; fleet workers and gateways default higher.
+
+        Hostile-client hardening (docs/chaos.md; the slowloris defenses
+        the wire chaos harness forces):
+
+        - ``header_deadline_s``: once a request's FIRST byte arrives,
+          the full head must land within this budget or the connection
+          is answered 408 and closed (an idle keep-alive connection
+          between requests is never timed — idleness is not dripping).
+          The body rides the same clock with a floor of 256 KiB/s so a
+          legitimately large upload at normal speed always fits. None
+          disables.
+        - ``max_header_bytes`` / ``max_body_bytes``: 431 / 413 bounds —
+          a hostile client cannot buffer-balloon a reactor.
+        - ``max_conns_per_reactor``: connections beyond the cap are
+          answered 503 and closed immediately, so one client opening
+          sockets in a loop cannot pin a reactor's fd table. All four
+          sheds are counted in ``mmlspark_serving_rejected_total`` and
+          never touch the request queue."""
         self.name = name
         self.host = host
         self._forwarding_cfg = forwarding
@@ -173,6 +204,13 @@ class WorkerServer:
         # are one process-unique prefix + a shared atomic counter
         self._id_prefix = uuid.uuid4().hex[:12]
         self._id_counter = itertools.count()
+        self._header_deadline_s = header_deadline_s
+        self._max_header_bytes = int(max_header_bytes)
+        self._max_body_bytes = int(max_body_bytes)
+        self._max_conns_per_reactor = max(1, int(max_conns_per_reactor))
+        # per-reactor live-connection counts (each loop touches only its
+        # own key from its own thread)
+        self._conn_counts: dict = {}
 
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
@@ -206,6 +244,20 @@ class WorkerServer:
         )
         self._m_rej_404 = _M_REJECTED.labels(server=name, reason="not_found")
         self._m_rej_400 = _M_REJECTED.labels(server=name, reason="bad_request")
+        self._m_rej_slow = _M_REJECTED.labels(
+            server=name, reason="slow_client"
+        )
+        self._m_rej_hdr_big = _M_REJECTED.labels(
+            server=name, reason="header_too_large"
+        )
+        self._m_rej_body_big = _M_REJECTED.labels(
+            server=name, reason="body_too_large"
+        )
+        self._m_rej_conn_cap = _M_REJECTED.labels(
+            server=name, reason="conn_cap"
+        )
+        self._m_inflight = _M_INFLIGHT.labels(server=name)
+        self._inflight_accepted = 0
         self._m_qdepth = _M_QDEPTH.labels(server=name)
         self._m_qwait = _M_QWAIT.labels(server=name)
         self._m_batch = _M_BATCH.labels(server=name)
@@ -294,8 +346,13 @@ class WorkerServer:
                 # each reactor owns a dup of the shared listen fd: the
                 # loops race accept(); asyncio absorbs the loser's
                 # BlockingIOError, so the herd costs a wakeup, not a bug
+                # the stream buffer must hold one full-size header line:
+                # asyncio's default 64 KiB limit would make readline()
+                # raise ValueError BEFORE the head_bytes/431 check sees
+                # a configured max_header_bytes >= 64 KiB
                 aserver = await asyncio.start_server(
-                    handle, sock=self._lsock.dup()
+                    handle, sock=self._lsock.dup(),
+                    limit=self._max_header_bytes + 4096,
                 )
                 self._reactors.append((loop, aserver))
                 ok = True
@@ -314,6 +371,22 @@ class WorkerServer:
                 loop.run_forever()
         finally:
             loop.close()
+
+    def pause_accepting(self) -> None:
+        """Stop taking NEW connections; established connections (and
+        their in-flight requests) live on. The graceful-drain lifecycle's
+        middle step: deregister -> pause_accepting -> wait
+        :meth:`inflight` to zero -> :meth:`stop` (docs/chaos.md)."""
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+        for loop, aserver in list(self._reactors):
+            try:
+                loop.call_soon_threadsafe(aserver.close)
+            except RuntimeError:
+                pass
 
     def stop(self) -> None:
         if self._forwarding is not None:
@@ -367,7 +440,29 @@ class WorkerServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         loop = asyncio.get_running_loop()
+        key = id(loop)
+        n_conns = self._conn_counts.get(key, 0)
+        if n_conns >= self._max_conns_per_reactor:
+            # per-reactor connection cap: a client opening sockets in a
+            # loop must not pin this reactor's fd table — shed NOW,
+            # before the connection costs anything
+            self._m_rej_conn_cap.inc()
+            try:
+                self._write_response(
+                    writer, 503, b"connection limit", False,
+                    {"Retry-After": "1"},
+                )
+                await writer.drain()
+            except Exception:
+                pass
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
+        self._conn_counts[key] = n_conns + 1
         self._writers[writer] = loop
+        watchdog = None  # the current request's slow-client timer
         try:
             while True:
                 # line-framed head read (readline resolves from the
@@ -375,44 +470,141 @@ class WorkerServer:
                 # decoded and split in one pass at the end. NOT
                 # readuntil(b"\r\n\r\n"): a bare-LF client — which this
                 # parser has always tolerated — would never match the
-                # CRLF terminator and hang the connection open forever
+                # CRLF terminator and hang the connection open forever.
+                #
+                # Slowloris defense: the idle wait for a request's FIRST
+                # byte is unbounded (keep-alive idleness is legitimate),
+                # but once that byte lands the WHOLE request must land
+                # within its deadline — a client dripping one header
+                # byte per second is answered 408 and dropped, pinning
+                # nothing. Enforced by ONE call_later watchdog per
+                # request, not a wait_for per line: wait_for mints a
+                # Task + timer per call, and at data-plane rates that
+                # tax measured ~2x on echo throughput
+                first = await reader.read(1)
+                if not first:
+                    return
+                reading = [True]  # the watchdog's am-I-still-relevant flag
+                if self._header_deadline_s:
+                    def _expire(reading=reading, writer=writer):
+                        if not reading[0]:
+                            return
+                        reading[0] = False  # mark expired for the reader
+                        self._m_rej_slow.inc()
+                        try:
+                            self._write_response(
+                                writer, 408, b"request read timed out",
+                                False,
+                            )
+                            # flush the 408, FIN, and wake the pending
+                            # readline/readexactly with EOF
+                            writer.transport.close()
+                        except Exception:
+                            pass
+
+                    watchdog = loop.call_later(
+                        self._header_deadline_s, _expire
+                    )
                 raw_lines = []
+                head_bytes = 0
+                lead = first
                 while True:
-                    h = await reader.readline()
+                    try:
+                        h = await reader.readline()
+                    except ValueError:
+                        # a single line overran the stream buffer (sized
+                        # max_header_bytes + margin above): same attack,
+                        # same counted 431 as the head_bytes check below
+                        if watchdog is not None:
+                            watchdog.cancel()
+                        self._m_rej_hdr_big.inc()
+                        self._write_response(
+                            writer, 431, b"header too large", False
+                        )
+                        return
+                    if not reading[0]:
+                        return  # the watchdog fired (already 408'd)
+                    if lead is not None:
+                        h = lead + h
+                        lead = None
+                    head_bytes += len(h)
+                    if head_bytes > self._max_header_bytes:
+                        if watchdog is not None:
+                            watchdog.cancel()
+                        self._m_rej_hdr_big.inc()
+                        self._write_response(
+                            writer, 431, b"header too large", False
+                        )
+                        return
                     if h in (b"\r\n", b"\n", b""):
                         break
                     raw_lines.append(h)
                 if not raw_lines:
+                    if watchdog is not None:
+                        watchdog.cancel()
                     return
-                # split on the actual line framing only — NOT
-                # str.splitlines(), which also breaks on latin1 control
-                # bytes (NEL \x85, \x0b, \x0c, ...) that a header value
-                # may legally carry
-                lines = [
-                    ln.rstrip("\r")
-                    for ln in b"".join(raw_lines).decode("latin1").split("\n")
-                ]
-                if lines and lines[-1] == "":
-                    lines.pop()  # the head's trailing newline
                 try:
-                    method, path, version = lines[0].split()
-                except ValueError:
-                    return
-                headers: dict = {}
-                for h in lines[1:]:
-                    k, _, v = h.partition(":")
-                    headers[k.strip().lower()] = v.strip()
-                try:
-                    n = int(headers.get("content-length") or 0)
-                except ValueError:
-                    self._m_rej_400.inc()
-                    self._write_response(writer, 400, b"bad Content-Length", False)
-                    return
-                if n < 0:
-                    self._m_rej_400.inc()
-                    self._write_response(writer, 400, b"bad Content-Length", False)
-                    return
-                body = await reader.readexactly(n) if n else b""
+                    # split on the actual line framing only — NOT
+                    # str.splitlines(), which also breaks on latin1
+                    # control bytes (NEL \x85, \x0b, \x0c, ...) that a
+                    # header value may legally carry
+                    lines = [
+                        ln.rstrip("\r")
+                        for ln in b"".join(raw_lines).decode("latin1")
+                        .split("\n")
+                    ]
+                    if lines and lines[-1] == "":
+                        lines.pop()  # the head's trailing newline
+                    try:
+                        method, path, version = lines[0].split()
+                    except ValueError:
+                        return
+                    headers: dict = {}
+                    for h in lines[1:]:
+                        k, _, v = h.partition(":")
+                        headers[k.strip().lower()] = v.strip()
+                    try:
+                        n = int(headers.get("content-length") or 0)
+                    except ValueError:
+                        self._m_rej_400.inc()
+                        self._write_response(
+                            writer, 400, b"bad Content-Length", False
+                        )
+                        return
+                    if n < 0:
+                        self._m_rej_400.inc()
+                        self._write_response(
+                            writer, 400, b"bad Content-Length", False
+                        )
+                        return
+                    if n > self._max_body_bytes:
+                        self._m_rej_body_big.inc()
+                        self._write_response(
+                            writer, 413, b"body too large", False
+                        )
+                        return
+                    if n and watchdog is not None:
+                        # the body gets a fresh budget with a floor of
+                        # 256 KiB/s, so a large-but-honest upload at
+                        # normal speed always fits; a dripped body does
+                        # not (the watchdog 408s and closes)
+                        watchdog.cancel()
+                        watchdog = loop.call_later(
+                            max(
+                                self._header_deadline_s,
+                                n / (256 * 1024.0),
+                            ),
+                            _expire,
+                        )
+                    body = await reader.readexactly(n) if n else b""
+                    if not reading[0]:
+                        return  # the watchdog fired mid-body
+                finally:
+                    # the request is fully read (or abandoned): the
+                    # slow-client clock stops here, before any model
+                    # work or queue wait
+                    if watchdog is not None:
+                        watchdog.cancel()
                 keep = headers.get("connection", "keep-alive").lower() != "close"
                 prefix = self.api_path.rstrip("/")
                 path_only = path.split("?", 1)[0]
@@ -568,14 +760,21 @@ class WorkerServer:
                         # failed probe, exactly the signal intended
                         return
                     self._routing[req.id] = (
-                        writer, keep, replied, admission is not None, loop
+                        writer, keep, replied, admission is not None, loop,
+                        not is_probe,
                     )
                     self._queue.append(req)
                     self._history.setdefault(req.epoch, []).append(req)
                     self.requests_seen += 1
-                    if not is_probe and self._m_accepted._on:
-                        self._m_accepted.inc()
-                        self._m_qdepth.set(len(self._queue))
+                    if not is_probe:
+                        # the nothing-lost gauge: accepted, not yet
+                        # replied — the invariant checker demands this
+                        # drains to zero after traffic stops
+                        self._inflight_accepted += 1
+                        if self._m_accepted._on:
+                            self._m_accepted.inc()
+                            self._m_qdepth.set(len(self._queue))
+                            self._m_inflight.set(self._inflight_accepted)
                     self._not_empty.notify()
                 # wait for the reply before reading the next request on this
                 # connection (no HTTP/1.1 pipelining needed)
@@ -585,6 +784,13 @@ class WorkerServer:
         except (asyncio.IncompleteReadError, ConnectionError):
             return
         finally:
+            if watchdog is not None:
+                # a head/body read that RAISED (client reset mid-request)
+                # skips the per-request cancel — without this, the timer
+                # later fires on the dead connection and falsely counts
+                # a slow_client shed for every abrupt disconnect
+                watchdog.cancel()
+            self._conn_counts[key] = max(0, self._conn_counts.get(key, 1) - 1)
             self._writers.pop(writer, None)
             try:
                 writer.close()
@@ -653,9 +859,13 @@ class WorkerServer:
         HTTPSourceV2.scala:516-527)."""
         with self._lock:
             entry = self._routing.pop(request_id, None)
+            if entry is not None and entry[5]:
+                self._inflight_accepted -= 1
+                if self._m_inflight._on:
+                    self._m_inflight.set(self._inflight_accepted)
         if entry is None:
             return False
-        writer, keep, replied, admitted, loop = entry
+        writer, keep, replied, admitted, loop, _counted = entry
         if admitted and self.admission is not None:
             # the admitted request is answered (any status): free its
             # concurrency slot exactly once (the routing-table pop above
@@ -694,9 +904,14 @@ class WorkerServer:
                 for rid, body, code, headers in replies
                 if (entry := self._routing.pop(rid, None)) is not None
             ]
+            dec = sum(1 for entry, _b, _c, _h in entries if entry[5])
+            if dec:
+                self._inflight_accepted -= dec
+                if self._m_inflight._on:
+                    self._m_inflight.set(self._inflight_accepted)
         by_loop: dict = {}
-        for (writer, keep, replied, admitted, loop), body, code, hdrs \
-                in entries:
+        for (writer, keep, replied, admitted, loop, _counted), body, code, \
+                hdrs in entries:
             if admitted and self.admission is not None:
                 self.admission.release()
             if loop is not None:
@@ -787,3 +1002,19 @@ class WorkerServer:
         dispatcher) — the set a graceful drain must see through to zero."""
         with self._lock:
             return len(self._routing)
+
+    def drain_inflight(self, timeout_s: float = 10.0) -> bool:
+        """Wait until every accepted (non-probe) request has been
+        replied to — queued, dispatched AND staged continuous batches
+        all hold routing entries until their reply lands, so a True
+        return means zero requests will be dropped by a subsequent
+        :meth:`stop`. Supervisor health probes are excluded (a probing
+        supervisor must not hold the drain open)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._queue and self._inflight_accepted <= 0:
+                    return True
+            time.sleep(0.02)
+        with self._lock:
+            return not self._queue and self._inflight_accepted <= 0

@@ -101,7 +101,17 @@ class WorkerCharge:
         return self.proc is not None and self.proc.poll() is None
 
 
-def _probe_health(url: str, timeout_s: float) -> bool:
+def _probe_health(url: str, timeout_s: float) -> str:
+    """One ``/health`` probe -> ``"ok"`` | ``"refused"`` | ``"silent"``.
+
+    The distinction matters: a WEDGED worker (stuck event loop) still
+    ACCEPTS connections — its listen backlog answers the handshake —
+    and then never replies (``silent``). A connect that is REFUSED
+    means there is no listener at all: the worker is booting, or mid
+    graceful-drain (``pause_accepting`` closed the listener while
+    in-flight requests finish). Killing a draining worker as "wedged"
+    would drop exactly the requests the drain exists to protect, so
+    the caller weighs ``refused`` far more leniently than ``silent``."""
     import http.client
     import urllib.parse
 
@@ -118,11 +128,13 @@ def _probe_health(url: str, timeout_s: float) -> bool:
             # shedding (alive and protecting itself; killing it would
             # shrink the fleet under overload, the exact wrong
             # direction). Only no-answer-at-all counts as wedged.
-            return True
+            return "ok"
         finally:
             c.close()
+    except ConnectionRefusedError:
+        return "refused"
     except Exception:  # noqa: BLE001 — any transport failure = probe miss
-        return False
+        return "silent"
 
 
 def spawn_from_template(template: str) -> Any:
@@ -399,7 +411,8 @@ class FleetSupervisor:
                 c.restart_due = 0.0
                 c.last_reason = ""
                 if c.health_url:
-                    if _probe_health(c.health_url, self.probe_timeout_s):
+                    verdict = _probe_health(c.health_url, self.probe_timeout_s)
+                    if verdict == "ok":
                         c.probe_fails = 0
                         c.healthy_once = True
                     elif (
@@ -411,9 +424,21 @@ class FleetSupervisor:
                         # yet may still be importing/warming — killing it
                         # mid-warmup would crash-loop a healthy charge.
                         # Once it HAS been healthy (or the grace is
-                        # blown), silence means wedged
-                        c.probe_fails += 1
-                        _M_PROBE_FAILS.labels(worker=c.name).inc()
+                        # blown), silence means wedged. A REFUSED connect
+                        # is weighed 10x more leniently: no listener
+                        # means booting or mid graceful-drain (SIGTERM
+                        # closed the acceptor while in-flight work
+                        # finishes) — killing a draining worker would
+                        # drop exactly the requests the drain protects;
+                        # a shutdown genuinely stuck with its listener
+                        # closed still gets reaped, just slowly
+                        weight = 1.0 if verdict == "silent" else 0.1
+                        c.probe_fails += weight
+                        # the metric carries the SAME weight as the
+                        # wedge accounting: a draining worker's refused
+                        # probes must not read as full-rate failures to
+                        # an operator alert
+                        _M_PROBE_FAILS.labels(worker=c.name).inc(weight)
                         if c.probe_fails >= self.wedge_after:
                             self._restart(c, "wedged")
                             continue
@@ -533,6 +558,135 @@ class FleetSupervisor:
                 print(f"supervisor: tick failed: {e}", file=sys.stderr,
                       flush=True)
             self._stop.wait(self.probe_s)
+
+    # -- rolling restart ------------------------------------------------------
+
+    def rolling_restart(
+        self, wait_up_s: float = 60.0, settle_s: float = 1.0,
+    ) -> bool:
+        """Restart every charge ONE AT A TIME with zero capacity dip
+        beyond a single replica: SIGTERM the charge (a fleet worker's
+        graceful-drain path — deregister, stop accepting, finish
+        in-flight work, exit), let the ordinary supervision loop respawn
+        it, and only move to the next charge once the replacement is
+        **routable again** — up, answering ``/health`` (when probed),
+        AND back on the registry roster (when one is configured): health
+        alone is not enough, because SIGTERMing the next charge while
+        this one is alive-but-unregistered would drain the roster dry
+        and strand the gateway. ``settle_s`` then lets gateway roster
+        refreshes pick the replacement up before the next roll. The
+        fleet-roll primitive the chaos drill drives at throughput-gate
+        load with zero dropped requests (docs/chaos.md). Returns False
+        if any replacement failed to come back within ``wait_up_s``."""
+        import signal as signal_mod
+        import urllib.parse
+
+        ok = True
+        for c in list(self.charges):
+            if not c.alive():
+                continue  # the loop is already restarting it
+            old_pid = c.proc.pid
+            try:
+                c.proc.send_signal(signal_mod.SIGTERM)
+            except OSError:
+                continue
+            rostered_url = None
+            old_boot = None
+            if self.registry_url and c.health_url:
+                u = urllib.parse.urlparse(c.health_url)
+                rostered_url = f"http://{u.hostname}:{u.port}"
+                # the dying worker's own entry must not satisfy the
+                # wait below: remember its boot stamp so only a FRESH
+                # registration (new process generation) counts — a
+                # blackholed deregister leaves the stale entry on a
+                # TTL-less registry, same port as the replacement
+                old_boot = self._roster_boot(rostered_url)
+            deadline = time.monotonic() + wait_up_s
+            # wait out the drain + respawn (the supervision loop's
+            # backoff applies — a clean roll restarts on the base delay)
+            while time.monotonic() < deadline:
+                if (
+                    c.alive() and c.proc.pid != old_pid
+                    and (
+                        c.health_url is None
+                        or _probe_health(
+                            c.health_url, self.probe_timeout_s
+                        ) == "ok"
+                    )
+                    and self._rostered(rostered_url, not_boot=old_boot)
+                ):
+                    break
+                # every iteration costs a health probe + a registry
+                # roster fetch: 0.25 s keeps the roll just as tight
+                # without hammering the registry the roll depends on
+                time.sleep(0.25)
+            else:
+                ok = False
+                print(
+                    f"supervisor: rolling restart of {c.name} did not "
+                    f"come back within {wait_up_s:g}s",
+                    file=sys.stderr, flush=True,
+                )
+            time.sleep(settle_s)
+        return ok
+
+    def _roster_entries(self, url: str) -> list:
+        """Roster entries whose bound OR forwarded port matches ``url``'s
+        — never the forwarded-preferring URL the gateway routes to: a
+        worker fronted by a port forward advertises
+        forwarded_host:forwarded_port while the supervisor probes the
+        local health endpoint, so an exact-URL comparison would never
+        match. Supervised charges are local siblings with distinct fixed
+        ports, so the port is their stable roster identity."""
+        import urllib.parse
+
+        from mmlspark_tpu.serving.fleet import roster_entries_from_registry
+
+        port = urllib.parse.urlparse(url).port
+        matched = []
+        for e in roster_entries_from_registry(
+            self.registry_url, self.service_name
+        ):
+            try:
+                if int(e.get("port") or 0) == port or int(
+                    e.get("forwarded_port") or 0
+                ) == port:
+                    matched.append(e)
+            except (TypeError, ValueError):
+                continue
+        return matched
+
+    def _roster_boot(self, url: str) -> Optional[float]:
+        """The process-generation ``boot`` stamp of the roster entry
+        matching ``url`` (None when absent or unstamped)."""
+        try:
+            for e in self._roster_entries(url):
+                if e.get("boot") is not None:
+                    return e["boot"]
+        except Exception:  # noqa: BLE001 — no registry answered
+            pass
+        return None
+
+    def _rostered(
+        self, url: Optional[str], not_boot: Optional[float] = None
+    ) -> bool:
+        """Is the charge behind ``url`` advertised under this service on
+        any registry? ``not_boot`` excludes a known-stale generation: an
+        entry still carrying the SIGTERM'd process's boot stamp is the
+        old worker's ghost (failed deregister + no TTL), not evidence
+        the replacement is routable. True when there is nothing to
+        check (no registry / no fixed port)."""
+        if url is None:
+            return True
+        try:
+            entries = self._roster_entries(url)
+        except Exception:  # noqa: BLE001 — no registry answered: degrade
+            return True
+        for e in entries:
+            if not_boot is not None and e.get("boot") == not_boot:
+                continue
+            return True
+        return False
 
 
 def charge_from_train_args(

@@ -734,3 +734,119 @@ def test_supervisor_spawn_cmd_wraps_restarts_and_scaleout(tmp_path):
         assert spawn_count() == 2  # the restart rode the template too
     finally:
         sup.stop()
+
+
+# -- the PR 11 corruption matrix, re-driven by REAL wire faults ---------------
+#
+# The _EvilPeer tests above stay (they pin peer-side misbehavior); these
+# drive the same matrix through a seeded ChaosProxy on the wire of an
+# HONEST peer — flipped bytes, mid-frame resets and asymmetric
+# partitions produced by the fabric itself (docs/chaos.md).
+
+
+def _artifact_metric(name: str) -> float:
+    from mmlspark_tpu import obs
+
+    return obs.sum_samples(obs.parse_text(obs.render()), name)
+
+
+def test_wire_flip_corrupts_transfer_quarantine_and_failover(
+    stores, tmp_path
+):
+    """A byte flipped ON THE WIRE (honest peer): the completed transfer
+    fails sha256, the bytes are quarantined, and the fetch fails over to
+    a clean peer — byte-identical result."""
+    from mmlspark_tpu.chaos.wire import ChaosProxy, WireRule
+
+    producer, consumer = stores
+    p = _blob(tmp_path, seed=31)
+    ref = producer.put(p, name="w.bin")
+    peer = ArtifactServer(producer)
+    evil_wire = ChaosProxy(
+        "127.0.0.1", peer.port, seed=5, name="art-flip",
+        rules=[WireRule("flip", direction="s2c", at_offset=5000)],
+    ).start()
+    q_before = _artifact_metric("mmlspark_artifact_quarantines_total")
+    try:
+        path = consumer.fetch(
+            ref.digest, [evil_wire.url, peer.url], backoffs_ms=(10,)
+        )
+        with open(path, "rb") as got, open(p, "rb") as want:
+            assert got.read() == want.read()
+        assert _artifact_metric(
+            "mmlspark_artifact_quarantines_total"
+        ) - q_before >= 1
+        assert [e.kind for e in evil_wire.journal() if e.kind == "flip"] \
+            == ["flip"]
+    finally:
+        evil_wire.stop()
+        peer.stop()
+
+
+def test_wire_truncate_rst_resumes_via_range(stores, tmp_path):
+    """A mid-frame RST on the wire (first connection only): the partial
+    bytes are kept and the NEXT attempt resumes with a Range request
+    from the byte offset — counted by the resume counter."""
+    from mmlspark_tpu.chaos.wire import ChaosProxy, WireRule
+
+    producer, consumer = stores
+    p = _blob(tmp_path, seed=32)
+    ref = producer.put(p, name="w.bin")
+    peer = ArtifactServer(producer)
+    # the throttle makes the partial REAL: an RST discards whatever the
+    # client hasn't read out of its kernel buffer yet, so without it the
+    # reset could race ahead of the reader and leave ~nothing on disk
+    wire = ChaosProxy(
+        "127.0.0.1", peer.port, seed=5, name="art-trunc",
+        rules=[
+            WireRule("throttle", direction="s2c", bytes_per_s=400_000.0,
+                     conns=frozenset({0})),
+            WireRule("truncate_rst", direction="s2c",
+                     at_offset=50_000, conns=frozenset({0})),
+        ],
+    ).start()
+    r_before = _artifact_metric("mmlspark_artifact_resumes_total")
+    try:
+        path = consumer.fetch(
+            ref.digest, [wire.url], backoffs_ms=(10, 10)
+        )
+        with open(path, "rb") as got, open(p, "rb") as want:
+            assert got.read() == want.read()
+        assert _artifact_metric(
+            "mmlspark_artifact_resumes_total"
+        ) - r_before >= 1
+        assert any(
+            e.kind == "truncate_rst" for e in wire.journal()
+        )
+    finally:
+        wire.stop()
+        peer.stop()
+
+
+def test_wire_asymmetric_partition_fails_over_per_peer(stores, tmp_path):
+    """peer1's link blackholed one-way (requests vanish, connects still
+    succeed): the fetch times that peer out and fails over to peer2 —
+    a partitioned peer costs one bounded attempt, never the fetch."""
+    from mmlspark_tpu.chaos.wire import ChaosProxy, WireRule
+
+    producer, consumer = stores
+    p = _blob(tmp_path, seed=33)
+    ref = producer.put(p, name="w.bin")
+    peer = ArtifactServer(producer)
+    dead_wire = ChaosProxy(
+        "127.0.0.1", peer.port, seed=5, name="art-bh",
+        rules=[WireRule("blackhole", direction="c2s")],
+    ).start()
+    try:
+        t0 = time.monotonic()
+        path = consumer.fetch(
+            ref.digest, [dead_wire.url, peer.url], timeout_s=1.0,
+            backoffs_ms=(10,),
+        )
+        dt = time.monotonic() - t0
+        with open(path, "rb") as got, open(p, "rb") as want:
+            assert got.read() == want.read()
+        assert dt < 20.0  # the blackhole cost ~one timeout, not forever
+    finally:
+        dead_wire.stop()
+        peer.stop()

@@ -1,0 +1,1426 @@
+"""Hostile-wire chaos suite: the fabric itself as the adversary.
+
+Every earlier chaos test injects faults INSIDE our own functions
+(core/faults.py) or kills whole processes; this suite puts a seeded
+:class:`~mmlspark_tpu.chaos.wire.ChaosProxy` ON THE WIRE of real fleet
+links — flipped bytes, slow-dripped headers, throttled and asymmetric
+links, mid-frame resets — and asserts the byte-level hardening holds:
+
+- TcpReducer payload CRC: a flipped allreduce byte is DETECTED (counted,
+  NACKed, retransmitted), never silently summed; persistent corruption
+  degrades to ordinary peer-loss, never a wrong sum.
+- Ingress slowloris defenses: header deadline, size caps, per-reactor
+  connection cap — sheds that never stall other connections.
+- Gateway forwarding: truncated responses never double-dispatch a
+  non-idempotent POST; a throttled link costs latency, never breaker
+  blame; asymmetric partitions fail over cleanly.
+- Registry blackholes cost a bounded beat, never a hung shutdown.
+- The graceful-drain lifecycle + supervisor rolling restart: zero
+  dropped requests at load.
+- The fleet-wide invariant checker: whatever the wire did, nothing the
+  fleet accepted goes unaccounted (the soak's acceptance gate).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.chaos.conductor import ChaosConductor, Scenario
+from mmlspark_tpu.chaos.invariants import InvariantChecker
+from mmlspark_tpu.chaos.wire import RULE_KINDS, ChaosProxy, WireRule
+
+pytestmark = pytest.mark.chaos
+
+
+# -- helpers ------------------------------------------------------------------
+
+
+def _raw_echo_server():
+    """A raw TCP echo server; returns (port, close_fn)."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    srv.settimeout(0.25)
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+
+            def h(c=c):
+                try:
+                    while True:
+                        d = c.recv(4096)
+                        if not d:
+                            break
+                        c.sendall(d)
+                except OSError:
+                    pass
+                finally:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+
+            threading.Thread(target=h, daemon=True).start()
+
+    threading.Thread(target=serve, daemon=True).start()
+
+    def close():
+        stop.set()
+        srv.close()
+
+    return srv.getsockname()[1], close
+
+
+def _post(port, body=b"x", path="/", timeout=10.0, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body, headers or {})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+# -- WireRule / proxy unit behavior ------------------------------------------
+
+
+def test_wire_rule_vocabulary_and_validation():
+    # the linter-enforced vocabulary: latency throttle flip truncate_rst
+    # slowdrip blackhole
+    assert set(RULE_KINDS) == {
+        "latency", "throttle", "flip", "truncate_rst", "slowdrip",
+        "blackhole",
+    }
+    with pytest.raises(ValueError, match="unknown wire rule kind"):
+        WireRule("fliip")
+    with pytest.raises(ValueError, match="unknown direction"):
+        WireRule("flip", direction="up")
+    r = WireRule.from_dict(
+        {"kind": "flip", "at_offset": 3, "conns": [0, 2]}
+    )
+    assert r.applies(0, "c2s") and r.applies(2, "s2c")
+    assert not r.applies(1, "c2s")
+    assert not WireRule("latency", after_conn=2).applies(1, "c2s")
+    assert not WireRule("latency", direction="s2c").applies(0, "c2s")
+
+
+def test_proxy_latency_throttle_and_journal():
+    port, close = _raw_echo_server()
+    proxy = ChaosProxy(
+        "127.0.0.1", port, seed=5, name="lt",
+        rules=[
+            WireRule("latency", direction="c2s", delay_ms=40.0),
+            WireRule("throttle", direction="s2c", bytes_per_s=4096.0),
+        ],
+    ).start()
+    try:
+        c = socket.create_connection(("127.0.0.1", proxy.port), timeout=5)
+        c.settimeout(5)
+        payload = b"z" * 1024
+        t0 = time.monotonic()
+        c.sendall(payload)
+        got = b""
+        while len(got) < len(payload):
+            got += c.recv(4096)
+        dt = time.monotonic() - t0
+        # 40 ms latency + 1024/4096 s throttle = ~290 ms floor
+        assert got == payload
+        assert dt >= 0.25
+        kinds = {e.kind for e in proxy.journal()}
+        assert kinds == {"latency", "throttle"}
+        c.close()
+    finally:
+        proxy.stop()
+        close()
+
+
+def test_proxy_flip_offsets_and_seeded_digest():
+    port, close = _raw_echo_server()
+
+    def run(seed):
+        proxy = ChaosProxy(
+            "127.0.0.1", port, seed=seed, name="flip",
+            rules=[
+                WireRule("flip", direction="c2s", at_offset=2,
+                         xor_mask=0x01),
+                WireRule("latency", direction="c2s", delay_ms=0.0,
+                         jitter_ms=3.0),
+            ],
+        ).start()
+        try:
+            c = socket.create_connection(
+                ("127.0.0.1", proxy.port), timeout=5
+            )
+            c.settimeout(5)
+            c.sendall(b"abcdef")
+            got = b""
+            while len(got) < 6:
+                got += c.recv(64)
+            c.close()
+            return got, proxy.schedule_digest(), proxy.journal()
+        finally:
+            proxy.stop()
+
+    got1, d1, j1 = run(seed=9)
+    assert got1 == b"abbdef"  # 'c' ^ 0x01 == 'b'
+    flips = [e for e in j1 if e.kind == "flip"]
+    assert [(e.offset, e.value) for e in flips] == [(2, 1)]
+    # determinism contract: same seed + same bytes => identical digest;
+    # a different seed draws different jitter => different digest
+    _, d2, _ = run(seed=9)
+    assert d1 == d2
+    _, d3, _ = run(seed=10)
+    assert d1 != d3
+    close()
+
+
+def test_proxy_flip_every_bytes_stride():
+    port, close = _raw_echo_server()
+    proxy = ChaosProxy(
+        "127.0.0.1", port, seed=0, name="stride",
+        rules=[WireRule("flip", direction="c2s", at_offset=1,
+                        every_bytes=4, xor_mask=0xFF)],
+    ).start()
+    try:
+        c = socket.create_connection(("127.0.0.1", proxy.port), timeout=5)
+        c.settimeout(5)
+        c.sendall(bytes(12))
+        got = b""
+        while len(got) < 12:
+            got += c.recv(64)
+        assert [i for i, b in enumerate(got) if b == 0xFF] == [1, 5, 9]
+        c.close()
+    finally:
+        proxy.stop()
+        close()
+
+
+def test_proxy_truncate_rst_is_a_visible_reset():
+    port, close = _raw_echo_server()
+    proxy = ChaosProxy(
+        "127.0.0.1", port, seed=0, name="trunc",
+        rules=[WireRule("truncate_rst", direction="s2c", at_offset=4)],
+    ).start()
+    try:
+        c = socket.create_connection(("127.0.0.1", proxy.port), timeout=5)
+        c.settimeout(5)
+        c.sendall(b"0123456789")
+        got = b""
+        with pytest.raises(ConnectionResetError):
+            while True:
+                d = c.recv(64)
+                if not d:
+                    raise ConnectionResetError("fin, not rst")
+                got += d
+        assert got == b"0123"  # truncated exactly at the offset, then RST
+    finally:
+        proxy.stop()
+        close()
+
+
+def test_proxy_flip_before_truncate_in_same_chunk_still_applies():
+    """A flip whose offset lands BEFORE a truncate_rst offset in the
+    same recv chunk must still mutate (and journal into) the forwarded
+    prefix — the applied schedule must not depend on how TCP chunked
+    the stream (review regression: the truncate check ran first and
+    skipped the flip entirely when both offsets shared a chunk)."""
+    port, close = _raw_echo_server()
+    proxy = ChaosProxy(
+        "127.0.0.1", port, seed=0, name="fliptrunc",
+        rules=[
+            WireRule("flip", direction="s2c", at_offset=1, xor_mask=0x01),
+            WireRule("truncate_rst", direction="s2c", at_offset=4),
+        ],
+    ).start()
+    try:
+        c = socket.create_connection(("127.0.0.1", proxy.port), timeout=5)
+        c.settimeout(5)
+        c.sendall(b"0123456789")  # one send: echo returns one chunk
+        got = b""
+        with pytest.raises(ConnectionResetError):
+            while True:
+                d = c.recv(64)
+                if not d:
+                    raise ConnectionResetError("fin, not rst")
+                got += d
+        assert got == b"0\x3023"  # byte 1 flipped (0x31^0x01), cut at 4
+        kinds = [(e.kind, e.offset) for e in proxy.journal()
+                 if e.direction == "s2c"]
+        assert ("flip", 1) in kinds and ("truncate_rst", 4) in kinds
+    finally:
+        proxy.stop()
+        close()
+
+
+def test_proxy_asymmetric_blackhole():
+    """A -> B dead while B -> A lives: the server's greeting arrives,
+    the client's bytes are swallowed (sends still succeed)."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    srv.settimeout(5)
+    seen = []
+
+    def serve():
+        c, _ = srv.accept()
+        c.sendall(b"HELLO")  # s2c direction lives
+        c.settimeout(1.0)
+        try:
+            seen.append(c.recv(64))
+        except socket.timeout:
+            seen.append(None)  # nothing ever arrived: c2s is dead
+        c.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    proxy = ChaosProxy(
+        "127.0.0.1", srv.getsockname()[1], seed=0, name="bh",
+        rules=[WireRule("blackhole", direction="c2s")],
+    ).start()
+    try:
+        c = socket.create_connection(("127.0.0.1", proxy.port), timeout=5)
+        c.settimeout(5)
+        assert c.recv(64) == b"HELLO"   # reverse direction lives
+        c.sendall(b"ping")              # swallowed, but the send SUCCEEDS
+        t.join(5)
+        assert seen == [None]
+        assert any(e.kind == "blackhole" for e in proxy.journal())
+        c.close()
+    finally:
+        proxy.stop()
+        srv.close()
+
+
+# -- ingress hardening (the sheds the wire chaos forces) ---------------------
+
+
+def test_ingress_slowdrip_shed_without_stalling_others():
+    """A slowloris (the proxy slow-dripping the head) is shed 408 at the
+    header deadline while a parallel direct client is served normally —
+    one dripping client pins nothing."""
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer(header_deadline_s=0.6)
+    info = srv.start()
+    q = ServingQuery(
+        srv, lambda reqs: {r.id: (200, r.body or b"ok", {}) for r in reqs}
+    ).start()
+    proxy = ChaosProxy(
+        "127.0.0.1", info.port, seed=2, name="drip",
+        rules=[WireRule("slowdrip", direction="c2s", drip_bytes=2,
+                        drip_interval_ms=60.0)],
+    ).start()
+    try:
+        results = {}
+
+        def dripped():
+            # ~45 head bytes at 2 B / 60 ms ≈ 1.4 s > the 0.6 s deadline
+            try:
+                results["drip"] = _post(proxy.port, b"slow", timeout=10.0)
+            except OSError as e:
+                results["drip"] = ("conn-error", str(e))
+
+        t = threading.Thread(target=dripped, daemon=True)
+        t.start()
+        # meanwhile the direct path must stay fully served
+        for i in range(5):
+            assert _post(info.port, b"fast")[0] == 200
+        t.join(10)
+        status = results["drip"][0]
+        assert status in (408, "conn-error")
+        from mmlspark_tpu import obs
+
+        parsed = obs.parse_text(obs.render())
+        assert obs.sum_samples(
+            parsed, "mmlspark_serving_rejected_total",
+            {"reason": "slow_client"},
+        ) >= 1
+    finally:
+        proxy.stop()
+        q.stop()
+        srv.stop()
+
+
+def test_ingress_header_and_body_caps_and_conn_cap():
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer(
+        max_header_bytes=512, max_body_bytes=1024, max_conns_per_reactor=2,
+    )
+    info = srv.start()
+    q = ServingQuery(
+        srv, lambda reqs: {r.id: (200, b"ok", {}) for r in reqs}
+    ).start()
+    try:
+        # oversized header -> 431
+        s = socket.create_connection(("127.0.0.1", info.port), timeout=5)
+        s.sendall(b"GET / HTTP/1.1\r\nX-Big: " + b"a" * 600 + b"\r\n\r\n")
+        s.settimeout(5)
+        assert b"431" in s.recv(256).split(b"\r\n", 1)[0]
+        s.close()
+        # ONE header line overrunning the whole stream buffer (never a
+        # newline) must take the SAME counted 431 path — asyncio's
+        # readline raises ValueError at the stream limit, which used to
+        # tear the connection with no reply and no count (review
+        # regression)
+        from mmlspark_tpu import obs
+
+        before = obs.sum_samples(
+            obs.parse_text(obs.render()),
+            "mmlspark_serving_rejected_total",
+            {"reason": "header_too_large"},
+        )
+        s = socket.create_connection(("127.0.0.1", info.port), timeout=5)
+        s.sendall(b"GET / HTTP/1.1\r\nX-Big: " + b"a" * 8192)
+        s.settimeout(5)
+        assert b"431" in s.recv(256).split(b"\r\n", 1)[0]
+        s.close()
+        after = obs.sum_samples(
+            obs.parse_text(obs.render()),
+            "mmlspark_serving_rejected_total",
+            {"reason": "header_too_large"},
+        )
+        assert after == before + 1
+        # oversized body -> 413 (shed before the body is read)
+        assert _post(info.port, b"x" * 2048)[0] == 413
+        # connection cap: two parked connections fill the reactor; the
+        # third is answered 503 immediately
+        idle = [
+            socket.create_connection(("127.0.0.1", info.port), timeout=5)
+            for _ in range(2)
+        ]
+        time.sleep(0.1)  # the reactor must register both
+        s3 = socket.create_connection(("127.0.0.1", info.port), timeout=5)
+        s3.settimeout(5)
+        head = s3.recv(256).split(b"\r\n", 1)[0]
+        assert b"503" in head
+        s3.close()
+        for s in idle:
+            s.close()
+        time.sleep(0.1)  # caps release: a fresh request serves again
+        assert _post(info.port, b"ok-again")[0] == 200
+    finally:
+        q.stop()
+        srv.stop()
+
+
+def test_midhead_reset_is_not_counted_as_slow_client():
+    """A client that sends a partial head then RESETS is a disconnect,
+    not a slowloris: the per-request watchdog must be cancelled on the
+    read error, never fire later and falsely count a slow_client shed
+    (review regression)."""
+    import struct as struct_mod
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer(header_deadline_s=0.3)
+    info = srv.start()
+    q = ServingQuery(
+        srv, lambda reqs: {r.id: (200, b"ok", {}) for r in reqs}
+    ).start()
+    try:
+        before = obs.sum_samples(
+            obs.parse_text(obs.render()),
+            "mmlspark_serving_rejected_total", {"reason": "slow_client"},
+        )
+        s = socket.create_connection(("127.0.0.1", info.port), timeout=5)
+        s.sendall(b"GET /par")  # torn head...
+        s.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER,
+            struct_mod.pack("ii", 1, 0),
+        )
+        s.close()  # ...then RST, well before the deadline
+        time.sleep(0.8)  # past the deadline: a leaked watchdog would fire
+        after = obs.sum_samples(
+            obs.parse_text(obs.render()),
+            "mmlspark_serving_rejected_total", {"reason": "slow_client"},
+        )
+        assert after == before
+    finally:
+        q.stop()
+        srv.stop()
+
+
+def test_idle_keepalive_is_never_deadline_killed():
+    """The header deadline arms at a request's FIRST byte — a keep-alive
+    connection idling between requests longer than the deadline must
+    still serve its next request."""
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer(header_deadline_s=0.4)
+    info = srv.start()
+    q = ServingQuery(
+        srv, lambda reqs: {r.id: (200, b"ok", {}) for r in reqs}
+    ).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", info.port, timeout=5)
+        conn.request("POST", "/", b"a")
+        r1 = conn.getresponse()
+        assert r1.status == 200
+        r1.read()
+        time.sleep(1.0)  # idle well past the deadline
+        conn.request("POST", "/", b"b")
+        assert conn.getresponse().status == 200
+        conn.close()
+    finally:
+        q.stop()
+        srv.stop()
+
+
+# -- TcpReducer CRC (the silent-corruption fix) -------------------------------
+
+
+def _gang_pair(reg_url, proxy_rules, seed=3, heartbeat_s=0.2):
+    """Two in-process GangMembers with member b's allreduce link pointed
+    through a ChaosProxy; returns (a, b, proxy)."""
+    from mmlspark_tpu.parallel.elastic import GangMember
+
+    # pre-bind b's port so the proxy fronts it BEFORE the first
+    # heartbeat can advertise the unproxied endpoint
+    ls = socket.create_server(("127.0.0.1", 0))
+    b_port = ls.getsockname()[1]
+    ls.close()
+    proxy = ChaosProxy(
+        "127.0.0.1", b_port, seed=seed, name="ab", rules=proxy_rules
+    ).start()
+    b = GangMember(
+        reg_url, "b", heartbeat_s=heartbeat_s,
+        listen_port=b_port, advertise_port=proxy.port,
+    )
+    a = GangMember(reg_url, "a", heartbeat_s=heartbeat_s)
+    time.sleep(3 * heartbeat_s)  # both on the roster
+    return a, b, proxy
+
+
+def test_reducer_crc_flip_detected_nacked_retransmitted():
+    """One flipped payload byte on the a->b link: b detects (CRC), NACKs,
+    a retransmits, and BOTH members compute the exact correct sum —
+    wire corruption becomes a counted retransmit, never a wrong sum."""
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.parallel.elastic import Generation, TcpReducer
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    reg = DriverRegistry(ttl_s=10.0)
+    # frame layout: 32-byte head + 1-byte name -> payload starts at 33
+    a, b, proxy = _gang_pair(
+        reg.url,
+        [WireRule("flip", direction="c2s", at_offset=40)],
+    )
+    before = obs.sum_samples(
+        obs.parse_text(obs.render()), "mmlspark_elastic_crc_failures_total"
+    )
+    gen = Generation(gen=1, members=["a", "b"])
+    ra = TcpReducer(a, gen, timeout_s=20.0)
+    rb = TcpReducer(b, gen, timeout_s=20.0)
+    try:
+        out = {}
+        xa = np.arange(8, dtype=np.float64)
+        xb = np.full(8, 2.0)
+        ta = threading.Thread(
+            target=lambda: out.__setitem__("a", ra.allreduce(xa))
+        )
+        tb = threading.Thread(
+            target=lambda: out.__setitem__("b", rb.allreduce(xb))
+        )
+        ta.start(); tb.start(); ta.join(25); tb.join(25)
+        expected = xa + xb
+        assert np.array_equal(out["a"], expected)
+        assert np.array_equal(out["b"], expected)
+        assert b.crc_drops == 1          # detected exactly the one flip
+        assert ra.retransmits == 1       # and recovered by retransmit
+        after = obs.sum_samples(
+            obs.parse_text(obs.render()),
+            "mmlspark_elastic_crc_failures_total",
+        )
+        assert after - before == 1
+        assert [e.offset for e in proxy.journal() if e.kind == "flip"] \
+            == [40]
+    finally:
+        ra.close(); rb.close(); a.close(); b.close()
+        proxy.stop(); reg.stop()
+
+
+def test_reducer_crc_same_seed_same_schedule():
+    """Re-running the same seeded flip scenario reproduces the identical
+    wire fault schedule (the determinism half of the acceptance gate)."""
+    from mmlspark_tpu.parallel.elastic import Generation, TcpReducer
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    def run():
+        reg = DriverRegistry(ttl_s=10.0)
+        a, b, proxy = _gang_pair(
+            reg.url, [WireRule("flip", direction="c2s", at_offset=40)],
+            seed=11,
+        )
+        gen = Generation(gen=1, members=["a", "b"])
+        ra = TcpReducer(a, gen, timeout_s=20.0)
+        rb = TcpReducer(b, gen, timeout_s=20.0)
+        try:
+            out = {}
+            ta = threading.Thread(target=lambda: out.__setitem__(
+                "a", ra.allreduce(np.ones(4))))
+            tb = threading.Thread(target=lambda: out.__setitem__(
+                "b", rb.allreduce(np.ones(4))))
+            ta.start(); tb.start(); ta.join(25); tb.join(25)
+            assert np.array_equal(out["a"], np.full(4, 2.0))
+            return proxy.schedule_digest()
+        finally:
+            ra.close(); rb.close(); a.close(); b.close()
+            proxy.stop(); reg.stop()
+
+    assert run() == run()
+
+
+def test_reducer_persistent_corruption_is_peer_loss_never_wrong_sum():
+    """Every a->b frame byte-striped with flips: retransmits arrive torn
+    too, so b's allreduce times out into the ordinary peer-loss path —
+    corruption may evict a peer, it can NEVER produce a wrong sum."""
+    from mmlspark_tpu.parallel.elastic import (
+        Generation,
+        HostLostError,
+        TcpReducer,
+    )
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    reg = DriverRegistry(ttl_s=10.0)
+    # stride-1 flips corrupt EVERY payload byte of every frame on a->b
+    a, b, proxy = _gang_pair(
+        reg.url,
+        [WireRule("flip", direction="c2s", at_offset=33, every_bytes=1)],
+    )
+    gen = Generation(gen=1, members=["a", "b"])
+    ra = TcpReducer(a, gen, timeout_s=2.5)
+    rb = TcpReducer(b, gen, timeout_s=2.5)
+    try:
+        out, errs = {}, {}
+
+        def run(red, name):
+            try:
+                out[name] = red.allreduce(np.ones(4))
+            except Exception as e:  # noqa: BLE001
+                errs[name] = e
+
+        ta = threading.Thread(target=run, args=(ra, "a"))
+        tb = threading.Thread(target=run, args=(rb, "b"))
+        ta.start(); tb.start(); ta.join(15); tb.join(15)
+        # b never got a clean frame: its wait times out as peer loss
+        assert isinstance(errs.get("b"), HostLostError)
+        assert "b" not in out
+        assert b.crc_drops >= 1
+    finally:
+        ra.close(); rb.close(); a.close(); b.close()
+        proxy.stop(); reg.stop()
+
+
+# -- gateway forwarding under a hostile wire ---------------------------------
+
+
+def _echo_worker(counter):
+    from mmlspark_tpu.serving.query import ServingQuery
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    srv = WorkerServer()
+    info = srv.start()
+
+    def handler(reqs):
+        counter.extend(r.id for r in reqs)
+        return {r.id: (200, r.body or b"ok", {}) for r in reqs}
+
+    q = ServingQuery(srv, handler).start()
+    return srv, q, info
+
+
+def test_gateway_truncated_response_no_double_dispatch():
+    """A worker reply RST mid-frame proves the worker executed: the
+    gateway answers 502 instead of re-dispatching the non-idempotent
+    POST to another backend (which would double-execute it)."""
+    from mmlspark_tpu.serving.distributed import ServingGateway
+    from mmlspark_tpu.serving.server import ServiceInfo
+
+    handled: list = []
+    srv, q, info = _echo_worker(handled)
+    # measure one full response's wire length to position the truncation
+    # inside the SECOND response on backend A's keep-alive connection
+    body = b"0123456789"
+    s = socket.create_connection(("127.0.0.1", info.port), timeout=5)
+    s.sendall(
+        b"POST / HTTP/1.1\r\nContent-Length: 10\r\n\r\n" + body
+    )
+    s.settimeout(5)
+    resp1 = b""
+    while b"0123456789" not in resp1:
+        resp1 += s.recv(4096)
+    s.close()
+    resp_len = len(resp1)
+    handled.clear()
+    # two proxy "backends" over the same worker: A truncates its second
+    # response mid-frame, B is clean
+    proxy_a = ChaosProxy(
+        "127.0.0.1", info.port, seed=0, name="gw-a",
+        rules=[WireRule("truncate_rst", direction="s2c",
+                        at_offset=resp_len + 5)],
+    ).start()
+    proxy_b = ChaosProxy("127.0.0.1", info.port, seed=0, name="gw-b").start()
+    gw = ServingGateway(
+        workers=[
+            ServiceInfo(name="serving", host="127.0.0.1", port=proxy_a.port),
+            ServiceInfo(name="serving", host="127.0.0.1", port=proxy_b.port),
+        ],
+        num_dispatchers=1, request_timeout_s=5.0,
+    )
+    ginfo = gw.start()
+    try:
+        # round-robin: r1 -> A (ok), r2 -> B (ok), r3 -> A (truncated)
+        assert _post(ginfo.port, body)[0] == 200
+        assert _post(ginfo.port, body)[0] == 200
+        status, out = _post(ginfo.port, body)
+        assert status == 502 and b"truncated" in out
+        # THE pin: the request executed exactly once — no re-dispatch to
+        # B after A's torn reply (pre-fix behavior double-executed here)
+        assert len(handled) == 3
+        assert gw.failed == 1 and gw.retried == 0
+    finally:
+        gw.stop()
+        proxy_a.stop(); proxy_b.stop()
+        q.stop(); srv.stop()
+
+
+def test_gateway_throttled_link_no_breaker_blame():
+    """A starved (but correct) link costs latency only: every request
+    completes, the breaker stays closed, nothing is retried."""
+    from mmlspark_tpu.serving.distributed import ServingGateway
+    from mmlspark_tpu.serving.server import ServiceInfo
+
+    handled: list = []
+    srv, q, info = _echo_worker(handled)
+    proxy = ChaosProxy(
+        "127.0.0.1", info.port, seed=0, name="slowlink",
+        rules=[WireRule("throttle", bytes_per_s=4096.0),
+               WireRule("latency", delay_ms=5.0)],
+    ).start()
+    gw = ServingGateway(
+        workers=[
+            ServiceInfo(name="serving", host="127.0.0.1", port=proxy.port)
+        ],
+        num_dispatchers=1, request_timeout_s=10.0,
+    )
+    ginfo = gw.start()
+    try:
+        for i in range(6):
+            status, out = _post(ginfo.port, b"payload-%d" % i)
+            assert status == 200 and out == b"payload-%d" % i
+        assert gw.forwarded == 6 and gw.failed == 0 and gw.retried == 0
+        assert all(
+            s == "closed" for s in gw.pool.breaker_states().values()
+        )
+    finally:
+        gw.stop()
+        proxy.stop()
+        q.stop(); srv.stop()
+
+
+def test_gateway_asymmetric_partition_fails_over():
+    """gateway->w1 blackholed (sends vanish) while w2 lives: with
+    idempotent retry enabled every request still completes on w2, and
+    the partitioned backend takes the blame, not the healthy one."""
+    from mmlspark_tpu.serving.distributed import ServingGateway
+    from mmlspark_tpu.serving.server import ServiceInfo
+
+    handled: list = []
+    srv, q, info = _echo_worker(handled)
+    bh = ChaosProxy(
+        "127.0.0.1", info.port, seed=0, name="part",
+        rules=[WireRule("blackhole", direction="c2s")],
+    ).start()
+    gw = ServingGateway(
+        workers=[
+            ServiceInfo(name="serving", host="127.0.0.1", port=bh.port),
+            ServiceInfo(name="serving", host="127.0.0.1", port=info.port),
+        ],
+        num_dispatchers=1, request_timeout_s=1.0, retry_after_send=True,
+    )
+    ginfo = gw.start()
+    try:
+        for i in range(4):
+            status, _ = _post(ginfo.port, b"x", timeout=10.0)
+            assert status == 200
+        assert gw.forwarded == 4
+    finally:
+        gw.stop()
+        bh.stop()
+        q.stop(); srv.stop()
+
+
+# -- registry blackhole: bounded beats, bounded shutdown ----------------------
+
+
+def test_registry_blackhole_bounds_heartbeat_and_shutdown():
+    from mmlspark_tpu.parallel.elastic import GangMember
+    from mmlspark_tpu.serving.registry import DriverRegistry
+    from mmlspark_tpu.serving.server import ServiceInfo
+
+    reg = DriverRegistry(ttl_s=10.0)
+    bh = ChaosProxy(
+        "127.0.0.1", reg.port, seed=0, name="reg-bh",
+        rules=[WireRule("blackhole", direction="s2c")],
+    ).start()
+    # a register against the blackholed registry returns at its explicit
+    # timeout, not the transport default
+    t0 = time.monotonic()
+    ok = DriverRegistry.register(
+        bh.url, ServiceInfo("serving", "127.0.0.1", 1), timeout=1.0
+    )
+    assert not ok and time.monotonic() - t0 < 4.0
+    # a gang member heartbeating THROUGH the blackhole: each beat is
+    # bounded, and close() (deregister) cannot hang the shutdown
+    m = GangMember(bh.url, "m", heartbeat_s=0.5)
+    t0 = time.monotonic()
+    m.heartbeat()
+    assert time.monotonic() - t0 < 5.0
+    t0 = time.monotonic()
+    m.close()
+    assert time.monotonic() - t0 < 8.0
+    bh.stop()
+    reg.stop()
+
+
+# -- invariant checker --------------------------------------------------------
+
+
+def _fake_metrics(**families):
+    """{name: {(label_tuple): value}} -> the parse_text dict shape."""
+    out = {}
+    for name, samples in families.items():
+        for labels, v in samples.items():
+            out[(name, labels)] = float(v)
+    return out
+
+
+def test_invariant_checker_green_and_each_violation():
+    gw_label = (("server", "serving-gateway"),)
+    w_label = (("server", "serving"),)
+    healthy = {
+        "http://gw": _fake_metrics(
+            mmlspark_serving_requests_total={gw_label: 10},
+            mmlspark_gateway_requests_total={(): 8},
+            mmlspark_gateway_failures_total={
+                (("reason", "deadline"),): 2,
+            },
+            mmlspark_serving_inflight_requests={gw_label: 0},
+            mmlspark_gateway_breaker_state={
+                (("backend", "127.0.0.1:1"),): 1,
+            },
+            mmlspark_gateway_retry_budget_remaining_ratio={(): 0.7},
+        ),
+        "http://w1": _fake_metrics(
+            mmlspark_serving_requests_total={w_label: 9},
+            mmlspark_serving_inflight_requests={w_label: 0},
+            mmlspark_modelstore_version_refs_count={(): 0},
+        ),
+        "http://online": _fake_metrics(
+            mmlspark_online_ingested_total={(): 100},
+            mmlspark_online_examples_total={(): 80},
+            mmlspark_online_buffered_examples_count={(): 12},
+            mmlspark_online_shed_examples_total={(): 5},
+            mmlspark_online_poisoned_examples_total={(): 3},
+        ),
+    }
+
+    def checker(scrapes):
+        return InvariantChecker(
+            gateway_url="http://gw", worker_urls=["http://w1"],
+            online_url="http://online", scrape=scrapes.get,
+        )
+
+    assert checker(healthy).check(final=True) == []
+
+    def broken(url, name, labels, v):
+        s = {u: dict(p) for u, p in healthy.items()}
+        s[url][(name, labels)] = v
+        return s
+
+    cases = [
+        ("gateway_conservation",
+         broken("http://gw", "mmlspark_gateway_requests_total", (), 5)),
+        ("worker_conservation",
+         broken("http://w1", "mmlspark_serving_inflight_requests",
+                w_label, 2)),
+        ("modelstore_refs_drain",
+         broken("http://w1", "mmlspark_modelstore_version_refs_count",
+                (), 1)),
+        ("breaker_sane",
+         broken("http://gw", "mmlspark_gateway_breaker_state",
+                (("backend", "127.0.0.1:1"),), 7)),
+        ("retry_budget_sane",
+         broken("http://gw", "mmlspark_gateway_retry_budget_remaining_ratio",
+                (), 1.4)),
+        ("online_conservation",
+         broken("http://online", "mmlspark_online_examples_total", (), 70)),
+        ("artifact_quarantine",
+         broken("http://w1", "mmlspark_artifact_verify_failures_total",
+                (), 3)),
+    ]
+    for expect, scrapes in cases:
+        names = [v.name for v in checker(scrapes).check(final=True)]
+        assert expect in names, (expect, names)
+    # mid-soak (final=False) tolerates in-flight imbalance in the safe
+    # direction but still rejects over-accounting
+    midsoak = broken("http://gw", "mmlspark_gateway_requests_total", (), 5)
+    assert checker(midsoak).check(final=False) == []
+    over = broken("http://gw", "mmlspark_gateway_requests_total", (), 50)
+    names = {v.name for v in checker(over).check(final=False)}
+    # over-accounting trips the gateway law AND the fleet law (workers
+    # can't have accepted fewer than the gateway claims to have forwarded)
+    assert names == {"gateway_conservation", "fleet_conservation"}
+
+
+def test_invariant_checker_skips_fleet_law_on_unreachable_worker():
+    """A SIGKILLed worker's scrape returns None: its accepted counter is
+    invisible, so the Σworker >= forwarded law must be SKIPPED, not
+    reported as a violation against a correctly-accounting fleet
+    (review regression)."""
+    gw_label = (("server", "serving-gateway"),)
+    scrapes = {
+        "http://gw": _fake_metrics(
+            mmlspark_serving_requests_total={gw_label: 10},
+            mmlspark_gateway_requests_total={(): 10},
+            mmlspark_gateway_failures_total={},
+            mmlspark_serving_inflight_requests={gw_label: 0},
+        ),
+        # w1 answered some of the 10 forwards, then was SIGKILLed
+        "http://w1": None,
+        "http://w2": _fake_metrics(
+            mmlspark_serving_requests_total={(("server", "serving"),): 4},
+            mmlspark_serving_inflight_requests={
+                (("server", "serving"),): 0,
+            },
+        ),
+    }
+    checker = InvariantChecker(
+        gateway_url="http://gw", worker_urls=["http://w1", "http://w2"],
+        scrape=scrapes.get,
+    )
+    assert checker.check(final=True) == []
+
+
+def test_invariant_checker_disables_fleet_law_on_worker_restart():
+    """A supervisor respawn re-registers the SAME URL with a reset
+    accepted counter: the gateway's forwarded total spans both process
+    eras while the worker sum only counts the new one, so the law must
+    be disabled (counter went backward), never reported as a violation
+    against a correctly-accounting fleet (review regression)."""
+    gw_label = (("server", "serving-gateway"),)
+    w_label = (("server", "serving"),)
+
+    def gw(forwarded):
+        return _fake_metrics(
+            mmlspark_serving_requests_total={gw_label: forwarded},
+            mmlspark_gateway_requests_total={(): forwarded},
+            mmlspark_gateway_failures_total={},
+            mmlspark_serving_inflight_requests={gw_label: 0},
+        )
+
+    def w(accepted):
+        return _fake_metrics(
+            mmlspark_serving_requests_total={w_label: accepted},
+            mmlspark_serving_inflight_requests={w_label: 0},
+        )
+
+    scrapes = {"http://gw": gw(10), "http://w1": w(10)}
+    checker = InvariantChecker(
+        gateway_url="http://gw", worker_urls=["http://w1"],
+        scrape=lambda u: scrapes[u],
+    )
+    assert checker.check() == []
+    # SIGKILL + respawn on the same port: counter restarts, more traffic
+    scrapes["http://gw"] = gw(14)
+    scrapes["http://w1"] = w(3)  # 3 < the 10 this checker already saw
+    assert checker.check(final=True) == []
+
+
+def test_invariant_checker_store_quarantine_never_served(tmp_path):
+    from mmlspark_tpu.serving.artifacts import ArtifactStore
+
+    store = ArtifactStore(str(tmp_path / "store"))
+    blob = tmp_path / "a.bin"
+    blob.write_bytes(b"payload-bytes")
+    ref = store.put(str(blob), name="a.bin")
+    checker = InvariantChecker(scrape=lambda u: None, stores=[store])
+    assert checker.check(final=True) == []
+    store.quarantine(ref.digest)
+    # the REAL store's guards hold: quarantined digests are invisible to
+    # both advertisement and the ranged-GET handler — still green
+    assert checker.check(final=True) == []
+
+    class LeakyStore:
+        """A buggy store that advertises and serves quarantined bytes —
+        the checker must catch exactly this."""
+
+        root = "leaky"
+        _quarantined = {ref.digest}
+
+        def refs(self):
+            return [f"a.bin@{ref.digest}"]
+
+        def handle_http(self, path, headers):
+            return 200, b"poison", {}
+
+    violations = InvariantChecker(
+        scrape=lambda u: None, stores=[LeakyStore()]
+    ).check(final=True)
+    assert {v.name for v in violations} == {"artifact_quarantine"}
+    assert len(violations) == 2  # advertised AND served
+
+
+# -- conductor ----------------------------------------------------------------
+
+
+def test_conductor_scenario_validation_and_run():
+    port, close = _raw_echo_server()
+    proxy = ChaosProxy("127.0.0.1", port, seed=1, name="lnk").start()
+    victim = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    try:
+        # a typo'd rule kind / signal / link fails the LOAD, not the run
+        with pytest.raises(ValueError, match="unknown wire rule kind"):
+            Scenario.from_spec({"steps": [
+                {"action": "rules", "link": "lnk",
+                 "rules": [{"kind": "fliip"}]},
+            ]})
+        with pytest.raises(ValueError, match="unknown signal"):
+            Scenario.from_spec({"steps": [
+                {"action": "signal", "target": "v", "signal": "SIGFOO"},
+            ]})
+        sc = Scenario.from_spec(json.dumps({"seed": 4, "steps": [
+            {"at_s": 0.0, "action": "rules", "link": "lnk",
+             "rules": [{"kind": "latency", "delay_ms": 1}]},
+            {"at_s": 0.05, "action": "signal", "target": "v",
+             "signal": "SIGSTOP"},
+            {"at_s": 0.15, "action": "signal", "target": "v",
+             "signal": "SIGCONT"},
+            {"at_s": 0.2, "action": "clear", "link": "lnk"},
+            {"at_s": 0.25, "action": "check"},
+        ]}))
+        with pytest.raises(ValueError, match="unknown link"):
+            ChaosConductor(sc, proxies={}, pids={"v": victim.pid})
+        with pytest.raises(ValueError, match="unknown target"):
+            ChaosConductor(sc, proxies={"lnk": proxy}, pids={})
+        conductor = ChaosConductor(
+            sc, proxies={"lnk": proxy}, pids={"v": victim.pid}
+        )
+
+        states = []
+
+        def state():
+            with open(f"/proc/{victim.pid}/stat") as f:
+                return f.read().split(") ", 1)[1].split()[0]
+
+        t = threading.Thread(target=lambda: states.append(
+            (time.sleep(0.1), state())[1]
+        ))
+        t.start()
+        journal = conductor.run()
+        t.join(5)
+        assert states == ["T"]      # SIGSTOP landed mid-scenario
+        assert state() in ("S", "R")  # SIGCONT resumed it (not stopped)
+        actions = [e["action"] for e in journal]
+        assert actions == ["rules", "signal", "signal", "clear", "check"]
+        assert all("trace_id" in e and "t_wall" in e for e in journal)
+        assert proxy.rules() == ()  # the clear step really applied
+        assert journal[-1].get("skipped") is True  # no checker attached
+    finally:
+        victim.kill()
+        victim.wait(5)
+        proxy.stop()
+        close()
+
+
+def test_conductor_accumulates_mid_soak_violations():
+    """A mid-soak red followed by a green final check must still leave
+    the run red: ``violations`` is the union of every check action, not
+    the last one (review regression — exit code 0 would bless a soak
+    that provably violated an invariant)."""
+
+    class FlakyChecker:
+        def __init__(self):
+            self.calls = 0
+
+        def check(self, final=False):
+            self.calls += 1
+            return [] if final else ["gateway_conservation: mid-soak red"]
+
+    sc = Scenario.from_spec({"steps": [
+        {"at_s": 0.0, "action": "check"},
+        {"at_s": 0.01, "action": "check", "final": True},
+    ]})
+    conductor = ChaosConductor(sc, checker=FlakyChecker())
+    journal = conductor.run()
+    assert len(conductor.violations) == 1
+    # the journal still records the PER-STEP count (final check green)
+    assert [e.get("violations") for e in journal] == [1, 0]
+
+
+# -- graceful drain + rolling restart ----------------------------------------
+
+
+def test_worker_graceful_drain_replies_everything(tmp_path):
+    """stopper.drain(): deregister -> pause accepting -> every accepted
+    request (incl. staged continuous batches) replied before returning;
+    the ingress in-flight gauge reads zero — nothing dropped."""
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.serving.fleet import run_worker
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    reg = DriverRegistry(ttl_s=10.0)
+    srv, q, stopper = run_worker(
+        reg.url, model="echo", host="127.0.0.1", heartbeat_s=0.2,
+        slo_p99_ms=None, artifact_dir=str(tmp_path / "art"),
+    )
+    stop_load = threading.Event()
+    results = {"ok": 0, "refused": 0, "dropped": 0}
+
+    def load():
+        while not stop_load.is_set():
+            try:
+                status, _ = _post(
+                    srv.port, json.dumps({"v": 1}).encode(), timeout=5.0
+                )
+                if status == 200:
+                    results["ok"] += 1
+                else:
+                    results["dropped"] += 1
+            except OSError:
+                # refused/reset connect AFTER pause_accepting is the
+                # drain working as designed, not a dropped request
+                results["refused"] += 1
+                time.sleep(0.02)
+
+    threads = [threading.Thread(target=load, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.7)
+    assert stopper.drain(timeout_s=8.0) is True
+    assert srv.inflight() == 0
+    assert reg.services("serving") == []  # deregistered everywhere
+    stop_load.set()
+    for t in threads:
+        t.join(5)
+    q.stop()
+    srv.stop()
+    reg.stop()
+    assert results["ok"] > 0 and results["dropped"] == 0
+    parsed = obs.parse_text(obs.render())
+    assert obs.sum_samples(
+        parsed, "mmlspark_serving_inflight_requests", {"server": "serving"}
+    ) == 0
+
+
+def test_rostered_matches_ports_and_excludes_stale_generation(monkeypatch):
+    """_rostered matches the roster entry's bound OR forwarded port (an
+    exact-URL compare against the forwarded-preferring gateway URL never
+    matched a port-forwarded or 0.0.0.0-bound worker), and ``not_boot``
+    excludes the SIGTERM'd process's own stale entry — a blackholed
+    deregister on a TTL-less registry must not satisfy the roll wait
+    (review regressions)."""
+    from mmlspark_tpu.serving import fleet as fleet_mod
+    from mmlspark_tpu.serving.supervisor import FleetSupervisor
+
+    entries = [
+        {"host": "0.0.0.0", "port": 9101, "boot": 111.0},
+        {"host": "10.0.0.2", "port": 9102,
+         "forwarded_host": "edge", "forwarded_port": 19102, "boot": 222.0},
+    ]
+    monkeypatch.setattr(
+        fleet_mod, "roster_entries_from_registry",
+        lambda *_a, **_k: entries,
+    )
+    sup = FleetSupervisor.__new__(FleetSupervisor)
+    sup.registry_url = "http://registry:1/"
+    sup.service_name = "serving"
+    assert sup._rostered("http://127.0.0.1:9101")          # bound port
+    assert sup._rostered("http://127.0.0.1:19102")         # forwarded port
+    assert not sup._rostered("http://127.0.0.1:9999")
+    assert sup._rostered(None)
+    # the stale generation is excluded; a fresh boot stamp matches again
+    assert sup._roster_boot("http://127.0.0.1:9101") == 111.0
+    assert not sup._rostered("http://127.0.0.1:9101", not_boot=111.0)
+    entries[0]["boot"] = 333.0  # replacement re-registered
+    assert sup._rostered("http://127.0.0.1:9101", not_boot=111.0)
+
+
+def test_supervisor_rolling_restart_drill_zero_drops(tmp_path):
+    """THE drill (acceptance): a supervisor rolls two fleet workers one
+    at a time (SIGTERM -> graceful drain -> respawn) under sustained
+    gateway load — zero dropped requests across both restarts."""
+    from mmlspark_tpu.serving.distributed import ServingGateway
+    from mmlspark_tpu.serving.registry import DriverRegistry
+    from mmlspark_tpu.serving.supervisor import (
+        FleetSupervisor,
+        charge_from_worker_args,
+    )
+
+    reg = DriverRegistry(ttl_s=6.0)
+
+    def free_port():
+        s = socket.create_server(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    p1, p2 = free_port(), free_port()
+    charges = [
+        charge_from_worker_args(
+            f"--model echo --host 127.0.0.1 --port {p} --heartbeat-s 0.3 "
+            f"--drain-s 6 --slo-p99-ms 0",
+            reg.url, i,
+        )
+        for i, p in enumerate((p1, p2))
+    ]
+    sup = FleetSupervisor(
+        charges, registry_url=reg.url, probe_s=0.3, backoff_s=0.2,
+        stable_s=2.0,
+    ).start()
+    gw = ServingGateway(registry_url=reg.url, refresh_s=0.3,
+                        request_timeout_s=10.0)
+    ginfo = gw.start()
+    try:
+        # both workers must come up, register, AND land in the gateway's
+        # pool (its refresh runs every 0.3 s) before load starts — the
+        # drill measures the ROLL, not the fleet's cold start
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if len(reg.services("serving")) >= 2 and gw.pool.size() >= 2:
+                break
+            time.sleep(0.25)
+        assert gw.pool.size() >= 2, "workers never became routable"
+
+        stop_load = threading.Event()
+        failures: list = []
+        counts = {"ok": 0}
+
+        def load(i):
+            while not stop_load.is_set():
+                try:
+                    status, body = _post(
+                        ginfo.port, json.dumps({"i": i}).encode(),
+                        timeout=15.0,
+                    )
+                    if status == 200:
+                        counts["ok"] += 1
+                    else:
+                        failures.append((status, body[:80]))
+                except OSError as e:
+                    failures.append(("conn", str(e)))
+                time.sleep(0.005)
+
+        threads = [
+            threading.Thread(target=load, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        assert sup.rolling_restart(wait_up_s=90.0) is True
+        time.sleep(1.0)
+        stop_load.set()
+        for t in threads:
+            t.join(20)
+        assert counts["ok"] > 50
+        assert failures == [], failures[:5]
+        assert sum(c.restarts for c in sup.charges) == 2
+    finally:
+        gw.stop()
+        sup.stop()
+        reg.stop()
+
+
+# -- THE SOAK (acceptance) ----------------------------------------------------
+
+
+def test_hostile_wire_soak_invariants_green(tmp_path):
+    """Seeded hostile-wire soak against a live gateway + 2 workers + a
+    2-member gang: byte-flip on the allreduce link (CRC-detected, never
+    summed), asymmetric blackhole on one gateway->worker link (failover),
+    slowloris + throttle + jitter on the client link (shed/absorbed) —
+    and the fleet-wide invariant checker ends GREEN: zero silent
+    corruption, zero unaccounted requests."""
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.parallel.elastic import Generation, TcpReducer
+    from mmlspark_tpu.serving.distributed import ServingGateway
+    from mmlspark_tpu.serving.modelstore import ModelDispatcher, ModelStore
+    from mmlspark_tpu.serving.registry import DriverRegistry
+    from mmlspark_tpu.serving.server import ServiceInfo, WorkerServer
+
+    obs.reset()
+    reg = DriverRegistry(ttl_s=None)
+
+    workers = []
+    for _i in range(2):
+        srv = WorkerServer(name="serving", header_deadline_s=1.0)
+        info = srv.start()
+        store = ModelStore()
+        store.load("echo", "echo", wait=True)
+        disp = ModelDispatcher(srv, store, default_model="echo").start()
+        workers.append((srv, disp, store, info))
+
+    # worker2's data path rides a proxy so the scenario can partition it
+    w2_proxy = ChaosProxy(
+        "127.0.0.1", workers[1][3].port, seed=7, name="gw-w2"
+    ).start()
+    DriverRegistry.register(reg.url, ServiceInfo(
+        "serving", "127.0.0.1", workers[0][3].port, models=("echo",),
+        boot=time.time(),
+    ))
+    DriverRegistry.register(reg.url, ServiceInfo(
+        "serving", "127.0.0.1", w2_proxy.port, models=("echo",),
+        boot=time.time(),
+    ))
+    gw = ServingGateway(
+        registry_url=reg.url, refresh_s=0.3, request_timeout_s=1.5,
+        retry_after_send=True,  # echo is idempotent: clean failover
+        header_deadline_s=1.0,
+    )
+    ginfo = gw.start()
+    # the client link rides its own seeded proxy
+    client_proxy = ChaosProxy(
+        "127.0.0.1", ginfo.port, seed=7, name="client"
+    ).start()
+
+    stop_load = threading.Event()
+    results = {"ok": 0, "failed": 0, "conn": 0}
+
+    def load():
+        while not stop_load.is_set():
+            try:
+                status, _ = _post(
+                    client_proxy.port, b'{"x": 1}', timeout=20.0
+                )
+                if status == 200:
+                    results["ok"] += 1
+                else:
+                    results["failed"] += 1
+            except OSError:
+                results["conn"] += 1
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=load, daemon=True) for _ in range(3)]
+
+    # the gang: member b's allreduce link flips one byte mid-payload
+    gang_reg = DriverRegistry(ttl_s=10.0)
+    a, b, ab_proxy = _gang_pair(
+        gang_reg.url,
+        [WireRule("flip", direction="c2s", at_offset=40)],
+        seed=7,
+    )
+    gen = Generation(gen=1, members=["a", "b"])
+    ra = TcpReducer(a, gen, timeout_s=20.0)
+    rb = TcpReducer(b, gen, timeout_s=20.0)
+    gang_sums = {}
+
+    def gang_run(red, name):
+        acc = []
+        for _ in range(5):
+            acc.append(red.allreduce(np.arange(16, dtype=np.float64)))
+        gang_sums[name] = acc
+
+    checker = InvariantChecker(
+        gateway_url=f"http://127.0.0.1:{ginfo.port}/",
+        worker_urls=[
+            f"http://127.0.0.1:{w[3].port}" for w in workers
+        ],
+        service_name="serving",
+    )
+    scenario = Scenario.from_spec({"seed": 7, "steps": [
+        {"at_s": 0.0, "action": "rules", "link": "client", "rules": [
+            {"kind": "latency", "delay_ms": 1.0, "jitter_ms": 3.0},
+            {"kind": "throttle", "direction": "c2s",
+             "bytes_per_s": 65536.0},
+        ]},
+        {"at_s": 1.0, "action": "rules", "link": "gw-w2", "rules": [
+            {"kind": "blackhole", "direction": "c2s"},
+        ]},
+        {"at_s": 3.0, "action": "clear", "link": "gw-w2"},
+        {"at_s": 3.5, "action": "check"},   # mid-soak: inequality forms
+        {"at_s": 4.0, "action": "clear", "link": "client"},
+    ]})
+    conductor = ChaosConductor(
+        scenario,
+        proxies={"client": client_proxy, "gw-w2": w2_proxy},
+        checker=checker,
+    )
+    try:
+        for t in threads:
+            t.start()
+        # slowloris against the gateway ingress, dripping forever
+        dripper = socket.create_connection(
+            ("127.0.0.1", ginfo.port), timeout=5
+        )
+        dripper.sendall(b"GET /x")
+        gt_a = threading.Thread(target=gang_run, args=(ra, "a"))
+        gt_b = threading.Thread(target=gang_run, args=(rb, "b"))
+        gt_a.start(); gt_b.start()
+        journal = conductor.run()
+        gt_a.join(30); gt_b.join(30)
+        # the dripper was shed at the 1 s header deadline (408/close),
+        # without stalling the soak traffic around it
+        dripper.settimeout(5)
+        try:
+            head = dripper.recv(256)
+            assert (not head) or b"408" in head.split(b"\r\n", 1)[0]
+        except OSError:
+            pass
+        dripper.close()
+        stop_load.set()
+        for t in threads:
+            t.join(25)
+        # traffic survived the storm: the blackhole window fails over
+        # (idempotent retry), nothing is silently lost
+        assert results["ok"] > 30, results
+        # mid-soak check ran and was green (inequality forms)
+        assert conductor.violations == []
+        assert [e["action"] for e in journal].count("check") == 1
+        # the flipped allreduce byte was DETECTED, and every sum on both
+        # members is exactly right
+        expected = 2 * np.arange(16, dtype=np.float64)
+        for name in ("a", "b"):
+            for arr in gang_sums[name]:
+                assert np.array_equal(arr, expected)
+        assert b.crc_drops >= 1
+        assert obs.sum_samples(
+            obs.parse_text(obs.render()),
+            "mmlspark_elastic_crc_failures_total",
+        ) >= 1
+        # FINAL gate: traffic drained -> every conservation law closes
+        time.sleep(0.5)
+        violations = checker.check(final=True)
+        assert violations == [], checker.report(violations)
+    finally:
+        stop_load.set()
+        for t in threads:
+            t.join(5)
+        ra.close(); rb.close(); a.close(); b.close()
+        ab_proxy.stop(); gang_reg.stop()
+        client_proxy.stop(); w2_proxy.stop()
+        gw.stop()
+        for srv, disp, _store, _info in workers:
+            disp.stop()
+            srv.stop()
+        reg.stop()
+        # the soak's counters must not leak into later in-process gates
+        obs.reset()

@@ -105,3 +105,37 @@ def test_fault_point_linter_catches_unexercised_point(tmp_path):
     violations, seen = lint([str(prod)], [str(tests_file)])
     assert seen == 2
     assert [v[0] for v in violations] == ["zzz.never_tested"]
+
+
+def test_wire_rule_kinds_all_exercised_by_tests():
+    """Every ChaosProxy rule kind (chaos/wire.py RULE_KINDS) must be
+    named by at least one test — an untested wire fault is an adversary
+    nobody has ever watched the fleet survive."""
+    from tools.lint_fault_points import (
+        MIN_EXPECTED_KINDS,
+        lint_chaos_rules,
+        wire_rule_kinds,
+    )
+
+    kinds = wire_rule_kinds()
+    assert len(kinds) >= MIN_EXPECTED_KINDS, (
+        f"only {len(kinds)} wire rule kinds extracted — the RULE_KINDS "
+        "regex no longer matches chaos/wire.py"
+    )
+    assert "flip" in kinds and "blackhole" in kinds
+    untested, n = lint_chaos_rules()
+    assert n == len(kinds)
+    assert untested == [], untested
+
+
+def test_wire_rule_linter_catches_untested_kind(tmp_path):
+    from tools.lint_fault_points import lint_chaos_rules
+
+    tests_file = tmp_path / "test_x.py"
+    # names every kind except truncate_rst
+    tests_file.write_text(
+        'WireRule("latency"); "throttle flip slowdrip blackhole"\n'
+    )
+    untested, n = lint_chaos_rules(test_paths=[str(tests_file)])
+    assert n >= 6
+    assert untested == ["truncate_rst"]

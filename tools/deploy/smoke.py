@@ -705,6 +705,102 @@ def _smoke_chaos(url: str, n: int, fault_plan: str) -> tuple:
     return ok, lat, plan
 
 
+def _verify_chaos_wire(
+    url: str, registry_url, service: str, seed: int = 7, n: int = 40,
+) -> bool:
+    """Opt-in hostile-wire gate (``--chaos-wire``): run a short SEEDED
+    wire-fault schedule — latency+jitter, a bandwidth throttle, and a
+    slowloris connection — through a ChaosProxy fronting the gateway,
+    then require (a) the normal traffic still completed, (b) the
+    slowloris was shed without wedging anything, and (c) the fleet-wide
+    invariant checker comes back green: chaos may cost latency or shed
+    requests, never accounting (docs/chaos.md)."""
+    _ensure_repo_path()
+    import socket as socket_mod
+
+    from mmlspark_tpu.chaos.invariants import InvariantChecker
+    from mmlspark_tpu.chaos.wire import ChaosProxy, WireRule
+
+    u = urllib.parse.urlparse(url)
+    proxy = ChaosProxy(
+        u.hostname, u.port or 80, seed=seed, name="smoke-gw",
+        rules=[
+            WireRule("latency", delay_ms=2.0, jitter_ms=5.0),
+            WireRule("throttle", direction="c2s", bytes_per_s=256 * 1024),
+        ],
+    ).start()
+    try:
+        ok = 0
+        for i in range(n):
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", proxy.port, timeout=15.0
+                )
+                conn.request(
+                    "POST", u.path or "/", json.dumps({"x": i}),
+                    {"Content-Type": "application/json"},
+                )
+                if conn.getresponse().status == 200:
+                    ok += 1
+                conn.close()
+            except OSError:
+                pass
+        # one slowloris: while a client drips a torn head and never
+        # finishes it, OTHER connections must keep being served — the
+        # non-stalling property (the 408 shed itself lands at the
+        # ingress's header deadline, too long to wait out in a smoke)
+        shed = True
+        dripper = None
+        try:
+            dripper = socket_mod.create_connection(
+                (u.hostname, u.port or 80), timeout=2.0
+            )
+            dripper.sendall(b"GET /heal")  # torn head, never completed
+        except OSError as e:
+            # no dripper on the wire = the non-stalling property was
+            # NOT tested — that must fail the gate, never pass it
+            # vacuously (the dripper dials the gateway direct, off the
+            # chaos link, so a refused connect is a real problem)
+            print(f"smoke: chaos-wire slowloris dripper failed to "
+                  f"connect: {e}")
+            shed = False
+        for i in range(3):
+            try:
+                conn = http.client.HTTPConnection(
+                    u.hostname, u.port or 80, timeout=5.0
+                )
+                conn.request(
+                    "POST", u.path or "/", json.dumps({"drip": i}),
+                    {"Content-Type": "application/json"},
+                )
+                if conn.getresponse().status != 200:
+                    shed = False
+                conn.close()
+            except OSError:
+                shed = False
+        if dripper is not None:
+            dripper.close()
+        checker = InvariantChecker(
+            gateway_url=url, registry_url=registry_url,
+            service_name=service, tolerance=0,
+        )
+        violations = checker.check(final=True)
+        digest = proxy.schedule_digest()[:16]
+        passed = ok >= int(0.9 * n) and shed and not violations
+        print(
+            f"smoke: chaos-wire gate — {ok}/{n} ok through the hostile "
+            f"link, slowloris shed: {shed}, invariants: "
+            f"{'green' if not violations else 'VIOLATED'} "
+            f"(schedule {digest}, seed {seed}) — "
+            f"{'ok' if passed else 'FAILED'}"
+        )
+        for v in violations:
+            print(f"smoke:   invariant violation: {v}")
+        return passed
+    finally:
+        proxy.stop()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="smoke.py", description=__doc__)
     ap.add_argument("url", nargs="?", default="http://127.0.0.1:8080/")
@@ -746,6 +842,18 @@ def main(argv=None) -> int:
                     help="model name to swap (default: echo)")
     ap.add_argument("--swap-spec", default="echo",
                     help="spec to load as the new version (default: echo)")
+    ap.add_argument(
+        "--chaos-wire", action="store_true",
+        help="opt-in hostile-wire gate: run a short seeded wire-fault "
+        "schedule (latency+jitter, throttle, slowloris) through a chaos "
+        "proxy fronting the gateway and require the fleet-wide "
+        "invariant checker green (mmlspark_tpu/chaos/; docs/chaos.md)",
+    )
+    ap.add_argument(
+        "--chaos-wire-seed", type=int, default=7,
+        help="seed for the --chaos-wire schedule (same seed => "
+        "byte-identical fault schedule)",
+    )
     args = ap.parse_args(argv)
     n = args.n_requests if args.n_requests is not None else args.n
     verify = not args.no_verify_metrics
@@ -800,9 +908,18 @@ def main(argv=None) -> int:
     flight_ok = True
     if plan is not None:
         flight_ok = _verify_flightrec(plan, faults_before)
+    chaos_wire_ok = True
+    if args.chaos_wire:
+        # AFTER the counter gates: the proxy's extra traffic lands on
+        # the fleet's counters, and the invariant checker judges the
+        # totals on its own terms
+        chaos_wire_ok = _verify_chaos_wire(
+            args.url, args.registry, args.service_name,
+            seed=args.chaos_wire_seed,
+        )
     return 0 if (
         ok == n and metrics_ok and swap_ok and trace_ok and flight_ok
-        and throughput_ok
+        and throughput_ok and chaos_wire_ok
     ) else 1
 
 

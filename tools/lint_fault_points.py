@@ -16,6 +16,11 @@ land without a test naming it.
   assertion on its fires — any mention counts; the gate is grep-grade
   by design).
 
+The same gate covers the WIRE-fault vocabulary: every rule kind in
+``chaos/wire.py``'s ``RULE_KINDS`` tuple (latency, throttle, flip, ...)
+must be named by at least one test — an untested wire fault is an
+adversary nobody has ever watched the fleet survive.
+
 A minimum-points guard protects the scan regex itself: if a refactor
 moves injection sites out of the pattern's reach, the linter fails
 loudly instead of silently passing an empty scan.
@@ -42,6 +47,14 @@ _INJECT_RE = re.compile(
 # fewer registered points than this means the scan regex rotted, not
 # that the tree lost its chaos hooks
 MIN_EXPECTED = 12
+
+# chaos/wire.py's rule vocabulary: RULE_KINDS = ("latency", ...) —
+# extracted by regex (same grep-grade spirit; an import would drag jax
+# into a lint tool)
+WIRE_RULES_FILE = os.path.join("mmlspark_tpu", "chaos", "wire.py")
+_RULE_KINDS_RE = re.compile(r"RULE_KINDS\s*=\s*\(([^)]*)\)", re.S)
+# fewer kinds than this means the extraction regex rotted
+MIN_EXPECTED_KINDS = 4
 
 
 def iter_sources(base_dirs: tuple = SCAN_DIRS) -> Iterator[str]:
@@ -83,6 +96,38 @@ def exercised_points(test_paths: Optional[list] = None) -> set:
     return mentioned
 
 
+def wire_rule_kinds(path: Optional[str] = None) -> list:
+    """The RULE_KINDS tuple of chaos/wire.py, regex-extracted."""
+    path = path or os.path.join(REPO, WIRE_RULES_FILE)
+    try:
+        with open(path, encoding="utf-8") as f:
+            m = _RULE_KINDS_RE.search(f.read())
+    except OSError:
+        return []  # no chaos subsystem in this checkout: nothing to lint
+    if m is None:
+        return []
+    return re.findall(r"""["']([a-z0-9_]+)["']""", m.group(1))
+
+
+def lint_chaos_rules(
+    test_paths: Optional[list] = None, rules_path: Optional[str] = None
+) -> tuple:
+    """Returns (untested_kinds, n_kinds): every wire-fault rule kind
+    must appear verbatim in at least one test file."""
+    kinds = wire_rule_kinds(rules_path)
+    mentioned: set = set()
+    paths = test_paths or [
+        os.path.join(REPO, TEST_DIR, f)
+        for f in os.listdir(os.path.join(REPO, TEST_DIR))
+        if f.endswith(".py")
+    ]
+    word_re = re.compile(r"[a-z0-9_]+")
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            mentioned.update(word_re.findall(f.read()))
+    return sorted(k for k in kinds if k not in mentioned), len(kinds)
+
+
 def lint(
     paths: Optional[list] = None, test_paths: Optional[list] = None
 ) -> tuple:
@@ -116,13 +161,37 @@ def main(argv: Optional[list] = None) -> int:
             "(add a chaos test arming a FaultPlan at it)",
             file=sys.stderr,
         )
-    if violations:
+    # the chaos-rule check is repo-global (it greps ALL of tests/ for
+    # every RULE_KINDS entry) — a path-scoped invocation must not fail
+    # on state unrelated to the paths it was asked to lint
+    untested_kinds, n_kinds = (
+        ([], 0) if args.paths else lint_chaos_rules()
+    )
+    if n_kinds < MIN_EXPECTED_KINDS and not args.paths:
+        print(
+            f"lint_fault_points: only {n_kinds} wire rule kinds found "
+            f"(expected >= {MIN_EXPECTED_KINDS}) — the RULE_KINDS "
+            "extraction no longer matches chaos/wire.py",
+            file=sys.stderr,
+        )
+        return 2
+    for kind in untested_kinds:
+        print(
+            f"{WIRE_RULES_FILE}: wire rule kind {kind!r} is exercised by "
+            "no test (add a ChaosProxy test applying it)",
+            file=sys.stderr,
+        )
+    if violations or untested_kinds:
         print(
             f"lint_fault_points: {len(violations)} untested point(s) of "
-            f"{seen}", file=sys.stderr,
+            f"{seen}, {len(untested_kinds)} untested wire rule kind(s) "
+            f"of {n_kinds}", file=sys.stderr,
         )
         return 1
-    print(f"lint_fault_points: {seen} fault points all exercised by tests")
+    print(
+        f"lint_fault_points: {seen} fault points and {n_kinds} wire rule "
+        "kinds all exercised by tests"
+    )
     return 0
 
 

@@ -32,7 +32,7 @@ SCAN_DIRS = ("mmlspark_tpu", "tools")
 SUBSYSTEMS = (
     "core", "io", "serving", "gateway", "registry", "parallel", "gbdt",
     "faults", "trace", "modelstore", "slo", "admission", "supervisor",
-    "compiler", "online", "autoscaler", "elastic", "artifact",
+    "compiler", "online", "autoscaler", "elastic", "artifact", "chaos",
 )
 # "state" is for enum-valued gauges (e.g. the circuit-breaker gauge
 # mmlspark_gateway_breaker_state: 0=closed 1=open 2=half-open)
