@@ -1,4 +1,5 @@
-from mmlspark_tpu.models.gbdt.binning import BinMapper
+from mmlspark_tpu.models.gbdt.binning import BinMapper, BinnedDataset
+from mmlspark_tpu.models.gbdt.sketch import QuantileSketch
 from mmlspark_tpu.models.gbdt.booster import Booster, Tree
 from mmlspark_tpu.models.gbdt.checkpoint import (
     TrainCheckpoint,
@@ -18,6 +19,8 @@ from mmlspark_tpu.models.gbdt.estimators import (
 
 __all__ = [
     "BinMapper",
+    "BinnedDataset",
+    "QuantileSketch",
     "Booster",
     "Tree",
     "LightGBMDelegate",

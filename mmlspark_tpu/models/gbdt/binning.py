@@ -204,6 +204,14 @@ class BinMapper:
     def num_bins(self, f: int) -> int:
         return len(self.uppers[f]) + 2  # missing bin + len(uppers)+1 value bins
 
+    def transform_into(
+        self, x: np.ndarray, out: np.ndarray, row0: int
+    ) -> None:
+        """Bin a chunk straight into ``out[row0:row0+len(x)]`` — the
+        out-of-core ingestion path writes uint8 rows into a preallocated
+        matrix without ever holding a second float copy."""
+        out[row0:row0 + len(x)] = self.transform(x)
+
     def threshold_value(self, f: int, bin_idx: int) -> float:
         """Upper bound of value-bin ``bin_idx`` (split 'x <= thr')."""
         u = self.uppers[f]
@@ -213,3 +221,29 @@ class BinMapper:
         if i >= len(u):
             return np.inf
         return float(u[i])
+
+
+@dataclass
+class BinnedDataset:
+    """An already-quantized training input: the uint8 bin matrix plus
+    the mapper that produced it. ``train()`` accepts one wherever it
+    accepts a float matrix and skips its own fit/transform — the
+    out-of-core path bins streaming chunks into this shape so the float
+    matrix never exists in memory at once (docs/gbdt-training.md)."""
+
+    bins: np.ndarray        # (n, d) uint8
+    mapper: BinMapper
+
+    def __post_init__(self) -> None:
+        self.bins = np.ascontiguousarray(self.bins)
+        if self.bins.dtype != np.uint8 or self.bins.ndim != 2:
+            raise ValueError("BinnedDataset.bins must be a (n, d) uint8")
+        if self.bins.shape[1] != self.mapper.num_features:
+            raise ValueError(
+                f"bins have {self.bins.shape[1]} features, mapper has "
+                f"{self.mapper.num_features}"
+            )
+
+    @property
+    def shape(self) -> tuple:
+        return self.bins.shape

@@ -47,6 +47,57 @@ def _soft(G: np.ndarray, l1: float) -> np.ndarray:
     return np.sign(G) * np.maximum(np.abs(G) - l1, 0.0)
 
 
+def _feature_blocks(d: int) -> list:
+    """Contiguous feature ranges for the build/allreduce overlap
+    pipeline. Elementwise sums are blocking-invariant, so the block
+    count changes only WHEN bytes move, never what they sum to.
+
+    Blocks must stay >= 16 features wide: the histogram pool stripes
+    work BY FEATURE, so narrower blocks would shrink per-call worker
+    parallelism — measured at the 1M x 16 bench shape, 4-feature blocks
+    cost more build time than the wire time they overlapped. Narrow
+    planes therefore stay whole (one block = plain build + one
+    allreduce); the pipeline engages on wide planes, where both the
+    payload and the per-block parallelism are large."""
+    nb = max(1, min(4, d // 16))
+    return [
+        (i * d // nb, (i + 1) * d // nb)
+        for i in range(nb)
+        if (i + 1) * d // nb > i * d // nb
+    ]
+
+
+def _gang_summed_cube(
+    blocks_fn,
+    bins: np.ndarray,
+    stats: np.ndarray,
+    slot: np.ndarray,
+    ns_hist: int,
+    B: int,
+) -> np.ndarray:
+    """Gang-global (ns_hist, d, B, 3) cube with compute/communication
+    overlap: per-feature-block histograms are handed to the reducer as
+    soon as they finish, while the NEXT block is still being built
+    (GangContext.allreduce_blocks double-buffers). Bit-identical to
+    building the whole cube and allreducing it in one piece."""
+    d = bins.shape[1]
+
+    def build(lo: int, hi: int):
+        def _go() -> np.ndarray:
+            blk = np.ascontiguousarray(bins[:, lo:hi])
+            return _host_multi_kernel(
+                ns_hist, B, True, blk, stats, slot
+            ).reshape(ns_hist, hi - lo, B, 3)
+
+        return _go
+
+    bounds = _feature_blocks(d)
+    outs = blocks_fn([build(lo, hi) for lo, hi in bounds])
+    if len(outs) == 1:
+        return outs[0]
+    return np.concatenate(outs, axis=1)
+
+
 def _combine_candidates(
     cube: np.ndarray,        # (S, d, B, 3)
     gains: np.ndarray,       # (d, S) f64
@@ -75,6 +126,70 @@ def _combine_candidates(
         rank = np.argsort(order, axis=1, kind="stable")
         catmask = rank <= bb[:, None]
     return bgain, bf.astype(np.int64), bb, catmask
+
+
+def _voting_combine(
+    cube_local: np.ndarray,     # (S, d, B, 3) member-LOCAL histograms
+    local_gains: np.ndarray,    # (d, S) f64 local best gain per feature
+    fm: np.ndarray,
+    cat_f: "np.ndarray | None",
+    min_data: float,
+    msh: float,
+    lam: float,
+    l1: float,
+    gsum,
+    top_k: int,
+) -> tuple:
+    """PV-Tree voting exchange (LightGBM ``voting_parallel``) for the
+    gang growers: instead of allreducing the full (S, d, B, 3) plane,
+
+    1. each member votes its local top-``K`` features per slot (ballots
+       derived from the ALREADY-computed local gain scan — free);
+    2. one tiny (d,) vote allreduce; the top ``2K`` vote-getters (ties
+       to the lower feature id, mirroring voting.py's device tie-break)
+       become the refresh's candidates — identical on every member;
+    3. only the candidates' histogram columns are summed
+       ((S, 2K, B, 3) instead of (S, d, B, 3)) and the exact split scan
+       runs on those global columns.
+
+    Payload per exchange drops from O(d*B) to O(d + 2K*B) — the win
+    voting mode exists for when features are wide. The chosen split is
+    exact over the candidate set; a feature outvoted everywhere cannot
+    win, which is the mode's documented quality tolerance versus full
+    data-parallel (docs/gbdt-training.md)."""
+    from mmlspark_tpu.ops.histpool import feature_candidates
+    from mmlspark_tpu.parallel.elastic import note_vote_round
+
+    d, S = local_gains.shape
+    K = max(1, min(int(top_k), d))
+    C = min(2 * K, d)
+    masked = np.where(np.isfinite(local_gains), local_gains, -np.inf)
+    ballots = np.zeros(d, np.float64)
+    if K < d:
+        idx = np.argpartition(-masked, K - 1, axis=0)[:K]       # (K, S)
+        chosen = np.take_along_axis(masked, idx, axis=0)
+        np.add.at(ballots, idx[np.isfinite(chosen)], 1.0)
+    else:
+        ballots += np.isfinite(masked).sum(axis=1)
+    votes = np.asarray(gsum(ballots), np.float64)
+    if C < d:
+        # ties to the LOWER feature id — the same deterministic rank
+        # voting.py uses on device (scores are distinct by construction)
+        score = votes * np.float64(d + 1) - np.arange(d, dtype=np.float64)
+        cand = np.sort(np.argpartition(-score, C - 1)[:C])
+    else:
+        cand = np.arange(d)
+    cand_cube = np.asarray(
+        gsum(np.ascontiguousarray(cube_local[:, cand]))
+    )
+    cat_c = cat_f[cand] if cat_f is not None else None
+    gains_c, bbs_c = feature_candidates(
+        cand_cube, np.asarray(fm)[cand], float(min_data), msh, lam, l1,
+        cat_c,
+    )
+    bg, bfc, bb, cm = _combine_candidates(cand_cube, gains_c, bbs_c, cat_c)
+    note_vote_round()
+    return bg, cand[bfc], bb, cm
 
 
 def grow_tree_depthwise_host(
@@ -156,12 +271,17 @@ def _grow_host(
     use_pool: bool,
 ) -> tuple:
     from mmlspark_tpu.ops.histpool import feature_candidates, get_pool
-    from mmlspark_tpu.parallel.elastic import gang_sum
+    from mmlspark_tpu.parallel.elastic import gang_blocks, gang_sum
 
     # elastic gang: sum histograms (and child-size decisions) across the
     # gang, LightGBM data-parallel style — every member then makes the
-    # identical split decision from the identical global cube
+    # identical split decision from the identical global cube.
+    # gblocks: the compute/communication overlap pipeline (feature
+    # blocks allreduce while later blocks build). Voting-parallel never
+    # reaches this grower: PV-Tree is leaf-wise, and train() rejects
+    # depthwise + voting before any grower runs.
     gsum = gang_sum()
+    gblocks = gang_blocks()
 
     min_gain = float(np.asarray(min_gain))
     lambda_l2 = float(np.asarray(lambda_l2))
@@ -250,11 +370,20 @@ def _grow_host(
             pooled_any = True
         else:
             pool = None
-            half = _host_multi_kernel(
-                ns_hist, B, True, b, stats, slot_hist
-            ).reshape(ns_hist, d, B, 3)
-            if gsum is not None:
-                half = gsum(half)
+            if gsum is not None and gblocks is not None:
+                # data-parallel gang: per-feature-block histograms hand
+                # off to the reducer while later blocks still build —
+                # wire time hides behind compute (bit-identical to one
+                # whole-plane allreduce)
+                half = _gang_summed_cube(
+                    gblocks, b, stats, slot_hist, ns_hist, B
+                )
+            else:
+                half = _host_multi_kernel(
+                    ns_hist, B, True, b, stats, slot_hist
+                ).reshape(ns_hist, d, B, 3)
+                if gsum is not None:
+                    half = gsum(half)
             if sib:
                 parents_ok = parent_local >= 0
                 parents = cube_prev[np.maximum(parent_local, 0)]
@@ -400,10 +529,18 @@ def grow_tree_lossguide_host(
     cache the XLA grower carries). Early exhaustion breaks the loop — the
     XLA grower's remaining steps are provable no-ops."""
     from mmlspark_tpu.ops.histogram import _host_multi_kernel as _mk
-    from mmlspark_tpu.parallel.elastic import gang_sum
+    from mmlspark_tpu.parallel.elastic import (
+        gang_blocks,
+        gang_sum,
+        gang_voting_k,
+    )
 
-    # elastic gang: histograms summed across members (see _grow_host)
+    # elastic gang: histograms summed across members (see _grow_host);
+    # voting mode keeps planes LOCAL and exchanges only ballots +
+    # candidate columns per refresh
     gsum = gang_sum()
+    gblocks = gang_blocks()
+    gv_k = gang_voting_k()
 
     min_gain = float(np.asarray(min_gain))
     lambda_l2 = float(np.asarray(lambda_l2))
@@ -437,11 +574,20 @@ def grow_tree_lossguide_host(
     cache_bin = np.zeros(L, np.int64)
     cache_cm = np.zeros((L, B), bool)
 
-    # root: the only full-data histogram of the tree (pool-eligible)
-    root = _mk(1, B, True, b, stats, np.zeros(n, np.int64)).reshape(
-        1, d, B, 3
-    )[0]
-    hist[0] = gsum(root) if gsum is not None else root
+    def _gang_cube(slot: np.ndarray, ns: int) -> np.ndarray:
+        """One (ns, d, B, 3) histogram, gang-summed with the feature-
+        block overlap pipeline when available."""
+        if gsum is not None and gv_k is None and gblocks is not None:
+            return _gang_summed_cube(gblocks, b, stats, slot, ns, B)
+        cube = _mk(ns, B, True, b, stats, slot).reshape(ns, d, B, 3)
+        if gsum is not None and gv_k is None:
+            cube = gsum(cube)
+        return cube
+
+    # root: the only full-data histogram of the tree (pool-eligible).
+    # Voting mode keeps it LOCAL — the exchange happens per refresh.
+    root = _gang_cube(np.zeros(n, np.int64), 1)[0]
+    hist[0] = root
     prev_pair = np.array([0, 0])
 
     def _refresh(pair: np.ndarray) -> None:
@@ -450,7 +596,15 @@ def grow_tree_lossguide_host(
             cube, fm, float(min_data_in_leaf), min_sum_hessian,
             lambda_l2, lambda_l1, cat_f,
         )
-        bg, bf, bb, cm = _combine_candidates(cube, gains, bbs, cat_f)
+        if gv_k is not None and gsum is not None:
+            # PV-Tree: ballots from the local scan, then an exact scan
+            # over only the top-2K candidates' GLOBAL columns
+            bg, bf, bb, cm = _voting_combine(
+                cube, gains, fm, cat_f, float(min_data_in_leaf),
+                min_sum_hessian, lambda_l2, lambda_l1, gsum, gv_k,
+            )
+        else:
+            bg, bf, bb, cm = _combine_candidates(cube, gains, bbs, cat_f)
         cache_gain[pair] = bg
         cache_feat[pair] = bf
         cache_bin[pair] = bb
@@ -490,9 +644,7 @@ def grow_tree_lossguide_host(
         # sibling as parent - small
         small_mask = moved if n_right <= n_left else (in_leaf & ~moved)
         slot = np.where(small_mask, 0, 1).astype(np.int64)  # 1 = dropped
-        small = _mk(1, B, True, b, stats, slot).reshape(1, d, B, 3)[0]
-        if gsum is not None:
-            small = gsum(small)
+        small = _gang_cube(slot, 1)[0]
         parent = hist[bl]
         if n_right <= n_left:
             hist[new_id] = small

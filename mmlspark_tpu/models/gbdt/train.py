@@ -864,9 +864,38 @@ def train(
         # LightGBM hard-errors here too: the sampler's unbiasedness
         # guarantee needs b/(1-a) <= 1
         raise ValueError("goss requires top_rate + other_rate <= 1")
-    from mmlspark_tpu.models.gbdt.binning import is_sparse
+    from mmlspark_tpu.models.gbdt.binning import BinnedDataset, is_sparse
 
-    sparse_input = is_sparse(x)
+    pre_binned = isinstance(x, BinnedDataset)
+    sparse_input = False if pre_binned else is_sparse(x)
+    if pre_binned:
+        # the out-of-core path: rows were binned chunk-by-chunk against
+        # a mapper fitted from streaming sketches — everything that
+        # would need the FLOAT matrix back is out of contract here
+        if cfg.boosting_type == "dart":
+            raise ValueError(
+                "pre-binned input does not support dart (dropped-tree "
+                "re-prediction needs the float matrix)"
+            )
+        if init_booster is not None and init_booster.trees:
+            raise ValueError(
+                "pre-binned input does not support init_booster "
+                "(warm-start scoring needs the float matrix)"
+            )
+        if cfg.categorical_features:
+            raise ValueError(
+                "pre-binned input does not support categorical_features "
+                "(identity binning is a fit-time decision)"
+            )
+        if x.mapper.max_bin > cfg.max_bin:
+            # hist_bins is sized from cfg.max_bin: a code past it would
+            # scatter into the wrong plane and train a silently wrong
+            # model — refuse instead
+            raise ValueError(
+                f"pre-binned input was quantized with max_bin="
+                f"{x.mapper.max_bin} but cfg.max_bin={cfg.max_bin}; "
+                "bin codes would overflow the histogram space"
+            )
     n, d = x.shape
     # np.matrix-shaped labels (scipy .sum(axis=) results) flatten silently
     y = np.asarray(y).reshape(n)
@@ -910,7 +939,13 @@ def train(
     # voting_parallel across processes: the shard_map grower's psums simply
     # ride DCN instead of ICI — same program, bigger mesh.
 
-    if multihost:
+    if pre_binned:
+        if multihost:
+            raise ValueError(
+                "pre-binned input is single-process / elastic-gang only"
+            )
+        mapper = x.mapper
+    elif multihost:
         # bin bounds must be IDENTICAL on every process: fit the mapper on
         # a NaN-padded sample allgathered from all processes (NaN rows are
         # ignored by quantile fitting; for sparse inputs absent entries
@@ -980,7 +1015,7 @@ def train(
         mapper = BinMapper.fit(
             x, max_bin=cfg.max_bin, seed=cfg.seed, categorical_features=cat_features
         )
-    bins_host = mapper.transform(x)
+    bins_host = x.bins if pre_binned else mapper.transform(x)
     # histogram bin space: the smallest MXU-tile-aligned width covering
     # every bin code (codes live in [0, max_bin-1]). At the default
     # max_bin=255 this is the full uint8 space (256); smaller max_bin

@@ -104,7 +104,8 @@ _M_ABORTS = obs.counter(
 )
 _M_ALLREDUCE = obs.histogram(
     "mmlspark_elastic_allreduce_seconds",
-    "Gang histogram-allreduce wall time (TCP full mesh)",
+    "Gang histogram-allreduce wall time (ring reduce-scatter + "
+    "allgather by default; mode=mesh keeps the full-mesh baseline)",
 )
 _M_CRC_DROPS = obs.counter(
     "mmlspark_elastic_crc_failures_total",
@@ -114,6 +115,26 @@ _M_CRC_DROPS = obs.counter(
 _M_RETRANSMITS = obs.counter(
     "mmlspark_elastic_retransmits_total",
     "Allreduce frames re-sent after a peer's corruption NACK",
+)
+_M_RING_STEPS = obs.counter(
+    "mmlspark_elastic_ring_steps_total",
+    "Ring-collective steps executed (each moves O(payload/world) bytes)",
+    labels=("phase",),
+)
+_M_PAYLOAD_BYTES = obs.counter(
+    "mmlspark_elastic_payload_bytes_total",
+    "Allreduce payload bytes put on the wire (frame heads excluded)",
+    labels=("mode",),
+)
+_M_OVERLAP_BLOCKS = obs.counter(
+    "mmlspark_elastic_overlap_blocks_total",
+    "Histogram feature blocks built while an earlier block's allreduce "
+    "was in flight (the compute/communication pipeline)",
+)
+_M_VOTE_ROUNDS = obs.counter(
+    "mmlspark_elastic_vote_rounds_total",
+    "Voting-parallel exchanges: a (d,) ballot sum + top-2K candidate "
+    "columns instead of the full histogram plane",
 )
 
 
@@ -733,13 +754,56 @@ class GangMember:
 # -- the TCP allreduce --------------------------------------------------------
 
 
+class _PendingReduce:
+    """Handle for an in-flight :meth:`TcpReducer.allreduce_async`."""
+
+    def __init__(self) -> None:
+        self._ev = threading.Event()
+        self._val: Any = None
+        self._exc: Optional[BaseException] = None
+
+    def _set(self, val: Any = None, exc: Optional[BaseException] = None):
+        self._val, self._exc = val, exc
+        self._ev.set()
+
+    def result(self, timeout_s: Optional[float] = None) -> np.ndarray:
+        if not self._ev.wait(timeout_s):
+            raise TimeoutError("allreduce_async result not ready")
+        if self._exc is not None:
+            raise self._exc
+        return self._val
+
+
 class TcpReducer:
-    """Full-mesh framed-TCP sum-allreduce among one generation's members.
+    """Framed-TCP sum-allreduce among one generation's members.
+
+    Two wire patterns, both accumulating in f64 in **sorted-member
+    order** so every member computes the bit-identical total:
+
+    - ``mode="ring"`` (default): chunked ring reduce-scatter +
+      allgather. The flat payload splits into ``world`` contiguous
+      segments (``partition_bounds`` — the same math that slices the
+      dataset); member ``i`` owns segment ``i``. Scatter phase: each
+      member sends every OTHER owner's segment of its local contribution
+      (raw input dtype — an f32 contribution upcasts to f64 exactly, so
+      the wire carries half the bytes with zero precision loss); the
+      owner, holding all ``world`` contributions of its segment, sums
+      them in sorted-member order in f64. Gather phase: each owner sends
+      its summed f64 segment to every peer. 2(w-1) steps of
+      O(payload/world) each — per-member bytes drop from ``(w-1) * 8n``
+      to ``(w-1)/w * (itemsize + 8) * n``, strictly less at every world
+      size for f32 payloads and ~2x/w of full mesh for large worlds.
+    - ``mode="mesh"``: the original everyone-sends-everything exchange,
+      kept as the A/B baseline (bit-identical results by construction;
+      tests pin ring == mesh byte-for-byte).
 
     Every member executes the identical sequence of collectives (the host
-    growers are SPMD over the gang), so a monotonically increasing
-    ``seq`` pairs frames without negotiation. Sums accumulate in f64 in
-    sorted-member order — every member computes the bit-identical total.
+    growers are SPMD over the gang), so monotonically increasing ``seq``
+    numbers pair frames without negotiation (a ring op consumes two: one
+    per phase). :meth:`allreduce_async` runs the exchange on a dedicated
+    worker thread so the growers can overlap the NEXT histogram block's
+    build with this block's wire time — seqs are allocated on the
+    calling thread, keeping the SPMD frame pairing deterministic.
 
     A peer whose frame never arrives AND whose registry heartbeats have
     lapsed raises :class:`HostLostError` — the socket-level failure the
@@ -752,11 +816,15 @@ class TcpReducer:
         generation: Generation,
         timeout_s: float = 60.0,
         connect_timeout_s: float = 10.0,
+        mode: str = "ring",
     ):
+        if mode not in ("ring", "mesh"):
+            raise ValueError(f"unknown reduce mode {mode!r}")
         self.member = member
         self.gen = generation.gen
         self.members = sorted(generation.members)
         self.me = member.name
+        self.mode = mode
         self.timeout_s = float(timeout_s)
         self.connect_timeout_s = float(connect_timeout_s)
         # same loss debounce as GangContext.on_round: a freshly
@@ -774,13 +842,26 @@ class TcpReducer:
         self.seq = 0
         self._conns: dict = {}
         self._send_lock = threading.Lock()
-        # recent outgoing frames, keyed (gen, nonce, seq): the
-        # retransmit source when a peer NACKs a CRC-torn frame. The gang
-        # is SPMD-lockstep, so peers only ever NACK the last few seqs
+        # recent outgoing frames, keyed (gen, nonce, seq, peer): the
+        # retransmit source when a peer NACKs a CRC-torn frame (ring
+        # frames differ per peer — each owner gets its own segment). The
+        # gang is SPMD-lockstep, so peers only ever NACK recent seqs;
+        # the cap covers a couple of in-flight overlapped ops
         self._sent_frames: dict = {}
-        self._sent_cap = 4
+        self._sent_cap = max(16, 6 * len(self.members))
+        # (seq, peer) frames whose send transiently failed — retried at
+        # each roster check (a dropped send must not wedge the PEER)
+        self._unsent: set = set()
         self.retransmits = 0
+        self.payload_bytes_sent = 0
+        self.ring_steps = 0
+        self.ops = 0
         self.world = len(self.members)
+        self._rank = self.members.index(self.me) if self.me in self.members else 0
+        # async worker: one thread, FIFO — started on first use
+        self._jobs: Any = None
+        self._worker: Optional[threading.Thread] = None
+        self._failed: Optional[BaseException] = None
         member.drop_stale_frames(self.gen)
         member._attach_reducer(self)
 
@@ -803,62 +884,80 @@ class TcpReducer:
         self._conns[peer] = c
         return c
 
-    def allreduce(self, arr: np.ndarray) -> np.ndarray:
-        """Sum ``arr`` across the gang; returns the same dtype/shape.
-        World 1 is an exact no-op (bit-identical to unsharded training)."""
-        if self.world <= 1:
-            return arr
-        t0 = time.perf_counter()
-        x = np.ascontiguousarray(np.asarray(arr, np.float64))
-        seq = self.seq
-        self.seq += 1
-        payload = x.tobytes()
-        head = struct.pack(
-            _FRAME_HEAD, self.gen, seq, self.nonce,
-            zlib.crc32(payload) & 0xFFFFFFFF,
-            len(self.me.encode()), x.nbytes,
-        )
-        frame = head + self.me.encode() + payload
+    # -- frame bookkeeping ----------------------------------------------------
+
+    def _post_frames(self, seq: int, payloads: dict) -> None:
+        """Build, cache and (best-effort) send one frame per peer.
+        ``payloads``: peer -> payload bytes. A payload OBJECT shared by
+        several peers (the whole mesh exchange; the ring gather phase)
+        serializes into ONE frame that every cache entry references —
+        w-1 identical multi-MB frames would otherwise be copied and
+        retained per collective. Failed sends land in ``_unsent`` and
+        are retried at every roster check."""
+        name = self.me.encode()
+        frame_for: dict = {}  # id(payload) -> built frame
         with self._send_lock:
-            self._sent_frames[(self.gen, self.nonce, seq)] = frame
-            while len(self._sent_frames) > self._sent_cap:
-                del self._sent_frames[next(iter(self._sent_frames))]
-        peers = [m for m in self.members if m != self.me]
+            for peer, payload in payloads.items():
+                frame = frame_for.get(id(payload))
+                if frame is None:
+                    head = struct.pack(
+                        _FRAME_HEAD, self.gen, seq, self.nonce,
+                        zlib.crc32(payload) & 0xFFFFFFFF,
+                        len(name), len(payload),
+                    )
+                    frame = head + name + payload
+                    frame_for[id(payload)] = frame
+                self._sent_frames[(self.gen, self.nonce, seq, peer)] = frame
+                while len(self._sent_frames) > self._sent_cap:
+                    del self._sent_frames[next(iter(self._sent_frames))]
+                try:
+                    self._conn(peer).sendall(frame)
+                    self.payload_bytes_sent += len(payload)
+                    _M_PAYLOAD_BYTES.labels(mode=self.mode).inc(len(payload))
+                except (OSError, HostLostError):
+                    # a dead socket is not yet a dead HOST: the roster
+                    # decides at the next check (may be mid-restart)
+                    self._conns.pop(peer, None)
+                    self._unsent.add((seq, peer))
 
-        def send_to(targets: list) -> list:
-            """Send the frame; returns the peers it could NOT reach —
-            retried below, because a transiently dropped send would
-            otherwise wedge the PEER for the full timeout and get this
-            healthy host wrongly evicted as 'wedged'."""
-            failed = []
-            with self._send_lock:
-                for p in targets:
-                    try:
-                        self._conn(p).sendall(frame)
-                    except (OSError, HostLostError):
-                        # a dead socket is not yet a dead HOST: the
-                        # roster decides below (may be mid-restart)
-                        self._conns.pop(p, None)
-                        failed.append(p)
-            return failed
+    def _resend_unsent(self) -> None:
+        with self._send_lock:
+            for seq, peer in list(self._unsent):
+                frame = self._sent_frames.get(
+                    (self.gen, self.nonce, seq, peer)
+                )
+                if frame is None:
+                    self._unsent.discard((seq, peer))
+                    continue
+                try:
+                    self._conn(peer).sendall(frame)
+                    self._unsent.discard((seq, peer))
+                    n = len(frame) - _FRAME_HEAD_LEN - len(self.me.encode())
+                    self.payload_bytes_sent += n
+                    _M_PAYLOAD_BYTES.labels(mode=self.mode).inc(n)
+                except (OSError, HostLostError):
+                    self._conns.pop(peer, None)
 
-        unsent = send_to(peers)
-        bufs = {self.me: x.reshape(-1)}
+    def _collect(self, seq: int, senders: list) -> dict:
+        """Wait for one frame from each of ``senders`` at ``seq``.
+        Shared loss machinery of both modes: re-send transiently-failed
+        frames, re-NACK CRC-dropped keys, consult the roster's loss
+        policy, and surface wedged peers at the timeout."""
+        got: dict = {}
         deadline = time.monotonic() + self.timeout_s
         next_roster_check = time.monotonic() + 0.5
-        while len(bufs) < self.world:
-            missing = [p for p in peers if p not in bufs]
-            got = self.member.take_frame(
+        while len(got) < len(senders):
+            missing = [p for p in senders if p not in got]
+            buf = self.member.take_frame(
                 self.gen, self.nonce, seq, missing[0], 0.05
             )
-            if got is not None:
-                bufs[missing[0]] = np.frombuffer(got, np.float64)
+            if buf is not None:
+                got[missing[0]] = buf
                 continue
             now = time.monotonic()
             if now >= next_roster_check:
                 next_roster_check = now + 0.5
-                if unsent:
-                    unsent = send_to(unsent)
+                self._resend_unsent()
                 for p in missing:
                     # a frame we dropped for bad CRC: re-NACK until the
                     # clean retransmit lands (the first NACK — sent by
@@ -894,11 +993,175 @@ class TcpReducer:
                     f"{self.timeout_s:g}s with live heartbeats — wedged "
                     "peer(s)",
                 )
+        return got
+
+    # -- the collectives ------------------------------------------------------
+
+    def allreduce(self, arr: np.ndarray) -> np.ndarray:
+        """Sum ``arr`` across the gang; returns the same dtype/shape.
+        World 1 is an exact no-op (bit-identical to unsharded training)."""
+        if self.world <= 1:
+            return arr
+        if self._failed is not None:
+            raise self._failed
+        with self._send_lock:
+            seq = self.seq
+            self.seq += 2 if self.mode == "ring" else 1
+        return self._allreduce_at(arr, seq)
+
+    def allreduce_async(self, arr: np.ndarray) -> _PendingReduce:
+        """Start an allreduce on the reducer's worker thread and return
+        a handle. Seqs are allocated HERE, on the calling thread — every
+        member submits the identical op sequence, so frames pair even
+        though the wire work happens off-thread. The caller overlaps the
+        next histogram block's build with this block's wire time."""
+        p = _PendingReduce()
+        if self.world <= 1:
+            p._set(val=arr)
+            return p
+        if self._failed is not None:
+            p._set(exc=self._failed)
+            return p
+        with self._send_lock:
+            seq = self.seq
+            self.seq += 2 if self.mode == "ring" else 1
+            if self._jobs is None:
+                import queue as _queue
+
+                self._jobs = _queue.Queue()
+                self._worker = threading.Thread(
+                    target=self._work_loop,
+                    name=f"reduce-{self.me}-g{self.gen}", daemon=True,
+                )
+                self._worker.start()
+        self._jobs.put((arr, seq, p))
+        return p
+
+    def _work_loop(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            arr, seq, pending = job
+            if self._failed is not None:
+                # once the gang broke, later queued ops must fail fast,
+                # not each burn a full timeout
+                pending._set(exc=self._failed)
+                continue
+            try:
+                pending._set(val=self._allreduce_at(arr, seq))
+            except BaseException as e:  # noqa: BLE001 — relayed to caller
+                self._failed = e
+                pending._set(exc=e)
+
+    def _allreduce_at(self, arr: np.ndarray, seq: int) -> np.ndarray:
+        t0 = time.perf_counter()
+        self.ops += 1
+        src = np.asarray(arr)
+        if self.mode == "ring":
+            out = self._allreduce_ring(src, seq)
+        else:
+            out = self._allreduce_mesh(src, seq)
+        _M_ALLREDUCE.observe(time.perf_counter() - t0)
+        return out
+
+    def _allreduce_mesh(self, src: np.ndarray, seq: int) -> np.ndarray:
+        """The legacy full-mesh exchange: every member sends its full
+        f64 contribution to every peer; everyone sums locally."""
+        x = np.ascontiguousarray(src.astype(np.float64))
+        peers = [m for m in self.members if m != self.me]
+        payload = x.tobytes()  # serialized ONCE; _post_frames shares it
+        self._post_frames(seq, {p: payload for p in peers})
+        got = self._collect(seq, peers)
+        bufs = {self.me: x.reshape(-1)}
+        for p, buf in got.items():
+            bufs[p] = np.frombuffer(buf, np.float64)
         total = bufs[self.members[0]].astype(np.float64, copy=True)
         for m in self.members[1:]:
             total = total + bufs[m]
-        _M_ALLREDUCE.observe(time.perf_counter() - t0)
-        return total.reshape(x.shape).astype(np.asarray(arr).dtype)
+        return total.reshape(x.shape).astype(src.dtype)
+
+    def _allreduce_ring(self, src: np.ndarray, seq: int) -> np.ndarray:
+        """Ring reduce-scatter + allgather; seq (scatter) and seq+1
+        (gather). Accumulation order per element is members[0..w-1] in
+        f64 — bit-identical to the mesh exchange's sum."""
+        # contributions travel in the input dtype when that upcasts to
+        # f64 exactly (f32/f64); anything else is cast to f64 up front,
+        # exactly like the mesh path
+        wire_dtype = src.dtype if src.dtype in (
+            np.dtype(np.float32), np.dtype(np.float64)
+        ) else np.dtype(np.float64)
+        flat = np.ascontiguousarray(src.astype(wire_dtype)).reshape(-1)
+        w = self.world
+        bounds = partition_bounds(flat.size, w)
+        rank = self._rank
+        # fault point elastic.ring_step: fires before each ring step on
+        # each member (context names phase/step); a delay stalls the
+        # pipeline (visible in allreduce seconds), an error kills the
+        # trainer — the supervisor-restart path
+        # -- scatter: send every other owner its segment of my contribution
+        payloads = {}
+        for t in range(1, w):
+            j = (rank + t) % w
+            peer = self.members[j]
+            faults.inject(
+                "elastic.ring_step",
+                context={"phase": "scatter", "step": t, "peer": peer},
+            )
+            lo, hi = bounds[j]
+            payloads[peer] = flat[lo:hi].tobytes()
+            self.ring_steps += 1
+            _M_RING_STEPS.labels(phase="scatter").inc()
+        self._post_frames(seq, payloads)
+        peers = [m for m in self.members if m != self.me]
+        got = self._collect(seq, peers)
+        # -- owner sum: all w contributions of MY segment, sorted order
+        lo, hi = bounds[rank]
+        seg_len = hi - lo
+        contrib = {self.me: flat[lo:hi]}
+        for p, buf in got.items():
+            piece = np.frombuffer(buf, wire_dtype)
+            if piece.size != seg_len:
+                # only reachable through a CRC-colliding corruption of a
+                # resized frame — refuse to sum garbage
+                raise HostLostError(
+                    [p], self.gen,
+                    f"ring segment from {p} has {piece.size} elements, "
+                    f"expected {seg_len}",
+                )
+            contrib[p] = piece
+        total_seg = contrib[self.members[0]].astype(np.float64, copy=True)
+        for m in self.members[1:]:
+            total_seg = total_seg + contrib[m]
+        # -- allgather: every owner distributes its summed f64 segment
+        seg_bytes = np.ascontiguousarray(total_seg).tobytes()
+        payloads = {}
+        for t in range(1, w):
+            peer = self.members[(rank + t) % w]
+            faults.inject(
+                "elastic.ring_step",
+                context={"phase": "gather", "step": t, "peer": peer},
+            )
+            payloads[peer] = seg_bytes
+            self.ring_steps += 1
+            _M_RING_STEPS.labels(phase="gather").inc()
+        self._post_frames(seq + 1, payloads)
+        got = self._collect(seq + 1, peers)
+        out = np.empty(flat.size, np.float64)
+        out[lo:hi] = total_seg
+        for j, m in enumerate(self.members):
+            if m == self.me:
+                continue
+            jlo, jhi = bounds[j]
+            piece = np.frombuffer(got[m], np.float64)
+            if piece.size != jhi - jlo:
+                raise HostLostError(
+                    [m], self.gen,
+                    f"ring gather segment from {m} has {piece.size} "
+                    f"elements, expected {jhi - jlo}",
+                )
+            out[jlo:jhi] = piece
+        return out.reshape(src.shape).astype(src.dtype)
 
     def send_nack(self, peer: str, gen: int, nonce: int, seq: int) -> None:
         """Tell ``peer`` its (gen, seq) frame arrived torn — control
@@ -921,7 +1184,7 @@ class TcpReducer:
         seq, different incarnation) is ignored — the peer's timeout path
         handles it as peer-loss."""
         with self._send_lock:
-            frame = self._sent_frames.get((gen, nonce, seq))
+            frame = self._sent_frames.get((gen, nonce, seq, peer))
             if frame is None:
                 return
             try:
@@ -934,6 +1197,8 @@ class TcpReducer:
 
     def close(self) -> None:
         self.member._detach_reducer(self)
+        if self._jobs is not None:
+            self._jobs.put(None)
         for c in self._conns.values():
             try:
                 c.close()
@@ -965,6 +1230,7 @@ class GangContext:
         global_rows: Optional[np.ndarray] = None,
         ckpt_dir: Optional[str] = None,
         all_write: bool = False,
+        voting_top_k: Optional[int] = None,
     ):
         """``global_rows``: the full global feature matrix when the host
         already has it (the ``fleet train`` data model: every host loads
@@ -992,6 +1258,12 @@ class GangContext:
         self.global_rows = global_rows
         self.ckpt_dir = ckpt_dir
         self.all_write = bool(all_write)
+        # voting-parallel (PV-Tree): the host growers exchange only the
+        # top-2K candidate features' histogram columns instead of the
+        # full plane; None = full data-parallel
+        self.voting_top_k = (
+            int(voting_top_k) if voting_top_k else None
+        )
         # loss debounce: a peer missing from the roster is only declared
         # dead once its last sighting is older than this — an
         # answering-but-freshly-restarted registry returns an EMPTY
@@ -1040,14 +1312,58 @@ class GangContext:
             self.world_changed = e.gen
             raise
 
+    def allreduce_blocks(self, builders: list) -> list:
+        """Compute/communication overlap: ``builders`` are zero-arg
+        callables producing arrays (e.g. per-feature-block histograms).
+        Block ``i``'s allreduce rides the reducer's worker thread while
+        block ``i+1`` is still being BUILT — double-buffered (at most
+        two blocks in flight), critical-path ordered (results return in
+        submission order). Elementwise sums are blocking-invariant, so
+        the concatenated result is bit-identical to one whole-plane
+        allreduce."""
+        if self.reducer is None or self.world <= 1:
+            return [b() for b in builders]
+        try:
+            out: list = []
+            pending: list = []
+            for b in builders:
+                if pending:
+                    # this block's build runs while the previous
+                    # block(s) are on the wire — the overlap the
+                    # counter advertises
+                    _M_OVERLAP_BLOCKS.inc()
+                arr = b()
+                pending.append(self.reducer.allreduce_async(arr))
+                if len(pending) >= 2:
+                    # true double-buffer: harvest the older op before
+                    # building a third block, bounding peak memory to
+                    # two blocks (cube + wire frames) at any moment
+                    out.append(pending.pop(0).result())
+            while pending:
+                out.append(pending.pop(0).result())
+            return out
+        except HostLostError as e:
+            self.lost = e.lost
+            raise
+        except WorldChangedError as e:
+            self.world_changed = e.gen
+            raise
+
     def all_rows(self, local: np.ndarray) -> np.ndarray:
         """Local rows -> the (global_n, ...) array in global row order
-        (scatter + sum-allreduce; exact for f32 payloads on the f64
-        wire). The collective every member runs at checkpoint time."""
+        (scatter + sum-allreduce: every element is one member's value
+        plus zeros, so the result is EXACT at any wire dtype). f32
+        payloads stay f32 on the scatter wire — half the checkpoint
+        gather's bytes at zero precision cost; the ring's owner still
+        accumulates in f64. The collective every member runs at
+        checkpoint time."""
         local = np.asarray(local)
         if self.world <= 1:
             return local
-        out = np.zeros((self.global_n,) + local.shape[1:], np.float64)
+        wire = (
+            np.float32 if local.dtype == np.float32 else np.float64
+        )
+        out = np.zeros((self.global_n,) + local.shape[1:], wire)
         out[self.lo:self.hi] = local
         return self.allreduce(out).astype(local.dtype)
 
@@ -1310,6 +1626,31 @@ def gang_sum() -> Optional[Callable[[np.ndarray], np.ndarray]]:
     return g.allreduce
 
 
+def gang_blocks() -> Optional[Callable[[list], list]]:
+    """The host growers' overlap hook: a callable summing a LIST of
+    lazily-built arrays with block ``i``'s wire time hidden behind block
+    ``i+1``'s build (GangContext.allreduce_blocks), else None."""
+    g = _ACTIVE_GANG
+    if g is None or g.world <= 1 or g.reducer is None:
+        return None
+    return g.allreduce_blocks
+
+
+def gang_voting_k() -> Optional[int]:
+    """Voting-parallel hook: the PV-Tree ``top_k`` when the active gang
+    trains in voting mode (host growers exchange ballots + top-2K
+    candidate columns instead of the full plane), else None."""
+    g = _ACTIVE_GANG
+    if g is None or g.world <= 1 or g.reducer is None:
+        return None
+    return g.voting_top_k
+
+
+def note_vote_round() -> None:
+    """Growers report one completed voting exchange (metrics only)."""
+    _M_VOTE_ROUNDS.inc()
+
+
 # -- checkpoint snapshot (the bit-identity audit trail) -----------------------
 
 
@@ -1391,6 +1732,11 @@ class ElasticTrainer:
         artifact_dir: Optional[str] = None,
         allreduce_port: int = 0,
         advertise_allreduce_port: Optional[int] = None,
+        reduce_mode: str = "ring",
+        stream: Optional[Callable[[], Iterator]] = None,
+        n_rows: Optional[int] = None,
+        n_features: Optional[int] = None,
+        sketch_bits: int = 16,
     ):
         """``artifact_dir``: enables **artifact mode** — ``ckpt_dir`` is
         treated as HOST-LOCAL (every member writes its own checkpoints),
@@ -1398,11 +1744,44 @@ class ElasticTrainer:
         out of an :class:`~mmlspark_tpu.serving.artifacts.ArtifactStore`
         rooted here, and a member whose disk lacks the agreed resume
         snapshot pulls it over HTTP from any surviving peer. Without it,
-        the original shared-``ckpt_dir`` data model is unchanged."""
+        the original shared-``ckpt_dir`` data model is unchanged.
+
+        ``reduce_mode``: the gang allreduce wire pattern — ``ring``
+        (chunked reduce-scatter + allgather, the default) or ``mesh``
+        (the legacy everyone-sends-everything baseline). Bit-identical
+        results either way; only bytes-on-the-wire differ.
+
+        ``stream``: **out-of-core mode** — instead of in-memory ``x``/
+        ``y``, a re-invocable factory yielding ``(x_chunk, y_chunk)``
+        pairs in global row order (``load_streaming_data`` builds one
+        from a spec; StreamingDataFrame adapts via
+        ``stream_from_dataframe``). Each generation, the member streams
+        its row slice twice: pass 1 feeds a per-host quantile sketch
+        whose counts merge across the gang THROUGH THE REDUCER (bin
+        bounds come out identical on every member at every world size,
+        no global gather), pass 2 bins the slice into a uint8 matrix.
+        The full float matrix never exists in memory; requires
+        ``n_rows``/``n_features``."""
         self.registry_urls = registry_urls
         self.name = name
-        self.x = np.asarray(x)
-        self.y = np.asarray(y)
+        self._stream = stream
+        if stream is not None:
+            if n_rows is None or n_features is None:
+                raise ValueError(
+                    "stream mode requires n_rows and n_features"
+                )
+            if x is not None or y is not None:
+                raise ValueError("pass either x/y or stream, not both")
+            self.x = self.y = None
+            self.n = int(n_rows)
+            self.n_features = int(n_features)
+        else:
+            self.x = np.asarray(x)
+            self.y = np.asarray(y)
+            self.n = len(self.x)
+            self.n_features = int(self.x.shape[1])
+        self.sketch_bits = int(sketch_bits)
+        self.reduce_mode = reduce_mode
         self.cfg = cfg
         self.ckpt_dir = ckpt_dir
         self.n_partitions = int(n_partitions)
@@ -1447,6 +1826,9 @@ class ElasticTrainer:
             "reshard_to_first_round_s": None, "rounds_per_s_pre": None,
             "rounds_per_s_post": None, "done": False,
             "artifact_fetches": 0, "crc_drops": 0, "retransmits": 0,
+            "reduce_mode": reduce_mode, "payload_bytes": 0,
+            "ingest_payload_bytes": 0, "ring_steps": 0,
+            "allreduce_ops": 0,
         }
 
     # -- status ---------------------------------------------------------------
@@ -1524,7 +1906,7 @@ class ElasticTrainer:
         from mmlspark_tpu.models.gbdt.train import train
 
         lo, hi = member_row_slice(
-            len(self.x), self.n_partitions, gen.members, self.name
+            self.n, self.n_partitions, gen.members, self.name
         )
         if hi <= lo:
             raise RuntimeError(
@@ -1532,11 +1914,14 @@ class ElasticTrainer:
                 f"{len(gen.members)} (n_partitions={self.n_partitions})"
             )
         reducer = (
-            TcpReducer(member, gen, timeout_s=self.allreduce_timeout_s)
+            TcpReducer(
+                member, gen, timeout_s=self.allreduce_timeout_s,
+                mode=self.reduce_mode,
+            )
             if len(gen.members) > 1 else None
         )
         gang = GangContext(
-            member, gen, n_rows=len(self.x),
+            member, gen, n_rows=self.n,
             n_partitions=self.n_partitions,
             checkpoint_every=self.checkpoint_every, reducer=reducer,
             global_rows=self.x,
@@ -1548,8 +1933,19 @@ class ElasticTrainer:
             allow_growback=self.allow_growback,
             ckpt_dir=self.ckpt_dir,
             all_write=self._store is not None,
+            voting_top_k=(
+                self.cfg.top_k
+                if getattr(self.cfg, "parallelism", "") == "voting_parallel"
+                else None
+            ),
         )
         self.status.update(gen=gen.gen, members=sorted(gen.members))
+        # per-round cost changes with the WORLD (a survivor histograms
+        # twice the rows after a 2->1 shrink): a fresh generation gets a
+        # fresh EWMA, so the straggler signal and the recorded
+        # rounds-per-second never blend two world sizes (the r08->r12
+        # throughput comparison depends on this honesty)
+        member.ewma_s = 0.0
         self._write_status()
         # the agreed resume point: a reshard's snapshot when there is
         # one (every survivor resumes from the SAME state even if the
@@ -1567,9 +1963,28 @@ class ElasticTrainer:
         resume_t0 = time.monotonic()
         try:
             gang.join(timeout_s=self.gen_timeout_s)
+            if self._stream is not None:
+                # out-of-core: two streaming passes over this member's
+                # slice — sketch (merged via the reducer, a collective
+                # EVERY member of the generation runs) then uint8 bins.
+                # Per-generation by design: the merged counts are a pure
+                # function of the global rows, so every generation (and
+                # every world size) derives the identical mapper
+                x_arg, y_arg = self._ingest_stream(reducer, lo, hi)
+                if reducer is not None:
+                    # the sketch merge consumed seqs; re-anchor the
+                    # trained-without-allreduce guard at the loop start,
+                    # and record the one-off ingestion wire cost so the
+                    # bench's per-round payload math can subtract it
+                    gang._join_seq = reducer.seq
+                    self.status["ingest_payload_bytes"] += (
+                        reducer.payload_bytes_sent
+                    )
+            else:
+                x_arg, y_arg = self.x[lo:hi], self.y[lo:hi]
             with activate(gang):
                 booster = train(
-                    self.x[lo:hi], self.y[lo:hi], self.cfg, shard=False,
+                    x_arg, y_arg, self.cfg, shard=False,
                     checkpoint_dir=self.ckpt_dir,
                     checkpoint_every=self.checkpoint_every,
                     resume_from=resume,
@@ -1615,7 +2030,66 @@ class ElasticTrainer:
         finally:
             if reducer is not None:
                 self.status["retransmits"] += reducer.retransmits
+                self.status["payload_bytes"] += reducer.payload_bytes_sent
+                self.status["ring_steps"] += reducer.ring_steps
+                self.status["allreduce_ops"] += reducer.ops
             gang.close()
+
+    def _ingest_stream(self, reducer: Optional[TcpReducer], lo: int, hi: int):
+        """Out-of-core ingestion of this member's ``[lo, hi)`` slice.
+
+        Pass 1 streams the slice through a :class:`QuantileSketch`
+        (fixed d x 2^bits counts); the counts are summed across the gang
+        by the reducer — the ONLY network the binning costs, chunked
+        through the ring like any histogram — and every member derives
+        the identical bin bounds. Pass 2 re-streams and bins the slice
+        straight into a preallocated uint8 matrix. Peak memory is
+        chunk + bins + sketch; the float matrix never materializes."""
+        from mmlspark_tpu.models.gbdt.binning import BinnedDataset
+        from mmlspark_tpu.models.gbdt.sketch import QuantileSketch
+
+        def slice_chunks(pass_name: str, with_y: bool):
+            """Yield ``(x_slice, y_slice_or_None, row0)`` for the parts
+            of each chunk inside [lo, hi); shared by both passes so the
+            slice arithmetic and the completeness guard can never
+            diverge (``with_y`` skips the f64 label conversion on the
+            binning pass, which discards labels). A short pass (a
+            one-shot generator exhausted by pass 1, a source shrinking
+            between passes) fails loudly — np.empty bins would
+            otherwise train a garbage model silently."""
+            cursor = 0
+            for x_chunk, y_chunk in self._stream():
+                c0, c1 = cursor, cursor + len(x_chunk)
+                cursor = c1
+                s0, s1 = max(lo, c0), min(hi, c1)
+                if s1 > s0:
+                    yield (
+                        np.asarray(x_chunk[s0 - c0:s1 - c0]),
+                        np.asarray(y_chunk[s0 - c0:s1 - c0], np.float64)
+                        if with_y else None,
+                        s0 - lo,
+                    )
+            if cursor != self.n:
+                raise RuntimeError(
+                    f"stream yielded {cursor} rows on the {pass_name} "
+                    f"pass, expected n_rows={self.n} (the source must "
+                    "be re-iterable and stable across passes)"
+                )
+
+        d = self.n_features
+        sk = QuantileSketch(d, bits=self.sketch_bits)
+        y_local = np.empty(hi - lo, np.float64)
+        for x_sl, y_sl, row0 in slice_chunks("sketch", with_y=True):
+            sk.update(x_sl)
+            y_local[row0:row0 + len(y_sl)] = y_sl
+        mapper = sk.to_binmapper(
+            self.cfg.max_bin,
+            reduce=reducer.allreduce if reducer is not None else None,
+        )
+        bins = np.empty((hi - lo, d), np.uint8)
+        for x_sl, _y, row0 in slice_chunks("binning", with_y=False):
+            mapper.transform_into(x_sl, bins, row0)
+        return BinnedDataset(bins, mapper), y_local
 
     def _resolve_resume_from(self, member: GangMember) -> None:
         """An ``--resume-from artifact:<name>@<digest>[@peer,...]`` seed
@@ -1808,6 +2282,90 @@ def load_training_data(spec: str) -> tuple:
     raise ValueError(f"unknown training data spec {spec!r}")
 
 
+def is_streaming_spec(spec: str) -> bool:
+    return str(spec).startswith(("stream-synth:", "stream-csv:"))
+
+
+def load_streaming_data(spec: str) -> tuple:
+    """Out-of-core data specs -> ``(chunk_factory, n_rows, n_features)``.
+
+    - ``stream-synth:<n>x<d>:<seed>[:<chunk>]`` — the synth dataset
+      generated chunk-by-chunk: chunk ``i`` draws from
+      ``default_rng([seed, i])``, so every host produces the identical
+      global row stream without ever holding it (default chunk 65536).
+    - ``stream-csv:<path>:<label>[:<chunk>]`` — a CSV streamed through
+      :class:`~mmlspark_tpu.io.stream.StreamingDataFrame` (label column
+      named; every other numeric column is a feature). ``n``/``d`` come
+      from one counting pre-pass (the file is on disk; rows are never
+      all resident).
+    """
+    if spec.startswith("stream-synth:"):
+        body = spec[len("stream-synth:"):]
+        parts = body.split(":")
+        shape = parts[0]
+        seed = int(parts[1]) if len(parts) > 1 and parts[1] else 0
+        chunk = int(parts[2]) if len(parts) > 2 and parts[2] else 65536
+        n_s, _, d_s = shape.partition("x")
+        n, d = int(n_s), int(d_s)
+
+        def factory() -> Iterator:
+            done = 0
+            i = 0
+            while done < n:
+                c = min(chunk, n - done)
+                r = np.random.default_rng([seed, i])
+                x = r.normal(size=(c, d)).astype(np.float32)
+                y = (
+                    x[:, 0] + 0.5 * x[:, 1] + 0.1 * r.normal(size=c) > 0
+                ).astype(np.float64)
+                yield x, y
+                done += c
+                i += 1
+
+        return factory, n, d
+    if spec.startswith("stream-csv:"):
+        from mmlspark_tpu.io.stream import StreamingDataFrame
+
+        body = spec[len("stream-csv:"):]
+        parts = body.rsplit(":", 2)
+        if len(parts) == 3 and parts[2].isdigit():
+            path, label, chunk = parts[0], parts[1], int(parts[2])
+        else:
+            path, _, label = body.rpartition(":")
+            chunk = 65536
+        sdf = StreamingDataFrame.from_csv(
+            path, chunk_rows=chunk, numeric_only=True
+        )
+        factory, n, d = stream_from_dataframe(sdf, label)
+        return factory, n, d
+    raise ValueError(f"unknown streaming data spec {spec!r}")
+
+
+def stream_from_dataframe(sdf: Any, label_col: str) -> tuple:
+    """Adapt a :class:`~mmlspark_tpu.io.stream.StreamingDataFrame` into
+    an elastic-trainer chunk factory: every column except ``label_col``
+    becomes a feature (sorted-name order, so every host agrees on the
+    layout). Returns ``(factory, n_rows, n_features)``; the counting
+    pre-pass touches only chunk SHAPES, never accumulates rows."""
+    feat_cols: list = []
+    n = 0
+    for chunk in sdf.iter_chunks():
+        if not feat_cols:
+            feat_cols = sorted(c for c in chunk.columns if c != label_col)
+        n += len(chunk)
+
+    def factory() -> Iterator:
+        for chunk in sdf.iter_chunks():
+            x = np.stack(
+                [np.asarray(chunk[c], np.float32) for c in feat_cols],
+                axis=1,
+            )
+            y = np.asarray(chunk[label_col], np.float64)
+            yield x, y
+
+    return factory, n, len(feat_cols)
+
+
 __all__ = [
     "ElasticTrainer",
     "GangContext",
@@ -1820,9 +2378,14 @@ __all__ = [
     "active_gang",
     "activate",
     "assign_partitions",
+    "gang_blocks",
     "gang_sum",
+    "gang_voting_k",
+    "is_streaming_spec",
+    "load_streaming_data",
     "load_training_data",
     "member_row_slice",
     "partition_bounds",
     "snapshot_checkpoint",
+    "stream_from_dataframe",
 ]

@@ -819,6 +819,10 @@ def run_train(
     artifact_dir: Optional[str] = None,
     allreduce_port: int = 0,
     advertise_allreduce_port: Optional[int] = None,
+    reduce_mode: str = "ring",
+    tree_parallelism: str = "data",
+    top_k: int = 20,
+    sketch_bits: int = 16,
 ) -> Any:
     """``fleet train``: one elastic training host (parallel/elastic.py).
 
@@ -836,16 +840,30 @@ def run_train(
     from mmlspark_tpu.models.gbdt.train import TrainConfig
     from mmlspark_tpu.parallel.elastic import (
         ElasticTrainer,
+        is_streaming_spec,
+        load_streaming_data,
         load_training_data,
     )
 
     obs.set_process_label(f"{service_name}@{name}")
-    x, y = load_training_data(data)
+    if is_streaming_spec(data):
+        # out-of-core mode: rows stream chunk-by-chunk (binning via
+        # reducer-merged sketches); the float matrix never materializes
+        stream, n_rows, n_features = load_streaming_data(data)
+        x = y = None
+    else:
+        stream, n_rows, n_features = None, None, None
+        x, y = load_training_data(data)
     cfg = TrainConfig(
         objective=objective, num_iterations=num_iterations,
         num_leaves=num_leaves, learning_rate=learning_rate,
         min_data_in_leaf=min_data_in_leaf, seed=seed,
         boosting_type=boosting_type, growth_policy=growth_policy,
+        parallelism=(
+            "voting_parallel" if tree_parallelism == "voting"
+            else "data_parallel"
+        ),
+        top_k=top_k,
     )
     trainer = ElasticTrainer(
         registry_url, name, x, y, cfg, ckpt_dir,
@@ -860,6 +878,9 @@ def run_train(
         artifact_dir=artifact_dir,
         allreduce_port=allreduce_port,
         advertise_allreduce_port=advertise_allreduce_port,
+        reduce_mode=reduce_mode,
+        stream=stream, n_rows=n_rows, n_features=n_features,
+        sketch_bits=sketch_bits,
     )
     booster = trainer.run()
     model = booster.to_model_string()
@@ -1465,8 +1486,11 @@ def main(argv: Optional[list] = None) -> None:
                     help="this host's gang member name")
     tn.add_argument(
         "--data", required=True,
-        help="training data spec: synth:<n>x<d>:<seed> or npz:<path> "
-        "(every host must see the same dataset)",
+        help="training data spec: synth:<n>x<d>:<seed>, npz:<path>, or "
+        "an out-of-core stream — stream-synth:<n>x<d>:<seed>[:<chunk>] "
+        "/ stream-csv:<path>:<label>[:<chunk>] — binned from streaming "
+        "sketches within a fixed memory budget (every host must see the "
+        "same dataset)",
     )
     tn.add_argument("--ckpt-dir", required=True,
                     help="shared checkpoint dir (doubles as auto-resume)")
@@ -1518,6 +1542,29 @@ def main(argv: Optional[list] = None) -> None:
         help="advertise THIS port on the roster instead of the bound "
         "one — peers dial it, so the member's allreduce link can be "
         "pointed through a chaos proxy or NAT (docs/chaos.md)",
+    )
+    tn.add_argument(
+        "--reduce-mode", choices=("ring", "mesh"), default="ring",
+        help="gang allreduce wire pattern: chunked ring reduce-scatter "
+        "+ allgather (default) or the legacy full-mesh baseline — "
+        "bit-identical results, fewer bytes on the ring",
+    )
+    tn.add_argument(
+        "--tree-parallelism", choices=("data", "voting"), default="data",
+        help="histogram exchange: full data-parallel plane (default) or "
+        "PV-Tree voting — only the top-2*K candidate features' columns "
+        "cross the wire (O(2k) payload on wide data; documented quality "
+        "tolerance, docs/gbdt-training.md)",
+    )
+    tn.add_argument(
+        "--top-k", type=int, default=20,
+        help="voting-parallel K: each member nominates its local top-K "
+        "features; the global top-2K become exact-scan candidates",
+    )
+    tn.add_argument(
+        "--sketch-bits", type=int, default=16,
+        help="streaming-binning sketch resolution (buckets = 2^bits "
+        "per feature; out-of-core --data specs only)",
     )
     t = sub.add_parser(
         "top", help="scrape /metrics across the fleet, print a summary"
@@ -1688,6 +1735,10 @@ def main(argv: Optional[list] = None) -> None:
             artifact_dir=args.artifact_dir,
             allreduce_port=args.allreduce_port,
             advertise_allreduce_port=args.advertise_allreduce_port,
+            reduce_mode=args.reduce_mode,
+            tree_parallelism=args.tree_parallelism,
+            top_k=args.top_k,
+            sketch_bits=args.sketch_bits,
         )
     elif args.role == "registry":
         from mmlspark_tpu.obs.flightrec import install_sigusr1

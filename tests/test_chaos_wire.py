@@ -629,6 +629,94 @@ def test_reducer_persistent_corruption_is_peer_loss_never_wrong_sum():
         proxy.stop(); reg.stop()
 
 
+def test_ring_reduce_scatter_flip_detect_retransmit():
+    """PR-14 ring data plane on the PR-13 harness: one byte-flip on the
+    a->b ring link lands inside a reduce-scatter SEGMENT frame — b
+    drops it (CRC), NACKs, a retransmits the per-peer cached frame, and
+    both members still compute the exact sorted-order sum. Same wire
+    contract as full-mesh, pinned on the new chunked pattern."""
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.parallel.elastic import Generation, TcpReducer
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    reg = DriverRegistry(ttl_s=10.0)
+    # ring scatter frame: 32-byte head + 1-byte name -> payload at 33;
+    # world-2 halves the 8-element f64 array, so offset 40 is inside
+    # the 32-byte segment payload
+    a, b, proxy = _gang_pair(
+        reg.url, [WireRule("flip", direction="c2s", at_offset=40)],
+    )
+    gen = Generation(gen=1, members=["a", "b"])
+    ra = TcpReducer(a, gen, timeout_s=20.0, mode="ring")
+    rb = TcpReducer(b, gen, timeout_s=20.0, mode="ring")
+    try:
+        out = {}
+        xa = np.arange(8, dtype=np.float64)
+        xb = np.full(8, 2.0)
+        ta = threading.Thread(
+            target=lambda: out.__setitem__("a", ra.allreduce(xa))
+        )
+        tb = threading.Thread(
+            target=lambda: out.__setitem__("b", rb.allreduce(xb))
+        )
+        ta.start(); tb.start(); ta.join(25); tb.join(25)
+        expected = xa + xb
+        assert np.array_equal(out["a"], expected)
+        assert np.array_equal(out["b"], expected)
+        assert b.crc_drops == 1          # detected exactly the one flip
+        assert ra.retransmits == 1       # per-peer frame cache recovered
+        assert ra.ring_steps >= 2 and rb.ring_steps >= 2
+        assert [e.offset for e in proxy.journal() if e.kind == "flip"] \
+            == [40]
+    finally:
+        ra.close(); rb.close(); a.close(); b.close()
+        proxy.stop(); reg.stop()
+
+
+def test_ring_reduce_scatter_blackhole_neighbor_host_lost():
+    """The a->b direction of the ring link blackholed mid
+    reduce-scatter (b->a lives): b never receives its segment, so its
+    owner sum — and therefore a's allgather — can never complete.
+    With heartbeats still flowing, both sides surface the wedge as
+    HostLostError naming the silent neighbor, which is exactly what
+    drives the trainer's reshard path."""
+    from mmlspark_tpu.parallel.elastic import (
+        Generation,
+        HostLostError,
+        TcpReducer,
+    )
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    reg = DriverRegistry(ttl_s=10.0)
+    a, b, proxy = _gang_pair(
+        reg.url, [WireRule("blackhole", direction="c2s")],
+    )
+    gen = Generation(gen=1, members=["a", "b"])
+    ra = TcpReducer(a, gen, timeout_s=2.5, mode="ring")
+    rb = TcpReducer(b, gen, timeout_s=2.5, mode="ring")
+    try:
+        out, errs = {}, {}
+
+        def run(red, name):
+            try:
+                out[name] = red.allreduce(np.ones(8))
+            except Exception as e:  # noqa: BLE001
+                errs[name] = e
+
+        ta = threading.Thread(target=run, args=(ra, "a"))
+        tb = threading.Thread(target=run, args=(rb, "b"))
+        ta.start(); tb.start(); ta.join(15); tb.join(15)
+        # no sums were produced on either side; each names the neighbor
+        assert not out
+        assert isinstance(errs.get("a"), HostLostError)
+        assert errs["a"].lost == ["b"]
+        assert isinstance(errs.get("b"), HostLostError)
+        assert errs["b"].lost == ["a"]
+    finally:
+        ra.close(); rb.close(); a.close(); b.close()
+        proxy.stop(); reg.stop()
+
+
 # -- gateway forwarding under a hostile wire ---------------------------------
 
 

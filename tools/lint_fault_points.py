@@ -45,8 +45,9 @@ _INJECT_RE = re.compile(
     re.S,
 )
 # fewer registered points than this means the scan regex rotted, not
-# that the tree lost its chaos hooks
-MIN_EXPECTED = 12
+# that the tree lost its chaos hooks (20 as of PR 14, which added
+# elastic.ring_step — fired before every ring-collective step)
+MIN_EXPECTED = 13
 
 # chaos/wire.py's rule vocabulary: RULE_KINDS = ("latency", ...) —
 # extracted by regex (same grep-grade spirit; an import would drag jax

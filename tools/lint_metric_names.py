@@ -29,6 +29,9 @@ from typing import Iterator, Optional
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCAN_DIRS = ("mmlspark_tpu", "tools")
 
+# "elastic" also covers the ring data plane's wire accounting
+# (mmlspark_elastic_ring_steps_total, mmlspark_elastic_payload_bytes_total,
+# overlap/vote counters — PR 14)
 SUBSYSTEMS = (
     "core", "io", "serving", "gateway", "registry", "parallel", "gbdt",
     "faults", "trace", "modelstore", "slo", "admission", "supervisor",
