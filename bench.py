@@ -1221,6 +1221,191 @@ def _seg_elastic(on_accel: bool, n_dev: int) -> dict:
     except Exception as e:  # noqa: BLE001 — the base segment's measured
         # recovery numbers must survive a scale-block failure
         out["elastic_scale_error"] = str(e)[:200]
+    try:
+        out.update(_elastic_partition(env))
+    except Exception as e:  # noqa: BLE001 — same isolation as the
+        # scale block: a partition-block failure keeps the base numbers
+        out["elastic_partition_error"] = str(e)[:200]
+    return out
+
+
+def _elastic_partition(env: dict) -> dict:
+    """The PR-16 split-brain numbers: a 2-host gang whose minority
+    member reaches the registry only through a chaos proxy. A
+    conductor ``partition`` blackholes that link — the majority
+    declares the minority dead and CAS-commits the next generation; the
+    minority loses its registry quorum and PARKS (stops training, keeps
+    heartbeating, commits nothing). Records partition-to-park latency
+    (how fast a minority fences itself off), heal-to-rejoin latency
+    (grow-back is ON here: the healed member is re-invited at the next
+    checkpoint boundary), and the zombie-commit rejection count (three
+    stale-epoch CAS attempts, all refused by the registry)."""
+    import json as _json
+    import subprocess
+    import tempfile
+    import urllib.parse
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.chaos.conductor import ChaosConductor, Scenario
+    from mmlspark_tpu.chaos.wire import ChaosProxy
+    from mmlspark_tpu.parallel.elastic import (
+        GangMember,
+        Generation,
+        GenerationConflictError,
+        QuorumLostError,
+    )
+    from mmlspark_tpu.serving import fleet
+
+    def cas_rejections() -> float:
+        samples = obs.parse_text(obs.render())
+        return sum(
+            obs.sum_samples(
+                samples, "mmlspark_registry_cas_commits_total",
+                {"result": r},
+            )
+            for r in ("stale", "conflict")
+        )
+
+    out: dict = {}
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=1.2)
+    work = tempfile.mkdtemp(prefix="bench-elastic-part-")
+    ck = os.path.join(work, "ck")
+    reg_port = urllib.parse.urlparse(reg.url).port
+    proxy = ChaosProxy(
+        "127.0.0.1", reg_port, seed=13, name="reg-b"
+    ).start()
+    deadline = time.monotonic() + float(
+        os.environ.get("MMLSPARK_BENCH_ELASTIC_PARTITION_BUDGET", "150")
+    )
+
+    def left(floor: float = 10.0) -> float:
+        rem = deadline - time.monotonic()
+        if rem < floor:
+            raise RuntimeError(
+                "elastic partition block over its wall budget "
+                "(MMLSPARK_BENCH_ELASTIC_PARTITION_BUDGET)"
+            )
+        return rem
+
+    train_args = [
+        "--data", "synth:4000x16:7", "--partitions", "8",
+        # iterations sized so the MAJORITY is still training through
+        # heal + the next grow-back boundary (the gang is killed once
+        # the latencies land — this block never waits for completion)
+        "--num-iterations", "400", "--num-leaves", "15",
+        "--min-data-in-leaf", "5", "--seed", "3",
+        "--checkpoint-every", "2", "--heartbeat-s", "0.25",
+        # grow-back stays ON: heal-to-rejoin latency IS the number
+    ]
+
+    def spawn(name: str, reg_url: str, extra=()) -> subprocess.Popen:
+        argv = [
+            sys.executable, "-m", "mmlspark_tpu.serving.fleet",
+            "train", "--registry", reg_url, "--name", name,
+            "--ckpt-dir", ck, "--world-size", "2",
+            "--status-file", os.path.join(work, f"{name}.json"),
+            *train_args, *extra,
+        ]
+        return subprocess.Popen(
+            argv, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True,
+        )
+
+    def status(name: str) -> dict:
+        try:
+            with open(os.path.join(work, f"{name}.json")) as f:
+                return _json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    surv = vict = None
+    try:
+        surv = spawn("a", reg.url)
+        vict = spawn(
+            "b", proxy.url, extra=["--gen-timeout-s", "240"],
+        )
+        latest = os.path.join(ck, "LATEST")
+        while left():
+            try:
+                with open(latest) as f:
+                    if f.read().strip() >= "round-0000004":
+                        break
+            except OSError:
+                pass
+            for p in (surv, vict):
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        "trainer died before the partition: "
+                        + p.communicate()[1][-500:]
+                    )
+            time.sleep(0.05)
+        ChaosConductor(Scenario.from_spec({"seed": 13, "steps": [
+            {"at_s": 0.0, "action": "partition", "links": ["reg-b"]},
+        ]}), proxies={"reg-b": proxy}).run()
+        partition_t = time.monotonic()
+        while left():
+            if status("b").get("parked"):
+                break
+            time.sleep(0.05)
+        park_t = time.monotonic()
+        out["elastic_partition_to_park_s"] = round(park_t - partition_t, 3)
+        sb = status("b")
+        out["elastic_partition_minority_commits"] = len(
+            sb.get("committed_gens", ())
+        )
+        ChaosConductor(Scenario.from_spec({"seed": 13, "steps": [
+            {"at_s": 0.0, "action": "heal", "links": ["reg-b"]},
+        ]}), proxies={"reg-b": proxy}).run()
+        heal_t = time.monotonic()
+        rejoin_s = None
+        # a soft deadline: a missed grow-back loses only THIS number,
+        # never the park latency already measured above
+        rejoin_deadline = time.monotonic() + min(
+            45.0, max(0.0, deadline - time.monotonic() - 15.0)
+        )
+        while time.monotonic() < rejoin_deadline:
+            sb = status("b")
+            if (
+                not sb.get("parked")
+                and sb.get("gen", 0) >= 3
+                and "b" in sb.get("members", ())
+            ):
+                rejoin_s = round(time.monotonic() - heal_t, 3)
+                break
+            if surv.poll() is not None:
+                break  # majority finished before the grow-back boundary
+            time.sleep(0.05)
+        out["elastic_heal_to_rejoin_s"] = rejoin_s
+        # the zombie: three stale-epoch CAS attempts against the live
+        # registry, every one refused (the count is the headline — a
+        # zero here would mean a rollback LANDED)
+        before = cas_rejections()
+        z = GangMember(reg.url, "z", heartbeat_s=5.0)
+        try:
+            z.adopt(Generation(gen=1, members=["a", "b"]))
+            for k in range(3):
+                try:
+                    z.commit_generation(
+                        Generation(gen=2 + k, members=["z"]),
+                        expected_gen=1,
+                    )
+                except (GenerationConflictError, QuorumLostError):
+                    pass
+        finally:
+            z.close()
+        out["elastic_zombie_rejections"] = int(cas_rejections() - before)
+    finally:
+        for proc in (surv, vict):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+        for proc in (surv, vict):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001 — best-effort reap
+                    pass
+        proxy.stop()
+        reg.stop()
     return out
 
 

@@ -23,6 +23,12 @@ Scenario JSON (inline or a file path; ``fleet chaos --scenario``)::
       {"at_s": 6.0, "action": "check"}
     ]}
 
+``partition`` / ``heal`` are the split-brain macro: ``{"action":
+"partition", "links": ["reg-b", "ar-a"]}`` expands to a symmetric
+``blackhole`` rule (both directions) on EVERY named link, and ``heal``
+clears those links — one step cuts a member off from the registry and
+its peers at once, the drill docs/chaos.md builds on.
+
 Steps run in ``at_s`` order against one monotonic clock, so the same
 scenario against the same fleet replays the same storm; the wire-level
 schedule inside each window is the proxy's own seeded contract
@@ -47,7 +53,10 @@ _M_ACTIONS = obs.counter(
     labels=("action",),
 )
 
-_ACTIONS = ("rules", "clear", "signal", "check", "sleep", "mark")
+_ACTIONS = (
+    "rules", "clear", "signal", "check", "sleep", "mark",
+    "partition", "heal",
+)
 _SIGNALS = {
     "SIGKILL": signal_mod.SIGKILL,
     "SIGSTOP": signal_mod.SIGSTOP,
@@ -92,6 +101,19 @@ class Scenario:
                     r if isinstance(r, WireRule) else WireRule.from_dict(r)
                     for r in step.get("rules", ())
                 ]
+            if action in ("partition", "heal"):
+                # normalize: a partition names the SET of links it cuts
+                # (``links``; bare ``link`` accepted for a 1-link cut)
+                links = step.get("links")
+                if links is None:
+                    links = [step["link"]] if step.get("link") else []
+                if not links:
+                    raise ValueError(
+                        f"{action} step needs 'links' (or 'link'): the "
+                        f"set of proxy links to cut/restore"
+                    )
+                step["links"] = list(links)
+                step.pop("link", None)
             step["at_s"] = float(step.get("at_s", 0.0))
             steps.append(step)
         steps.sort(key=lambda s: s["at_s"])
@@ -128,6 +150,13 @@ class ChaosConductor:
                     f"scenario names unknown link {link!r}; known: "
                     f"{sorted(self.proxies)}"
                 )
+            if step["action"] in ("partition", "heal"):
+                for ln in step["links"]:
+                    if ln not in self.proxies:
+                        raise ValueError(
+                            f"scenario names unknown link {ln!r}; known: "
+                            f"{sorted(self.proxies)}"
+                        )
             if step["action"] == "signal" and \
                     step.get("target") not in self.pids:
                 raise ValueError(
@@ -208,6 +237,20 @@ class ChaosConductor:
                     )
                 else:
                     self._journal_action(step, t_rel, skipped=True)
+            elif action == "partition":
+                # a symmetric partition is the paired blackhole: every
+                # named link swallows BOTH directions — connects still
+                # succeed (the proxy accepts), bytes never arrive, the
+                # exact shape under which both halves suspect the other
+                for ln in step["links"]:
+                    self.proxies[ln].set_rules(
+                        [WireRule(kind="blackhole", direction="both")]
+                    )
+                self._journal_action(step, t_rel)
+            elif action == "heal":
+                for ln in step["links"]:
+                    self.proxies[ln].clear_rules()
+                self._journal_action(step, t_rel)
             elif action == "sleep":
                 self._journal_action(step, t_rel)
             elif action == "mark":
@@ -223,6 +266,7 @@ def run_chaos_cli(
     registry_url: Optional[str] = None,
     service_name: str = "serving",
     seed: Optional[int] = None,
+    status_files: Any = (),
 ) -> int:
     """``fleet chaos`` entrypoint: build proxies from ``name=listen_port:
     target_host:target_port`` specs, pids from ``name=PID``, run the
@@ -253,10 +297,10 @@ def run_chaos_cli(
             name, _, pid = spec.partition("=")
             pids[name] = int(pid)
         checker = None
-        if gateway_url or registry_url:
+        if gateway_url or registry_url or status_files:
             checker = InvariantChecker(
                 gateway_url=gateway_url, registry_url=registry_url,
-                service_name=service_name,
+                service_name=service_name, status_files=status_files,
             )
         conductor = ChaosConductor(
             scenario, proxies=proxies, pids=pids, checker=checker

@@ -41,6 +41,15 @@ catalogue (docs/chaos.md):
                             with the previous incarnation)
 ``breaker_sane``            every breaker-state gauge is 0/1/2
 ``retry_budget_sane``       retry-budget-remaining gauge is in [0, 1]
+``generation_monotonic``    every registry's committed ``<service>-gen``
+                            record only moves forward across checker
+                            passes — a backward step is a resurrected,
+                            superseded world (split-brain rollback)
+``single_writer``           across trainer status files, no two members
+                            claim to have committed the same generation
+                            (``committed_gens`` join) — commit makes a
+                            member the epoch's writer, so a double claim
+                            is split-brain made visible
 ``artifact_quarantine``     every failed verification quarantined
                             (verify_failures == quarantines, final only:
                             the failure counter lands before the
@@ -114,11 +123,14 @@ class InvariantChecker:
         scrape: Optional[Callable] = None,
         stores: Any = (),
         tolerance: int = 0,
+        status_files: Any = (),
     ):
         """``stores``: live ArtifactStore handles for the in-process
         never-serve-quarantined check (metrics alone cannot prove it).
         ``tolerance``: absolute slack allowed on equality checks (for
-        counters read while a scrape races a reply)."""
+        counters read while a scrape races a reply). ``status_files``:
+        elastic-trainer status JSON paths — when given, the
+        ``single_writer`` law joins their ``committed_gens`` claims."""
         from mmlspark_tpu.serving import fleet as fleet_mod
 
         self.gateway_url = gateway_url
@@ -128,6 +140,12 @@ class InvariantChecker:
         self.service_name = service_name
         self.stores = list(stores or ())
         self.tolerance = int(tolerance)
+        self.status_files = list(status_files or ())
+        # per (registry_url, record) committed-gen high-water across
+        # check() passes: a registry whose generation record goes
+        # BACKWARD resurrected a superseded world — the exact rollback
+        # the quorum CAS exists to forbid
+        self._gen_high: dict = {}
         self._scrape = scrape or fleet_mod.scrape_metrics
         # every worker URL any check() has resolved: a worker that later
         # vanishes from the roster (TTL-pruned after a SIGKILL) must not
@@ -333,6 +351,9 @@ class InvariantChecker:
                     self._artifact_checks(parsed, self.online_url, final)
                 )
 
+        violations.extend(self._generation_checks())
+        violations.extend(self._writer_checks())
+
         for store in self.stores:
             violations.extend(self._store_checks(store))
 
@@ -341,6 +362,86 @@ class InvariantChecker:
         ).inc()
         _M_VIOLATIONS.set(len(violations))
         return violations
+
+    def _generation_checks(self) -> list:
+        """``generation_monotonic``: every registry's committed
+        generation record (``<service>-gen``) only ever moves FORWARD
+        across this checker's passes. A backward step means a
+        superseded world was resurrected — a restarted registry that
+        anti-entropy failed to reconcile, or a last-writer-wins commit
+        the CAS endpoint exists to reject. Unreachable registries are
+        skipped (blindness is chaos's doing, not a rollback)."""
+        if not self.registry_url:
+            return []
+        import json as json_mod
+
+        from mmlspark_tpu.io.clients import send_request
+        from mmlspark_tpu.io.http_schema import HTTPRequestData
+        from mmlspark_tpu.serving.fleet import split_registry_urls
+
+        out: list = []
+        for url in split_registry_urls(self.registry_url):
+            try:
+                resp = send_request(
+                    HTTPRequestData(url.rstrip("/") + "/", "GET"),
+                    timeout=5.0,
+                )
+                if resp["status_code"] != 200:
+                    continue
+                roster = json_mod.loads(resp["entity"])
+            except Exception:  # noqa: BLE001 — blind registry: skip
+                continue
+            for name, entries in roster.items():
+                if not name.endswith("-gen"):
+                    continue
+                gens = [
+                    int(e.get("port") or 0) for e in entries
+                    if isinstance(e, dict)
+                ]
+                if not gens:
+                    continue
+                gen = max(gens)
+                key = (url, name)
+                high = self._gen_high.get(key, 0)
+                if gen < high:
+                    out.append(Violation(
+                        "generation_monotonic", url,
+                        f"{name} rolled back: committed gen {gen} after "
+                        f"this checker saw gen {high}",
+                    ))
+                self._gen_high[key] = max(high, gen)
+        return out
+
+    def _writer_checks(self) -> list:
+        """``single_writer``: across every trainer status file, no two
+        members claim to have COMMITTED the same generation — commit is
+        what makes a member that epoch's writer, so a doubly-claimed gen
+        is split-brain made visible (both halves of a partition fenced
+        off the same epoch number)."""
+        if not self.status_files:
+            return []
+        import json as json_mod
+
+        out: list = []
+        claimed: dict = {}  # gen -> (member, path) that claimed it first
+        for path in self.status_files:
+            try:
+                with open(path) as f:
+                    st = json_mod.load(f)
+            except (OSError, ValueError):
+                continue  # not written yet / mid-rewrite: no claim
+            member = st.get("name") or path
+            for gen in st.get("committed_gens", ()):
+                prev = claimed.get(gen)
+                if prev is not None and prev[0] != member:
+                    out.append(Violation(
+                        "single_writer", path,
+                        f"gen {gen} committed by both {prev[0]!r} "
+                        f"({prev[1]}) and {member!r}",
+                    ))
+                else:
+                    claimed[gen] = (member, path)
+        return out
 
     @staticmethod
     def _artifact_checks(parsed: dict, where: str, final: bool) -> list:

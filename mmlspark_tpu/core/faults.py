@@ -79,6 +79,18 @@ point                     fires inside
                           checked — a truthy payload forces the failure
                           verdict (quarantine + re-fetch-elsewhere path)
                           without corrupting anything
+``registry.commit_cas``   serving/registry.py as a generation CAS commit is
+                          evaluated — an error refuses the commit (503, a
+                          missing ack toward the caller's quorum), delay
+                          stalls the commit endpoint
+``elastic.park``          parallel/elastic.py as a member parks (lost the
+                          registry quorum or the generation CAS) — delay
+                          stalls the stop-training transition, an error
+                          kills the trainer mid-park
+``publish.fence``         serving/modelstore/dispatch.py as a stale-epoch
+                          publication is refused — delay stalls the 409,
+                          an error kills the control op instead of
+                          answering (the publisher retry path)
 ========================  ====================================================
 
 Schedules are **seeded and step-indexed**: a rule fires by absolute step

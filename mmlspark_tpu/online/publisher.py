@@ -93,6 +93,7 @@ class Publisher:
         time_fn: Callable[[], float] = time.monotonic,
         artifact_store: Any = None,
         artifact_url: Optional[str] = None,
+        epoch: Optional[int] = None,
     ):
         """``artifact_store`` (an :class:`~mmlspark_tpu.serving.artifacts.
         ArtifactStore`) switches publication to **artifact mode**: each
@@ -102,7 +103,15 @@ class Publisher:
         ``artifact_url`` (this process's ingress serving ``/artifacts``)
         or any registry-advertised peer, so the fleet needs NO shared
         filesystem. Leaving it None keeps the shared-fs ``vw:<path>``
-        fast path exactly as before."""
+        fast path exactly as before.
+
+        ``epoch``: the coordination epoch (committed training
+        generation) stamped onto every worker load/swap as a fencing
+        token — a worker that has already seen a higher epoch rejects
+        the publication with 409 (a SIGSTOP'd zombie coordinator waking
+        after a reshard cannot roll the serving fleet back). Bump it
+        with :meth:`set_epoch` when the gang reshards; None publishes
+        unstamped (pre-fencing behaviour)."""
         if store is None and not worker_urls and not registry_url:
             raise ValueError(
                 "Publisher needs a target: store=, worker_urls= or "
@@ -121,6 +130,7 @@ class Publisher:
         self._now = time_fn
         self.artifact_store = artifact_store
         self.artifact_url = artifact_url
+        self.epoch = int(epoch) if epoch is not None else None
         # version ledger for _gc: (snapshot path, artifact digest | None)
         # in publication order — GC never touches a version it cannot
         # first unadvertise (pinned / mid-pull artifacts stay)
@@ -208,6 +218,13 @@ class Publisher:
     # kept as an alias: pre-artifact callers and docs name the old verb
     _prune_snapshots = _gc
 
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the publication fencing token (never backwards — a
+        publisher cannot un-see an epoch)."""
+        e = int(epoch)
+        if self.epoch is None or e > self.epoch:
+            self.epoch = e
+
     # -- targets -------------------------------------------------------------
 
     def _publish_store(self, spec: str) -> int:
@@ -234,6 +251,13 @@ class Publisher:
         from mmlspark_tpu.io.clients import send_request
         from mmlspark_tpu.io.http_schema import HTTPRequestData
 
+        load_body: dict = {"spec": spec, "activate": "never"}
+        swap_body: dict = {}
+        if self.epoch is not None:
+            # the fencing token: workers reject (409) any publication
+            # stamped older than the highest epoch they have seen
+            load_body["epoch"] = self.epoch
+            swap_body["epoch"] = self.epoch
         flipped = 0
         for base in self._resolve_workers():
             base = base.rstrip("/")
@@ -241,13 +265,14 @@ class Publisher:
                 loaded = send_request(HTTPRequestData(
                     f"{base}/models/{self.model}/load", "POST",
                     {"Content-Type": "application/json"},
-                    json.dumps({"spec": spec, "activate": "never"}),
+                    json.dumps(load_body),
                 ), timeout=self.request_timeout_s)
                 if loaded["status_code"] not in (200, 202):
                     continue
                 swapped = send_request(HTTPRequestData(
                     f"{base}/models/{self.model}/swap", "POST",
-                    {"Content-Type": "application/json"}, "{}",
+                    {"Content-Type": "application/json"},
+                    json.dumps(swap_body),
                 ), timeout=self.request_timeout_s)
                 if swapped["status_code"] == 200:
                     flipped += 1

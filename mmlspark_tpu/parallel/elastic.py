@@ -136,6 +136,19 @@ _M_VOTE_ROUNDS = obs.counter(
     "Voting-parallel exchanges: a (d,) ballot sum + top-2K candidate "
     "columns instead of the full histogram plane",
 )
+_M_PARKS = obs.counter(
+    "mmlspark_elastic_parks_total",
+    "Members that parked (stopped training, kept heartbeating) because "
+    "they lost registry quorum or lost a generation CAS race — the "
+    "minority side of a partition parking instead of split-braining",
+    labels=("reason",),
+)
+_M_FENCED = obs.counter(
+    "mmlspark_elastic_fenced_writes_total",
+    "Writes refused because the writer's adopted epoch was superseded "
+    "(a fenced-out zombie cannot persist, publish, or advertise)",
+    labels=("plane",),
+)
 
 
 # -- the allreduce wire frame --------------------------------------------------
@@ -182,6 +195,36 @@ class WorldChangedError(RuntimeError):
     def __init__(self, gen: int):
         self.gen = gen
         super().__init__(f"training gang moved to generation {gen}")
+
+
+class QuorumLostError(RuntimeError):
+    """This member cannot reach a strict majority of the registries —
+    it may be on the minority side of a partition. The only safe move is
+    to PARK (stop training, keep heartbeating, commit nothing): a
+    minority that reshards to its own world double-writes the epoch."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(
+            "lost registry quorum" + (f": {detail}" if detail else "")
+        )
+
+
+class GenerationConflictError(RuntimeError):
+    """A generation commit lost its compare-and-swap race: another
+    member already committed a conflicting epoch. Carries the winning
+    record (when the registry returned it) so the loser can park and
+    rejoin the winning generation after heal."""
+
+    def __init__(self, gen: int, current: Optional["Generation"] = None):
+        self.gen = gen
+        self.current = current
+        msg = f"generation {gen} commit rejected by CAS"
+        if current is not None:
+            msg += (
+                f" (registry holds gen {current.gen} "
+                f"members={current.members})"
+            )
+        super().__init__(msg)
 
 
 # -- deterministic partition assignment ---------------------------------------
@@ -295,6 +338,45 @@ def _post_json(url: str, payload: dict, timeout: float = 5.0) -> bool:
     return resp["status_code"] == 200
 
 
+def _post_json_status(
+    url: str, payload: dict, timeout: float = 5.0
+) -> tuple:
+    """POST returning ``(status_code, decoded_body)`` — the CAS commit
+    path needs the 409 body (it carries the winning record), not just a
+    success bool."""
+    from mmlspark_tpu.io.clients import send_request
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+    resp = send_request(
+        HTTPRequestData(
+            url, "POST", {"Content-Type": "application/json"},
+            json.dumps(payload),
+        ),
+        timeout=timeout,
+    )
+    try:
+        body = json.loads(resp["entity"])
+    except (ValueError, TypeError):
+        body = {}
+    return resp["status_code"], body
+
+
+def _generation_from_entry(e: dict) -> "Generation":
+    """Roster generation entry (``host="generation"``) -> Generation."""
+    return Generation(
+        gen=int(e.get("port", 0)),
+        members=list(e.get("members", [])),
+        reason=e.get("reason", ""),
+        resume_round=int(e.get("resume_round", 0)),
+        snapshot=e.get("snapshot"),
+        snapshot_digest=e.get("snapshot_digest"),
+        committer=e.get("committer", ""),
+        detect_latency_s=float(e.get("detect_latency_s", 0.0)),
+        stamp=float(e.get("ts", 0.0)),
+        evicted=dict(e.get("evicted") or {}),
+    )
+
+
 def _get_roster(url: str, timeout: float = 5.0) -> Optional[dict]:
     from mmlspark_tpu.io.clients import send_request
     from mmlspark_tpu.io.http_schema import HTTPRequestData
@@ -369,8 +451,17 @@ class GangMember:
             info = srv.start()
             self._artifact_srv = srv
             self.artifact_port = info.port
-        self.last_seen: dict = {}       # member -> wall ts last on roster
+        self.last_seen: dict = {}   # member -> MONOTONIC ts last on roster
         self._adopted: Optional[Generation] = None
+        # registry reachability (monotonic ts of each registry's last
+        # answer): the quorum signal — a member whose majority-reachable
+        # age exceeds ``quorum_grace_s`` is on the minority side of a
+        # partition and must park rather than reshard
+        self._reg_seen: dict = {}
+        self._boot_mono = time.monotonic()
+        self.quorum_grace_s = max(2.0, 5.0 * self.heartbeat_s)
+        self.commit_acks = 0            # registries acking the last commit
+        self.committed_gens: list = []  # gens THIS member CAS-committed
         self._stop = threading.Event()
         # allreduce frame listener (one across generations; the port is
         # what peers learn from the roster)
@@ -569,8 +660,13 @@ class GangMember:
         timeout = beat_timeout(self.heartbeat_s, factor=2.0)
         for url in self.registry_urls:
             try:
-                _post_json(url, self._registration(), timeout=timeout)
+                if _post_json(url, self._registration(), timeout=timeout):
+                    self._reg_seen[url] = time.monotonic()
                 if gen is not None:
+                    # the registry monotone-guards generation re-posts: a
+                    # 409 here means OUR copy is the superseded one (the
+                    # heartbeat conflict rule above adopts the winner at
+                    # the next beat) — never last-writer-wins
                     _post_json(url, self._gen_payload(gen), timeout=timeout)
             except Exception:  # noqa: BLE001 — registry may be restarting
                 pass
@@ -585,20 +681,43 @@ class GangMember:
         entry, or **None when no registry answered** — blindness is not
         evidence of death (a restarting registry must not make every
         survivor declare every peer lost and split-brain the gang).
-        Tracks ``last_seen`` wall times for the detection-latency
-        metric. The first live registry answers (registry HA)."""
+        Tracks ``last_seen`` MONOTONIC times for the loss grace and the
+        detection-latency metric (wall clock steps must not distort
+        either). The first live registry answers (registry HA)."""
         for url in self.registry_urls:
             data = _get_roster(url)
             if data is None:
                 continue
+            self._reg_seen[url] = time.monotonic()
             entries = {
                 e.get("host"): e for e in data.get(f"{self.service}-gang", [])
             }
-            now = time.time()
+            now = time.monotonic()
             for host in entries:
                 self.last_seen[host] = now
             return entries
         return None
+
+    # -- registry quorum -------------------------------------------------------
+
+    def majority(self) -> int:
+        """Strict majority of the configured registries (majority-of-1
+        for single-registry deployments)."""
+        return len(self.registry_urls) // 2 + 1
+
+    def quorum_age_s(self) -> float:
+        """Seconds since a strict majority of the registries was last
+        reachable from here (0-ish while healthy). The park trigger:
+        an age beyond ``quorum_grace_s`` means this member may be on
+        the minority side of a partition — it must stop training and
+        commit nothing, because the majority side is entitled to
+        declare it dead and reshard without it."""
+        times = sorted(
+            (self._reg_seen.get(u, self._boot_mono)
+             for u in self.registry_urls),
+            reverse=True,
+        )
+        return time.monotonic() - times[self.majority() - 1]
 
     # -- generation record -----------------------------------------------------
 
@@ -628,14 +747,18 @@ class GangMember:
         (some registry answered AND it has collected our own heartbeat
         — a freshly-restarted registry's empty roster is blindness, not
         mass death), the candidate is absent, and its last sighting is
-        older than the grace (debounces the re-registration race)."""
+        older than the grace (debounces the re-registration race).
+
+        Sighting ages are MONOTONIC deltas: a wall-clock step (NTP slew,
+        manual date set) must neither mass-declare death nor mask a real
+        one — pinned by the clock-step test."""
         if not candidates or ros is None or self.name not in ros:
             return []
-        now = time.time()
+        now = time.monotonic()
         return [
             c for c in candidates
             if c not in ros
-            and now - self.last_seen.get(c, 0.0) >= grace_s
+            and now - self.last_seen.get(c, self._boot_mono) >= grace_s
         ]
 
     def read_generation(self) -> Optional[Generation]:
@@ -647,36 +770,91 @@ class GangMember:
             data = _get_roster(url)
             if data is None:
                 continue
+            self._reg_seen[url] = time.monotonic()
             entries.extend(data.get(f"{self.service}-gen", []))
         if entries:
             e = max(
                 entries,
                 key=lambda x: (x.get("port", 0), x.get("ts", 0.0)),
             )
-            return Generation(
-                gen=int(e.get("port", 0)),
-                members=list(e.get("members", [])),
-                reason=e.get("reason", ""),
-                resume_round=int(e.get("resume_round", 0)),
-                snapshot=e.get("snapshot"),
-                snapshot_digest=e.get("snapshot_digest"),
-                committer=e.get("committer", ""),
-                detect_latency_s=float(e.get("detect_latency_s", 0.0)),
-                stamp=float(e.get("ts", 0.0)),
-                evicted=dict(e.get("evicted") or {}),
-            )
+            return _generation_from_entry(e)
         return None
 
-    def commit_generation(self, g: Generation) -> Generation:
-        """POST the generation record; the registry stamps it (``ts``).
-        Deterministic content, so racing survivors committing the same
-        world collapse to one record."""
+    def commit_generation(
+        self, g: Generation, expected_gen: Optional[int] = None,
+    ) -> Generation:
+        """Quorum compare-and-swap commit: POST the record to EVERY
+        registry's ``/generation/commit`` with the predecessor claim
+        (``expected_gen``, derived from the adopted generation when not
+        given) and count acks. Succeeds only when a strict majority
+        acks (majority-of-1 for single-registry fleets); raises
+
+        - :class:`GenerationConflictError` when a registry rejects the
+          CAS because a conflicting epoch already won (carries the
+          winner so the loser can park and rejoin it), and
+        - :class:`QuorumLostError` when fewer than a majority of
+          registries ack — including the zero-ack case (a dead or
+          partitioned registry list must never read as success; the
+          old code swallowed every POST failure and proceeded as
+          committed).
+        """
         g.committer = self.name
+        if expected_gen is None:
+            if self._adopted is not None:
+                expected_gen = int(self._adopted.gen)
+            else:
+                cur0 = self.read_generation()
+                expected_gen = int(cur0.gen) if cur0 is not None else 0
+        payload = {
+            "name": f"{self.service}-gen",
+            "gen": int(g.gen),
+            "expected_gen": int(expected_gen),
+            "record": self._gen_payload(g),
+        }
+        acks = 0
+        conflict: Optional[Generation] = None
+        conflict_gen = -1
         for url in self.registry_urls:
             try:
-                _post_json(url, self._gen_payload(g))
-            except Exception:  # noqa: BLE001
-                pass
+                status, body = _post_json_status(
+                    url.rstrip("/") + "/generation/commit", payload
+                )
+            except Exception:  # noqa: BLE001 — unreachable: not an ack
+                continue
+            self._reg_seen[url] = time.monotonic()
+            if status == 200:
+                acks += 1
+            elif status == 404:
+                # pre-CAS registry: fall back to the plain roster POST
+                try:
+                    if _post_json(url, self._gen_payload(g)):
+                        acks += 1
+                except Exception:  # noqa: BLE001
+                    pass
+            elif status == 409:
+                cur = body.get("current") if isinstance(body, dict) else None
+                cg = int(body.get("current_gen", 0)) if isinstance(
+                    body, dict
+                ) else 0
+                if cg > conflict_gen:
+                    conflict_gen = cg
+                    conflict = (
+                        _generation_from_entry(cur) if cur else None
+                    )
+        self.commit_acks = acks
+        if acks < self.majority():
+            # a minority of acks is NOT a commit, whatever the mix of
+            # rejections and silence — but a CAS rejection is the more
+            # specific diagnosis (it carries the winning epoch to park
+            # against); plain blindness is quorum loss
+            if conflict_gen >= 0:
+                raise GenerationConflictError(int(g.gen), conflict)
+            raise QuorumLostError(
+                f"generation {g.gen} commit acked by {acks} of "
+                f"{len(self.registry_urls)} registries "
+                f"(majority is {self.majority()})"
+            )
+        self.committed_gens.append(int(g.gen))
         self._adopted = g
         _M_GEN.set(g.gen)
         _M_MEMBERS.set(len(g.members))
@@ -686,6 +864,29 @@ class GangMember:
         self._adopted = g
         _M_GEN.set(g.gen)
         _M_MEMBERS.set(len(g.members))
+
+    def fenced_out(self, plane: str) -> bool:
+        """Is this member's adopted epoch superseded by a committed
+        generation that EXCLUDES it? The committed gen is the fencing
+        token: a fenced-out writer must refuse to persist or advertise
+        on ``plane`` (counted in ``mmlspark_elastic_fenced_writes_total``)
+        — a SIGSTOP'd zombie coordinator that wakes after the survivors
+        resharded cannot roll the fleet back. Blindness is NOT fencing
+        (the quorum park path owns that side); only a registry-confirmed
+        newer world fences."""
+        g = self._adopted
+        if g is None:
+            return False
+        cur = self.read_generation()
+        if cur is None:
+            return False
+        superseded = cur.gen > g.gen or (
+            cur.gen == g.gen and sorted(cur.members) != sorted(g.members)
+        )
+        if superseded and self.name not in cur.members:
+            _M_FENCED.labels(plane=plane).inc()
+            return True
+        return False
 
     def await_generation(
         self,
@@ -703,17 +904,37 @@ class GangMember:
             if g is not None and g.gen > min_gen and self.name in g.members:
                 self.adopt(g)
                 return g
-            if g is None and min_gen == 0:
+            if min_gen == 0 and self._adopted is None:
+                # bootstrap only before EVER adopting a generation: a
+                # parked or resharded member re-awaiting must not fork a
+                # fresh gen-1 world while blind to the winner's record.
+                # Generation records are DURABLE (no TTL): a brand-new
+                # gang may take over a committed gen only when every
+                # incumbent member is gone from the roster — it then
+                # CONTINUES the sequence (gen+1, CAS on the incumbent
+                # gen), never rewinds it; a single live incumbent blocks
+                # the takeover (grow-back owns joining a live gang)
                 ros = self.roster()
                 names = sorted(ros or {})
+                incumbent_alive = g is not None and any(
+                    m in (ros or {}) for m in g.members
+                )
                 if (
-                    self.name in names
+                    not incumbent_alive
+                    and self.name in names
                     and len(names) >= world_size
                     and self.name == names[0]
                 ):
-                    return self.commit_generation(
-                        Generation(gen=1, members=names[:world_size])
-                    )
+                    base = g.gen if g is not None else 0
+                    try:
+                        return self.commit_generation(
+                            Generation(
+                                gen=base + 1, members=names[:world_size]
+                            ),
+                            expected_gen=base,
+                        )
+                    except (QuorumLostError, GenerationConflictError):
+                        pass  # lost the race or the quorum: keep polling
             time.sleep(poll_s)
         raise TimeoutError(
             f"member {self.name!r}: no generation including me appeared "
@@ -972,8 +1193,9 @@ class TcpReducer:
                     missing, self.member.roster(), self.loss_grace_s
                 )
                 if dead:
+                    now_m = time.monotonic()
                     latency = [
-                        time.time() - self.member.last_seen.get(p, time.time())
+                        now_m - self.member.last_seen.get(p, now_m)
                         for p in dead
                     ]
                     for lat in latency:
@@ -986,6 +1208,15 @@ class TcpReducer:
                 g = self.member.read_generation()
                 if g is not None and g.gen > self.gen:
                     raise WorldChangedError(g.gen)
+                # the minority side of a partition: peers AND registries
+                # unreachable. Waiting out the full allreduce timeout
+                # would leave a zombie training long after the majority
+                # resharded — park as soon as the quorum grace lapses
+                if self.member.quorum_age_s() > self.member.quorum_grace_s:
+                    raise QuorumLostError(
+                        f"allreduce seq {seq}: no registry majority for "
+                        f"{self.member.quorum_age_s():.1f}s"
+                    )
             if now >= deadline:
                 raise HostLostError(
                     missing, self.gen,
@@ -1274,6 +1505,7 @@ class GangContext:
         )
         self.lost: list = []
         self.world_changed: Optional[int] = None
+        self.quorum_lost = False
         self.rounds_seen = 0
         self._round_t = time.monotonic()
         self._last_it = 0
@@ -1311,6 +1543,9 @@ class GangContext:
         except WorldChangedError as e:
             self.world_changed = e.gen
             raise
+        except QuorumLostError:
+            self.quorum_lost = True
+            raise
 
     def allreduce_blocks(self, builders: list) -> list:
         """Compute/communication overlap: ``builders`` are zero-arg
@@ -1347,6 +1582,9 @@ class GangContext:
             raise
         except WorldChangedError as e:
             self.world_changed = e.gen
+            raise
+        except QuorumLostError:
+            self.quorum_lost = True
             raise
 
     def all_rows(self, local: np.ndarray) -> np.ndarray:
@@ -1431,7 +1669,20 @@ class GangContext:
         # evidence of death — hold rather than split-brain the gang.
         # For visible peers, a miss only counts once the last sighting
         # is older than the loss grace (debounces the re-register race).
-        now_w = time.time()
+        # Sustained blindness past the quorum grace is different from a
+        # blip: this member is (at best) on the minority side of a
+        # partition, and in a multi-member gang it must PARK rather than
+        # train into an epoch the majority is entitled to reshard away.
+        if (
+            self.world > 1
+            and self.member.quorum_age_s() > self.member.quorum_grace_s
+        ):
+            self.quorum_lost = True
+            raise QuorumLostError(
+                f"round {it}: no registry majority for "
+                f"{self.member.quorum_age_s():.1f}s"
+            )
+        now_m = time.monotonic()
         lost = self.member.declared_dead(
             [m for m in self.members if m != self.member.name],
             ros, self.loss_grace_s,
@@ -1441,7 +1692,7 @@ class GangContext:
         if lost:
             for m in lost:
                 _M_DETECT.observe(
-                    max(0.0, now_w - self.member.last_seen.get(m, now_w))
+                    max(0.0, now_m - self.member.last_seen.get(m, now_m))
                 )
             self.lost = sorted(set(lost))
             raise HostLostError(self.lost, self.generation.gen,
@@ -1476,6 +1727,16 @@ class GangContext:
         store = self.member.artifact_store
         if store is None or not self.ckpt_dir:
             return None, None, it
+        if self.member.fenced_out("artifact"):
+            # the epoch moved past us while we were deciding to resize:
+            # a fenced-out writer must not persist or advertise snapshot
+            # bytes (the commit below would lose its CAS anyway — this
+            # refuses the WRITE, not just the record)
+            cur = self.member.read_generation()
+            self.world_changed = (
+                cur.gen if cur is not None else self.generation.gen + 1
+            )
+            raise WorldChangedError(self.world_changed)
         snap, resume_round = snapshot_checkpoint(self.ckpt_dir, next_gen)
         if snap is None:
             return None, None, it
@@ -1553,12 +1814,17 @@ class GangContext:
         """Was ``exc`` a gang change? In-callback failures surface as
         ``XlaRuntimeError`` with the real cause recorded on this context,
         so classify by state, not by exception type."""
-        if isinstance(exc, (HostLostError, WorldChangedError)):
+        if isinstance(exc, (
+            HostLostError, WorldChangedError,
+            QuorumLostError, GenerationConflictError,
+        )):
             return exc
         if self.lost:
             return HostLostError(self.lost, self.generation.gen)
         if self.world_changed is not None:
             return WorldChangedError(self.world_changed)
+        if self.quorum_lost:
+            return QuorumLostError("recorded on gang context")
         return None
 
     def join(self, timeout_s: float = 30.0) -> None:
@@ -1829,6 +2095,13 @@ class ElasticTrainer:
             "reduce_mode": reduce_mode, "payload_bytes": 0,
             "ingest_payload_bytes": 0, "ring_steps": 0,
             "allreduce_ops": 0,
+            # split-brain stance: parked == currently refusing to train
+            # (minority side / lost CAS race); committed_gens are the
+            # epochs THIS member won the commit for — the invariant
+            # checker's at-most-one-writer law joins these across the
+            # fleet's status files
+            "parked": False, "parks": 0, "park_reasons": [],
+            "committed_gens": [], "commit_acks": 0,
         }
 
     # -- status ---------------------------------------------------------------
@@ -1838,6 +2111,10 @@ class ElasticTrainer:
             return
         if self._member is not None:
             self.status["crc_drops"] = self._member.crc_drops
+            self.status["committed_gens"] = list(
+                self._member.committed_gens
+            )
+            self.status["commit_acks"] = self._member.commit_acks
         tmp = self.status_file + f".tmp-{os.getpid()}"
         try:
             with open(tmp, "w") as f:
@@ -1939,7 +2216,9 @@ class ElasticTrainer:
                 else None
             ),
         )
-        self.status.update(gen=gen.gen, members=sorted(gen.members))
+        self.status.update(
+            gen=gen.gen, members=sorted(gen.members), parked=False,
+        )
         # per-round cost changes with the WORLD (a survivor histograms
         # twice the rows after a 2->1 shrink): a fresh generation gets a
         # fresh EWMA, so the straggler signal and the recorded
@@ -2025,7 +2304,17 @@ class ElasticTrainer:
                     1.0 / member.ewma_s, 3
                 )
             if isinstance(abort, HostLostError):
-                self._reshard(member, gen, abort)
+                try:
+                    self._reshard(member, gen, abort)
+                except (QuorumLostError, GenerationConflictError) as pe:
+                    # the reshard commit could not win a majority (or
+                    # lost the CAS): this member is the minority — park,
+                    # never fork a minority world
+                    self._park(member, gen, pe)
+            elif isinstance(
+                abort, (QuorumLostError, GenerationConflictError)
+            ):
+                self._park(member, gen, abort)
             return None
         finally:
             if reducer is not None:
@@ -2200,6 +2489,27 @@ class ElasticTrainer:
         except (OSError, ValueError):
             return None
 
+    def _park(
+        self, member: GangMember, gen: Generation, err: Exception,
+    ) -> None:
+        """The minority-side stance after losing quorum or a CAS race:
+        stop training, commit NOTHING, keep heartbeating (the member's
+        beat thread runs on), and wait in ``await_generation`` to rejoin
+        the winning epoch once the partition heals (grow-back re-admits
+        us at the majority coordinator's next checkpoint boundary)."""
+        reason = (
+            "conflict" if isinstance(err, GenerationConflictError)
+            else "quorum"
+        )
+        faults.inject(
+            "elastic.park", context={"gen": gen.gen, "reason": reason}
+        )
+        _M_PARKS.labels(reason=reason).inc()
+        self.status["parked"] = True
+        self.status["parks"] += 1
+        self.status["park_reasons"].append(reason)
+        self._write_status()
+
     def _reshard(
         self, member: GangMember, gen: Generation, err: HostLostError
     ) -> None:
@@ -2209,7 +2519,7 @@ class ElasticTrainer:
             return  # evicted/forced out: wait for grow-back
         detect_latency = max(
             (
-                time.time() - member.last_seen[m]
+                time.monotonic() - member.last_seen[m]
                 for m in err.lost if m in member.last_seen
             ),
             default=0.0,
@@ -2233,6 +2543,11 @@ class ElasticTrainer:
                     break
                 except Exception:  # noqa: BLE001 — injected refusal
                     time.sleep(self.heartbeat_s)
+            if member.fenced_out("checkpoint"):
+                # the fleet moved past us while we were deciding (a
+                # SIGSTOP'd zombie waking after the survivors resharded
+                # lands here): refuse to persist the snapshot or commit
+                return
             snap, resume_round = snapshot_checkpoint(
                 self.ckpt_dir, gen.gen + 1
             )
@@ -2371,7 +2686,9 @@ __all__ = [
     "GangContext",
     "GangMember",
     "Generation",
+    "GenerationConflictError",
     "HostLostError",
+    "QuorumLostError",
     "StragglerTracker",
     "TcpReducer",
     "WorldChangedError",

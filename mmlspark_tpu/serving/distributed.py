@@ -1601,6 +1601,13 @@ class ServingGateway:
             ct = resp.getheader("Content-Type")
             if ct:
                 out_headers["Content-Type"] = ct
+            # epoch-fence rejections (modelstore dispatch 409) carry the
+            # highest-seen epoch; preserve it across the hop so a
+            # publisher behind the gateway learns the winning epoch
+            # instead of a bare 409
+            fenced = resp.getheader("x-mmlspark-fenced")
+            if fenced:
+                out_headers["x-mmlspark-fenced"] = fenced
             self._reply(req, body, resp.status, out_headers)
             return
         if not_ready is not None:

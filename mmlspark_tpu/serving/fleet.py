@@ -1033,6 +1033,7 @@ def run_online(
     text_col: Optional[str] = None,
     distributed: bool = False,
     artifact_dir: Optional[str] = None,
+    publish_epoch: Optional[int] = None,
 ) -> tuple:
     """``fleet online``: run the continuous-learning loop as a fleet
     role. Starts the HTTP ingest ingress (``POST /ingest``; ``GET
@@ -1092,6 +1093,7 @@ def run_online(
         worker_urls=worker_urls, registry_url=registry_url,
         service_name=service_name,
         artifact_store=art_store, artifact_url=artifact_url,
+        epoch=publish_epoch,
     )
     loop = OnlineLearningLoop(
         stream, trainer, publisher, publish_every_s=publish_every_s,
@@ -1452,6 +1454,12 @@ def main(argv: Optional[list] = None) -> None:
     )
     on.add_argument("--heartbeat-s", type=float, default=5.0)
     on.add_argument("--advertise-host", default=None)
+    on.add_argument(
+        "--publish-epoch", type=int, default=None,
+        help="fencing token stamped on every publication: workers "
+        "reject load/swap bodies whose epoch is older than the highest "
+        "seen (docs/robustness.md split brain)",
+    )
     on.add_argument("--num-bits", type=int, default=18)
     on.add_argument("--loss", default="logistic")
     on.add_argument("--lr", type=float, default=0.5)
@@ -1636,6 +1644,11 @@ def main(argv: Optional[list] = None) -> None:
     ch.add_argument("--service-name", default="serving")
     ch.add_argument("--seed", type=int, default=None,
                     help="override the scenario's seed")
+    ch.add_argument(
+        "--status-file", action="append", default=[], metavar="PATH",
+        help="one elastic-trainer status JSON for the check step's "
+        "single_writer law (repeatable; docs/chaos.md)",
+    )
     m = sub.add_parser(
         "model",
         help="model lifecycle control against a worker or gateway "
@@ -1678,6 +1691,7 @@ def main(argv: Optional[list] = None) -> None:
             args.scenario, args.proxy, args.pid,
             gateway_url=args.gateway, registry_url=args.registry,
             service_name=args.service_name, seed=args.seed,
+            status_files=args.status_file,
         ))
     if args.role == "model":
         raise SystemExit(run_model_verb(
@@ -1809,6 +1823,7 @@ def main(argv: Optional[list] = None) -> None:
             label_col=args.label_col, features_col=args.features_col,
             text_col=args.text_col, distributed=args.distributed,
             artifact_dir=args.artifact_dir,
+            publish_epoch=args.publish_epoch,
         )
         _serve_forever([stopper])
     else:

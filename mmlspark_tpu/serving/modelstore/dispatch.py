@@ -47,6 +47,7 @@ from collections import deque
 from typing import Optional
 
 from mmlspark_tpu import obs
+from mmlspark_tpu.core import faults
 from mmlspark_tpu.obs.flightrec import FLIGHT
 from mmlspark_tpu.serving.admission import (
     DEADLINE_HEADER,
@@ -94,6 +95,12 @@ _M_SHED = obs.counter(
 _M_ERRS = obs.counter(
     "mmlspark_modelstore_handler_errors_total",
     "Handler exceptions turned into 500 batches", labels=("model",),
+)
+_M_EPOCH_FENCED = obs.counter(
+    "mmlspark_elastic_fenced_publications_total",
+    "Model load/swap publications rejected because their epoch stamp "
+    "was older than the highest seen (zombie-coordinator rollback "
+    "refused at the worker's swap path)", labels=("model",),
 )
 _M_QDEPTH = obs.gauge(
     "mmlspark_modelstore_queue_depth_requests",
@@ -456,6 +463,13 @@ class ModelDispatcher:
         self.shed = 0
         self.deadline_expired = 0
         self._lat = LatencyRing()
+        # epoch fencing on the publication plane: per-model highest
+        # coordination epoch seen on a load/swap body. A publication
+        # stamped with an OLDER epoch is a zombie coordinator (one that
+        # woke after the fleet resharded) trying to roll the serving
+        # fleet back — rejected with 409, never applied
+        self._model_epochs: dict[str, int] = {}
+        self._epoch_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -678,6 +692,38 @@ class ModelDispatcher:
                 body = json.loads(r.body) if r.body else {}
                 if not isinstance(body, dict):
                     raise ValueError("control body must be a JSON object")
+                if verb in ("load", "swap") and body.get("epoch") is not None:
+                    # epoch fence: the committed training generation
+                    # rides the publication as a fencing token — an
+                    # epoch older than the highest this worker has seen
+                    # is a zombie's rollback and is refused, counted
+                    epoch = int(body["epoch"])
+                    with self._epoch_lock:
+                        high = self._model_epochs.get(name, 0)
+                        if epoch < high:
+                            fenced = True
+                        else:
+                            fenced = False
+                            self._model_epochs[name] = epoch
+                    if fenced:
+                        faults.inject("publish.fence", context={
+                            "model": name, "epoch": epoch, "highest": high,
+                        })
+                        _M_EPOCH_FENCED.labels(model=name).inc()
+                        self._reply_json(r, {
+                            "error": (
+                                f"fenced: publication epoch {epoch} is "
+                                f"older than highest seen {high}"
+                            ),
+                            "fenced": True, "highest_epoch": high,
+                        }, 409, headers={
+                            "Content-Type": "application/json",
+                            # survives the gateway hop (distributed.py
+                            # preserves it), so a publisher behind the
+                            # gateway still sees WHY the 409 happened
+                            "x-mmlspark-fenced": str(high),
+                        })
+                        return
                 if verb == "load":
                     spec = body.get("spec")
                     if spec is None:

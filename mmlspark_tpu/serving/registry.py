@@ -15,6 +15,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from mmlspark_tpu import obs
+from mmlspark_tpu.core import faults
 from mmlspark_tpu.io.clients import send_request
 from mmlspark_tpu.io.http_schema import HTTPRequestData
 from mmlspark_tpu.serving.server import ServiceInfo
@@ -42,6 +43,11 @@ _M_RECONCILES = obs.counter(
 _M_RECONCILED = obs.counter(
     "mmlspark_registry_reconciled_entries_total",
     "Roster entries adopted from peers (newer registration stamp)",
+)
+_M_CAS = obs.counter(
+    "mmlspark_registry_cas_commits_total",
+    "Generation CAS commits by outcome (committed/conflict/stale)",
+    labels=("result",),
 )
 
 
@@ -84,6 +90,13 @@ class DriverRegistry:
         # missed the goodbye (a RE-registration after the delete carries
         # a newer stamp and wins over the tombstone)
         self._tombstones: dict = {}
+        # committed generation records (split-brain fencing): keyed by the
+        # record name (``<service>-gen``), each holds the HIGHEST
+        # CAS-committed generation. Deliberately exempt from TTL expiry —
+        # a committed epoch is durable coordination state (the fencing
+        # token a late zombie must still collide with), not a liveness
+        # claim.
+        self._generations: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._stop_reconcile = threading.Event()
         self._reconcile_thread: Optional[threading.Thread] = None
@@ -131,6 +144,40 @@ class DriverRegistry:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if self.path.split("?", 1)[0] == "/generation/commit":
+                    # compare-and-swap generation commit (split-brain
+                    # fencing): the predecessor check rejects conflicting
+                    # or stale commits instead of last-writer-wins
+                    try:
+                        n = int(self.headers.get("Content-Length") or 0)
+                        body = json.loads(self.rfile.read(n))
+                        name = body["name"]
+                        gen = int(body["gen"])
+                        expected = int(body.get("expected_gen", 0))
+                        record = dict(body.get("record") or {})
+                    except (ValueError, KeyError, TypeError):
+                        code, out = 400, {
+                            "committed": False, "reason": "bad-request",
+                        }
+                    else:
+                        try:
+                            code, out = registry.commit_cas(
+                                name, gen, expected, record
+                            )
+                        except Exception as e:  # noqa: BLE001 — injected
+                            # fault / internal error: refuse the commit
+                            # (the client counts this as a missing ack,
+                            # never as a committed generation)
+                            code, out = 503, {
+                                "committed": False, "reason": str(e),
+                            }
+                    payload = json.dumps(out).encode()
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 try:
                     n = int(self.headers.get("Content-Length") or 0)
                     info = json.loads(self.rfile.read(n))
@@ -139,6 +186,19 @@ class DriverRegistry:
                     self.send_response(400)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
+                    return
+                if name.endswith("-gen") and info.get("host") == "generation":
+                    # plain roster POST of a generation record (heartbeat
+                    # refresh / HA catch-up): monotone-guarded so a zombie
+                    # re-advertising a superseded epoch is rejected, not
+                    # last-writer-wins
+                    code, out = registry._gen_refresh(name, info)
+                    payload = json.dumps(out).encode()
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                     return
                 with registry._lock:
                     # re-registration replaces the same (host, port) — a
@@ -224,7 +284,7 @@ class DriverRegistry:
                     return
                 with registry._lock:
                     registry._expire_locked()
-                    body = json.dumps(registry._services).encode()
+                    body = json.dumps(registry._dump_locked()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -243,6 +303,83 @@ class DriverRegistry:
                 daemon=True,
             )
             self._reconcile_thread.start()
+
+    # -- generation CAS (split-brain fencing) ---------------------------------
+
+    def _dump_locked(self) -> dict:
+        """Roster dump plus the committed generation records, each
+        rendered as a single ``host="generation"`` entry so existing
+        readers (``GangMember.read_generation``) work unchanged."""
+        out = {k: list(v) for k, v in self._services.items()}
+        for name, g in self._generations.items():
+            out[name] = [dict(g["record"])]
+        return out
+
+    def commit_cas(
+        self, name: str, gen: int, expected_gen: int, record: dict,
+    ) -> tuple:
+        """Compare-and-swap commit of generation ``gen`` for record
+        ``name`` (``<service>-gen``). Commits iff ``gen`` advances the
+        currently committed generation AND the committer's predecessor
+        claim is not stale (``expected_gen >= cur_gen``) — a commit
+        racing against an already-won epoch (conflict) or rolling it
+        back (stale) gets a 409 carrying the winner, never
+        last-writer-wins. ``expected_gen > cur_gen`` is accepted: that
+        is a member whose adopted predecessor this registry missed
+        (registry catch-up), not a stale read. Forward jumps (2 -> 5)
+        are allowed for the same reason."""
+        faults.inject("registry.commit_cas", context={
+            "name": name, "gen": gen, "expected_gen": expected_gen,
+        })
+        with self._lock:
+            cur = self._generations.get(name)
+            cur_gen = int(cur["gen"]) if cur else 0
+            if gen <= cur_gen or expected_gen < cur_gen:
+                result = "stale" if gen <= cur_gen else "conflict"
+                _M_CAS.labels(result=result).inc()
+                return 409, {
+                    "committed": False, "reason": result,
+                    "current_gen": cur_gen,
+                    "current": dict(cur["record"]) if cur else None,
+                }
+            rec = dict(record)
+            rec["name"] = name
+            rec["host"] = "generation"
+            rec["port"] = gen
+            rec["ts"] = time.time()  # the REGISTRY stamps commit order
+            self._generations[name] = {"gen": gen, "record": rec}
+            _M_CAS.labels(result="committed").inc()
+            _M_REGISTRATIONS.labels(service=name).inc()
+            return 200, {"committed": True, "gen": gen}
+
+    def _gen_refresh(self, name: str, info: dict) -> tuple:
+        """Monotone rules for plain roster POSTs of generation records:
+        accept a strictly newer generation (HA catch-up: a member
+        multi-homing a record this registry missed), refresh the stamp on
+        an exact re-post of the current one (heartbeat TTL refresh), and
+        reject everything else — a lower gen, or the same gen with a
+        different member set, is a zombie trying to roll the epoch back."""
+        g = int(info.get("port", 0))
+        with self._lock:
+            cur = self._generations.get(name)
+            cur_gen = int(cur["gen"]) if cur else 0
+            if cur is None or g > cur_gen:
+                rec = dict(info)
+                rec["ts"] = time.time()
+                self._generations[name] = {"gen": g, "record": rec}
+                _M_REGISTRATIONS.labels(service=name).inc()
+                return 200, {"registered": True}
+            if g == cur_gen and info.get("members") == cur["record"].get(
+                "members"
+            ):
+                cur["record"]["ts"] = time.time()
+                _M_REGISTRATIONS.labels(service=name).inc()
+                return 200, {"registered": True}
+            _M_CAS.labels(result="stale").inc()
+            return 409, {
+                "registered": False, "reason": "stale-generation",
+                "current_gen": cur_gen,
+            }
 
     # -- anti-entropy ---------------------------------------------------------
 
@@ -290,6 +427,31 @@ class DriverRegistry:
             with self._lock:
                 self._prune_tombstones_locked()
                 for svc, entries in remote.items():
+                    if svc.endswith("-gen") and any(
+                        e.get("host") == "generation" for e in entries
+                    ):
+                        # generation records merge to the HIGHEST
+                        # committed gen (never by freshness): a registry
+                        # restarted mid-commit must re-learn the winning
+                        # epoch from its peers, not resurrect a
+                        # superseded one. No TTL floor — committed epochs
+                        # are durable fencing state.
+                        for e in entries:
+                            if e.get("host") != "generation":
+                                continue
+                            g = int(e.get("port", 0))
+                            cur = self._generations.get(svc)
+                            cur_gen = int(cur["gen"]) if cur else 0
+                            if g > cur_gen:
+                                self._generations[svc] = {
+                                    "gen": g, "record": dict(e),
+                                }
+                                adopted += 1
+                            elif cur is not None and g == cur_gen and float(
+                                e.get("ts", 0.0)
+                            ) > float(cur["record"].get("ts", 0.0)):
+                                cur["record"] = dict(e)
+                        continue
                     local = self._services.setdefault(svc, [])
                     by_key = {
                         (e.get("host"), e.get("port")): e for e in local

@@ -72,6 +72,11 @@ _M_BACKOFF = obs.counter(
     "mmlspark_supervisor_backoff_seconds_total",
     "Cumulative restart-backoff delay imposed on crash-looping workers",
 )
+_M_FENCED_RESPAWNS = obs.counter(
+    "mmlspark_supervisor_fenced_respawns_total",
+    "Respawns deferred because the charge's gang incumbent is alive in "
+    "the majority registry view", labels=("worker",),
+)
 
 
 class WorkerCharge:
@@ -84,10 +89,19 @@ class WorkerCharge:
     is supervised on process liveness alone."""
 
     def __init__(self, argv: list, name: str,
-                 health_url: Optional[str] = None):
+                 health_url: Optional[str] = None,
+                 gang_member: Optional[str] = None,
+                 gang_service: Optional[str] = None):
         self.argv = list(argv)
         self.name = name
         self.health_url = health_url
+        # gang identity of a training charge: when set, a respawn is
+        # FENCED while a live roster entry under <gang_service>-gang
+        # still advertises this member name on a majority of the
+        # configured registries — a partitioned-but-alive incumbent must
+        # not gain a same-name twin (split-brain via supervisor grow-back)
+        self.gang_member = gang_member
+        self.gang_service = gang_service
         self.proc: Optional[subprocess.Popen] = None
         self.restarts = 0
         self.streak = 0            # consecutive fast deaths (backoff input)
@@ -390,6 +404,21 @@ class FleetSupervisor:
             return
         if now < c.restart_due:
             return  # still inside the backoff window
+        if self._incumbent_fenced(c):
+            # the majority registry view says this gang member is STILL
+            # ALIVE — the "death" we observed is our local partition
+            # talking, and a respawn would seed a same-name twin gang.
+            # Defer; TTL expiry clears the entry once it is truly dead.
+            _M_FENCED_RESPAWNS.labels(worker=c.name).inc()
+            c.restart_due = now + self.backoff_s
+            c.last_reason = "fenced: incumbent alive in majority view"
+            print(
+                f"supervisor: respawn of {c.name} fenced — gang member "
+                f"{c.gang_member} is alive on a registry majority; "
+                f"retry in {self.backoff_s:.1f}s",
+                file=sys.stderr, flush=True,
+            )
+            return
         if self._spawn_charge(c):
             c.restarts += 1
             c.restart_due = 0.0
@@ -630,6 +659,34 @@ class FleetSupervisor:
             time.sleep(settle_s)
         return ok
 
+    def _incumbent_fenced(self, c: WorkerCharge) -> bool:
+        """Does a STRICT MAJORITY of the configured registries still
+        advertise a live ``<gang_service>-gang`` roster entry for this
+        charge's member name? True fences the respawn: the incumbent
+        process is alive somewhere we cannot see (partition), and
+        spawning a twin with the same gang identity is exactly the
+        split-brain the quorum commit exists to prevent. Registries we
+        cannot reach count as NOT claiming the incumbent alive — total
+        blindness therefore never blocks recovery (majority unreachable
+        → no majority view → respawn allowed, the CAS commit path is
+        the backstop)."""
+        if not c.gang_member or not self.registry_url:
+            return False
+        from mmlspark_tpu.serving.fleet import roster_entries_from_registry
+
+        urls = self._registry_urls()
+        gang = f"{c.gang_service or 'train'}-gang"
+        claims = 0
+        for url in urls:
+            try:
+                for e in roster_entries_from_registry(url, gang):
+                    if e.get("host") == c.gang_member:
+                        claims += 1
+                        break
+            except Exception:  # noqa: BLE001 — blind registry: no claim
+                continue
+        return claims >= len(urls) // 2 + 1
+
     def _roster_entries(self, url: str) -> list:
         """Roster entries whose bound OR forwarded port matches ``url``'s
         — never the forwarded-preferring URL the gateway routes to: a
@@ -713,7 +770,20 @@ def charge_from_train_args(
             name = extra[extra.index("--name") + 1]
         except IndexError:
             pass
-    return WorkerCharge(argv, name=f"train-{index}:{name}", health_url=None)
+    service = "train"
+    if "--service-name" in extra:
+        try:
+            service = extra[extra.index("--service-name") + 1]
+        except IndexError:
+            pass
+    # gang identity makes the respawn FENCEABLE: while the majority
+    # registry view still advertises this member alive under
+    # <service>-gang, the supervisor must not seed a same-name twin
+    gang_member = name if name != "trainer" else None
+    return WorkerCharge(
+        argv, name=f"train-{index}:{name}", health_url=None,
+        gang_member=gang_member, gang_service=service,
+    )
 
 
 def charge_from_worker_args(

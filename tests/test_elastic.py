@@ -710,3 +710,476 @@ def test_chaos_elastic_supervisor_growback_at_checkpoint_boundary(tmp_path):
     finally:
         sup.stop()
         reg.stop()
+
+
+# -- split brain: quorum CAS, fencing, parking --------------------------------
+
+
+def test_declared_dead_pinned_to_monotonic_not_wall_clock(
+    gang_registry, monkeypatch
+):
+    """An NTP step (wall clock jumps an hour) must neither mass-declare
+    death nor mask a real one: sighting ages are time.monotonic()
+    deltas, so only genuinely-stale sightings cross the grace."""
+    from mmlspark_tpu.parallel.elastic import GangMember
+
+    a = GangMember(gang_registry.url, "a", heartbeat_s=0.2)
+    b = GangMember(gang_registry.url, "b", heartbeat_s=0.2)
+    try:
+        deadline = time.monotonic() + 10.0
+        while (
+            set(a.roster() or {}) != {"a", "b"}
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert set(a.roster()) == {"a", "b"}
+        # b crashes (no clean deregister): silence its heartbeats and
+        # let the TTL prune it from the roster
+        b.registry_urls = []
+        deadline = time.monotonic() + 10.0
+        while "b" in (a.roster() or {}) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        ros = a.roster()
+        assert "b" not in ros
+        real = time.time
+        with monkeypatch.context() as mp:
+            # the NTP step: wall clock leaps one hour forward. b's last
+            # sighting is ~2s old on the monotonic clock — a 30s grace
+            # must NOT declare it dead just because the wall moved
+            mp.setattr(time, "time", lambda: real() + 3600.0)
+            assert a.declared_dead(["b"], ros, grace_s=30.0) == []
+        # and the real death is still detected once the (monotonic)
+        # grace genuinely elapses
+        time.sleep(0.6)
+        assert a.declared_dead(["b"], ros, grace_s=0.5) == ["b"]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_commit_generation_zero_acks_raises_not_false_success():
+    """Regression: with every registry dead, commit_generation used to
+    swallow every POST failure and report the commit as done. Now zero
+    acks raises (QuorumLostError) and the ack count is visible."""
+    from mmlspark_tpu.parallel.elastic import (
+        GangMember,
+        Generation,
+        QuorumLostError,
+    )
+
+    m = GangMember(
+        "http://127.0.0.1:9/,http://127.0.0.1:19/", "a", heartbeat_s=30.0
+    )
+    try:
+        with pytest.raises(QuorumLostError):
+            m.commit_generation(
+                Generation(gen=1, members=["a"]), expected_gen=0
+            )
+        assert m.commit_acks == 0
+        assert m.committed_gens == []
+    finally:
+        m.close()
+
+
+def test_generation_cas_concurrent_commits_exactly_one_winner(gang_registry):
+    """Two members race conflicting gen-2 commits from the same adopted
+    gen 1: the registry's CAS admits exactly one; the loser gets a
+    rejection carrying the winning record, not a silent last-write."""
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.parallel.elastic import (
+        GangMember,
+        Generation,
+        GenerationConflictError,
+    )
+
+    def stale_count():
+        return obs.sum_samples(
+            obs.parse_text(obs.render()),
+            "mmlspark_registry_cas_commits_total", {"result": "stale"},
+        )
+
+    a = GangMember(gang_registry.url, "a", heartbeat_s=0.2)
+    b = GangMember(gang_registry.url, "b", heartbeat_s=0.2)
+    try:
+        a.commit_generation(Generation(gen=1, members=["a", "b"]))
+        gb = b.await_generation(2, timeout_s=10.0)
+        assert gb.gen == 1
+        before = stale_count()
+        barrier = threading.Barrier(2)
+        results: dict = {}
+
+        def race(m):
+            barrier.wait()
+            try:
+                results[m.name] = m.commit_generation(
+                    Generation(gen=2, members=[m.name])
+                )
+            except GenerationConflictError as e:
+                results[m.name] = e
+
+        t = threading.Thread(target=race, args=(b,))
+        t.start()
+        race(a)
+        t.join(10.0)
+        winners = [
+            n for n, r in results.items() if isinstance(r, Generation)
+        ]
+        losers = [
+            n for n, r in results.items()
+            if isinstance(r, GenerationConflictError)
+        ]
+        assert len(winners) == 1 and len(losers) == 1, results
+        # the loser's rejection names the winning world
+        loss = results[losers[0]]
+        assert loss.current is not None
+        assert loss.current.gen == 2
+        assert loss.current.members == [winners[0]]
+        # the registry counted the rejected commit
+        assert stale_count() == before + 1
+        # and the record IS the winner's, not the last writer's
+        g = a.read_generation()
+        assert g.gen == 2 and g.members == [winners[0]]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_registry_restart_does_not_resurrect_superseded_generation():
+    """HA: gen 2 wins a 2-of-3 majority while registry C is down. C
+    restarts empty, a straggler re-posts the OLD gen-1 record to it, and
+    anti-entropy must reconcile C to the HIGHEST committed generation —
+    never resurrect the superseded world."""
+    from mmlspark_tpu.parallel.elastic import (
+        GangMember,
+        Generation,
+        GenerationConflictError,
+    )
+    from mmlspark_tpu.serving.registry import DriverRegistry
+
+    reg_a = DriverRegistry(host="127.0.0.1", port=0, ttl_s=30.0)
+    reg_b = DriverRegistry(host="127.0.0.1", port=0, ttl_s=30.0)
+    reg_c = DriverRegistry(host="127.0.0.1", port=0, ttl_s=30.0)
+    urls = f"{reg_a.url},{reg_b.url},{reg_c.url}"
+    m = GangMember(urls, "a", heartbeat_s=30.0)
+    regs = [reg_a, reg_b]
+    try:
+        g1 = m.commit_generation(
+            Generation(gen=1, members=["a", "b"]), expected_gen=0
+        )
+        assert g1.gen == 1 and m.commit_acks == 3
+        reg_c.stop()  # C misses the next commit
+        g2 = m.commit_generation(Generation(gen=2, members=["a"]))
+        assert g2.gen == 2 and m.commit_acks == 2  # majority of 3
+        # C restarts EMPTY; a partitioned straggler's heartbeat re-post
+        # lands the superseded gen-1 record on it first
+        reg_c2 = DriverRegistry(host="127.0.0.1", port=0, ttl_s=30.0)
+        regs.append(reg_c2)
+        z = GangMember(reg_c2.url, "b", heartbeat_s=30.0)
+        try:
+            z.adopt(g1)
+            z.heartbeat()  # re-posts the adopted gen-1 record
+            # anti-entropy pulls from A: the gen record merges to the
+            # HIGHEST gen, not the freshest timestamp
+            reg_c2.peers = [reg_a.url]
+            reg_c2.reconcile_now()
+            got = z.read_generation()
+            assert got.gen == 2 and got.members == ["a"]
+            # and a CAS commit against the reconciled C from the stale
+            # world is rejected, not adopted
+            with pytest.raises(GenerationConflictError):
+                z.commit_generation(
+                    Generation(gen=2, members=["b"]), expected_gen=1
+                )
+        finally:
+            z.close()
+    finally:
+        m.close()
+        for r in regs:
+            r.stop()
+
+
+def test_registry_commit_cas_fault_point_refuses_then_relents(gang_registry):
+    """Fault point ``registry.commit_cas``: an injected error refuses
+    the commit server-side (503 — a missing ack), so a single-registry
+    deployment loses its majority-of-1; the retry lands once the plan
+    relents."""
+    from mmlspark_tpu.parallel.elastic import (
+        GangMember,
+        Generation,
+        QuorumLostError,
+    )
+
+    m = GangMember(gang_registry.url, "a", heartbeat_s=0.2)
+    try:
+        plan = FaultPlan().on(
+            "registry.commit_cas", error=RuntimeError, max_fires=1
+        )
+        with plan.armed():
+            with pytest.raises(QuorumLostError):
+                m.commit_generation(
+                    Generation(gen=1, members=["a"]), expected_gen=0
+                )
+            assert m.commit_acks == 0
+            g = m.commit_generation(
+                Generation(gen=1, members=["a"]), expected_gen=0
+            )
+        assert g.gen == 1 and m.commit_acks == 1
+        assert len(plan.fires("registry.commit_cas")) == 1
+    finally:
+        m.close()
+
+
+def test_fenced_out_only_on_registry_confirmed_exclusion(gang_registry):
+    """The fencing token: a member whose adopted epoch is superseded by
+    a committed generation that EXCLUDES it refuses to write; blindness
+    or a newer world that still INCLUDES it never fences."""
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.parallel.elastic import GangMember, Generation
+
+    def fenced_count():
+        return obs.sum_samples(
+            obs.parse_text(obs.render()),
+            "mmlspark_elastic_fenced_writes_total", {"plane": "checkpoint"},
+        )
+
+    a = GangMember(gang_registry.url, "a", heartbeat_s=0.2)
+    z = GangMember(gang_registry.url, "z", heartbeat_s=0.2)
+    try:
+        g1 = a.commit_generation(
+            Generation(gen=1, members=["a", "z"]), expected_gen=0
+        )
+        z.adopt(g1)
+        assert not z.fenced_out("checkpoint")  # current world includes z
+        a.commit_generation(Generation(gen=2, members=["a"]))
+        before = fenced_count()
+        assert z.fenced_out("checkpoint")      # superseded AND excluded
+        assert fenced_count() == before + 1
+        # a newer world that still includes the member does not fence
+        a.commit_generation(Generation(gen=3, members=["a", "z"]))
+        assert not z.fenced_out("checkpoint")
+    finally:
+        a.close()
+        z.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.xdist_group("latency")
+def test_chaos_partition_drill_minority_parks_majority_wins_zombie_fenced(
+    tmp_path,
+):
+    """The split-brain acceptance drill (docs/chaos.md): member b's
+    registry link runs through a seeded chaos proxy; a conductor
+    ``partition`` step blackholes it. The majority side (a, with the
+    registry) declares b dead, CAS-commits gen 2 and trains on; the
+    minority (b) loses its registry quorum and PARKS — stops training,
+    commits nothing, keeps heartbeating. The survivor's booster is
+    bit-identical to a fresh majority-only run from the same snapshot; a
+    zombie's late generation commit and late (stale-epoch) publication
+    are both rejected and counted; the generation-monotonicity and
+    single-writer laws stay green through the whole soak; post-heal the
+    parked member's heartbeats reach the registry again."""
+    import urllib.parse
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.chaos.conductor import ChaosConductor, Scenario
+    from mmlspark_tpu.chaos.invariants import InvariantChecker
+    from mmlspark_tpu.chaos.wire import ChaosProxy
+    from mmlspark_tpu.parallel.elastic import (
+        GangMember,
+        Generation,
+        GenerationConflictError,
+    )
+    from mmlspark_tpu.serving import fleet
+    from mmlspark_tpu.serving.modelstore import ModelDispatcher, ModelStore
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    def counter(name, match=None):
+        return obs.sum_samples(obs.parse_text(obs.render()), name, match)
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=1.2)
+    out = str(tmp_path)
+    ck = os.path.join(out, "ck")
+    reg_port = urllib.parse.urlparse(reg.url).port
+    proxy = ChaosProxy("127.0.0.1", reg_port, seed=13, name="reg-b").start()
+    surv = vict = fresh = None
+    try:
+        # b's ONLY path to the registry is the proxy; the park fault
+        # point fires (armed with a tiny delay) as b stops training
+        park_fault = json.dumps({
+            "rules": [{"point": "elastic.park", "delay_s": 0.05}],
+        })
+        surv = _spawn_trainer(
+            reg.url, "a", ck, out, world=2, extra=["--no-growback"],
+        )
+        vict = _spawn_trainer(
+            f"http://127.0.0.1:{proxy.port}/", "b", ck, out, world=2,
+            extra=["--no-growback", "--gen-timeout-s", "240"],
+            fault=park_fault,
+        )
+        # wait until the 2-member gang is genuinely training (a couple
+        # of checkpoints committed) before cutting the wire
+        latest = os.path.join(ck, "LATEST")
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            try:
+                with open(latest) as f:
+                    if f.read().strip() >= "round-0000004":
+                        break
+            except OSError:
+                pass
+            assert surv.poll() is None, surv.communicate()[1][-2000:]
+            assert vict.poll() is None, vict.communicate()[1][-2000:]
+            time.sleep(0.1)
+        checker = InvariantChecker(
+            registry_url=reg.url, service_name="train",
+            status_files=[
+                os.path.join(out, "status-a.json"),
+                os.path.join(out, "status-b.json"),
+                os.path.join(out, "status-c.json"),
+            ],
+        )
+        cut = ChaosConductor(
+            Scenario.from_spec({"seed": 13, "steps": [
+                {"at_s": 0.0, "action": "partition", "links": ["reg-b"]},
+                {"at_s": 0.0, "action": "mark", "note": "partition open"},
+            ]}),
+            proxies={"reg-b": proxy},
+        )
+        journal = cut.run()
+        assert [e["action"] for e in journal] == ["partition", "mark"]
+        assert journal[0]["links"] == ["reg-b"]
+        # the soak: majority trains to completion while the invariant
+        # laws are evaluated continuously
+        soak_deadline = time.monotonic() + 180.0
+        while surv.poll() is None and time.monotonic() < soak_deadline:
+            assert checker.check(final=False) == []
+            time.sleep(0.3)
+        out_a, err_a = surv.communicate(timeout=30)
+        assert surv.returncode == 0, err_a[-3000:]
+        sa = _status(out, "a")
+        assert sa["done"] and sa["reshards"] == 1
+        assert sa["members"] == ["a"] and sa["gen"] == 2
+        assert sa["committed_gens"] == [1, 2]  # a bootstrapped AND won
+        # -- the minority parked: zero commits, training stopped
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            sb = _status(out, "b")
+            if sb.get("parked"):
+                break
+            time.sleep(0.2)
+        sb = _status(out, "b")
+        assert sb.get("parked") is True, sb
+        assert sb["parks"] >= 1
+        assert sb["park_reasons"][0] in ("quorum", "conflict")
+        assert sb["committed_gens"] == []
+        assert not sb.get("done")
+        assert vict.poll() is None, "parked member must keep running"
+        # -- zombie generation commit: a SIGSTOP'd coordinator waking
+        # after the reshard tries to move the world FORWARD from its
+        # stale epoch; the CAS rejects (expected_gen 1 < committed 2)
+        z = GangMember(reg.url, "z", heartbeat_s=0.5)
+        try:
+            z.adopt(Generation(gen=1, members=["a", "b"]))
+            before = counter(
+                "mmlspark_registry_cas_commits_total",
+                {"result": "conflict"},
+            )
+            with pytest.raises(GenerationConflictError) as ei:
+                z.commit_generation(
+                    Generation(gen=3, members=["b", "z"]), expected_gen=1
+                )
+            assert ei.value.current is not None
+            assert ei.value.current.gen == 2
+            assert counter(
+                "mmlspark_registry_cas_commits_total",
+                {"result": "conflict"},
+            ) == before + 1
+        finally:
+            z.close()
+        # -- zombie publication: the committed gen rides load/swap as an
+        # epoch; a worker that saw the winner's epoch 2 refuses epoch 1
+        srv = WorkerServer()
+        winfo = srv.start()
+        ModelDispatcher(srv, ModelStore(), default_model="m").start()
+        try:
+            import http.client
+
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", winfo.port, timeout=10
+            )
+
+            def publish(epoch):
+                conn.request(
+                    "POST", "/models/m/load",
+                    body=json.dumps(
+                        {"spec": "zoo:NoSuch", "epoch": epoch}
+                    ),
+                    headers={"Content-Type": "application/json"},
+                )
+                r = conn.getresponse()
+                return r.status, json.loads(r.read() or b"{}")
+
+            publish(2)  # the winner's epoch is now the highest seen
+            before = counter(
+                "mmlspark_elastic_fenced_publications_total",
+                {"model": "m"},
+            )
+            fence_plan = FaultPlan().on("publish.fence", delay_s=0.01)
+            with fence_plan.armed():
+                code, body = publish(1)
+            assert code == 409 and body["fenced"] is True
+            assert body["highest_epoch"] == 2
+            assert len(fence_plan.fires("publish.fence")) == 1
+            assert counter(
+                "mmlspark_elastic_fenced_publications_total",
+                {"model": "m"},
+            ) == before + 1
+            conn.close()
+        finally:
+            srv.stop()
+        # -- the hard contract: a fresh majority-only run from the same
+        # snapshot produces the SAME booster bytes
+        fresh = _spawn_trainer(
+            reg.url, "c", os.path.join(out, "ck-fresh"), out, world=1,
+            extra=["--resume-from", sa["snapshot"]],
+        )
+        out_c, err_c = fresh.communicate(timeout=180)
+        assert fresh.returncode == 0, err_c[-3000:]
+        with open(os.path.join(out, "model-a.txt")) as f:
+            survivor_model = f.read()
+        with open(os.path.join(out, "model-c.txt")) as f:
+            fresh_model = f.read()
+        assert survivor_model == fresh_model, (
+            "survivor's booster != fresh majority-only run from the "
+            "same snapshot"
+        )
+        # -- heal: the parked member's heartbeats reach the registry
+        # again (it parked, it never died), and the final invariant
+        # check — including generation monotonicity across the whole
+        # drill — is green
+        heal = ChaosConductor(
+            Scenario.from_spec({"seed": 13, "steps": [
+                {"at_s": 0.0, "action": "heal", "links": ["reg-b"]},
+                {"at_s": 0.5, "action": "check", "final": True},
+            ]}),
+            proxies={"reg-b": proxy}, checker=checker,
+        )
+        heal.run()
+        assert heal.violations == []
+        deadline = time.monotonic() + 20.0
+        back = False
+        while time.monotonic() < deadline:
+            entries = fleet.roster_entries_from_registry(
+                reg.url, "train-gang"
+            )
+            if any(e.get("host") == "b" for e in entries):
+                back = True
+                break
+            time.sleep(0.2)
+        assert back, "parked member's heartbeats never resumed post-heal"
+    finally:
+        for p in (surv, vict, fresh):
+            if p is not None and p.poll() is None:
+                p.kill()
+        proxy.stop()
+        reg.stop()

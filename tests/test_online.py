@@ -931,3 +931,64 @@ def test_online_loop_discards_poison_chunk_after_bounded_retries(tmp_path):
     loop._tick()  # the queue moves: the healthy chunk trains
     assert trainer.examples == 4
     assert stream.spill_pending() == 0  # poison acked away, not replayed
+
+
+def test_publication_epoch_fence_rejects_zombie_publisher(tmp_path):
+    """The committed training generation rides every publication as a
+    fencing token: a worker that has seen the winner's epoch refuses
+    (409 + counted) any publication stamped with an older one, so a
+    zombie publisher that slept through a reshard cannot roll the fleet
+    back to a stale model. ``set_epoch`` is monotone — a publisher can
+    never lower its own token."""
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.online import OnlineTrainer, PublishError, Publisher
+    from mmlspark_tpu.serving.modelstore import ModelDispatcher, ModelStore
+    from mmlspark_tpu.serving.server import WorkerServer
+
+    def fenced_count():
+        return obs.sum_samples(
+            obs.parse_text(obs.render()),
+            "mmlspark_elastic_fenced_publications_total",
+            {"model": "vw-online"},
+        )
+
+    bits = 10
+    rng = np.random.default_rng(7)
+    srv = WorkerServer()
+    info = srv.start()
+    ModelDispatcher(srv, ModelStore(), default_model="vw-online").start()
+    try:
+        url = f"http://127.0.0.1:{info.port}"
+        winner_trainer = OnlineTrainer(num_bits=bits, batch=32)
+        winner_trainer.step(_sparse_chunk(rng, 64, bits))
+        winner = Publisher(
+            model="vw-online", snapshot_dir=str(tmp_path / "w"),
+            worker_urls=[url], epoch=2,
+        )
+        winner.publish(winner_trainer, oldest_ts=time.monotonic() - 0.1)
+        assert winner.publishes == 1
+        # the zombie: a publisher whose epoch predates the reshard the
+        # worker already witnessed — every worker 409s, so the
+        # publication has zero targets and FAILS loudly
+        zombie_trainer = OnlineTrainer(num_bits=bits, batch=32)
+        zombie_trainer.step(_sparse_chunk(rng, 64, bits))
+        zombie = Publisher(
+            model="vw-online", snapshot_dir=str(tmp_path / "z"),
+            worker_urls=[url], epoch=1,
+        )
+        before = fenced_count()
+        plan = FaultPlan().on("publish.fence", delay_s=0.01)
+        with plan.armed():
+            with pytest.raises(PublishError):
+                zombie.publish(zombie_trainer)
+        assert zombie.failures >= 1 and zombie.publishes == 0
+        assert len(plan.fires("publish.fence")) == 1
+        assert fenced_count() == before + 1
+        # monotone token: the winner cannot be talked down to a stale
+        # epoch (a late reshard notification arriving out of order)
+        winner.set_epoch(1)
+        assert winner.epoch == 2
+        winner.set_epoch(3)
+        assert winner.epoch == 3
+    finally:
+        srv.stop()

@@ -707,6 +707,7 @@ def _smoke_chaos(url: str, n: int, fault_plan: str) -> tuple:
 
 def _verify_chaos_wire(
     url: str, registry_url, service: str, seed: int = 7, n: int = 40,
+    partition: bool = False,
 ) -> bool:
     """Opt-in hostile-wire gate (``--chaos-wire``): run a short SEEDED
     wire-fault schedule — latency+jitter, a bandwidth throttle, and a
@@ -714,7 +715,11 @@ def _verify_chaos_wire(
     then require (a) the normal traffic still completed, (b) the
     slowloris was shed without wedging anything, and (c) the fleet-wide
     invariant checker comes back green: chaos may cost latency or shed
-    requests, never accounting (docs/chaos.md)."""
+    requests, never accounting (docs/chaos.md). With ``partition``
+    (``--chaos-wire-partition``) the gate also runs a conductor-driven
+    partition/heal probe: a blackholed link must pass NOTHING, a healed
+    one must serve again — the same actions the split-brain drills use
+    (docs/chaos.md), proved against the live fleet."""
     _ensure_repo_path()
     import socket as socket_mod
 
@@ -780,17 +785,66 @@ def _verify_chaos_wire(
                 shed = False
         if dripper is not None:
             dripper.close()
+        part_ok = True
+        if partition:
+            from mmlspark_tpu.chaos.conductor import ChaosConductor, Scenario
+
+            ChaosConductor(Scenario.from_spec({"seed": seed, "steps": [
+                {"at_s": 0.0, "action": "partition", "links": ["smoke-gw"]},
+            ]}), proxies={"smoke-gw": proxy}).run()
+            # across an open partition NOTHING comes back — connects
+            # still succeed (the proxy accepts), bytes never arrive
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", proxy.port, timeout=2.0
+                )
+                conn.request(
+                    "POST", u.path or "/", json.dumps({"probe": "cut"}),
+                    {"Content-Type": "application/json"},
+                )
+                conn.getresponse()
+                part_ok = False  # a reply crossed an open partition
+                print("smoke: chaos-wire partition probe LEAKED a reply")
+            except OSError:
+                pass
+            finally:
+                conn.close()
+            ChaosConductor(Scenario.from_spec({"seed": seed, "steps": [
+                {"at_s": 0.0, "action": "heal", "links": ["smoke-gw"]},
+            ]}), proxies={"smoke-gw": proxy}).run()
+            healed = False
+            for _ in range(5):
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", proxy.port, timeout=5.0
+                    )
+                    conn.request(
+                        "POST", u.path or "/",
+                        json.dumps({"probe": "heal"}),
+                        {"Content-Type": "application/json"},
+                    )
+                    if conn.getresponse().status == 200:
+                        healed = True
+                    conn.close()
+                    break
+                except OSError:
+                    time.sleep(0.5)
+            if not healed:
+                print("smoke: chaos-wire healed link did not serve")
+            part_ok = part_ok and healed
         checker = InvariantChecker(
             gateway_url=url, registry_url=registry_url,
             service_name=service, tolerance=0,
         )
         violations = checker.check(final=True)
         digest = proxy.schedule_digest()[:16]
-        passed = ok >= int(0.9 * n) and shed and not violations
+        passed = ok >= int(0.9 * n) and shed and part_ok and not violations
         print(
             f"smoke: chaos-wire gate — {ok}/{n} ok through the hostile "
-            f"link, slowloris shed: {shed}, invariants: "
-            f"{'green' if not violations else 'VIOLATED'} "
+            f"link, slowloris shed: {shed}, "
+            + (f"partition/heal: {'ok' if part_ok else 'FAILED'}, "
+               if partition else "")
+            + f"invariants: {'green' if not violations else 'VIOLATED'} "
             f"(schedule {digest}, seed {seed}) — "
             f"{'ok' if passed else 'FAILED'}"
         )
@@ -854,6 +908,12 @@ def main(argv=None) -> int:
         help="seed for the --chaos-wire schedule (same seed => "
         "byte-identical fault schedule)",
     )
+    ap.add_argument(
+        "--chaos-wire-partition", action="store_true",
+        help="with --chaos-wire: also run a conductor-driven "
+        "partition/heal probe on the chaos link (blackholed link must "
+        "pass nothing, healed link must serve again)",
+    )
     args = ap.parse_args(argv)
     n = args.n_requests if args.n_requests is not None else args.n
     verify = not args.no_verify_metrics
@@ -916,6 +976,7 @@ def main(argv=None) -> int:
         chaos_wire_ok = _verify_chaos_wire(
             args.url, args.registry, args.service_name,
             seed=args.chaos_wire_seed,
+            partition=args.chaos_wire_partition,
         )
     return 0 if (
         ok == n and metrics_ok and swap_ok and trace_ok and flight_ok

@@ -45,9 +45,12 @@ _INJECT_RE = re.compile(
     re.S,
 )
 # fewer registered points than this means the scan regex rotted, not
-# that the tree lost its chaos hooks (20 as of PR 14, which added
-# elastic.ring_step — fired before every ring-collective step)
-MIN_EXPECTED = 13
+# that the tree lost its chaos hooks (PR 16 added the split-brain trio:
+# registry.commit_cas — a registry refusing a generation CAS commit,
+# elastic.park — a minority member stopping training on quorum loss,
+# publish.fence — a worker rejecting a stale-epoch publication; each is
+# named by at least one test in test_elastic.py / test_online.py)
+MIN_EXPECTED = 16
 
 # chaos/wire.py's rule vocabulary: RULE_KINDS = ("latency", ...) —
 # extracted by regex (same grep-grade spirit; an import would drag jax

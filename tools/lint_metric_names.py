@@ -31,7 +31,12 @@ SCAN_DIRS = ("mmlspark_tpu", "tools")
 
 # "elastic" also covers the ring data plane's wire accounting
 # (mmlspark_elastic_ring_steps_total, mmlspark_elastic_payload_bytes_total,
-# overlap/vote counters — PR 14)
+# overlap/vote counters — PR 14) and the split-brain fencing families
+# (mmlspark_elastic_parks_total, mmlspark_elastic_fenced_writes_total,
+# mmlspark_elastic_fenced_publications_total — PR 16); "registry" covers
+# the generation CAS verdicts (mmlspark_registry_cas_commits_total) and
+# "supervisor" the fenced-respawn deferrals
+# (mmlspark_supervisor_fenced_respawns_total)
 SUBSYSTEMS = (
     "core", "io", "serving", "gateway", "registry", "parallel", "gbdt",
     "faults", "trace", "modelstore", "slo", "admission", "supervisor",
