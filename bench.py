@@ -64,7 +64,7 @@ SEGMENT_TIMEOUT_S = int(os.environ.get("MMLSPARK_BENCH_SEGMENT_TIMEOUT", "200"))
 # phase deadline caps everything regardless.
 SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280,
                     "pipeline": 240, "freshness": 240, "elastic": 600,
-                    "throughput": 280}
+                    "throughput": 280, "tune": 420}
 
 # Canonical segment set. Two orders, learned the hard way:
 # - On the TPU attempt, spend the chip's uncertain lifetime on the
@@ -75,11 +75,11 @@ SEGMENT_TIMEOUTS = {"gbdt": 280, "sklearn": 300, "featurizer": 280,
 #   out of the CPU child identically.
 # - On the CPU fallback, cheap-first so a late death costs least.
 SEGMENTS = ["serving", "modelstore", "tracing", "artifact", "overload",
-            "throughput", "chaos", "freshness", "elastic", "pipeline",
-            "hist", "vw", "gbdt", "sklearn", "featurizer"]
+            "throughput", "chaos", "freshness", "elastic", "tune",
+            "pipeline", "hist", "vw", "gbdt", "sklearn", "featurizer"]
 TPU_ORDER = ["sklearn", "gbdt", "hist", "featurizer", "pipeline", "vw",
              "serving", "modelstore", "tracing", "artifact", "overload",
-             "throughput", "chaos", "freshness", "elastic"]
+             "throughput", "chaos", "freshness", "elastic", "tune"]
 CPU_ORDER = SEGMENTS
 
 
@@ -1604,6 +1604,112 @@ def _elastic_scale(env: dict) -> dict:
     return out
 
 
+def _seg_tune(on_accel: bool, n_dev: int) -> dict:
+    """Fleet-parallel ASHA (``fleet tune``) vs the sequential in-process
+    TuneHyperparameters at EQUAL trial budget — the same 4 sampled
+    configurations. ASHA runs the trials concurrently as supervisor
+    charges AND early-stops the losers at rung boundaries, so it pays
+    for the winner's full depth plus a fraction of everyone else's;
+    the sequential tuner pays full depth (times k folds) for every
+    draw, one after another. Records both wall-clocks, the speedup, and
+    the trial-iteration budgets actually spent on each side. Runs on
+    CPU subprocesses on every backend — like the elastic plane, trial
+    scheduling is host-side by design."""
+    import shutil
+    import tempfile
+
+    from mmlspark_tpu.serving import fleet
+    from mmlspark_tpu.experiments import asha
+    from mmlspark_tpu.experiments.controller import ExperimentController
+
+    out: dict = {}
+    n_trials = 4
+    min_it, max_it, eta = 16, 256, 4
+    data, valid = "synth:6000x16:1", "synth:2048x16:99"
+    work = tempfile.mkdtemp(prefix="bench-tune-")
+    # trial charges inherit the environment: pin them to CPU and the
+    # shared compile cache (a cold XLA compile per trial would swamp the
+    # scheduling story this segment measures)
+    saved = {
+        k: os.environ.get(k)
+        for k in ("JAX_PLATFORMS", "PYTHONPATH", "JAX_COMPILATION_CACHE_DIR")
+    }
+    os.environ.update(
+        JAX_PLATFORMS="cpu", PYTHONPATH=HERE,
+        JAX_COMPILATION_CACHE_DIR=CACHE_DIR,
+    )
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=2.0)
+    ctrl = ExperimentController(
+        reg.url, "bench", n_trials=n_trials, data=data, valid=valid,
+        min_iters=min_it, max_iters=max_it, eta=eta, seed=11,
+        workdir=work, deadline_s=240.0,
+    )
+    try:
+        t0 = time.monotonic()
+        res = ctrl.run()
+        asha_wall = time.monotonic() - t0
+        out["tune_asha_wall_s"] = round(asha_wall, 2)
+        out["tune_asha_metric"] = round(float(res["winner"]["metric"]), 4)
+        out["tune_trials"] = n_trials
+        # trial-iterations ASHA actually spent: survivors per rung times
+        # that rung's incremental depth (the early-stopping dividend)
+        bounds = asha.rung_boundaries(min_it, max_it, eta)
+        survivors = n_trials
+        spent = 0
+        for r, b in enumerate(bounds):
+            prev = bounds[r - 1] if r else 0
+            spent += survivors * (b - prev)
+            survivors = asha.n_promote(survivors, eta)
+        out["tune_asha_trial_iters"] = spent
+    finally:
+        ctrl.close()
+        reg.stop()
+        shutil.rmtree(work, ignore_errors=True)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # sequential baseline: the same trial budget through the in-process
+    # tuner (k=2 folds, its methodological floor)
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.automl import (
+        DiscreteHyperParam,
+        HyperparamBuilder,
+        RangeHyperParam,
+        TuneHyperparameters,
+    )
+    from mmlspark_tpu.models.gbdt import LightGBMClassifier
+    from mmlspark_tpu.parallel.elastic import load_training_data
+
+    x, y = load_training_data(data)
+    df = DataFrame.from_dict({"features": x, "label": y})
+    spaces = (
+        HyperparamBuilder()
+        .add_hyperparam("num_leaves", DiscreteHyperParam([7, 15, 31]))
+        .add_hyperparam(
+            "learning_rate", RangeHyperParam(0.05, 0.3, log=True)
+        )
+        .add_hyperparam("min_data_in_leaf", DiscreteHyperParam([5, 10, 20]))
+        .build()
+    )
+    tuner = TuneHyperparameters(label_col="label")
+    tuner.set(
+        models=[LightGBMClassifier(num_iterations=max_it)],
+        hyperparams=spaces, number_of_runs=n_trials, number_of_folds=2,
+        seed=11,
+    )
+    t0 = time.monotonic()
+    model = tuner.fit(df)
+    seq_wall = time.monotonic() - t0
+    out["tune_seq_wall_s"] = round(seq_wall, 2)
+    out["tune_seq_metric"] = round(float(model.get("best_metric")), 4)
+    out["tune_seq_trial_iters"] = n_trials * 2 * max_it  # k folds, full depth
+    out["tune_speedup"] = round(seq_wall / max(asha_wall, 1e-9), 2)
+    return out
+
+
 def _seg_artifact(on_accel: bool, n_dev: int) -> dict:
     """Content-addressed artifact plane (serving/artifacts.py): the
     transfer rates the no-shared-fs recovery story pays for. Records
@@ -2402,6 +2508,7 @@ SEGMENT_FNS = {
     "chaos": _seg_chaos,
     "freshness": _seg_freshness,
     "elastic": _seg_elastic,
+    "tune": _seg_tune,
     "pipeline": _seg_pipeline,
     "hist": _seg_hist,
     "vw": _seg_vw,
@@ -2735,12 +2842,31 @@ def main() -> None:
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = HERE
         env.pop("MMLSPARK_BENCH_REQUIRE_TPU", None)
-        child = _Child(remaining, env)
-        live_child[:] = [child]
-        _harvest(child, asm, remaining,
-                 time.monotonic() + CPU_BUDGET_S, on_cpu=True,
-                 order=CPU_ORDER)
-        live_child[:] = []
+        cpu_deadline = time.monotonic() + CPU_BUDGET_S
+        # one stalled segment must not discard everything queued after
+        # it: on a watchdog miss (or child death) the stuck segment is
+        # recorded and the REST get a fresh child — `remaining` shrinks
+        # by at least one per pass, so this terminates
+        while remaining and time.monotonic() < cpu_deadline - 5:
+            child = _Child(remaining, env)
+            live_child[:] = [child]
+            _harvest(child, asm, remaining, cpu_deadline, on_cpu=True,
+                     order=CPU_ORDER)
+            live_child[:] = []
+            if not remaining:
+                break
+            # the child stalled at (or died inside) the first segment it
+            # had not completed: keep it OUT of `done` — emit() reports
+            # it in segments_missing — and rerun the segments behind it
+            stuck = next(s for s in CPU_ORDER if s in remaining)
+            asm.extra.setdefault("segments_stalled", []).append(stuck)
+            remaining.remove(stuck)
+            if remaining:
+                sys.stderr.write(
+                    f"bench: segment {stuck!r} stalled on CPU; running "
+                    f"the {len(remaining)} segment(s) after it in a "
+                    f"fresh child\n{child.stderr_tail[-600:]}\n"
+                )
         if remaining:
             sys.stderr.write(
                 f"bench: segments never completed: {remaining}\n"

@@ -80,7 +80,15 @@ class TuneHyperparameters(Estimator, HasLabelCol):
                 self.get("number_of_runs")
             )
             for pm in draws:
-                candidates.append((est, {k_: v for k_, v in pm.items() if k_ in est.params()}))
+                unknown = sorted(k_ for k_ in pm if k_ not in est.params())
+                if unknown:
+                    raise ValueError(
+                        f"hyperparameter(s) {', '.join(map(repr, unknown))} "
+                        f"are not params of estimator "
+                        f"{type(est).__name__}; a sampled param that the "
+                        "estimator ignores silently searches nothing"
+                    )
+                candidates.append((est, dict(pm)))
 
         def cv_score(est: Estimator, pm: dict) -> float:
             scores = []

@@ -50,6 +50,18 @@ catalogue (docs/chaos.md):
                             (``committed_gens`` join) — commit makes a
                             member the epoch's writer, so a double claim
                             is split-brain made visible
+``experiment_conservation`` per experiment-controller status file:
+                            trials_spawned == completed + demoted +
+                            rescheduled + running — a trial the
+                            controller spawned but lost track of is an
+                            orphan process burning fleet capacity
+``single_promotion``        across every controller status file of the
+                            same experiment, at most ONE promoted set
+                            per rung — two controllers promoting
+                            different survivors is the tuning-plane
+                            flavour of split-brain, which the rung
+                            records' write-once generation CAS exists
+                            to forbid
 ``artifact_quarantine``     every failed verification quarantined
                             (verify_failures == quarantines, final only:
                             the failure counter lands before the
@@ -124,13 +136,17 @@ class InvariantChecker:
         stores: Any = (),
         tolerance: int = 0,
         status_files: Any = (),
+        experiment_status_files: Any = (),
     ):
         """``stores``: live ArtifactStore handles for the in-process
         never-serve-quarantined check (metrics alone cannot prove it).
         ``tolerance``: absolute slack allowed on equality checks (for
         counters read while a scrape races a reply). ``status_files``:
         elastic-trainer status JSON paths — when given, the
-        ``single_writer`` law joins their ``committed_gens`` claims."""
+        ``single_writer`` law joins their ``committed_gens`` claims.
+        ``experiment_status_files``: experiment-controller status JSON
+        paths — when given, the ``experiment_conservation`` and
+        ``single_promotion`` laws join them."""
         from mmlspark_tpu.serving import fleet as fleet_mod
 
         self.gateway_url = gateway_url
@@ -141,6 +157,7 @@ class InvariantChecker:
         self.stores = list(stores or ())
         self.tolerance = int(tolerance)
         self.status_files = list(status_files or ())
+        self.experiment_status_files = list(experiment_status_files or ())
         # per (registry_url, record) committed-gen high-water across
         # check() passes: a registry whose generation record goes
         # BACKWARD resurrected a superseded world — the exact rollback
@@ -353,6 +370,7 @@ class InvariantChecker:
 
         violations.extend(self._generation_checks())
         violations.extend(self._writer_checks())
+        violations.extend(self._experiment_checks())
 
         for store in self.stores:
             violations.extend(self._store_checks(store))
@@ -441,6 +459,59 @@ class InvariantChecker:
                     ))
                 else:
                     claimed[gen] = (member, path)
+        return out
+
+    def _experiment_checks(self) -> list:
+        """``experiment_conservation`` + ``single_promotion`` across
+        experiment-controller status files. Conservation holds in EVERY
+        snapshot, not just the final one: the controller's accounting is
+        membership-based (a charge is "running" from spawn until it is
+        classified exactly once), so a mid-experiment read is as bound
+        by the law as a final one. Promotion agreement is joined across
+        controllers of the same experiment — a restarted (or split)
+        controller must adopt the incumbent rung records, never mint
+        rival ones."""
+        if not self.experiment_status_files:
+            return []
+        import json as json_mod
+
+        out: list = []
+        # (experiment, rung) -> (promoted tuple, path) first seen
+        promoted_by_rung: dict = {}
+        for path in self.experiment_status_files:
+            try:
+                with open(path) as f:
+                    st = json_mod.load(f)
+            except (OSError, ValueError):
+                continue  # not written yet / mid-rewrite: no claim
+            spawned = int(st.get("trials_spawned", 0))
+            accounted = (
+                int(st.get("completed", 0)) + int(st.get("demoted", 0))
+                + int(st.get("rescheduled", 0)) + int(st.get("running", 0))
+            )
+            if spawned != accounted:
+                out.append(Violation(
+                    "experiment_conservation", path,
+                    f"trials_spawned {spawned} != completed "
+                    f"{st.get('completed', 0)} + demoted "
+                    f"{st.get('demoted', 0)} + rescheduled "
+                    f"{st.get('rescheduled', 0)} + running "
+                    f"{st.get('running', 0)}",
+                ))
+            exp = st.get("experiment") or path
+            for rung, promoted in (st.get("rungs") or {}).items():
+                key = (exp, str(rung))
+                claim = tuple(sorted(promoted or ()))
+                prev = promoted_by_rung.get(key)
+                if prev is not None and prev[0] != claim:
+                    out.append(Violation(
+                        "single_promotion", path,
+                        f"experiment {exp!r} rung {rung}: promoted "
+                        f"{list(claim)} but {prev[1]} promoted "
+                        f"{list(prev[0])}",
+                    ))
+                else:
+                    promoted_by_rung[key] = (claim, path)
         return out
 
     @staticmethod

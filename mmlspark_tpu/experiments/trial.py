@@ -1,0 +1,320 @@
+"""One ASHA trial: a single continuous process spanning rung boundaries.
+
+A trial is a supervisor-charge-shaped process (``fleet trial``) the
+controller spawns through the same pluggable ``--spawn-cmd`` hook every
+other charge uses — placement is the supervisor's business, not ours.
+Per rung it runs the REAL ``fleet train`` machinery (an elastic gang of
+world size 1 by default) to the rung's cumulative iteration boundary,
+evaluates on the held-out spec, packs its checkpoint dir and model
+string into its own content-addressed store, CAS-reports
+``(metric, ckpt digest, model digest)`` to the registry, then polls for
+the rung's promotion record: promoted → train on to the next boundary
+in-process; demoted → exit cleanly; record never arrives → exit with
+the reschedule code and let the controller decide.
+
+Rescheduling is digest-deep: a respawned trial (fresh workdir, possibly
+a different host) finds its last report in the registry, fetches that
+rung's checkpoint artifact from whoever advertises it, unpacks it into
+its empty checkpoint dir, and trains on — checkpoint restore is exact,
+so the rescheduled trial reproduces the booster (and therefore the
+metric) the uninterrupted trial would have reported. That determinism
+is what makes the chaos drill's byte-identical-leaderboard claim true
+rather than hopeful.
+
+Exit codes (the controller's classification input):
+``0`` completed (final rung reported) · ``4`` demoted (self-reaped) ·
+``3`` rung decision never arrived (controller restarts or reaps).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.experiments import asha, records
+
+EXIT_COMPLETED = 0
+EXIT_NO_DECISION = 3
+EXIT_DEMOTED = 4
+
+# hyperparameters a search space may legally bind — everything else in a
+# sampled param map is a spawn-argv bug, rejected loudly (same contract
+# TuneHyperparameters.fit enforces on estimator params)
+TRAIN_PARAMS = (
+    "num_leaves", "learning_rate", "min_data_in_leaf", "num_iterations",
+)
+
+_M_REPORTS = obs.counter(
+    "mmlspark_experiments_reports_total",
+    "Trial rung reports by result (committed | adopted | error)",
+    labels=("result",),
+)
+_M_RUNG_SECONDS = obs.histogram(
+    "mmlspark_experiments_rung_train_seconds",
+    "Wall-clock of one trial's train-to-rung-boundary step",
+    buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0),
+)
+
+
+def holdout_metric(booster: Any, x: np.ndarray, y: np.ndarray) -> float:
+    """Validation accuracy — deterministic in (model, data), which the
+    drill's leaderboard-equivalence property requires. ``predict`` gives
+    raw margins for the binary objective; the decision boundary is 0."""
+    margin = np.asarray(booster.predict(x), dtype=np.float64)
+    return float(np.mean((margin > 0.0) == (np.asarray(y) > 0.5)))
+
+
+def _live_loop(
+    urls: list, exp: str, trial: str, stop: threading.Event,
+    heartbeat_s: float,
+) -> None:
+    info = {
+        "name": records.live_service_name(exp),
+        "host": trial,
+        "port": os.getpid(),
+    }
+    while not stop.is_set():
+        records.register(urls, info, timeout=2.0)
+        stop.wait(heartbeat_s)
+
+
+def _report_with_retry(
+    urls: list, exp: str, trial: str, rung: int, metric: float,
+    ckpt_digest: str, model_digest: str, iters: int, params: dict,
+    attempts: int = 5, backoff_s: float = 0.2,
+) -> Optional[dict]:
+    """Rung reports must land: retry through injected faults and wire
+    loss (the ``experiment.report`` chaos drill arms exactly this path).
+    Returns the durable record, or None when every attempt failed."""
+    for i in range(attempts):
+        try:
+            rec = records.report_trial(
+                urls, exp, trial, rung, metric,
+                ckpt_digest, model_digest, iters, params,
+            )
+            _M_REPORTS.labels(
+                result="committed" if rec.get("ckpt") == ckpt_digest
+                else "adopted"
+            ).inc()
+            return rec
+        except Exception:  # noqa: BLE001 — injected or real, retry either
+            _M_REPORTS.labels(result="error").inc()
+            time.sleep(backoff_s * (i + 1))
+    return None
+
+
+def run_trial(
+    registry_url: Any,
+    experiment: str,
+    trial: str,
+    params: dict,
+    data: str,
+    valid: str,
+    workdir: str,
+    min_iters: int = 2,
+    max_iters: int = 8,
+    eta: int = 2,
+    seed: int = 0,
+    higher_is_better: bool = True,
+    heartbeat_s: float = 0.5,
+    poll_s: float = 0.25,
+    decision_timeout_s: float = 120.0,
+    partitions: int = 4,
+    status_file: Optional[str] = None,
+) -> int:
+    """``fleet trial``: run one trial across every rung it survives."""
+    from mmlspark_tpu.parallel.elastic import load_training_data
+    from mmlspark_tpu.serving.artifacts import (
+        ArtifactServer,
+        ArtifactStore,
+        registry_peers,
+        unpack_dir,
+    )
+    from mmlspark_tpu.serving.fleet import run_train, split_registry_urls
+
+    bad = sorted(k for k in params if k not in TRAIN_PARAMS)
+    if bad:
+        raise ValueError(
+            f"trial {trial}: sampled param(s) {bad} are not train "
+            f"hyperparameters {list(TRAIN_PARAMS)}"
+        )
+    urls = split_registry_urls(registry_url)
+    obs.set_process_label(f"{experiment}-{trial}")
+    os.makedirs(workdir, exist_ok=True)
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    store = ArtifactStore(os.path.join(workdir, "artifacts"))
+    server = ArtifactServer(
+        store, registry_urls=urls,
+        service=f"{experiment}-artifacts", heartbeat_s=heartbeat_s,
+    )
+    stop = threading.Event()
+    threading.Thread(
+        target=_live_loop, args=(urls, experiment, trial, stop, heartbeat_s),
+        name=f"{trial}-live", daemon=True,
+    ).start()
+    try:
+        return _run_rungs(
+            urls, experiment, trial, params, data, valid, ckpt_dir,
+            store, server, min_iters, max_iters, eta, seed,
+            higher_is_better, heartbeat_s, poll_s, decision_timeout_s,
+            partitions, status_file, registry_peers, unpack_dir,
+            run_train, load_training_data,
+        )
+    finally:
+        stop.set()
+        server.stop()
+
+
+def _run_rungs(
+    urls, experiment, trial, params, data, valid, ckpt_dir, store,
+    server, min_iters, max_iters, eta, seed, higher_is_better,
+    heartbeat_s, poll_s, decision_timeout_s, partitions, status_file,
+    registry_peers, unpack_dir, run_train, load_training_data,
+) -> int:
+    boundaries = asha.rung_boundaries(min_iters, max_iters, eta)
+    state = _read_state_retry(urls, experiment, decision_timeout_s, poll_s)
+    if state is None:
+        return EXIT_NO_DECISION
+    rung = asha.next_rung(trial, state.reports, boundaries)
+    if rung is None:
+        return EXIT_COMPLETED  # a twin already finished this trial
+    if asha.is_demoted(trial, rung, state.rungs):
+        return EXIT_DEMOTED
+    if rung > 0 and not os.path.exists(os.path.join(ckpt_dir, "LATEST")):
+        # rescheduled incarnation: pull our own last rung checkpoint by
+        # digest from whoever advertises it and train on from there
+        prev = state.reports[(trial, rung - 1)]
+        try:
+            blob = store.fetch(
+                prev["ckpt"], registry_peers(urls, prev["ckpt"]),
+                name=f"{trial}-r{rung - 1}-ckpt",
+                timeout_s=decision_timeout_s,
+            )
+            unpack_dir(blob, ckpt_dir)
+        except Exception:  # noqa: BLE001 — nobody advertises the bytes
+            # retrain from round 0 to the boundary instead: checkpointed
+            # training is deterministic, so the rung metric (and the
+            # leaderboard) is unchanged — only wall-clock suffers
+            pass
+    xv, yv = load_training_data(valid)
+    while rung is not None:
+        t0 = time.monotonic()
+        with obs.span(
+            "experiment.rung",
+            attrs={"experiment": experiment, "trial": trial, "rung": rung},
+        ):
+            booster = run_train(
+                ",".join(urls), trial, data, ckpt_dir,
+                partitions=partitions, world_size=1,
+                service_name=f"{experiment}-{trial}",
+                num_iterations=int(boundaries[rung]),
+                checkpoint_every=1, heartbeat_s=heartbeat_s,
+                seed=seed, status_file=status_file,
+                # a twin incarnation (controller respawn racing a live
+                # orphan) must NEVER grow into this gang: two members
+                # co-training would change the model and break the
+                # leaderboard's same-seed determinism
+                allow_growback=False,
+                **{k: v for k, v in params.items()
+                   if k != "num_iterations"},
+            )
+        _M_RUNG_SECONDS.observe(time.monotonic() - t0)
+        metric = holdout_metric(booster, xv, yv)
+        ck = store.put(ckpt_dir, name=f"{trial}-r{rung}-ckpt")
+        model = store.put_bytes(
+            booster.to_model_string().encode(),
+            name=f"{trial}-r{rung}.gbdt.json",
+        )
+        server.heartbeat()  # advertise the new digests before reporting
+        rec = _report_with_retry(
+            urls, experiment, trial, rung, metric,
+            ck.digest, model.digest, int(boundaries[rung]), params,
+        )
+        if rec is None:
+            return EXIT_NO_DECISION
+        if rung == len(boundaries) - 1:
+            # linger until the winner record lands: the controller must
+            # replicate this trial's model bytes from OUR artifact server
+            # before it commits the winner (exiting now would strand the
+            # digest with no advertiser — the publish path would starve)
+            _await_winner(urls, experiment, poll_s, decision_timeout_s)
+            return EXIT_COMPLETED
+        verdict = _await_decision(
+            urls, experiment, trial, rung, poll_s, decision_timeout_s,
+        )
+        if verdict is None:
+            return EXIT_NO_DECISION
+        if not verdict:
+            return EXIT_DEMOTED
+        rung += 1
+    return EXIT_COMPLETED
+
+
+def _read_state_retry(
+    urls: list, exp: str, timeout_s: float, poll_s: float
+) -> Optional[records.ExperimentState]:
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            return records.read_state(urls, exp)
+        except records.ExperimentWireError:
+            if time.monotonic() > deadline:
+                return None
+            time.sleep(poll_s)
+
+
+def _await_winner(
+    urls: list, exp: str, poll_s: float, timeout_s: float
+) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            if records.read_state(urls, exp).winner is not None:
+                return
+        except records.ExperimentWireError:
+            pass
+        time.sleep(poll_s)
+
+
+def _await_decision(
+    urls: list, exp: str, trial: str, rung: int,
+    poll_s: float, timeout_s: float,
+) -> Optional[bool]:
+    """Poll for rung ``rung``'s promotion record: True promoted, False
+    demoted, None when no decision landed inside the timeout."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            state = records.read_state(urls, exp)
+        except records.ExperimentWireError:
+            state = None
+        if state is not None:
+            rec = state.rungs.get(rung)
+            if rec is not None:
+                return trial in rec.get("promoted", ())
+        if time.monotonic() > deadline:
+            return None
+        time.sleep(poll_s)
+
+
+def params_json(params: dict) -> str:
+    """Canonical argv form of a sampled param map — byte-stable, so a
+    restarted controller rebuilds the identical spawn command."""
+    return json.dumps(params, sort_keys=True, separators=(",", ":"))
+
+
+__all__ = [
+    "EXIT_COMPLETED",
+    "EXIT_DEMOTED",
+    "EXIT_NO_DECISION",
+    "TRAIN_PARAMS",
+    "holdout_metric",
+    "params_json",
+    "run_trial",
+]

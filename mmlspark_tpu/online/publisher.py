@@ -345,5 +345,41 @@ class Publisher:
             "freshness_s": freshness,
         }
 
+    def publish_spec(self, spec: str) -> dict:
+        """Publish an already-materialized model ``spec`` (any loader
+        grammar the targets understand — ``artifact:gbdt:…``, ``zoo:…``)
+        through the same epoch-fenced load → warm → swap path as
+        :meth:`publish`. No snapshot is written and nothing is GC'd:
+        the caller owns the bytes (an experiment controller's artifact
+        store, a shared-fs file). Raises :class:`PublishError` when no
+        target flipped — the serving alias is unchanged."""
+        t0 = self._now()
+        _M_ATTEMPTS.inc()
+        try:
+            faults.inject(
+                "online.publish", context={"model": self.model, "spec": spec}
+            )
+            self.seq += 1
+            targets = 0
+            if self.store is not None:
+                targets += self._publish_store(spec)
+            if self.worker_urls or self.registry_url:
+                targets += self._publish_workers(spec)
+            if targets == 0:
+                raise PublishError(
+                    f"no target made {self.model} v{self.seq} servable"
+                )
+        except Exception as e:
+            self.failures += 1
+            _M_FAILURES.inc()
+            if isinstance(e, PublishError):
+                raise
+            raise PublishError(f"{type(e).__name__}: {e}") from e
+        _M_PUBLISH_S.observe(self._now() - t0)
+        self.publishes += 1
+        _M_PUBLISHES.inc()
+        _M_VERSION.set(self.seq)
+        return {"version": self.seq, "spec": spec, "targets": targets}
+
 
 __all__ = ["FRESHNESS_BUCKETS", "PublishError", "Publisher"]

@@ -1574,6 +1574,80 @@ def main(argv: Optional[list] = None) -> None:
         help="streaming-binning sketch resolution (buckets = 2^bits "
         "per feature; out-of-core --data specs only)",
     )
+    tu = sub.add_parser(
+        "tune",
+        help="ASHA experiment controller: schedule trials as supervisor "
+        "charges, promote the top 1/eta per rung via generation-CAS "
+        "records, auto-publish the winner into serving "
+        "(mmlspark_tpu/experiments/; docs/experiments.md)",
+    )
+    tu.add_argument("--registry", required=True)
+    tu.add_argument("--experiment", default="exp",
+                    help="experiment name (prefixes every registry record)")
+    tu.add_argument("--trials", type=int, default=6)
+    tu.add_argument(
+        "--space", default=None,
+        help="search-space JSON: {param: [choices]} or "
+        '{param: {"low": .., "high": .., "log"?: true, "int"?: true}} '
+        "(default: the stock GBDT space)",
+    )
+    tu.add_argument("--data", default="synth:512x8:1")
+    tu.add_argument("--valid", default="synth:256x8:99",
+                    help="held-out eval spec (same grammar as --data)")
+    tu.add_argument("--min-iters", type=int, default=2)
+    tu.add_argument("--max-iters", type=int, default=8)
+    tu.add_argument("--eta", type=int, default=2)
+    tu.add_argument("--seed", type=int, default=0)
+    tu.add_argument("--lower-is-better", action="store_true")
+    tu.add_argument("--workdir", default=None)
+    tu.add_argument(
+        "--spawn-cmd", default=None,
+        help="trial placement template, supervisor semantics: bare "
+        "{argv} splices, embedded {argv} substitutes the shell-quoted "
+        "command (fleet supervise --spawn-cmd docs)",
+    )
+    tu.add_argument("--tick-s", type=float, default=0.25)
+    tu.add_argument("--heartbeat-s", type=float, default=0.5)
+    tu.add_argument("--poll-s", type=float, default=0.25)
+    tu.add_argument("--decision-timeout-s", type=float, default=120.0)
+    tu.add_argument("--partitions", type=int, default=4)
+    tu.add_argument("--max-reschedules", type=int, default=5)
+    tu.add_argument(
+        "--publish-model", default=None,
+        help="serve the winner under this model name via the "
+        "epoch-fenced Publisher path (load -> warm -> swap on every "
+        "roster worker); omit to only CAS the winner record",
+    )
+    tu.add_argument("--publish-service", default="serving")
+    tu.add_argument("--publish-epoch", type=int, default=None)
+    tu.add_argument("--status-file", default=None,
+                    help="atomic JSON status (the invariant checker "
+                    "joins these; docs/experiments.md)")
+    tu.add_argument("--deadline-s", type=float, default=600.0)
+    tl = sub.add_parser(
+        "trial",
+        help="one ASHA trial charge (spawned by fleet tune; trains "
+        "through rung boundaries, CAS-reports metrics, self-reaps on "
+        "demotion)",
+    )
+    tl.add_argument("--registry", required=True)
+    tl.add_argument("--experiment", required=True)
+    tl.add_argument("--trial", required=True)
+    tl.add_argument("--params", required=True,
+                    help="sampled hyperparameter JSON (controller-built)")
+    tl.add_argument("--data", required=True)
+    tl.add_argument("--valid", required=True)
+    tl.add_argument("--workdir", required=True)
+    tl.add_argument("--min-iters", type=int, default=2)
+    tl.add_argument("--max-iters", type=int, default=8)
+    tl.add_argument("--eta", type=int, default=2)
+    tl.add_argument("--seed", type=int, default=0)
+    tl.add_argument("--lower-is-better", action="store_true")
+    tl.add_argument("--heartbeat-s", type=float, default=0.5)
+    tl.add_argument("--poll-s", type=float, default=0.25)
+    tl.add_argument("--decision-timeout-s", type=float, default=120.0)
+    tl.add_argument("--partitions", type=int, default=4)
+    tl.add_argument("--status-file", default=None)
     t = sub.add_parser(
         "top", help="scrape /metrics across the fleet, print a summary"
     )
@@ -1754,6 +1828,50 @@ def main(argv: Optional[list] = None) -> None:
             top_k=args.top_k,
             sketch_bits=args.sketch_bits,
         )
+    elif args.role == "tune":
+        from mmlspark_tpu.experiments.controller import (
+            ExperimentController,
+            space_from_json,
+        )
+
+        ctrl = ExperimentController(
+            args.registry, args.experiment, n_trials=args.trials,
+            space=(
+                space_from_json(json.loads(args.space))
+                if args.space else None
+            ),
+            data=args.data, valid=args.valid,
+            min_iters=args.min_iters, max_iters=args.max_iters,
+            eta=args.eta, seed=args.seed,
+            higher_is_better=not args.lower_is_better,
+            workdir=args.workdir, spawn_cmd=args.spawn_cmd,
+            tick_s=args.tick_s, heartbeat_s=args.heartbeat_s,
+            poll_s=args.poll_s,
+            decision_timeout_s=args.decision_timeout_s,
+            partitions=args.partitions,
+            max_reschedules=args.max_reschedules,
+            publish_model=args.publish_model,
+            publish_service=args.publish_service,
+            publish_epoch=args.publish_epoch,
+            status_file=args.status_file, deadline_s=args.deadline_s,
+        )
+        try:
+            ctrl.run()
+        finally:
+            ctrl.close()
+    elif args.role == "trial":
+        from mmlspark_tpu.experiments.trial import run_trial
+
+        raise SystemExit(run_trial(
+            args.registry, args.experiment, args.trial,
+            json.loads(args.params), args.data, args.valid, args.workdir,
+            min_iters=args.min_iters, max_iters=args.max_iters,
+            eta=args.eta, seed=args.seed,
+            higher_is_better=not args.lower_is_better,
+            heartbeat_s=args.heartbeat_s, poll_s=args.poll_s,
+            decision_timeout_s=args.decision_timeout_s,
+            partitions=args.partitions, status_file=args.status_file,
+        ))
     elif args.role == "registry":
         from mmlspark_tpu.obs.flightrec import install_sigusr1
 

@@ -47,6 +47,18 @@ def model_name_from_spec(spec: str) -> str:
         # exactly the Publisher's -v%06d suffix: a looser \d+ would
         # mangle user-named snapshots like fraud-v2.npz -> "fraud"
         return re.sub(r"-v\d{6}$", "", stem) or "vw"
+    if spec.startswith("gbdt:"):
+        import os
+        import re
+
+        stem = os.path.basename(spec[len("gbdt:"):])
+        for ext in (".gbdt.json", ".json"):
+            if stem.endswith(ext):
+                stem = stem[: -len(ext)]
+                break
+        # the experiment controller's -r<rung> suffix: every rung model
+        # of one trial serves under the trial's stable name
+        return re.sub(r"-r\d+$", "", stem) or "gbdt"
     if spec.startswith("artifact:"):
         # ``artifact:<scheme>:<name>@<digest>[@peers]`` serves under the
         # name the delegate grammar would give the named file — digests
@@ -539,6 +551,78 @@ def _vw_loaded(path: str) -> LoadedModel:
     )
 
 
+def _gbdt_loaded(path: str) -> LoadedModel:
+    """``gbdt:<model.json>`` — serve a trained GBDT booster from its
+    portable model string (``Booster.to_model_string`` — what ``fleet
+    train --out-model`` writes and the experiment controller publishes
+    by digest). Wire contract: POST body is one dense row
+    ``{"features": [...]}`` or ``{"rows": [[...], ...]}``; each reply
+    row carries the raw ``margin`` plus ``prediction`` (and, for the
+    binary objective, ``probability``)."""
+    from mmlspark_tpu.models.gbdt.booster import Booster
+
+    with open(path) as f:
+        text = f.read()
+    state = {"b": Booster.from_model_string(text)}
+    objective = state["b"].objective
+    n_features = int(getattr(state["b"], "num_features", 0) or 0)
+
+    def _score(rows: list) -> list:
+        x = np.asarray(rows, dtype=np.float32)
+        if x.ndim != 2:
+            raise ValueError("rows must be dense feature vectors")
+        margins = np.asarray(state["b"].predict(x), dtype=np.float64)
+        out = []
+        for m in np.atleast_1d(margins):
+            if getattr(m, "ndim", 0):  # multiclass: argmax over scores
+                row = {
+                    "margin": [float(v) for v in m],
+                    "prediction": float(int(np.argmax(m))),
+                }
+            else:
+                row = {"margin": float(m)}
+                if objective == "binary":
+                    row["prediction"] = float(m > 0)
+                    row["probability"] = float(1.0 / (1.0 + np.exp(-m)))
+                else:
+                    row["prediction"] = float(m)
+            out.append(row)
+        return out
+
+    def handler(reqs: list) -> dict:
+        out = {}
+        for r in reqs:
+            try:
+                body = json.loads(r.body) if r.body else {}
+                if isinstance(body, dict) and "rows" in body:
+                    scored = _score(body["rows"])
+                    payload: Any = {"rows": scored}
+                elif isinstance(body, dict) and "features" in body:
+                    payload = _score([body["features"]])[0]
+                else:
+                    raise ValueError(
+                        'body must be {"features": [...]} or '
+                        '{"rows": [[...], ...]}'
+                    )
+                out[r.id] = (200, json.dumps(payload).encode(), {})
+            except Exception as e:  # noqa: BLE001 — a bad row 400s alone
+                out[r.id] = (
+                    400, json.dumps({"error": str(e)[:300]}).encode(), {}
+                )
+        return out
+
+    def warmup() -> None:
+        _score([[0.0] * max(1, n_features)])
+
+    def release() -> None:
+        state["b"] = None
+
+    return LoadedModel(
+        handler=handler, nbytes=len(text), warmup=warmup, release=release,
+        meta={"spec": f"gbdt:{path}", "objective": objective},
+    )
+
+
 def build_loaded_model(spec: Any) -> LoadedModel:
     """Resolve a model spec:
 
@@ -554,6 +638,8 @@ def build_loaded_model(spec: Any) -> LoadedModel:
       accounting over the fitted stages;
     - ``"vw:<snapshot.npz>"`` — an online-published VW linear model
       (mmlspark_tpu/online/ Publisher artifact), scored on device;
+    - ``"gbdt:<model.json>"`` — a trained GBDT booster model string
+      (``fleet train --out-model`` / experiment-controller winner);
     - ``"artifact:<scheme>:<name>@<sha256>[@peer-url,...]"`` — fetch a
       content-addressed artifact from any advertising peer (hash-
       verified, resumable; serving/artifacts.py), then delegate to
@@ -573,6 +659,8 @@ def build_loaded_model(spec: Any) -> LoadedModel:
         return _pipeline_loaded(spec[len("pipeline:"):])
     if spec.startswith("vw:"):
         return _vw_loaded(spec[len("vw:"):])
+    if spec.startswith("gbdt:"):
+        return _gbdt_loaded(spec[len("gbdt:"):])
     if spec.startswith("artifact:"):
         # content-addressed spec (serving/artifacts.py): fetch the blob
         # by digest (spec-embedded peer hints first, then every

@@ -180,6 +180,54 @@ def test_harvest_clean_exit_keeps_retry(tmp_path, monkeypatch):
     assert remaining == ["gbdt"]  # only the failed segment is left
 
 
+def test_cpu_fallback_survives_one_stalled_segment(tmp_path, monkeypatch,
+                                                   capsys):
+    """A segment that hangs its watchdog on the CPU fallback must not
+    discard everything queued after it: the parent records the stuck
+    segment and runs the rest in a fresh child — the emitted line shows
+    the stalled segment in segments_missing (and segments_stalled), with
+    every other segment completed."""
+    b = _load_bench()
+    monkeypatch.setattr(b, "PARTIAL_PATH", str(tmp_path / "p.json"))
+    monkeypatch.setattr(b, "TOTAL_TPU_BUDGET_S", 0)  # skip the TPU phase
+
+    stall = b.CPU_ORDER[1]
+
+    class _Scripted(_FakeChild):
+        stderr_tail = ""
+
+    def _recs(segs):
+        recs = [{"segment": "init",
+                 "data": {"platform": "cpu", "n_dev": 1}}]
+        recs += [{"segment": s, "data": {f"{s}_x": 1.0}} for s in segs]
+        return recs
+
+    # child 1 completes the first segment, then hangs at `stall`
+    # (next_record -> None = watchdog miss); child 2 gets the rest
+    children = [
+        _Scripted(_recs([b.CPU_ORDER[0]]), running_at_end=True),
+        _Scripted(
+            _recs([s for s in b.CPU_ORDER[2:]])
+            + [{"segment": "done", "data": {}}],
+            running_at_end=False,
+        ),
+    ]
+    spawned = []
+
+    def _fake_child(remaining, env):
+        spawned.append(list(remaining))
+        return children.pop(0)
+
+    monkeypatch.setattr(b, "_Child", _fake_child)
+    b.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["extra"]["segments_missing"] == [stall]
+    assert out["extra"]["segments_stalled"] == [stall]
+    # every segment after the stalled one was re-offered to child 2
+    assert spawned[1] == [s for s in b.CPU_ORDER[2:]]
+    assert f"{b.CPU_ORDER[-1]}_x" in out["extra"]
+
+
 def test_segment_orders_cover_all_segments():
     """TPU_ORDER and CPU_ORDER must each be a permutation of SEGMENTS —
     a segment missing from either order would silently never run on

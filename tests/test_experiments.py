@@ -1,0 +1,515 @@
+"""Experiment-orchestration tests (mmlspark_tpu/experiments/).
+
+Three layers, mirroring the subsystem's own split:
+
+- ASHA rung math as pure functions — promotion determinism under seeded
+  ties, rung sizing for non-power-of-eta budgets, and the
+  resume-from-registry reconstruction equivalence the controller's
+  restart story rests on.
+- Records on a live registry — write-once generation-CAS semantics
+  (first writer wins, later writers adopt the incumbent), wire-loss
+  behaviour, and the three ``experiment.*`` fault points.
+- The pinned seeded chaos drill: a 6-trial experiment where one
+  promoted trial is SIGKILLed mid-rung AND the controller is abandoned
+  mid-experiment; a restarted controller resumes from registry state
+  alone and must produce the byte-identical leaderboard of an
+  undisturbed same-seed run, auto-publish the winner, and answer
+  through the gateway — with the invariant laws green across both
+  controllers' status files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.experiments import asha, records
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a port nothing listens on: connection-refused, instantly
+DEAD_REGISTRY = "http://127.0.0.1:9"
+
+
+# -- ASHA pure math (satellite: rung-math coverage) ---------------------------
+
+
+def test_rung_boundaries_geometric():
+    assert asha.rung_boundaries(2, 8, 2) == [2, 4, 8]
+    assert asha.rung_boundaries(1, 27, 3) == [1, 3, 9, 27]
+
+
+def test_rung_boundaries_non_power_of_eta_budget():
+    # the budget is spent, not rounded away: the final rung lands at
+    # max_iters itself even when it breaks the geometric progression
+    assert asha.rung_boundaries(2, 20, 3) == [2, 6, 18, 20]
+    assert asha.rung_boundaries(3, 10, 2) == [3, 6, 10]
+    assert asha.rung_boundaries(5, 5, 2) == [5]
+
+
+def test_rung_boundaries_rejects_bad_budgets():
+    with pytest.raises(ValueError):
+        asha.rung_boundaries(0, 8, 2)
+    with pytest.raises(ValueError):
+        asha.rung_boundaries(8, 2, 2)
+    with pytest.raises(ValueError):
+        asha.rung_boundaries(2, 8, 1)
+
+
+def test_n_promote_floor_one():
+    assert asha.n_promote(6, 2) == 3
+    assert asha.n_promote(7, 3) == 2
+    assert asha.n_promote(2, 3) == 1  # never strand the experiment
+    with pytest.raises(ValueError):
+        asha.n_promote(0, 2)
+
+
+def test_promotion_deterministic_under_seeded_ties():
+    # four trials, ALL tied: rank must be a pure function of (metrics,
+    # seed) — independent of dict insertion order
+    tied = {f"t{i:03d}": 0.5 for i in range(4)}
+    reversed_order = dict(reversed(list(tied.items())))
+    p1, b1 = asha.promote(tied, 2, seed=7)
+    p2, b2 = asha.promote(reversed_order, 2, seed=7)
+    assert p1 == p2 and b1 == b2
+    assert len(p1) == 2
+    # a different seed is allowed to rank ties differently, but must be
+    # just as deterministic
+    p3a, _ = asha.promote(tied, 2, seed=8)
+    p3b, _ = asha.promote(tied, 2, seed=8)
+    assert p3a == p3b
+
+
+def test_leaderboard_orders_by_metric_then_seeded_tiebreak():
+    metrics = {"a": 0.9, "b": 0.7, "c": 0.9, "d": 0.8}
+    board = asha.leaderboard(metrics, seed=0)
+    assert [m for _, m in board] == [0.9, 0.9, 0.8, 0.7]
+    lo = asha.leaderboard(metrics, seed=0, higher_is_better=False)
+    assert [m for _, m in lo] == [0.7, 0.8, 0.9, 0.9]
+
+
+def test_next_rung_and_is_demoted():
+    bounds = [2, 4, 8]
+    reports = {("t0", 0): {}, ("t0", 1): {}}
+    assert asha.next_rung("t0", reports, bounds) == 2
+    assert asha.next_rung("t1", reports, bounds) == 0
+    reports[("t0", 2)] = {}
+    assert asha.next_rung("t0", reports, bounds) is None
+    rungs = {0: {"promoted": ["t0"]}}
+    assert asha.is_demoted("t1", 1, rungs)
+    assert not asha.is_demoted("t0", 1, rungs)
+    assert not asha.is_demoted("t1", 0, rungs)  # rung 0 needs no ticket
+
+
+def test_leaderboard_bytes_canonical_and_stable():
+    rungs = {
+        1: asha.rung_record(1, ["a"], [["a", 0.9]], 2, 7),
+        0: asha.rung_record(0, ["a", "b"], [["a", 0.9], ["b", 0.1]], 2, 7),
+    }
+    b1 = asha.leaderboard_bytes(rungs)
+    b2 = asha.leaderboard_bytes(dict(sorted(rungs.items())))
+    assert b1 == b2
+    parsed = json.loads(b1)
+    assert list(parsed) == ["0", "1"]
+    assert parsed["0"]["promoted"] == ["a", "b"]
+
+
+def test_state_from_roster_reconstruction_equivalence():
+    # a state built incrementally (what a running controller holds) and
+    # one reconstructed from the roster dump (what a RESTARTED controller
+    # reads) must agree — the resume-from-registry contract
+    rep0 = {"trial": "t000", "rung": 0, "metric": 0.8, "ckpt": "c0",
+            "model": "m0", "iters": 2, "params": {"num_leaves": 7}}
+    rep1 = {"trial": "t001", "rung": 0, "metric": 0.9, "ckpt": "c1",
+            "model": "m1", "iters": 2, "params": {"num_leaves": 15}}
+    rung0 = asha.rung_record(0, ["t001"], [["t001", 0.9], ["t000", 0.8]], 2, 7)
+    roster = {
+        records.trial_record_name("e", "t000", 0): [rep0],
+        records.trial_record_name("e", "t001", 0): [rep1],
+        records.rung_record_name("e", 0): [rung0],
+        records.live_service_name("e"): [
+            {"host": "t001", "port": 123, "ts": 1.0},
+        ],
+        # noise the reconstruction must ignore: another experiment's
+        # records and unrelated roster services
+        records.trial_record_name("e2", "t000", 0): [rep0],
+        "serving": [{"host": "127.0.0.1", "port": 80}],
+    }
+    st = records.state_from_roster("e", roster)
+    assert st.reports == {("t000", 0): rep0, ("t001", 0): rep1}
+    assert st.rungs == {0: rung0}
+    assert st.winner is None
+    assert list(st.live) == ["t001"]
+    assert st.rung_metrics(["t000", "t001", "t999"], 0) == {
+        "t000": 0.8, "t001": 0.9,
+    }
+    # and the decision derived from the reconstruction is the decision
+    # the original controller committed
+    promoted, board = asha.promote(
+        st.rung_metrics(["t000", "t001"], 0), 2, seed=7
+    )
+    assert promoted == rung0["promoted"]
+    assert board == rung0["leaderboard"]
+
+
+# -- records on a live registry ----------------------------------------------
+
+
+@pytest.fixture()
+def registry():
+    from mmlspark_tpu.serving import fleet
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=2.0)
+    yield reg
+    reg.stop()
+
+
+def test_cas_commit_first_writer_wins(registry):
+    name = "casx-rung-0-gen"
+    committed, current = records.cas_commit(
+        registry.url, name, {"promoted": ["a"]}
+    )
+    assert committed and current is None
+    committed, current = records.cas_commit(
+        registry.url, name, {"promoted": ["b"]}
+    )
+    assert not committed
+    assert current["promoted"] == ["a"]  # the incumbent, to adopt
+
+
+def test_cas_commit_raises_below_majority():
+    with pytest.raises(records.ExperimentWireError):
+        records.cas_commit(DEAD_REGISTRY, "x-gen", {"a": 1})
+
+
+def test_report_trial_roundtrip_and_adoption(registry):
+    rec = records.report_trial(
+        registry.url, "expA", "t000", 0, 0.75, "ck0", "md0", 2,
+        {"num_leaves": 7},
+    )
+    assert rec["metric"] == 0.75 and rec["ckpt"] == "ck0"
+    # a rescheduled twin re-reporting adopts its earlier self
+    again = records.report_trial(
+        registry.url, "expA", "t000", 0, 0.75, "ckX", "mdX", 2,
+        {"num_leaves": 7},
+    )
+    assert again["ckpt"] == "ck0"
+    st = records.read_state(registry.url, "expA")
+    assert st.reports[("t000", 0)]["model"] == "md0"
+
+
+def test_trial_liveness_rides_ttl_roster(registry):
+    records.register(registry.url, {
+        "name": records.live_service_name("expL"),
+        "host": "t003", "port": 4242,
+    })
+    st = records.read_state(registry.url, "expL")
+    assert "t003" in st.live
+    time.sleep(2.5)  # ttl_s=2.0: liveness must expire, records must not
+    st = records.read_state(registry.url, "expL")
+    assert "t003" not in st.live
+
+
+def test_generation_records_survive_ttl(registry):
+    records.cas_commit(registry.url, "expT-rung-0-gen", {"promoted": []})
+    time.sleep(2.5)
+    st = records.read_state(registry.url, "expT")
+    assert 0 in st.rungs
+
+
+# -- fault points -------------------------------------------------------------
+
+
+def test_fault_point_experiment_report(registry):
+    plan = faults.FaultPlan(seed=0).on(
+        "experiment.report", error=faults.FaultError, at=(0,),
+    )
+    with plan.armed():
+        with pytest.raises(faults.FaultError):
+            records.report_trial(
+                registry.url, "expF", "t000", 0, 0.5, "c", "m", 2, {},
+            )
+        # retry (hit 1) sails through — the trial loop's retry contract
+        rec = records.report_trial(
+            registry.url, "expF", "t000", 0, 0.5, "c", "m", 2, {},
+        )
+    assert rec["ckpt"] == "c"
+    assert plan.fires("experiment.report")
+
+
+def test_fault_point_experiment_spawn(tmp_path):
+    from mmlspark_tpu.experiments.controller import ExperimentController
+
+    ctrl = ExperimentController(
+        DEAD_REGISTRY, "expS", n_trials=1, workdir=str(tmp_path),
+    )
+    plan = faults.FaultPlan(seed=0).on(
+        "experiment.spawn", error=faults.FaultError,
+    )
+    try:
+        with plan.armed():
+            with pytest.raises(faults.FaultError):
+                ctrl._spawn("t000")
+        assert ctrl.spawned == 0  # the fault fired before any Popen
+    finally:
+        ctrl.close()
+
+
+def test_fault_point_experiment_promote(registry, tmp_path):
+    from mmlspark_tpu.experiments.controller import ExperimentController
+
+    ctrl = ExperimentController(
+        registry.url, "expP", n_trials=2, workdir=str(tmp_path),
+    )
+    state = records.ExperimentState(reports={
+        (t, 0): {"trial": t, "rung": 0, "metric": 0.5 + i / 10,
+                 "ckpt": f"c{i}", "model": f"m{i}", "iters": 2,
+                 "params": {}}
+        for i, t in enumerate(ctrl.trials)
+    })
+    plan = faults.FaultPlan(seed=0).on(
+        "experiment.promote", error=faults.FaultError,
+    )
+    try:
+        with plan.armed():
+            with pytest.raises(faults.FaultError):
+                ctrl._promote_ready_rungs(state)
+        assert not state.rungs  # nothing committed past the fault
+        ctrl._promote_ready_rungs(state)  # disarmed: the decision lands
+        assert state.rungs[0]["promoted"] == ["t001"]
+    finally:
+        ctrl.close()
+
+
+def test_reschedule_budget_exhaustion_is_loud(tmp_path):
+    from mmlspark_tpu.experiments.controller import (
+        ExperimentController,
+        ExperimentError,
+    )
+
+    ctrl = ExperimentController(
+        DEAD_REGISTRY, "expB", n_trials=1, workdir=str(tmp_path),
+        max_reschedules=0, spawn_cmd="true {argv}",
+    )
+    try:
+        ctrl._spawn("t000")
+        del ctrl.charges["t000"]
+        with pytest.raises(ExperimentError):
+            ctrl._spawn("t000")
+    finally:
+        ctrl.close()
+
+
+def test_trial_rejects_unknown_params(tmp_path):
+    from mmlspark_tpu.experiments.trial import run_trial
+
+    with pytest.raises(ValueError, match="bogus"):
+        run_trial(
+            DEAD_REGISTRY, "expV", "t000", {"bogus": 1},
+            "synth:64x4:1", "synth:32x4:2", str(tmp_path),
+        )
+
+
+def test_controller_status_obeys_conservation_law(tmp_path):
+    from mmlspark_tpu.experiments.controller import ExperimentController
+
+    ctrl = ExperimentController(
+        DEAD_REGISTRY, "expC", n_trials=3, workdir=str(tmp_path),
+        spawn_cmd="true {argv}",  # charges exit immediately
+        status_file=str(tmp_path / "st.json"),
+    )
+    try:
+        for t in ctrl.trials:
+            ctrl._spawn(t)
+        # charges die instantly; classify them against an empty state
+        ctrl._reap_and_respawn(records.ExperimentState())
+        ctrl._write_status(None)
+        st = json.loads((tmp_path / "st.json").read_text())
+        assert st["trials_spawned"] == (
+            st["completed"] + st["demoted"] + st["rescheduled"]
+            + st["running"]
+        )
+        from mmlspark_tpu.chaos.invariants import InvariantChecker
+
+        checker = InvariantChecker(
+            experiment_status_files=[str(tmp_path / "st.json")],
+        )
+        assert checker.check(final=True) == []
+    finally:
+        ctrl.close()
+
+
+def test_invariant_checker_catches_experiment_violations(tmp_path):
+    from mmlspark_tpu.chaos.invariants import InvariantChecker
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "experiment": "e", "trials_spawned": 3, "completed": 1,
+        "demoted": 0, "rescheduled": 0, "running": 1,
+        "rungs": {"0": ["t000"]},
+    }))
+    rival = tmp_path / "rival.json"
+    rival.write_text(json.dumps({
+        "experiment": "e", "trials_spawned": 2, "completed": 1,
+        "demoted": 0, "rescheduled": 0, "running": 1,
+        "rungs": {"0": ["t001"]},  # a RIVAL promotion set for rung 0
+    }))
+    checker = InvariantChecker(
+        experiment_status_files=[str(bad), str(rival)],
+    )
+    names = {v.name for v in checker.check(final=True)}
+    assert names == {"experiment_conservation", "single_promotion"}
+
+
+# -- the pinned seeded chaos drill -------------------------------------------
+
+
+ARGS = dict(
+    n_trials=6, data="synth:256x6:1", valid="synth:128x6:99",
+    min_iters=2, max_iters=8, eta=2, seed=7, deadline_s=240.0,
+    heartbeat_s=0.5, tick_s=0.25,
+)
+
+
+def _run_undisturbed(reg_url, workdir):
+    from mmlspark_tpu.experiments.controller import ExperimentController
+
+    ctrl = ExperimentController(
+        reg_url, "undisturbed", workdir=str(workdir), **ARGS
+    )
+    try:
+        return ctrl.run()
+    finally:
+        ctrl.close()
+
+
+def test_asha_chaos_drill_end_to_end(tmp_path, monkeypatch):
+    """The acceptance drill: SIGKILL a promoted trial mid-rung, abandon
+    the controller mid-experiment, restart it cold — the resumed run
+    must reproduce the undisturbed same-seed leaderboard byte-for-byte,
+    auto-publish the winner, and answer through the gateway, with the
+    invariant laws green across both controllers' status files."""
+    from mmlspark_tpu.chaos.invariants import InvariantChecker
+    from mmlspark_tpu.experiments.controller import ExperimentController
+    from mmlspark_tpu.serving import fleet
+
+    # trial subprocesses inherit this env: keep them on CPU and on the
+    # shared persistent compile cache (cold XLA compiles would dominate)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+    )
+
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=2.0)
+    srv = q = wstop = gw = None
+    a = b = None
+    try:
+        # the undisturbed twin first, on its own registry namespace
+        undisturbed = _run_undisturbed(reg.url, tmp_path / "undisturbed")
+
+        # serving plane for the winner publication
+        srv, q, wstop = fleet.run_worker(
+            reg.url, model="echo", host="127.0.0.1", heartbeat_s=0.2
+        )
+        gw = fleet.run_gateway(reg.url, host="127.0.0.1", port=0)
+
+        st_a = tmp_path / "status-a.json"
+        st_b = tmp_path / "status-b.json"
+        a = ExperimentController(
+            reg.url, "drill", workdir=str(tmp_path / "wd-a"),
+            status_file=str(st_a), **ARGS
+        )
+        killed = False
+        ticks_after_kill = 0
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            state = a.tick()
+            if state is not None and 0 in state.rungs and not killed:
+                # SIGKILL one PROMOTED trial mid-rung-1 — the victim
+                # must later be rescheduled from its rung-0 artifact
+                for t in state.rungs[0]["promoted"]:
+                    ch = a.charges.get(t)
+                    if ch is not None and ch.alive():
+                        os.kill(ch.proc.pid, signal.SIGKILL)
+                        killed = True
+                        break
+            if killed:
+                ticks_after_kill += 1
+                if ticks_after_kill > 8:
+                    break
+            time.sleep(0.25)
+        assert killed, "no promoted trial was alive to SIGKILL"
+        # the controller "dies" mid-experiment: its ingress goes away,
+        # its charges become orphans the successor must not double-spawn
+        a._server.stop()
+
+        b = ExperimentController(
+            reg.url, "drill", workdir=str(tmp_path / "wd-b"),
+            status_file=str(st_b), publish_model="champion", **ARGS
+        )
+        out = b.run()
+
+        # byte-identical leaderboard vs the undisturbed same-seed run
+        assert (
+            out["leaderboard_sha256"] == undisturbed["leaderboard_sha256"]
+        )
+        assert out["winner"]["trial"] == undisturbed["winner"]["trial"]
+        assert out["published"] is True
+
+        # conservation + single-promotion laws, joined across BOTH
+        # controllers' status files (A's is a mid-experiment snapshot)
+        checker = InvariantChecker(
+            experiment_status_files=[str(st_a), str(st_b)],
+        )
+        assert checker.check(final=True) == []
+
+        # the published winner answers through the gateway
+        import http.client
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(gw.url)
+        host, port = parts.hostname, parts.port
+        score = None
+        wait = time.monotonic() + 15.0
+        while time.monotonic() < wait:
+            conn = http.client.HTTPConnection(host, int(port), timeout=5)
+            try:
+                conn.request(
+                    "POST", "/models/champion",
+                    body=json.dumps({"features": [0.5] * 6}),
+                    headers={"Content-Type": "application/json"},
+                )
+                r = conn.getresponse()
+                body = r.read()
+                if r.status == 200:
+                    score = json.loads(body)
+                    break
+            except OSError:
+                pass
+            finally:
+                conn.close()
+            time.sleep(0.3)
+        assert score is not None, "gateway never answered for the winner"
+        assert "prediction" in score and "margin" in score
+    finally:
+        for ctrl in (b, a):
+            if ctrl is not None:
+                ctrl.close()
+        if gw is not None:
+            gw.stop()
+        if wstop is not None:
+            wstop.stop()
+        reg.stop()
+        # same hygiene as the chaos soaks: the winner publication bumped
+        # the process-global online publish counters (with no freshness
+        # observation — a tune publish has no feedback timestamp), and a
+        # later in-process smoke's freshness gate must not inherit an
+        # attempted-but-never-fresh loop
+        from mmlspark_tpu import obs
+
+        obs.reset()

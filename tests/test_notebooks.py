@@ -29,6 +29,11 @@ _SLOW_NOTEBOOKS = {
     "DeepLearning - Importing Torch Checkpoints.ipynb",
     "DeepLearning - ViT with Sequence Parallelism.ipynb",
     "LightGBM - Quantile Regression for Drug Discovery.ipynb",
+    # ~33 s between them; direct tier-1 coverage: the gbdt suite
+    # (test_gbdt*, test_real_datasets) and the transfer path
+    # (test_zoo_weights transfer tests, test_e2e image flow)
+    "LightGBM - Overview.ipynb",
+    "DeepLearning - Transfer Learning with ImageFeaturizer.ipynb",
 }
 
 

@@ -134,7 +134,13 @@ class TestRealDatasetGoldens:
 MATRIX = [
     pytest.param(
         ds, mode,
-        marks=[pytest.mark.slow] if ds == "digits_binary" else [],
+        # breast_cancer's non-gbdt modes (~10 s each) follow digits to
+        # the full tier: wine + iris run every mode tier-1 and
+        # breast_cancer-gbdt stays via TestRealDatasetGoldens
+        marks=(
+            [pytest.mark.slow]
+            if ds in ("digits_binary", "breast_cancer") else []
+        ),
     )
     for ds in ("breast_cancer", "digits_binary", "wine")
     for mode in ("goss", "dart", "rf")

@@ -166,6 +166,9 @@ def test_import_rejects_architecture_mismatch():
         import_torch_resnet(sd, variant="ResNet18")
 
 
+@pytest.mark.slow  # ~19 s; the import math is tier-1 via
+# test_torch_state_dict_import_feature_parity and the zoo-install flow
+# via test_install_torch_vit_through_the_zoo (smaller model)
 def test_install_and_featurize_through_the_zoo(tmp_path):
     """install_torch_checkpoint -> ImageFeaturizer(model_name=...) serves
     the imported model's features (the reference's zoo-by-name flow)."""

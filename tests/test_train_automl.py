@@ -128,6 +128,23 @@ def test_tune_hyperparameters(tabular_df):
     assert "prediction" in out.columns
 
 
+def test_tune_hyperparameters_rejects_unknown_param(tabular_df):
+    # a sampled param the estimator does not declare used to be silently
+    # dropped — the tuner "searched" a space where every draw trained the
+    # identical model; now it must fail loudly, naming both sides
+    spaces = (
+        HyperparamBuilder()
+        .add_hyperparam("reg_param", RangeHyperParam(1e-5, 1e-2, log=True))
+        .add_hyperparam("num_leaves", DiscreteHyperParam([7, 15]))
+        .build()
+    )
+    tuner = TuneHyperparameters(label_col="label")
+    tuner.set(models=[LogisticRegression()], hyperparams=spaces)
+    tuner.set(number_of_runs=2, number_of_folds=2)
+    with pytest.raises(ValueError, match="num_leaves.*LogisticRegression"):
+        tuner.fit(tabular_df)
+
+
 def test_find_best_model(tabular_df):
     m1 = LogisticRegression(max_iter=5, learning_rate=0.01).fit(tabular_df)
     m2 = LogisticRegression(max_iter=200).fit(tabular_df)

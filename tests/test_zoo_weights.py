@@ -143,6 +143,10 @@ def _pool_features(imgs, model_name=None, seed=0):
     return np.asarray(out["pool"], np.float64)
 
 
+@pytest.mark.slow  # ~35 s; a training-quality gate like the digits
+# goldens — the RotNet backbone's serving path stays tier-1 via
+# test_patch_backbone_through_image_featurizer and
+# test_packaged_model_loads_and_classifies
 def test_natural_image_pretraining_beats_random_init():
     """The flagship transfer gate (ImageFeaturizer.scala:133-178 ships
     TRAINED backbones for exactly this reason): with only 64 labeled

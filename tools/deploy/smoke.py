@@ -855,6 +855,83 @@ def _verify_chaos_wire(
         proxy.stop()
 
 
+def _verify_tune(url: str, registry_url, service: str,
+                 seed: int = 11) -> bool:
+    """Tune probe (opt-in, ``--tune``): run a 2-trial ASHA
+    micro-experiment against the live fleet — trials are local
+    subprocesses reporting through the fleet's registry, the winner is
+    published through the epoch-fenced publish plane to ``service``'s
+    workers, and the gate requires it to answer a scoring request
+    through the gateway (mmlspark_tpu/experiments/;
+    docs/experiments.md). Exercises the full tune loop on a deployed
+    fleet: CAS rung records on the live registry, artifact replication,
+    publication, and gateway routing of a model that did not exist when
+    the fleet came up."""
+    _ensure_repo_path()
+    if not registry_url:
+        print("smoke: --tune needs --registry (the controller commits "
+              "rung records and publishes through it)", file=sys.stderr)
+        return False
+    import tempfile
+
+    from mmlspark_tpu.experiments.controller import (
+        ExperimentController, ExperimentError,
+    )
+
+    stamp = f"{os.getpid()}-{int(time.time())}"
+    experiment = f"smoke-tune-{stamp}"
+    model = f"smoke-champion-{stamp}"
+    ctrl = ExperimentController(
+        registry_url, experiment, n_trials=2,
+        data="synth:192x6:1", valid="synth:96x6:99",
+        min_iters=2, max_iters=4, eta=2, seed=seed,
+        workdir=tempfile.mkdtemp(prefix="smoke-tune-"),
+        deadline_s=180.0,
+        publish_model=model, publish_service=service,
+    )
+    try:
+        out = ctrl.run()
+    except ExperimentError as e:
+        print(f"smoke: tune probe FAILED ({e})", file=sys.stderr)
+        return False
+    finally:
+        ctrl.close()
+    if not out.get("published"):
+        print("smoke: tune probe: winner was never published",
+              file=sys.stderr)
+        return False
+    # the freshly published winner must answer through the gateway
+    u = urllib.parse.urlsplit(url)
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port or 80, timeout=5
+        )
+        try:
+            conn.request(
+                "POST", f"/models/{model}",
+                body=json.dumps({"features": [0.5] * 6}),
+                headers={"Content-Type": "application/json"},
+            )
+            r = conn.getresponse()
+            body = r.read()
+            if r.status == 200 and "prediction" in json.loads(body):
+                print(
+                    f"smoke: tune probe ok — trial "
+                    f"{out['winner']['trial']} won, published as "
+                    f"{model!r} and scored through the gateway"
+                )
+                return True
+        except (OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+        time.sleep(0.3)
+    print(f"smoke: tune probe: gateway never answered for {model!r}",
+          file=sys.stderr)
+    return False
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="smoke.py", description=__doc__)
     ap.add_argument("url", nargs="?", default="http://127.0.0.1:8080/")
@@ -907,6 +984,14 @@ def main(argv=None) -> int:
         "--chaos-wire-seed", type=int, default=7,
         help="seed for the --chaos-wire schedule (same seed => "
         "byte-identical fault schedule)",
+    )
+    ap.add_argument(
+        "--tune", action="store_true",
+        help="opt-in tune probe: 2-trial ASHA micro-experiment against "
+        "the live fleet's registry (trials run as local subprocesses), "
+        "winner published through the epoch-fenced publish plane and "
+        "required to answer through the gateway (needs --registry; "
+        "mmlspark_tpu/experiments/; docs/experiments.md)",
     )
     ap.add_argument(
         "--chaos-wire-partition", action="store_true",
@@ -978,9 +1063,15 @@ def main(argv=None) -> int:
             seed=args.chaos_wire_seed,
             partition=args.chaos_wire_partition,
         )
+    tune_ok = True
+    if args.tune:
+        # LAST: the probe's winner publication shifts worker model
+        # inventory and its scoring traffic would skew every counter
+        # gate above
+        tune_ok = _verify_tune(args.url, args.registry, args.service_name)
     return 0 if (
         ok == n and metrics_ok and swap_ok and trace_ok and flight_ok
-        and throughput_ok and chaos_wire_ok
+        and throughput_ok and chaos_wire_ok and tune_ok
     ) else 1
 
 

@@ -48,9 +48,13 @@ _INJECT_RE = re.compile(
 # that the tree lost its chaos hooks (PR 16 added the split-brain trio:
 # registry.commit_cas — a registry refusing a generation CAS commit,
 # elastic.park — a minority member stopping training on quorum loss,
-# publish.fence — a worker rejecting a stale-epoch publication; each is
-# named by at least one test in test_elastic.py / test_online.py)
-MIN_EXPECTED = 16
+# publish.fence — a worker rejecting a stale-epoch publication; the
+# experiments subsystem added experiment.spawn — a trial charge failing
+# to launch, experiment.report — a trial's rung report aborted before
+# the wire, experiment.promote — a controller dying at the promotion
+# decision; each is named by at least one test in test_elastic.py /
+# test_online.py / test_experiments.py)
+MIN_EXPECTED = 19
 
 # chaos/wire.py's rule vocabulary: RULE_KINDS = ("latency", ...) —
 # extracted by regex (same grep-grade spirit; an import would drag jax

@@ -41,6 +41,7 @@ SUBSYSTEMS = (
     "core", "io", "serving", "gateway", "registry", "parallel", "gbdt",
     "faults", "trace", "modelstore", "slo", "admission", "supervisor",
     "compiler", "online", "autoscaler", "elastic", "artifact", "chaos",
+    "experiments",
 )
 # "state" is for enum-valued gauges (e.g. the circuit-breaker gauge
 # mmlspark_gateway_breaker_state: 0=closed 1=open 2=half-open)
