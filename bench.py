@@ -2523,6 +2523,16 @@ SEGMENT_FNS = {
 # ---------------------------------------------------------------------------
 
 
+def _deliberate_wedge() -> None:
+    """Test hook (``MMLSPARK_BENCH_WEDGE_SEGMENT=<seg>``): block forever
+    on a lock that is never released, so the stall-forensics path has a
+    named frame to find — the SIGUSR2/watchdog dump must show this
+    function at the top of the wedged thread's stack."""
+    lock = threading.Lock()
+    lock.acquire()
+    lock.acquire()  # blocks forever — the dump names this frame
+
+
 def run_child() -> None:
     import jax
 
@@ -2531,6 +2541,25 @@ def run_child() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
         pass  # older jax: cache is an optimization, not a requirement
+    if os.environ.get("MMLSPARK_TPU_CPU_ASYNC_DISPATCH") != "1":
+        try:
+            # pure_callback growers deadlock against XLA:CPU async
+            # dispatch (docs/gbdt-training.md "Known issues"); the flag
+            # must land before the CPU client exists, i.e. here
+            jax.config.update("jax_cpu_enable_async_dispatch", False)
+        except Exception:
+            pass
+
+    # stall forensics: SIGUSR2 -> all-thread stack dump into the
+    # flightrec spool. The parent signals a stalled child and collects
+    # the dump BEFORE killing it, so a wedged segment names its frame in
+    # the BENCH json instead of just going missing.
+    try:
+        from mmlspark_tpu.obs import watchdog as _watchdog
+
+        _watchdog.install_sigusr2()
+    except Exception:  # noqa: BLE001 — forensics must never fail the bench
+        _watchdog = None
 
     def emit(seg: str, data: dict) -> None:
         sys.stdout.write(json.dumps({"segment": seg, "data": data}) + "\n")
@@ -2567,12 +2596,22 @@ def run_child() -> None:
             "MMLSPARK_BENCH_SEGMENTS", ",".join(SEGMENTS)
         ).split(",") if s in SEGMENT_FNS
     ]
+    wedge = os.environ.get("MMLSPARK_BENCH_WEDGE_SEGMENT")
     for seg in wanted:
+        if _watchdog is not None:
+            # heartbeat: a segment that outlives its own budget by a
+            # minute auto-dumps stacks even with no parent signaling
+            _watchdog.tick("bench.segment", deadline_s=max(
+                SEGMENT_TIMEOUT_S, SEGMENT_TIMEOUTS.get(seg, 0)) + 60)
+        if seg == wedge:
+            _deliberate_wedge()
         try:
             data = SEGMENT_FNS[seg](on_accel, n_dev)
         except Exception as e:  # noqa: BLE001
             data = {f"{seg}_error": str(e)[:200]}
         emit(seg, data)
+    if _watchdog is not None:
+        _watchdog.disarm("bench.segment")
     emit("done", {})
 
 
@@ -2725,6 +2764,59 @@ class _Assembly:
         sys.stdout.flush()
 
 
+def _collect_stall_stacks(child: _Child,
+                          timeout_s: float = 8.0) -> "dict | None":
+    """Send SIGUSR2 to a still-running child and collect the stall dump
+    it spools (obs/watchdog.py) — {thread_name: top_frame}. Returns None
+    when the child can't be signaled or no dump lands in time; stall
+    forensics must never block the harvest for long or fail it."""
+    import glob
+    import tempfile
+
+    pid = getattr(child.proc, "pid", None)
+    if pid is None or child.proc.poll() is not None:
+        return None
+    dump_dir = os.environ.get("MMLSPARK_FLIGHTREC_DIR") or os.path.join(
+        tempfile.gettempdir(), "mmlspark_flightrec"
+    )
+    pattern = os.path.join(dump_dir, "stalldump-*.json")
+    before = set(glob.glob(pattern))
+    try:
+        os.kill(pid, signal.SIGUSR2)
+    except (OSError, AttributeError, ValueError):
+        return None  # platform without SIGUSR2, or the child just died
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        new = [
+            p for p in glob.glob(pattern)
+            if p not in before and f"-{pid}-" in os.path.basename(p)
+        ]
+        if new:
+            try:  # atomic rename on the writer side: never half-written
+                with open(sorted(new)[-1]) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                return None
+            def top(stack):
+                # innermost frame that isn't the dump machinery itself:
+                # the SIGUSR2 handler runs ON the wedged main thread, so
+                # its literal top frames are obs/watchdog.py + obs/prof.py
+                # walking the stacks — the frame worth reporting is the
+                # one they interrupted
+                for fr in reversed(stack):
+                    if ("obs/watchdog.py" not in fr
+                            and "obs/prof.py" not in fr):
+                        return fr
+                return stack[-1] if stack else ""
+
+            return {
+                t.get("name", "?"): top(t.get("stack") or [])
+                for t in payload.get("threads", [])
+            }
+        time.sleep(0.25)
+    return None
+
+
 def _harvest(child: _Child, asm: _Assembly, remaining: list,
              deadline: float, on_cpu: bool, order: list) -> bool:
     """Drain records from a child until done/EOF/hang/deadline; removes
@@ -2771,6 +2863,18 @@ def _harvest(child: _Child, asm: _Assembly, remaining: list,
                 pass
             break
     was_running = child.proc.poll() is None
+    if was_running and remaining:
+        # the child is wedged on the first un-done segment: pull its
+        # all-thread stacks BEFORE the kill destroys the evidence
+        nxt = next(
+            (s for s in order if s in remaining and s not in failed_here),
+            None,
+        )
+        if nxt is not None:
+            stacks = _collect_stall_stacks(child)
+            if stacks:
+                asm.extra.setdefault("stall_stacks", {})[nxt] = stacks
+                asm._write_partial()
     child.kill()
     return was_running
 

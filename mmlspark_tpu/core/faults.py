@@ -91,6 +91,11 @@ point                     fires inside
                           publication is refused — delay stalls the 409,
                           an error kills the control op instead of
                           answering (the publisher retry path)
+``obs.watchdog_dump``     obs/watchdog.py as a stall dump is about to be
+                          spooled — an error is a failed dump write (the
+                          stall is still counted: losing the forensics
+                          must never lose the signal), delay stalls only
+                          the dump, not the monitor
 ========================  ====================================================
 
 Schedules are **seeded and step-indexed**: a rule fires by absolute step

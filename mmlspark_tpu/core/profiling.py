@@ -25,6 +25,40 @@ from mmlspark_tpu import obs
 from mmlspark_tpu.core.dataframe import DataFrame
 
 
+import time as _time
+
+# Device-time attribution: one counter splits where wall time actually
+# goes across the staged-dispatch path — phase=compile (first-call XLA
+# lowering+compile, blocked to completion), phase=execute (compiled
+# computation dispatch+run), phase=host_callback (pure_callback host
+# kernels running INSIDE a device computation — host time the device
+# waits out). Per-stage label = fused segment / pipeline stage name.
+# The first honest compile-vs-run split ahead of the Pallas/TPU arc.
+_M_DEVICE_SECONDS = obs.counter(
+    "mmlspark_device_seconds_total",
+    "Wall seconds at the compile/execute/host_callback boundaries, "
+    "by phase and pipeline stage / fused segment",
+    labels=("phase", "stage"),
+)
+
+
+@contextlib.contextmanager
+def device_phase(phase: str, stage: str) -> Iterator[None]:
+    """Attribute the wall time of a compile/execute/host_callback
+    boundary to ``mmlspark_device_seconds_total{phase,stage}``. Near-free
+    when the registry is disabled (one attribute read + perf_counter)."""
+    if not _M_DEVICE_SECONDS._on:
+        yield
+        return
+    t0 = _time.perf_counter()
+    try:
+        yield
+    finally:
+        _M_DEVICE_SECONDS.labels(phase=phase, stage=stage).inc(
+            _time.perf_counter() - t0
+        )
+
+
 @contextlib.contextmanager
 def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
     """Capture a device+host profiler trace into ``log_dir``."""
@@ -74,7 +108,8 @@ class ProfiledRun:
             for stage in _pipeline_stages(pipeline_model):
                 name = type(stage).__name__
                 with obs.span(f"pipeline.{name}") as sp:
-                    cur = stage.transform(cur)
+                    with device_phase("execute", name):
+                        cur = stage.transform(cur)
                 self.records.append((name, sp.duration_ns))
         return cur
 

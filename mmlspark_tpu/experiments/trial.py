@@ -167,6 +167,9 @@ def run_trial(
             run_train, load_training_data,
         )
     finally:
+        from mmlspark_tpu.obs import watchdog
+
+        watchdog.disarm("experiment.rung")
         stop.set()
         server.stop()
 
@@ -203,8 +206,17 @@ def _run_rungs(
             # leaderboard) is unchanged — only wall-clock suffers
             pass
     xv, yv = load_training_data(valid)
+    from mmlspark_tpu.obs import watchdog
     while rung is not None:
         t0 = time.monotonic()
+        # stall forensics: a rung whose report never lands (wedged train
+        # gang, dead controller) auto-dumps all-thread stacks well after
+        # the controller's own decision timeout would have fired
+        watchdog.tick(
+            "experiment.rung", deadline_s=max(
+                watchdog.DEFAULT_DEADLINE_S, 3 * decision_timeout_s,
+            ),
+        )
         with obs.span(
             "experiment.rung",
             attrs={"experiment": experiment, "trial": trial, "rung": rung},

@@ -72,12 +72,23 @@ def enabled() -> bool:
 def reset() -> None:
     """Zero every metric in the default registry IN PLACE (children stay
     bound — call sites pre-resolve label children for hot-path speed) and
-    drop recorded spans + flight records. Test isolation helper."""
+    drop recorded spans, flight records, profiler aggregates and watchdog
+    counters. Test isolation helper."""
+    import sys as _sys
+
     from mmlspark_tpu.obs import flightrec
 
     REGISTRY.reset()
     clear_recent_spans()
     flightrec.FLIGHT.clear()
+    # prof/watchdog state only if those modules were actually imported —
+    # reset() must not drag them (and core.faults) into every test
+    prof_mod = _sys.modules.get("mmlspark_tpu.obs.prof")
+    if prof_mod is not None:
+        prof_mod.PROFILER.reset()
+    wd_mod = _sys.modules.get("mmlspark_tpu.obs.watchdog")
+    if wd_mod is not None:
+        wd_mod.WATCHDOG.reset()
 
 
 __all__ = [

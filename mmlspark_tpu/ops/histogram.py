@@ -55,6 +55,23 @@ import numpy as np
 
 from mmlspark_tpu.parallel.compat import shard_map
 
+# The host-kernel pure_callbacks deadlock against XLA:CPU's async
+# dispatch: the callback thread's operand conversion (np.asarray on a
+# jax.Array) waits on a d2h materialization that is queued behind the
+# very computation suspended in the callback. The wedged pair was
+# captured by the stall-forensics watchdog — MainThread in
+# jax array._value under fit(), callback thread in hostgrow.py's
+# np.asarray(bins) under pure_callback_impl; see docs/gbdt-training.md
+# "Known issues". The flag is read ONCE at CPU client creation, so this
+# import-time update only protects processes that import this module
+# before their first dispatch — embedding code that runs jax first must
+# set it itself (tests/conftest.py and bench.py do). No effect on TPU.
+if os.environ.get("MMLSPARK_TPU_CPU_ASYNC_DISPATCH") != "1":
+    try:
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+    except Exception:  # pragma: no cover - option absent in this jax
+        pass
+
 NUM_BINS = 256
 
 # block sizes: DF features x NC rows per grid step; the one-hot block is
@@ -303,6 +320,25 @@ def _host_multi_kernel(
     return out.reshape(ns, b.shape[1] * nb, 3)
 
 
+_DEVICE_PHASE = None
+
+
+def _attributed(kernel, stage: str):
+    """Wrap a pure_callback host kernel so its wall time lands in
+    ``mmlspark_device_seconds_total{phase="host_callback"}`` — host time
+    the device computation sits waiting out (core/profiling.py)."""
+    def run(*args):
+        global _DEVICE_PHASE
+        if _DEVICE_PHASE is None:
+            from mmlspark_tpu.core.profiling import device_phase
+
+            _DEVICE_PHASE = device_phase
+        with _DEVICE_PHASE("host_callback", stage):
+            return kernel(*args)
+
+    return run
+
+
 def _callback(kernel, out_shape, *args) -> jnp.ndarray:
     """pure_callback with version-portable vmap handling."""
     try:
@@ -322,7 +358,10 @@ def _plane_histogram_host(
 ) -> jnp.ndarray:
     d = bins.shape[1]
     out = jax.ShapeDtypeStruct((d * num_bins, 3), jnp.float32)
-    kern = functools.partial(_host_plane_kernel, num_bins, assume_in_range)
+    kern = _attributed(
+        functools.partial(_host_plane_kernel, num_bins, assume_in_range),
+        "histogram_plane",
+    )
     if mask is None:
         return _callback(kern, out, bins, stats)
     return _callback(kern, out, bins, stats, mask)
@@ -338,8 +377,11 @@ def _multi_plane_host(
 ) -> jnp.ndarray:
     d = bins.shape[1]
     out = jax.ShapeDtypeStruct((num_slots, d * num_bins, 3), jnp.float32)
-    kern = functools.partial(
-        _host_multi_kernel, num_slots, num_bins, assume_in_range
+    kern = _attributed(
+        functools.partial(
+            _host_multi_kernel, num_slots, num_bins, assume_in_range
+        ),
+        "histogram_multi",
     )
     return _callback(kern, out, bins, stats, slot)
 

@@ -71,6 +71,7 @@ import numpy as np
 
 from mmlspark_tpu import obs
 from mmlspark_tpu.core import faults
+from mmlspark_tpu.obs import watchdog
 from mmlspark_tpu.parallel.distributed import BarrierTimeoutError
 
 _M_GEN = obs.gauge(
@@ -1626,6 +1627,10 @@ class GangContext:
         ``elastic.detect``), and — on checkpoint boundaries, coordinator
         only — grow-back and straggler policy. Raises
         :class:`HostLostError` / :class:`WorldChangedError` to abort."""
+        # stall forensics: a round that never reaches the next boundary
+        # (e.g. an allreduce wedged on a dead peer's half-open socket)
+        # auto-dumps all-thread stacks after the deadline
+        watchdog.tick("elastic.round")
         now = time.monotonic()
         if self.rounds_seen > 0:
             # boundaries are CHUNK boundaries on the scan-fused path and
@@ -1858,6 +1863,7 @@ class GangContext:
         return not self.lost and self.world_changed is None
 
     def close(self) -> None:
+        watchdog.disarm("elastic.round")  # a finished gang is not a stall
         if self.reducer is not None:
             self.reducer.close()
 

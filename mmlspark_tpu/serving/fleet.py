@@ -749,6 +749,84 @@ def run_traces_slowest(
     return "\n".join(notes)
 
 
+def scrape_profile(url: str, timeout: float = 5.0) -> Optional[str]:
+    """GET a /profile endpoint -> collapsed-stack text, or None when the
+    endpoint is unreachable / pre-profiler (404). The scrape itself
+    starts the remote sampler if it wasn't running."""
+    from mmlspark_tpu.io.clients import send_request
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+
+    if not url.rstrip("/").endswith("/profile"):
+        url = url.rstrip("/") + "/profile"
+    try:
+        resp = send_request(HTTPRequestData(url, "GET"), timeout=timeout)
+    except Exception:  # noqa: BLE001 — a dead process is a note, not a crash
+        return None
+    if resp["status_code"] != 200:
+        return None
+    body = resp["entity"]
+    if isinstance(body, bytes):
+        body = body.decode("utf-8", "replace")
+    return body
+
+
+def run_profile(
+    seconds: float = 5.0,
+    registry_url: Optional[str] = None,
+    gateway_url: Optional[str] = None,
+    worker_urls: Optional[list] = None,
+    service_name: str = "serving",
+) -> str:
+    """``fleet profile [--seconds N]``: scrape every /profile ingress
+    twice ``seconds`` apart, diff the collapsed-stack counts so only
+    samples taken *inside the window* survive, and merge the per-process
+    windows into one fleet-wide flamegraph-ready view (each stack
+    prefixed by its process label). The first scrape also starts any
+    sampler that wasn't running, so the window is live even on a fleet
+    booted without profiling."""
+    from mmlspark_tpu.obs import prof
+
+    endpoints, notes = _trace_endpoints(
+        registry_url, gateway_url, worker_urls, service_name
+    )
+    before: dict = {}
+    for ep in endpoints:
+        text = scrape_profile(ep)
+        if text is not None:
+            before[ep] = prof.parse_collapsed(text)
+    if not before:
+        notes.append(
+            f"profile: none of {len(endpoints)} endpoint(s) served /profile"
+        )
+        return "\n".join(notes)
+    time.sleep(max(0.0, float(seconds)))
+    per_process: dict = {}
+    for ep, base in before.items():
+        text = scrape_profile(ep)
+        if text is None:
+            notes.append(f"profile: {ep} vanished mid-window; skipped")
+            continue
+        window: dict = {}
+        for stack, n in prof.parse_collapsed(text).items():
+            d = n - base.get(stack, 0)
+            if d > 0:
+                window[stack] = d
+        label = ep
+        for line in text.splitlines():  # prefer the payload's own label
+            if line.startswith("# process:"):
+                label = line.split(":", 1)[1].strip() or ep
+                break
+        if label in per_process:  # two processes, same label: keep both
+            label = f"{label} {ep}"
+        per_process[label] = window
+    notes.append(
+        f"# fleet profile: {len(per_process)} process(es), "
+        f"{seconds:g}s window"
+    )
+    notes.append(prof.merge_collapsed(per_process).rstrip("\n"))
+    return "\n".join(notes)
+
+
 def run_gateway(
     registry_url: str,
     host: str = "0.0.0.0",
@@ -1187,6 +1265,19 @@ def supervisor_status_from_registry(
             f"restarts {restarts:.0f}"
         )
     return None
+
+
+def _install_forensics() -> None:
+    """Every long-running fleet role carries the same forensics kit:
+    SIGUSR1 -> flight-recorder dump, SIGUSR2 -> all-thread stall dump,
+    and the always-on sampling profiler (``MMLSPARK_PROF_HZ=0`` opts
+    out). Stall forensics: docs/observability.md."""
+    from mmlspark_tpu.obs import prof, watchdog
+    from mmlspark_tpu.obs.flightrec import install_sigusr1
+
+    install_sigusr1()
+    watchdog.install_sigusr2()
+    prof.ensure_started()
 
 
 def _serve_forever(stoppables: list, drain_s: float = 0.0) -> None:
@@ -1688,6 +1779,22 @@ def main(argv: Optional[list] = None) -> None:
         help="how many traces to render, worst first",
     )
     add_trace_endpoint_flags(trs)
+    pf = sub.add_parser(
+        "profile",
+        help="scrape every /profile ingress twice, N seconds apart, and "
+        "merge the sampling window into one fleet-wide collapsed-stack "
+        "flame view (stall forensics: docs/observability.md)",
+    )
+    pf.add_argument(
+        "url", nargs="?", default=None,
+        help="one base URL to profile directly (any /profile ingress); "
+        "omit and pass --registry/--gateway to sweep the fleet",
+    )
+    pf.add_argument(
+        "--seconds", type=float, default=5.0,
+        help="sampling window between the two scrapes",
+    )
+    add_trace_endpoint_flags(pf)
     ch = sub.add_parser(
         "chaos",
         help="drive a timed hostile-wire scenario against a live fleet: "
@@ -1787,6 +1894,16 @@ def main(argv: Optional[list] = None) -> None:
             service_name=args.service_name,
         ), flush=True)
         return
+    if args.role == "profile":
+        urls = list(args.worker or ())
+        if args.url:
+            urls.append(args.url)
+        print(run_profile(
+            args.seconds, registry_url=args.registry,
+            gateway_url=args.gateway, worker_urls=urls or None,
+            service_name=args.service_name,
+        ), flush=True)
+        return
     if args.role == "top":
         while True:
             print(
@@ -1801,6 +1918,7 @@ def main(argv: Optional[list] = None) -> None:
                 break
             time.sleep(args.watch)
     elif args.role == "train":
+        _install_forensics()
         run_train(
             args.registry, args.name, args.data, args.ckpt_dir,
             partitions=args.partitions, world_size=args.world_size,
@@ -1862,6 +1980,7 @@ def main(argv: Optional[list] = None) -> None:
     elif args.role == "trial":
         from mmlspark_tpu.experiments.trial import run_trial
 
+        _install_forensics()
         raise SystemExit(run_trial(
             args.registry, args.experiment, args.trial,
             json.loads(args.params), args.data, args.valid, args.workdir,
@@ -1873,18 +1992,14 @@ def main(argv: Optional[list] = None) -> None:
             partitions=args.partitions, status_file=args.status_file,
         ))
     elif args.role == "registry":
-        from mmlspark_tpu.obs.flightrec import install_sigusr1
-
-        install_sigusr1()
+        _install_forensics()
         reg = run_registry(
             args.host, args.port, args.ttl_s, peers=args.peer or None,
             reconcile_s=args.reconcile_s,
         )
         _serve_forever([reg])
     elif args.role == "worker":
-        from mmlspark_tpu.obs.flightrec import install_sigusr1
-
-        install_sigusr1()  # SIGUSR1 -> flight-recorder dump
+        _install_forensics()
         srv, q, stop = run_worker(
             args.registry, args.model, args.host, args.port,
             args.service_name, args.heartbeat_s, args.advertise_host,
@@ -1925,9 +2040,7 @@ def main(argv: Optional[list] = None) -> None:
         )
         _serve_forever([sup])
     elif args.role == "online":
-        from mmlspark_tpu.obs.flightrec import install_sigusr1
-
-        install_sigusr1()
+        _install_forensics()
         _stream, _loop, stopper = run_online(
             registry_url=args.registry, model=args.model, host=args.host,
             port=args.port, service_name=args.service_name,
@@ -1945,9 +2058,7 @@ def main(argv: Optional[list] = None) -> None:
         )
         _serve_forever([stopper])
     else:
-        from mmlspark_tpu.obs.flightrec import install_sigusr1
-
-        install_sigusr1()
+        _install_forensics()
         gw = run_gateway(
             args.registry, args.host, args.port, args.service_name,
             slo_targets=args.slo_targets,

@@ -48,6 +48,7 @@ from typing import Optional
 
 from mmlspark_tpu import obs
 from mmlspark_tpu.core import faults
+from mmlspark_tpu.obs import watchdog
 from mmlspark_tpu.obs.flightrec import FLIGHT
 from mmlspark_tpu.serving.admission import (
     DEADLINE_HEADER,
@@ -338,6 +339,10 @@ class _ModelQueue:
                 for r in batch
             }
         t0 = time.perf_counter()
+        # stall forensics: a handler that wedges mid-batch (lock, device
+        # hang) auto-dumps all-thread stacks; disarmed per batch so an
+        # IDLE dispatcher is never a stall (obs/watchdog.py)
+        watchdog.tick(f"modelstore.batch.{self.name}")
         try:
             if prep_err is not None:
                 raise prep_err
@@ -364,6 +369,7 @@ class _ModelQueue:
             replies = {r.id: (500, msg, {}) for r in batch}
         finally:
             disp.store.release(mv)
+            watchdog.disarm(f"modelstore.batch.{self.name}")
         svc = time.perf_counter() - t0
         self.svc_s = svc if self.svc_s <= 0 else (
             0.8 * self.svc_s + 0.2 * svc

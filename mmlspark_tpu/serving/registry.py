@@ -260,6 +260,28 @@ class DriverRegistry:
 
             def do_GET(self):
                 path_only = self.path.split("?", 1)[0]
+                if path_only == "/profile":
+                    from mmlspark_tpu.obs import prof
+                    # first scrape starts the sampler if the process
+                    # booted without it
+                    body = prof.ensure_started().profile_payload().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path_only == "/debug/threads":
+                    from mmlspark_tpu.obs import prof
+                    body = json.dumps(prof.threads_payload()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path_only == "/traces" or path_only.startswith("/traces/"):
                     tid = path_only[len("/traces/"):] or None
                     body = obs.render_traces(tid).encode()

@@ -658,6 +658,35 @@ class WorkerServer:
                     if not keep:
                         return
                     continue
+                if path_only == "/profile" and method == "GET":
+                    # sampling-profiler scrape: collapsed flame stacks,
+                    # same inline never-counted contract as /metrics.
+                    # First scrape starts the sampler, so even a process
+                    # booted without it accumulates from the moment
+                    # someone looks (obs/prof.py)
+                    from mmlspark_tpu.obs import prof
+
+                    body_out = prof.ensure_started().profile_payload()
+                    self._write_response(
+                        writer, 200, body_out.encode(), keep,
+                        {"Content-Type": "text/plain; version=0.0.4"},
+                    )
+                    if not keep:
+                        return
+                    continue
+                if path_only == "/debug/threads" and method == "GET":
+                    # instant all-thread stack dump — what is this
+                    # process standing in RIGHT NOW (no sampler needed)
+                    from mmlspark_tpu.obs import prof
+
+                    self._write_response(
+                        writer, 200,
+                        json.dumps(prof.threads_payload()).encode(), keep,
+                        {"Content-Type": "application/json"},
+                    )
+                    if not keep:
+                        return
+                    continue
                 if path_only == "/debug/dump" and method == "POST":
                     # on-demand flight-recorder dump (docs/observability.md)
                     from mmlspark_tpu.obs.flightrec import FLIGHT

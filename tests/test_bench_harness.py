@@ -228,6 +228,54 @@ def test_cpu_fallback_survives_one_stalled_segment(tmp_path, monkeypatch,
     assert f"{b.CPU_ORDER[-1]}_x" in out["extra"]
 
 
+def test_stalled_child_yields_stall_stacks_naming_the_wedge(tmp_path,
+                                                            monkeypatch):
+    """Stall forensics through the real parent/child pair: a child
+    deliberately wedged inside a segment (MMLSPARK_BENCH_WEDGE_SEGMENT)
+    is SIGUSR2'd by the harvest loop before the kill, and the collected
+    dump lands in extra["stall_stacks"] naming _deliberate_wedge as the
+    blocked frame."""
+    import time as _time
+
+    b = _load_bench()
+    monkeypatch.setattr(b, "PARTIAL_PATH", str(tmp_path / "p.json"))
+    monkeypatch.setattr(b, "SEGMENT_TIMEOUT_S", 4)
+    monkeypatch.setattr(b, "SEGMENT_TIMEOUTS", {})
+    monkeypatch.setenv("MMLSPARK_FLIGHTREC_DIR", str(tmp_path / "spool"))
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("PYTHONPATH", "PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
+                     "XLA_FLAGS")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["MMLSPARK_BENCH_WEDGE_SEGMENT"] = "serving"
+    env["MMLSPARK_FLIGHTREC_DIR"] = str(tmp_path / "spool")
+    asm = b._Assembly()
+    child = b._Child(["serving"], env)
+    remaining = ["serving"]
+    try:
+        engaged = b._harvest(child, asm, remaining,
+                             _time.monotonic() + 60, True, ["serving"])
+    finally:
+        child.kill()
+    assert engaged is True  # wedged child had to be killed
+    assert remaining == ["serving"]
+    stacks = asm.extra["stall_stacks"]["serving"]
+    assert "_deliberate_wedge" in stacks["MainThread"]
+
+
+def test_collect_stall_stacks_tolerates_pidless_child():
+    """_FakeChild-style children (and already-dead ones) have no
+    signalable pid: forensics returns None fast instead of raising —
+    the fallback-survival path must stay untouched."""
+    b = _load_bench()
+    assert b._collect_stall_stacks(
+        _FakeChild([], running_at_end=True)
+    ) is None
+
+
 def test_segment_orders_cover_all_segments():
     """TPU_ORDER and CPU_ORDER must each be a permutation of SEGMENTS —
     a segment missing from either order would silently never run on

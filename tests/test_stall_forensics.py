@@ -1,0 +1,499 @@
+"""Stall forensics: the sampling profiler, the hang watchdog, and their
+fleet exposure. The acceptance bar is the wedged-subprocess pair — a
+child with a thread blocked in a lock acquire yields an auto-spooled
+all-thread dump naming the blocking frame via BOTH the progress-counter
+watchdog and SIGUSR2 — plus /profile round-tripping through
+``fleet profile`` against a live multi-worker fleet, the
+``obs.watchdog_dump`` fault point, and the sampler's overhead budget."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core.faults import FaultPlan
+from mmlspark_tpu.obs import prof, watchdog
+from mmlspark_tpu.obs.flightrec import FLIGHT
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_forensics():
+    # an earlier in-process smoke gate may have started the global
+    # sampler via /profile; its thread would pollute the
+    # sampler-never-profiles-itself assertion
+    prof.PROFILER.stop()
+    prof.PROFILER.reset()
+    obs.reset()
+    yield
+    prof.PROFILER.stop()
+    prof.PROFILER.reset()
+    watchdog.WATCHDOG.stop()
+    watchdog.WATCHDOG.reset()
+    watchdog.WATCHDOG.poll_s = 1.0
+    obs.reset()
+
+
+def _get(port, path):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    c.request("GET", path)
+    r = c.getresponse()
+    data = r.read()
+    c.close()
+    return r.status, data
+
+
+def _wait_until(cond, timeout_s: float = 8.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _metric(name, match=None):
+    return obs.sum_samples(obs.parse_text(obs.render()), name, match or {})
+
+
+def _parked_in_test_helper(stop: threading.Event) -> None:
+    """A distinctively named frame the sampler must attribute."""
+    while not stop.wait(0.005):
+        pass
+
+
+# -- sampling profiler --------------------------------------------------------
+
+
+class TestSamplingProfiler:
+    def test_sampler_names_a_parked_thread(self):
+        stop = threading.Event()
+        t = threading.Thread(
+            target=_parked_in_test_helper, args=(stop,),
+            name="parked-worker", daemon=True,
+        )
+        t.start()
+        p = prof.SamplingProfiler(hz=200)
+        p.start()
+        try:
+            assert _wait_until(lambda: p.samples >= 20)
+        finally:
+            p.stop()
+            stop.set()
+            t.join(2)
+        text = p.collapsed()
+        mine = [ln for ln in text.splitlines()
+                if ln.startswith("parked-worker;")]
+        assert mine, text
+        # collapsed grammar: thread;frame;...;frame count
+        stack, _, n = mine[0].rpartition(" ")
+        assert int(n) >= 1
+        assert "_parked_in_test_helper" in stack
+        # the sampler never profiles itself
+        assert not any(
+            ln.startswith("mmlspark-prof-sampler;")
+            for ln in text.splitlines()
+        )
+        assert _metric("mmlspark_prof_samples_total") >= 20
+
+    def test_overflow_folds_into_one_bucket(self, monkeypatch):
+        p = prof.SamplingProfiler(hz=1000, max_stacks=3)
+        seq = iter(range(10_000))
+        monkeypatch.setattr(
+            prof, "_collapse", lambda frame: f"synthetic_stack_{next(seq)}"
+        )
+        for _ in range(8):
+            p._sample_once(skip_ident=-1)
+        for per in p._stacks.values():
+            # bound respected: max_stacks distinct + the overflow bucket
+            assert len(per) <= 3 + 1
+            assert prof._OVERFLOW_KEY in per
+        assert _metric(
+            "mmlspark_prof_drops_total", {"reason": "overflow"}
+        ) > 0
+
+    def test_threads_payload_and_collapsed_now(self):
+        payload = prof.threads_payload()
+        me = [t for t in payload["threads"]
+              if t["name"] == threading.current_thread().name]
+        assert me, payload
+        # stacks are root-first with line numbers; this test's frame is
+        # on the chain (the innermost frames are the dump walk itself)
+        assert any(
+            "test_threads_payload_and_collapsed_now" in fr
+            for fr in me[0]["stack"]
+        )
+        assert "test_threads_payload_and_collapsed_now" in me[0]["collapsed"]
+        assert payload["process"]
+        for line in prof.collapsed_now().splitlines():
+            assert line.endswith(" 1")
+
+    def test_parse_and_merge_round_trip(self):
+        text = "# process: w1\nmain;a:f;b:g 3\nmain;a:f 1\n"
+        parsed = prof.parse_collapsed(text)
+        assert parsed == {"main;a:f;b:g": 3, "main;a:f": 1}
+        merged = prof.merge_collapsed({"w1": parsed, "w2": {"main;a:f": 2}})
+        assert "w1;main;a:f;b:g 3\n" in merged
+        assert "w2;main;a:f 2\n" in merged
+        # merged text is itself parseable (fleet view feeds flamegraphs)
+        assert prof.parse_collapsed(merged)["w1;main;a:f;b:g"] == 3
+
+    def test_hz_zero_disables(self):
+        p = prof.SamplingProfiler(hz=0)
+        assert p.start().running is False
+
+    def test_profile_payload_header(self):
+        p = prof.SamplingProfiler(hz=50)
+        p.start()
+        try:
+            _wait_until(lambda: p.samples >= 3)
+            body = p.profile_payload()
+        finally:
+            p.stop()
+        assert body.startswith("# process: ")
+        assert "# hz: 50" in body and "# running: true" in body
+        assert "# overhead_ratio: " in body
+
+
+# -- watchdog -----------------------------------------------------------------
+
+
+class TestWatchdog:
+    def test_stall_dumps_once_per_episode(self, tmp_path):
+        wd = watchdog.Watchdog(poll_s=0.05)
+        dumps = []
+        orig = watchdog.dump_stacks
+        try:
+            watchdog.dump_stacks = (  # spy: count + redirect the spool
+                lambda reason, source=None, dump_dir=None: dumps.append(
+                    orig(reason, source, str(tmp_path))
+                ) or dumps[-1]
+            )
+            wd.tick("t.loop", deadline_s=0.2)
+            assert _wait_until(lambda: wd.stalls.get("t.loop") == 1)
+            time.sleep(0.4)  # silence continues: same episode, no re-dump
+            assert wd.stalls["t.loop"] == 1 and len(dumps) == 1
+            wd.tick("t.loop", deadline_s=0.2)  # progress re-arms
+            assert _wait_until(lambda: wd.stalls.get("t.loop") == 2)
+        finally:
+            watchdog.dump_stacks = orig
+            wd.stop()
+        payload = json.loads(open(dumps[0]).read())
+        assert payload["reason"] == "watchdog_stall"
+        assert payload["source"] == "t.loop"
+        assert any(t["stack"] for t in payload["threads"])
+        assert "flightrec_tail" in payload
+        assert _metric(
+            "mmlspark_watchdog_stalls_total", {"source": "t.loop"}
+        ) == 2.0
+
+    def test_disarm_pauses_and_scope_disarms(self):
+        wd = watchdog.Watchdog(poll_s=0.05)
+        try:
+            wd.tick("t.idle", deadline_s=0.15)
+            wd.disarm("t.idle")  # idle is healthy, not a stall
+            time.sleep(0.5)
+            assert wd.stalls.get("t.idle") is None
+            with wd.scope("t.block", deadline_s=30):
+                assert wd.counters()["t.block"]["armed"]
+            assert not wd.counters()["t.block"]["armed"]
+        finally:
+            wd.stop()
+
+    def test_dump_failure_still_counts_the_stall(self, tmp_path):
+        """Fault point ``obs.watchdog_dump``: chaos fails the spool
+        write; losing the dump must never lose the stall signal."""
+        wd = watchdog.Watchdog(poll_s=0.05)
+        plan = FaultPlan().on("obs.watchdog_dump", error=OSError)
+        try:
+            with plan.armed():
+                wd.tick("t.broken", deadline_s=0.2)
+                assert _wait_until(lambda: wd.stalls.get("t.broken") == 1)
+        finally:
+            wd.stop()
+        assert len(plan.fires()) >= 1
+        assert wd.last_dump is None
+        assert _metric(
+            "mmlspark_watchdog_stalls_total", {"source": "t.broken"}
+        ) == 1.0
+        # with chaos gone the same writer works
+        path = watchdog.dump_stacks("manual", dump_dir=str(tmp_path))
+        assert path and os.path.exists(path)
+
+
+# -- the acceptance bar: a wedged child names its blocking frame --------------
+
+
+_WEDGE_CHILD = """\
+import sys, threading, time
+sys.path.insert(0, {root!r})
+from mmlspark_tpu.obs import watchdog
+
+def wedge_here():
+    lock = threading.Lock()
+    lock.acquire()
+    lock.acquire()  # blocks forever; the dump must name this frame
+
+mode = sys.argv[1]
+if mode == "watchdog":
+    watchdog.WATCHDOG.poll_s = 0.1
+    watchdog.tick("demo.loop", deadline_s=0.4)
+    t = threading.Thread(target=wedge_here, name="worker-1", daemon=True)
+    t.start()
+    print("ready", flush=True)
+    time.sleep(30)
+else:  # sigusr2: the MAIN thread wedges; the parent signals it
+    watchdog.install_sigusr2()
+    print("ready", flush=True)
+    wedge_here()
+"""
+
+
+class TestWedgedSubprocess:
+    def _spawn(self, tmp_path, mode):
+        script = tmp_path / "wedge_child.py"
+        script.write_text(_WEDGE_CHILD.format(root=_ROOT))
+        env = dict(os.environ)
+        env["MMLSPARK_FLIGHTREC_DIR"] = str(tmp_path / "spool")
+        proc = subprocess.Popen(
+            [sys.executable, str(script), mode],
+            stdout=subprocess.PIPE, text=True, env=env,
+        )
+        assert proc.stdout.readline().strip() == "ready"
+        return proc, tmp_path / "spool"
+
+    def _await_dump(self, spool, reason):
+        found = []
+
+        def check():
+            if spool.is_dir():
+                found[:] = [
+                    p for p in spool.iterdir()
+                    if p.name.startswith("stalldump-")
+                    and p.name.endswith(f"-{reason}.json")
+                ]
+            return bool(found)
+
+        assert _wait_until(check, timeout_s=15), f"no {reason} dump"
+        return json.loads(found[0].read_text())
+
+    def test_watchdog_auto_dump_names_blocking_frame(self, tmp_path):
+        proc, spool = self._spawn(tmp_path, "watchdog")
+        try:
+            payload = self._await_dump(spool, "watchdog_stall")
+        finally:
+            proc.kill()
+            proc.wait()
+        assert payload["source"] == "demo.loop"
+        wedged = next(
+            t for t in payload["threads"] if t["name"] == "worker-1"
+        )
+        # innermost frame IS the blocked acquire inside wedge_here
+        assert "wedge_here" in wedged["stack"][-1]
+        assert wedged["collapsed"].endswith("wedge_child.py:wedge_here")
+
+    def test_sigusr2_dump_names_blocking_frame(self, tmp_path):
+        proc, spool = self._spawn(tmp_path, "sigusr2")
+        try:
+            time.sleep(0.3)  # let the main thread reach the lock
+            os.kill(proc.pid, signal.SIGUSR2)
+            payload = self._await_dump(spool, "sigusr2")
+        finally:
+            proc.kill()
+            proc.wait()
+        main = next(
+            t for t in payload["threads"] if t["name"] == "MainThread"
+        )
+        # the handler runs ON the wedged main thread, so the innermost
+        # frames are dump machinery — but the f_back chain (and thus the
+        # collapsed stack) still walks through the blocking frame
+        assert "wedge_here" in main["collapsed"]
+        assert any("wedge_here" in fr for fr in main["stack"])
+
+
+# -- ingress endpoints and the fleet verb -------------------------------------
+
+
+class TestEndpoints:
+    def test_worker_profile_and_debug_threads(self):
+        from mmlspark_tpu.serving import WorkerServer
+
+        srv = WorkerServer(name="profworker")
+        info = srv.start()
+        try:
+            status, body = _get(info.port, "/profile")
+            assert status == 200
+            text = body.decode()
+            assert text.startswith("# process: ")
+            # first scrape starts the sampler
+            assert "# running: true" in text
+            assert prof.PROFILER.running
+            status, body = _get(info.port, "/debug/threads")
+            assert status == 200
+            payload = json.loads(body)
+            assert payload["threads"]
+            for t in payload["threads"]:
+                assert t["name"] and isinstance(t["stack"], list)
+            # endpoint answered inline, never counted as a request
+            assert _metric(
+                "mmlspark_serving_requests_total", {"server": "profworker"}
+            ) == 0.0
+        finally:
+            srv.stop()
+
+    def test_registry_profile_and_debug_threads(self):
+        from mmlspark_tpu.serving import DriverRegistry
+
+        reg = DriverRegistry()
+        try:
+            status, body = _get(reg.port, "/profile")
+            assert status == 200
+            assert body.decode().startswith("# process: ")
+            status, body = _get(reg.port, "/debug/threads")
+            assert status == 200
+            assert json.loads(body)["threads"]
+        finally:
+            reg.stop()
+
+    def test_fleet_profile_round_trips_live_two_worker_fleet(self):
+        from mmlspark_tpu.serving import WorkerServer
+        from mmlspark_tpu.serving.fleet import run_profile, scrape_profile
+
+        w1 = WorkerServer(name="prof-a")
+        w2 = WorkerServer(name="prof-b")
+        i1, i2 = w1.start(), w2.start()
+        urls = [f"http://127.0.0.1:{i1.port}", f"http://127.0.0.1:{i2.port}"]
+        try:
+            assert scrape_profile(urls[0]).startswith("# process: ")
+            out = run_profile(seconds=0.5, worker_urls=urls)
+        finally:
+            w1.stop()
+            w2.stop()
+        assert "# fleet profile: 2 process(es)" in out
+        # both endpoints contributed a window (same process here, so the
+        # collision dedup suffixes the second label with its endpoint)
+        body = [ln for ln in out.splitlines() if not ln.startswith("#")]
+        assert any(ln for ln in body if ln), out
+
+    def test_fleet_profile_degrades_on_pre_profiler_fleet(self):
+        from mmlspark_tpu.serving.fleet import run_profile
+
+        out = run_profile(
+            seconds=0.0, worker_urls=["http://127.0.0.1:1"]
+        )
+        assert "none of 1 endpoint(s) served /profile" in out
+
+
+# -- overhead budget ----------------------------------------------------------
+
+
+@pytest.mark.xdist_group("latency")
+class TestSamplerOverhead:
+    def test_sampler_on_within_3pct_of_off(self):
+        """The always-on bar: echo latency with the 19 Hz sampler
+        running within 3% of sampler-off, paired rounds, best of 5 (the
+        same measurement discipline as the tracing-overhead gate in
+        test_traces.py — box noise swings exceed any real sampler cost,
+        so the best round carries the signal)."""
+        import numpy as np
+
+        from mmlspark_tpu.serving import (
+            ServingQuery, WorkerServer, make_reply, request_to_json,
+        )
+
+        def echo(reqs):
+            return {
+                r.id: make_reply({"echo": request_to_json(r)}) for r in reqs
+            }
+
+        srv = WorkerServer(name="prof-overhead")
+        info = srv.start()
+        q = ServingQuery(srv, echo, max_wait_ms=0).start()
+        payload = json.dumps({"x": 1})
+        conn = http.client.HTTPConnection("127.0.0.1", info.port, timeout=10)
+
+        def one() -> float:
+            t0 = time.perf_counter()
+            conn.request(
+                "POST", "/", body=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            conn.getresponse().read()
+            return time.perf_counter() - t0
+
+        sampler = prof.SamplingProfiler(hz=prof.DEFAULT_HZ)
+        try:
+            for _ in range(100):
+                one()
+            best = float("inf")
+            for _ in range(5):
+                offs, ons = [], []
+                sampler.stop()
+                for _ in range(150):
+                    offs.append(one())
+                sampler.start()
+                for _ in range(150):
+                    ons.append(one())
+                overhead = (
+                    float(np.median(ons)) - float(np.median(offs))
+                ) / float(np.median(offs))
+                best = min(best, overhead)
+                if best < 0.03:
+                    break
+        finally:
+            sampler.stop()
+            conn.close()
+            q.stop()
+            srv.stop()
+        assert best < 0.03, (
+            f"sampler-on echo latency {best * 100:.2f}% over sampler-off "
+            "(budget 3%)"
+        )
+
+
+# -- the deadlock the forensics diagnosed -------------------------------------
+
+
+_GBDT_CHILD = """\
+import sys
+sys.path.insert(0, {root!r})
+import numpy as np
+rng = np.random.default_rng(0)
+X = rng.normal(size=(7000, 20)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int32)
+from mmlspark_tpu.models.gbdt import LightGBMClassifier
+from mmlspark_tpu.core.dataframe import DataFrame
+df = DataFrame.from_dict({{"features": X, "label": y}})
+LightGBMClassifier(num_iterations=3, num_leaves=7).fit(df)
+print("done", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_gbdt_host_grower_completes_with_async_dispatch_fix(tmp_path):
+    """Regression pin for the >=6-7k-row pure_callback deadlock
+    (docs/gbdt-training.md "Known issues"): with XLA:CPU async dispatch
+    left at its default, the host grower's operand conversion deadlocked
+    against the fit's blocking value fetch — diagnosed from a watchdog
+    stall dump. ops/histogram.py now disables async dispatch at import;
+    a 7000-row fit in a fresh process must complete."""
+    script = tmp_path / "gbdt_child.py"
+    script.write_text(_GBDT_CHILD.format(root=_ROOT))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, str(script)],
+        stdout=subprocess.PIPE, text=True, env=env, timeout=600,
+    )
+    assert out.returncode == 0
+    assert "done" in out.stdout

@@ -445,6 +445,66 @@ def _verify_trace(url: str, registry_url, service: str) -> bool:
     return ok
 
 
+def _verify_profile(url: str, registry_url, service: str,
+                    overhead_bound: float = 0.05) -> bool:
+    """Stall-forensics gate (default on; ``--no-verify-profile`` to
+    skip): ``GET /profile`` must answer on the target (gateway) and —
+    with ``--registry`` — on at least one rostered worker; the scrape
+    itself starts a sampler that wasn't running, and the sampler's
+    overhead gauge must stay under ``overhead_bound`` of one core.
+    Degrades on pre-profiler builds (404 -> skip, the PR 2 precedent)."""
+    _ensure_repo_path()
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.obs import prof
+    from mmlspark_tpu.serving.fleet import (
+        scrape_metrics,
+        scrape_profile,
+        worker_urls_from_registry,
+    )
+
+    targets = [("target", url.rstrip("/"))]
+    if registry_url:
+        try:
+            workers = worker_urls_from_registry(registry_url, service)
+            if workers:
+                targets.append(("worker", workers[0]))
+        except Exception as e:  # noqa: BLE001 — gate degrades, smoke goes on
+            print(f"smoke: registry unavailable for profile gate ({e})")
+    ok = True
+    answered = 0
+    for role, base in targets:
+        text = scrape_profile(base)
+        if text is None:
+            print(f"smoke: {role} {base} does not serve /profile; skipping")
+            continue
+        answered += 1
+        # the first scrape may have just started the sampler: give it a
+        # beat so the second read sees samples + a live overhead gauge
+        time.sleep(0.3)
+        text = scrape_profile(base) or text
+        stacks = prof.parse_collapsed(text)
+        running = "# running: true" in text
+        print(
+            f"smoke: {role} /profile ok ({len(stacks)} stack(s), "
+            f"sampler {'running' if running else 'stopped'})"
+        )
+        parsed = scrape_metrics(base)
+        if parsed is not None:
+            oh = obs.sum_samples(parsed, "mmlspark_prof_overhead_ratio")
+            good = oh < overhead_bound
+            print(
+                f"smoke: {role} sampler overhead {oh:.4f} "
+                f"{'ok' if good else f'MISMATCH (>= {overhead_bound})'}"
+            )
+            ok = good and ok
+    if not answered:
+        print(
+            "smoke: no endpoint serves /profile (pre-profiler build); "
+            "skipping profile gate"
+        )
+    return ok
+
+
 def _verify_slo(url: str) -> bool:
     """SLO gate: when the target exports ``mmlspark_slo_*`` gauges, fail
     on a red (page-now) target; skip on fleets without the engine."""
@@ -964,6 +1024,11 @@ def main(argv=None) -> int:
         "requests through the gateway with a box-speed-scaled rps floor)",
     )
     ap.add_argument(
+        "--no-verify-profile", action="store_true",
+        help="skip the stall-forensics gate (GET /profile answers on the "
+        "target and one rostered worker; sampler overhead under bound)",
+    )
+    ap.add_argument(
         "--swap", action="store_true",
         help="hot-swap drill: load a new model version on every backend "
         "and swap it in while the request phase runs; the gate then "
@@ -1050,6 +1115,11 @@ def main(argv=None) -> int:
     trace_ok = True
     if not args.no_verify_trace:
         trace_ok = _verify_trace(args.url, args.registry, args.service_name)
+    profile_ok = True
+    if not args.no_verify_profile:
+        profile_ok = _verify_profile(
+            args.url, args.registry, args.service_name
+        )
     flight_ok = True
     if plan is not None:
         flight_ok = _verify_flightrec(plan, faults_before)
@@ -1071,7 +1141,7 @@ def main(argv=None) -> int:
         tune_ok = _verify_tune(args.url, args.registry, args.service_name)
     return 0 if (
         ok == n and metrics_ok and swap_ok and trace_ok and flight_ok
-        and throughput_ok and chaos_wire_ok and tune_ok
+        and throughput_ok and chaos_wire_ok and tune_ok and profile_ok
     ) else 1
 
 

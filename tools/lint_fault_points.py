@@ -53,8 +53,10 @@ _INJECT_RE = re.compile(
 # to launch, experiment.report — a trial's rung report aborted before
 # the wire, experiment.promote — a controller dying at the promotion
 # decision; each is named by at least one test in test_elastic.py /
-# test_online.py / test_experiments.py)
-MIN_EXPECTED = 19
+# test_online.py / test_experiments.py; PR 19's stall forensics added
+# obs.watchdog_dump — a stall dump failing to spool, named in
+# tests/test_stall_forensics.py)
+MIN_EXPECTED = 20
 
 # chaos/wire.py's rule vocabulary: RULE_KINDS = ("latency", ...) —
 # extracted by regex (same grep-grade spirit; an import would drag jax

@@ -42,6 +42,10 @@ SUBSYSTEMS = (
     "faults", "trace", "modelstore", "slo", "admission", "supervisor",
     "compiler", "online", "autoscaler", "elastic", "artifact", "chaos",
     "experiments",
+    # stall forensics (obs/prof.py, obs/watchdog.py, core/profiling.py):
+    # sampling profiler, hang watchdog, compile/execute/host_callback
+    # device-time attribution
+    "prof", "watchdog", "device",
 )
 # "state" is for enum-valued gauges (e.g. the circuit-breaker gauge
 # mmlspark_gateway_breaker_state: 0=closed 1=open 2=half-open)
