@@ -1718,7 +1718,15 @@ def _seg_artifact(on_accel: bool, n_dev: int) -> dict:
     pull, and the kill-mid-transfer story as a number: a peer that dies
     half-way through the body, with the fetch resuming from the byte
     offset on a second peer — resume-to-done wall seconds and the bytes
-    that did NOT have to be re-transferred. Host-side by design: runs
+    that did NOT have to be re-transferred.
+
+    PR 20 adds the push plane: replication-before-ack to two holders
+    timed against the shared-filesystem baseline it replaces (two
+    ``shutil.copyfile``), a mid-push RST with the retry resuming from
+    the receiver's durable offset (overhead and bytes saved), and
+    snapshot-to-servable — a vw snapshot put + replicated + resolved
+    from a bare-hint artifact spec into a warmed LoadedModel, the
+    no-shared-fs worker's boot path. Host-side by design: runs
     identically on every backend."""
     import hashlib
     import shutil
@@ -1824,7 +1832,103 @@ def _seg_artifact(on_accel: bool, n_dev: int) -> dict:
             after, "mmlspark_artifact_resumes_total"
         ) - obs.sum_samples(before, "mmlspark_artifact_resumes_total"))
         out["artifact_resume_saved_mb"] = round(n_bytes / 2 / 1e6, 1)
+        # what the RST cost vs an uninterrupted pull (includes the dead
+        # first peer's half-body transfer and the failover)
+        out["artifact_pull_resume_overhead_pct"] = round(
+            100.0 * (out["artifact_resume_to_done_s"] - pull_s) / pull_s, 1
+        )
         trunc.close()
+
+        # -- push + replicate vs the shared-fs copy it replaces ----------
+        holder_a = ArtifactStore(os.path.join(work, "holder-a"))
+        holder_b = ArtifactStore(os.path.join(work, "holder-b"))
+        srv_a = ArtifactServer(holder_a)
+        srv_b = ArtifactServer(holder_b)
+        t0 = time.perf_counter()
+        confirmed = producer.replicate(
+            ref.digest, [srv_a.url, srv_b.url], need=2, backoffs_ms=(10,)
+        )
+        repl_s = time.perf_counter() - t0
+        out["artifact_push_replicate_2_s"] = round(repl_s, 3)
+        out["artifact_push_replicate_2_mb_s"] = round(
+            2 * n_bytes / 1e6 / repl_s, 1
+        )
+        assert len(confirmed) == 2
+        t0 = time.perf_counter()
+        shutil.copyfile(src, os.path.join(work, "copy-a.bin"))
+        shutil.copyfile(src, os.path.join(work, "copy-b.bin"))
+        copy_s = max(time.perf_counter() - t0, 1e-9)
+        out["artifact_copy_2_s"] = round(copy_s, 3)
+        out["artifact_push_replicate_vs_copy_x"] = round(repl_s / copy_s, 1)
+
+        # -- mid-push RST -> retry resumes from the receiver's offset ----
+        from mmlspark_tpu.chaos.wire import ChaosProxy, WireRule
+
+        holder_c = ArtifactStore(os.path.join(work, "holder-c"))
+        srv_c = ArtifactServer(holder_c)
+        t0 = time.perf_counter()
+        producer.push_to(srv_c.url, ref.digest)
+        clean_push_s = max(time.perf_counter() - t0, 1e-9)
+        holder_d = ArtifactStore(os.path.join(work, "holder-d"))
+        srv_d = ArtifactServer(holder_d)
+        # conn 0 is the offset probe, conn 1 the first 16 MiB window,
+        # conn 2 the second — RST conn 2 mid-flight, so the receiver's
+        # durable offset (windows install atomically) is one full window
+        # the retry never re-sends
+        wire = ChaosProxy(
+            "127.0.0.1", srv_d.port,
+            rules=[WireRule(
+                "truncate_rst", direction="c2s",
+                at_offset=1 << 20, conns=frozenset({2}),
+            )],
+        )
+        wire.start()
+        t0 = time.perf_counter()
+        try:
+            producer.push_to(f"http://127.0.0.1:{wire.port}", ref.digest)
+        except Exception:  # noqa: BLE001 — the RST is the point
+            pass
+        part = os.path.join(holder_d.root, "partial", ref.digest + ".push")
+        saved = os.path.getsize(part) if os.path.exists(part) else 0
+        producer.push_to(srv_d.url, ref.digest)
+        rst_push_s = time.perf_counter() - t0
+        wire.stop()
+        out["artifact_push_rst_to_done_s"] = round(rst_push_s, 3)
+        out["artifact_push_resume_saved_mb"] = round(saved / 1e6, 1)
+        out["artifact_push_resume_overhead_pct"] = round(
+            100.0 * (rst_push_s - clean_push_s) / clean_push_s, 1
+        )
+
+        # -- snapshot-to-servable: the no-shared-fs worker's boot path ---
+        from mmlspark_tpu.serving.modelstore.loaders import (
+            build_loaded_model,
+        )
+
+        n_bits = 16
+        snap = os.path.join(work, "bench-nofs-v000001.npz")
+        meta = {"num_bits": n_bits, "loss": "logistic",
+                "no_constant": False, "quantile_tau": 0.5}
+        with open(snap, "wb") as f:
+            np.savez(
+                f,
+                weights=np.zeros(1 << n_bits, np.float32),
+                meta=json.dumps(meta).encode(),
+            )
+        pub = ArtifactStore(os.path.join(work, "nofs-pub"))
+        t0 = time.perf_counter()
+        ref2 = pub.put(snap, name=os.path.basename(snap))
+        srv_p = ArtifactServer(pub)
+        pub.replicate(ref2.digest, [srv_a.url], need=1, backoffs_ms=(10,))
+        lm = build_loaded_model(
+            f"artifact:vw:{ref2.spec}@{srv_a.url}"
+        )
+        lm.warmup()
+        out["artifact_snapshot_to_servable_s"] = round(
+            time.perf_counter() - t0, 3
+        )
+        lm.release()
+        for s in (srv_a, srv_b, srv_c, srv_d, srv_p):
+            s.stop()
         srv.stop()
     finally:
         shutil.rmtree(work, ignore_errors=True)

@@ -138,6 +138,7 @@ class ExperimentController:
         higher_is_better: bool = True,
         workdir: Optional[str] = None,
         spawn_cmd: Optional[str] = None,
+        placement: Any = None,
         python: Optional[str] = None,
         tick_s: float = 0.25,
         heartbeat_s: float = 0.5,
@@ -152,7 +153,10 @@ class ExperimentController:
         deadline_s: float = 600.0,
     ):
         from mmlspark_tpu.serving.fleet import split_registry_urls
-        from mmlspark_tpu.serving.supervisor import spawn_from_template
+        from mmlspark_tpu.serving.supervisor import (
+            placement_from_spec,
+            spawn_from_template,
+        )
 
         if n_trials < 1:
             raise ValueError("n_trials must be >= 1")
@@ -175,10 +179,20 @@ class ExperimentController:
         self.workdir = workdir or os.path.join(
             os.getcwd(), f".experiments-{experiment}"
         )
-        self._spawn_fn = (
-            spawn_from_template(spawn_cmd) if spawn_cmd
-            else lambda argv: subprocess.Popen(argv)
-        )
+        # trial placement mirrors the supervisor's hook: a
+        # PlacementProvider (or its --placement spec string) decides
+        # where trial processes land — remotely-placed trials publish
+        # rung reports and model bytes through the artifact plane, so
+        # the controller never needs to share a filesystem with them
+        if isinstance(placement, str):
+            placement = placement_from_spec(placement)
+        if placement is not None:
+            self._spawn_fn = placement.spawn
+        else:
+            self._spawn_fn = (
+                spawn_from_template(spawn_cmd) if spawn_cmd
+                else lambda argv: subprocess.Popen(argv)
+            )
         self.python = python
         self.tick_s = tick_s
         self.heartbeat_s = heartbeat_s
@@ -368,6 +382,52 @@ class ExperimentController:
                 except Exception:  # noqa: BLE001 — retried next tick
                     pass
 
+    def _recover_winner(self, state: records.ExperimentState) -> None:
+        """The PR 17 stranded-winner residual, closed: a successor
+        controller that finds ``<exp>-winner-gen`` committed but holds
+        none of the model bytes re-pulls them by digest — the record's
+        spec hints first (they name the holders that confirmed at commit
+        time), then every registry-advertised peer. Only when NOBODY
+        advertises the digest does it fall back to the deterministic
+        retrain: respawn the winner trial, whose same params + seed
+        re-derive the byte-identical model under the exact committed
+        digest (experiments/trial.py re-runs the final rung when it is
+        the unadvertised committed winner)."""
+        if state.winner is None or self._store is None:
+            return
+        digest = state.winner.get("model")
+        if not digest or self._store.has(digest):
+            return
+        own = self._server.url if self._server is not None else None
+        hints: list = []
+        tail = (state.winner.get("spec") or "").rsplit("@", 1)[-1]
+        if tail.startswith("http"):
+            hints = [u for u in tail.split(",") if u and u != own]
+        from mmlspark_tpu.serving.artifacts import registry_peers
+
+        peers = hints + [
+            p for p in registry_peers(self.urls, digest)
+            if p != own and p not in hints
+        ]
+        if peers:
+            try:
+                self._store.fetch(
+                    digest, peers,
+                    name=f"{state.winner.get('trial', 'winner')}.gbdt.json",
+                    timeout_s=10.0,
+                )
+                self._server.heartbeat()  # advertise the recovered copy
+                return
+            except Exception:  # noqa: BLE001 — every peer gone: retrain
+                pass
+        trial = state.winner.get("trial")
+        if (
+            trial and trial in self.params
+            and trial not in self.charges
+            and not self._is_live_elsewhere(trial, state)
+        ):
+            self._spawn(trial)
+
     # -- decisions ------------------------------------------------------------
 
     def _survivors(self, rung: int, state: records.ExperimentState) -> list:
@@ -426,11 +486,43 @@ class ExperimentController:
             # the record appears, so committing first would tear down
             # the last advertiser before replication — retried next tick
             return
+        # replicate-before-commit: push the winner bytes to every other
+        # rostered artifact plane (serving workers, lingering trials)
+        # BEFORE the record lands, and bake the confirmed holders into
+        # the record's spec hints — a controller SIGKILLed right after
+        # this commit strands nothing a successor (or a worker's own
+        # resolve path) cannot re-pull. Best-effort by design: with no
+        # other holders on the roster our store + the lingering trial
+        # still cover the normal path, and the successor's
+        # deterministic-retrain fallback covers the rest.
+        confirmed: list = []
+        if self._store is not None:
+            from mmlspark_tpu.serving.artifacts import registry_holders
+
+            own = [self._server.url] if self._server is not None else []
+            try:
+                # exclude the experiment's own ephemeral plane: a
+                # replica confirmed on a lingering trial (or this very
+                # controller) dies with the experiment — only DURABLE
+                # holders (serving workers, gang members) count
+                holders = registry_holders(
+                    self.urls, exclude=own,
+                    exclude_services=[f"{self.experiment}-artifacts"],
+                )
+                if holders:
+                    confirmed = self._store.replicate(
+                        report["model"], holders,
+                        need=min(1, len(holders)), timeout_s=10.0,
+                    )
+            except Exception:  # noqa: BLE001 — below quorum: commit
+                confirmed = []  # proceeds on the local + trial copies
         spec = (
             f"artifact:gbdt:{winner}-r{final}.gbdt.json@{report['model']}"
         )
-        if self._server is not None:
-            spec += f"@{self._server.url}"
+        hints = [self._server.url] if self._server is not None else []
+        hints += [u for u in confirmed if u not in hints]
+        if hints:
+            spec += "@" + ",".join(hints)
         rec = {
             "trial": winner,
             "metric": float(report["metric"]),
@@ -532,6 +624,7 @@ class ExperimentController:
         _M_RUNGS.set(len(state.rungs))
         self._reap_demoted(state)
         self._reap_and_respawn(state)
+        self._recover_winner(state)
         self._commit_winner(state)
         self._publish_winner(state)
         self._write_status(state)

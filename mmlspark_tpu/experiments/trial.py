@@ -186,7 +186,25 @@ def _run_rungs(
         return EXIT_NO_DECISION
     rung = asha.next_rung(trial, state.reports, boundaries)
     if rung is None:
-        return EXIT_COMPLETED  # a twin already finished this trial
+        # a twin already finished this trial — but if WE are the
+        # committed winner and the model bytes have no live advertiser
+        # (a controller died between winner-commit and publish, taking
+        # its store with it; a successor respawned us to recover), the
+        # bytes must be RE-DERIVED: re-run the final rung — training is
+        # deterministic (same params, same seed), so the byte-identical
+        # model lands back under the exact committed digest — and
+        # linger until a replica lands elsewhere (docs/robustness.md).
+        w = state.winner
+        if (
+            w is not None and w.get("trial") == trial and w.get("model")
+            and not [
+                p for p in registry_peers(urls, w["model"])
+                if p != server.url
+            ]
+        ):
+            rung = len(boundaries) - 1
+        else:
+            return EXIT_COMPLETED
     if asha.is_demoted(trial, rung, state.rungs):
         return EXIT_DEMOTED
     if rung > 0 and not os.path.exists(os.path.join(ckpt_dir, "LATEST")):
@@ -256,6 +274,14 @@ def _run_rungs(
             # before it commits the winner (exiting now would strand the
             # digest with no advertiser — the publish path would starve)
             _await_winner(urls, experiment, poll_s, decision_timeout_s)
+            # and if the committed winner names OUR bytes, hold the
+            # server open until some OTHER peer advertises the digest —
+            # exiting while we are the only advertiser re-opens the
+            # stranded-winner window this linger exists to close
+            _await_replica(
+                urls, experiment, trial, model.digest, server,
+                registry_peers, poll_s, decision_timeout_s,
+            )
             return EXIT_COMPLETED
         verdict = _await_decision(
             urls, experiment, trial, rung, poll_s, decision_timeout_s,
@@ -291,6 +317,41 @@ def _await_winner(
                 return
         except records.ExperimentWireError:
             pass
+        time.sleep(poll_s)
+
+
+def _await_replica(
+    urls: list, exp: str, trial: str, digest: str, server: Any,
+    registry_peers: Any, poll_s: float, timeout_s: float,
+) -> None:
+    """Linger while this process is the committed winner's ONLY
+    advertiser: return once another peer (the controller's store, a
+    worker, a successor controller) advertises ``digest`` — or the
+    bounded timeout passes. A controller killed between winner-commit
+    and publish leaves a successor that must re-pull these exact bytes;
+    this window is what it pulls through."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            state = records.read_state(urls, exp)
+        except records.ExperimentWireError:
+            state = None
+        if state is None or state.winner is None:
+            return  # record gone / unreadable: nothing left to guard
+        if (
+            state.winner.get("trial") != trial
+            or state.winner.get("model") != digest
+        ):
+            return  # not our bytes: not our guard
+        try:
+            others = [
+                p for p in registry_peers(urls, digest) if p != server.url
+            ]
+        except Exception:  # noqa: BLE001 — registry blinked; poll again
+            others = []
+        if others:
+            return
+        server.heartbeat()  # keep the advertisement fresh meanwhile
         time.sleep(poll_s)
 
 

@@ -94,6 +94,7 @@ class Publisher:
         artifact_store: Any = None,
         artifact_url: Optional[str] = None,
         epoch: Optional[int] = None,
+        replicas: int = 0,
     ):
         """``artifact_store`` (an :class:`~mmlspark_tpu.serving.artifacts.
         ArtifactStore`) switches publication to **artifact mode**: each
@@ -111,7 +112,17 @@ class Publisher:
         the publication with 409 (a SIGSTOP'd zombie coordinator waking
         after a reshard cannot roll the serving fleet back). Bump it
         with :meth:`set_epoch` when the gang reshards; None publishes
-        unstamped (pre-fencing behaviour)."""
+        unstamped (pre-fencing behaviour).
+
+        ``replicas``: replication-before-ack (artifact mode only) — the
+        snapshot blob must be confirmed installed on this many OTHER
+        artifact holders (registry-advertised artifact planes and/or the
+        explicit ``worker_urls``) BEFORE any target is driven to load
+        it; below quorum the publication raises and the serving alias is
+        untouched. The confirmed holders ride the published spec as peer
+        hints, so a worker can pull the snapshot even after this
+        process's host is gone — the no-shared-fs durability contract.
+        0 (default) keeps the single-copy behaviour."""
         if store is None and not worker_urls and not registry_url:
             raise ValueError(
                 "Publisher needs a target: store=, worker_urls= or "
@@ -130,6 +141,7 @@ class Publisher:
         self._now = time_fn
         self.artifact_store = artifact_store
         self.artifact_url = artifact_url
+        self.replicas = max(0, int(replicas))
         self.epoch = int(epoch) if epoch is not None else None
         # version ledger for _gc: (snapshot path, artifact digest | None)
         # in publication order — GC never touches a version it cannot
@@ -227,6 +239,28 @@ class Publisher:
 
     # -- targets -------------------------------------------------------------
 
+    def _replica_holders(self) -> list:
+        """Candidate push targets for replication-before-ack: every
+        registry-rostered artifact plane that is not this process, plus
+        the explicit worker URLs (their ingress serves ``/artifacts``
+        too)."""
+        own = (
+            [self.artifact_url.rstrip("/")] if self.artifact_url else []
+        )
+        holders: list = []
+        if self.registry_url:
+            from mmlspark_tpu.serving.artifacts import registry_holders
+
+            try:
+                holders = registry_holders(self.registry_url, exclude=own)
+            except Exception:  # noqa: BLE001 — worker_urls still replicate
+                holders = []
+        for u in self.worker_urls:
+            u = u.rstrip("/")
+            if u not in holders and u not in own:
+                holders.append(u)
+        return holders
+
     def _publish_store(self, spec: str) -> int:
         v = self.store.load(self.model, spec, wait=True, activate="never")
         self.store.swap(self.model, v)
@@ -289,6 +323,7 @@ class Publisher:
         is unchanged and the caller retries with the same watermark."""
         t0 = self._now()
         _M_ATTEMPTS.inc()
+        replicated: list = []
         try:
             # fault point online.publish: an injected error aborts the
             # publication before anything is written or loaded
@@ -305,9 +340,30 @@ class Publisher:
                     path, name=os.path.basename(path)
                 )
                 digest = ref.digest
+                hints = (
+                    [self.artifact_url.rstrip("/")]
+                    if self.artifact_url else []
+                )
+                if self.replicas > 0:
+                    # replication-before-ack: the snapshot must be
+                    # durable on `replicas` OTHER holders before any
+                    # worker is told to load it — below quorum this
+                    # raises (wrapped into PublishError) and the alias
+                    # stays put. Confirmed holders become spec hints so
+                    # pullers survive this host dying.
+                    confirmed = self.artifact_store.replicate(
+                        digest, self._replica_holders(),
+                        need=self.replicas,
+                        timeout_s=self.request_timeout_s,
+                    )
+                    replicated = list(confirmed)
+                    hints += [
+                        u.rstrip("/") for u in confirmed
+                        if u.rstrip("/") not in hints
+                    ]
                 spec = f"artifact:vw:{ref.spec}"
-                if self.artifact_url:
-                    spec += f"@{self.artifact_url.rstrip('/')}"
+                if hints:
+                    spec += "@" + ",".join(hints)
             else:
                 spec = f"vw:{path}"
             self._published.append((path, digest))
@@ -343,6 +399,7 @@ class Publisher:
             "path": path,
             "targets": targets,
             "freshness_s": freshness,
+            "replicas": replicated,
         }
 
     def publish_spec(self, spec: str) -> dict:

@@ -634,6 +634,25 @@ class GangMember:
                 peers.append(f"http://{e.get('addr', '127.0.0.1')}:{port}")
         return peers
 
+    def artifact_holders(self, members: Any = None) -> list:
+        """Gang members running an artifact ingress -> base URLs (the
+        push targets for snapshot replicate-before-commit); ``members``
+        narrows to a generation's roster. Unlike
+        :meth:`artifact_peers`, holders need not already advertise a
+        digest — they are where the digest is going."""
+        ros = self.roster() or {}
+        urls = []
+        for name in sorted(ros):
+            if name == self.name:
+                continue
+            if members is not None and name not in members:
+                continue
+            e = ros[name]
+            port = e.get("artifact_port")
+            if port:
+                urls.append(f"http://{e.get('addr', '127.0.0.1')}:{port}")
+        return urls
+
     def heartbeat(self) -> None:
         """One registration beat to every registry (also refreshes the
         adopted generation record's TTL).
@@ -1514,6 +1533,9 @@ class GangContext:
         self.first_round_done_t: Optional[float] = None
         self._join_seq = 0
         self.flagged_stragglers: list = []
+        # where replicate-before-commit bookkeeping lands (the owning
+        # ElasticTrainer points this at its status dict)
+        self.status_sink: Optional[dict] = None
 
     # -- data movement --------------------------------------------------------
 
@@ -1776,6 +1798,12 @@ class GangContext:
                 snapshot=snap,
                 snapshot_digest=digest,
             )
+            # replicate-before-commit: the joiners (and any survivor
+            # that outlives this host) must be able to pull the agreed
+            # resume bytes even if this host dies right after the CAS
+            replicate_snapshot(
+                self.member, digest, g.members, status=self.status_sink
+            )
             self.member.commit_generation(g)
             _M_RESHARDS.labels(reason="grow").inc()
             self.world_changed = g.gen
@@ -1807,6 +1835,9 @@ class GangContext:
                         **self.generation.evicted,
                         **{m: ros.get(m, {}).get("boot") for m in evictable},
                     },
+                )
+                replicate_snapshot(
+                    self.member, digest, g.members, status=self.status_sink
                 )
                 self.member.commit_generation(g)
                 _M_RESHARDS.labels(reason="straggler").inc()
@@ -1968,6 +1999,50 @@ def snapshot_checkpoint(ckpt_dir: str, gen: int) -> tuple:
 # -- the elastic trainer ------------------------------------------------------
 
 
+def replicate_snapshot(
+    member: GangMember,
+    digest: Optional[str],
+    members: list,
+    status: Optional[dict] = None,
+    timeout_s: float = 30.0,
+) -> int:
+    """Replicate-before-commit for the training plane: push a frozen
+    snapshot to the other artifact ingresses of the generation about to
+    be committed, so the committed record never names bytes only the
+    coordinator's host holds — a coordinator SIGKILLed right after the
+    commit leaves the resume point pullable from the survivors. Quorum
+    target: a majority of the NEW world counting the local copy
+    (``len(members) // 2`` remote confirms). Below quorum this DEGRADES
+    (the commit proceeds; the shortfall is recorded in ``status``)
+    instead of raising: a lone survivor must still be able to reshard,
+    and a missed replica costs a re-pull from the coordinator or at
+    worst a retrainable round — strict replication-before-ack lives on
+    the publish planes (Publisher, experiments winner) where a lost
+    blob means a lost model."""
+    store = member.artifact_store
+    if digest is None or store is None:
+        return 0
+    holders = member.artifact_holders(members)
+    majority = len(members) // 2
+    need = min(majority, len(holders))
+    confirmed = 0
+    if need > 0:
+        try:
+            confirmed = len(store.replicate(
+                digest, holders, need=need, timeout_s=timeout_s,
+                backoffs_ms=(100, 300),
+            ))
+        except Exception:  # noqa: BLE001 — below quorum / refused round
+            confirmed = 0
+    if status is not None:
+        status["snapshot_replicas"] = confirmed
+        if confirmed < majority:
+            status["snapshot_replica_shortfalls"] = (
+                status.get("snapshot_replica_shortfalls", 0) + 1
+            )
+    return confirmed
+
+
 class ElasticTrainer:
     """Drive one host's share of an elastic GBDT training run.
 
@@ -2009,6 +2084,7 @@ class ElasticTrainer:
         n_rows: Optional[int] = None,
         n_features: Optional[int] = None,
         sketch_bits: int = 16,
+        on_complete: Optional[Callable[[Any], None]] = None,
     ):
         """``artifact_dir``: enables **artifact mode** — ``ckpt_dir`` is
         treated as HOST-LOCAL (every member writes its own checkpoints),
@@ -2071,6 +2147,10 @@ class ElasticTrainer:
         self.min_world = min_world
         self.status_file = status_file
         self.allow_growback = allow_growback
+        # runs with the finished booster BEFORE the done status flush:
+        # anything a status-file watcher will read the moment it sees
+        # ``done`` (e.g. the exported model file) must be durable first
+        self.on_complete = on_complete
         self.artifact_dir = artifact_dir
         # chaos-proxy/NAT support: bind the allreduce listener to a fixed
         # port and/or advertise a different one on the roster (peers dial
@@ -2108,6 +2188,11 @@ class ElasticTrainer:
             # fleet's status files
             "parked": False, "parks": 0, "park_reasons": [],
             "committed_gens": [], "commit_acks": 0,
+            # replicate-before-commit bookkeeping: confirmed replica
+            # pushes of the latest frozen snapshot, and commits that
+            # went ahead despite a replication shortfall (liveness
+            # outranks strictness on the training plane)
+            "snapshot_replicas": 0, "snapshot_replica_shortfalls": 0,
         }
 
     # -- status ---------------------------------------------------------------
@@ -2159,6 +2244,8 @@ class ElasticTrainer:
             while True:
                 booster = self._train_generation(member, gen)
                 if booster is not None:
+                    if self.on_complete is not None:
+                        self.on_complete(booster)
                     self.status["done"] = True
                     self._write_status()
                     return booster
@@ -2222,6 +2309,7 @@ class ElasticTrainer:
                 else None
             ),
         )
+        gang.status_sink = self.status
         self.status.update(
             gen=gen.gen, members=sorted(gen.members), parked=False,
         )
@@ -2570,6 +2658,10 @@ class ElasticTrainer:
                     # to shared-dir semantics rather than blocking recovery
                     digest = None
             self.status.update(snapshot=snap, resume_round=resume_round)
+            # replicate-before-commit: fellow survivors hold the frozen
+            # resume point BEFORE the shrunk generation is committed —
+            # this host dying post-commit strands nothing
+            replicate_snapshot(member, digest, survivors, status=self.status)
             member.commit_generation(Generation(
                 gen=gen.gen + 1, members=survivors, reason="lost",
                 resume_round=resume_round, snapshot=snap,
@@ -2709,6 +2801,7 @@ __all__ = [
     "load_training_data",
     "member_row_slice",
     "partition_bounds",
+    "replicate_snapshot",
     "snapshot_checkpoint",
     "stream_from_dataframe",
 ]

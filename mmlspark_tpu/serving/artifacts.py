@@ -31,10 +31,22 @@ Transfer contract (docs/artifacts.md):
   any bytes land; the store itself is LRU-bounded (``max_bytes``) and
   never evicts pinned or mid-pull artifacts.
 
-Fault points ``artifact.put`` (a refused push), ``artifact.fetch`` (one
-transfer attempt dies / stalls) and ``artifact.verify`` (a forced
+Since PR 20 the plane also replicates the other way: a producer that is
+about to become load-bearing state (a Publisher snapshot, a reshard
+checkpoint, an experiment winner) PUSHES its blob to N replica holders
+over ``PUT /artifacts/<digest>`` (windowed ``Content-Range`` uploads
+with the holder's recorded offset as the resume currency) and only
+acks — publishes, commits the generation — once a quorum of holders has
+verified and installed the digest (**replication-before-ack**). A
+SIGKILLed source host then never strands the only copy: consumers pull
+by digest from any surviving holder through the fetch path above.
+
+Fault points ``artifact.put`` (a refused store), ``artifact.fetch`` (one
+transfer attempt dies / stalls), ``artifact.verify`` (a forced
 verification failure — drives the quarantine + re-fetch-elsewhere path
-without corrupting anything) make all of the above first-class chaos.
+without corrupting anything), ``artifact.push`` (one push attempt to one
+holder dies) and ``artifact.replicate`` (the whole replication round
+refused) make all of the above first-class chaos.
 """
 
 from __future__ import annotations
@@ -76,6 +88,21 @@ _M_RESUMES = obs.counter(
     "mmlspark_artifact_resumes_total",
     "Transfers resumed from a partial file via a Range request",
 )
+_M_PUSHES = obs.counter(
+    "mmlspark_artifacts_pushes_total",
+    "Push attempts to one replica holder, by outcome (ok / resumed / failed)",
+    labels=("outcome",),
+)
+_M_REPLICAS = obs.counter(
+    "mmlspark_artifacts_replicas_total",
+    "Replica confirmations by outcome (confirmed / failed / below_quorum)",
+    labels=("outcome",),
+)
+_M_PULL_RESUMES = obs.counter(
+    "mmlspark_artifacts_pull_resumes_total",
+    "Pulls resumed from a partial file via a Range request "
+    "(successor of mmlspark_artifact_resumes_total, kept in lockstep)",
+)
 _M_VERIFY_FAIL = obs.counter(
     "mmlspark_artifact_verify_failures_total",
     "Completed transfers or cache hits whose sha256 did not match",
@@ -115,6 +142,15 @@ class ArtifactVerifyError(ArtifactError):
 
 class ArtifactFetchError(ArtifactError):
     """Every peer was exhausted without a verified copy landing."""
+
+
+class ArtifactPushError(ArtifactError):
+    """One push attempt to one replica holder failed for good."""
+
+
+class ArtifactReplicationError(ArtifactError):
+    """Fewer holders confirmed the digest than the required quorum —
+    replication-before-ack raises here instead of false-acking."""
 
 
 @dataclass
@@ -257,6 +293,10 @@ class ArtifactStore:
         # and quarantine good bytes; the loser of the race gets a cache
         # hit instead
         self._fetch_locks: dict = {}
+        # one in-flight PUSH per digest per process on the receiving
+        # side: two pushers interleaving appends into the same partial
+        # would corrupt both transfers
+        self._push_locks: dict = {}
         self._index: dict[str, ArtifactRef] = {}
         self._last_used: dict[str, float] = {}
         self._pinned: set = set()
@@ -501,10 +541,24 @@ class ArtifactStore:
 
     # -- HTTP serving (called inline by WorkerServer's ingress) ---------------
 
-    def handle_http(self, path_only: str, headers: dict) -> tuple:
+    def handle_http(
+        self,
+        path_only: str,
+        headers: dict,
+        method: str = "GET",
+        body: bytes = b"",
+    ) -> tuple:
         """``GET /artifacts`` -> advertisement JSON; ``GET /artifacts/
         <digest>`` -> the blob (206 + Content-Range under a ``Range:
-        bytes=<start>-`` header). Returns ``(code, body, headers)``."""
+        bytes=<start>-`` header); ``PUT /artifacts/<digest>`` -> accept a
+        pushed replica window (:meth:`_handle_push`). Returns ``(code,
+        body, headers)``."""
+        if method in ("PUT", "POST"):
+            if path_only.rstrip("/") == "/artifacts":
+                return 405, b"push addresses a digest", {}
+            return self._handle_push(
+                path_only[len("/artifacts/"):], headers, body
+            )
         if path_only.rstrip("/") == "/artifacts":
             with self._lock:
                 body = json.dumps({
@@ -557,6 +611,273 @@ class ArtifactStore:
                 self._active[digest] = max(0, self._active.get(digest, 1) - 1)
                 if not self._active[digest]:
                     del self._active[digest]
+
+    # -- push receiving (replica-holder side) ---------------------------------
+
+    def _handle_push(self, digest: str, headers: dict, body: bytes) -> tuple:
+        """Accept one pushed window of ``digest``. Protocol (the server
+        analogue of :meth:`push_to` — docs/robustness.md "Artifact
+        plane"):
+
+        - ``Content-Range: bytes */<total>`` + empty body is a PROBE:
+          answers 308 with ``X-Artifact-Offset: <recorded offset>`` so a
+          pusher resumes exactly where the last push died (200 if the
+          digest is already installed — pushes are idempotent).
+        - ``Content-Range: bytes <s>-<e>/<total>`` + body appends a
+          window; a start that disagrees with the recorded offset gets
+          409 + the offset (the pusher resyncs — this, not trust, is how
+          a truncated push resumes). 202 + offset while incomplete.
+        - On the final window the whole partial is sha256-verified
+          BEFORE install: a flipped byte quarantines the bytes and
+          answers 422 — a corrupt replica can never be installed, so it
+          can never count toward a replication quorum.
+        """
+        if not _DIGEST_RE.match(digest):
+            return 400, b"malformed digest", {}
+        m = re.match(
+            r"bytes (?:(\d+)-(\d+)|\*)/(\d+)$",
+            headers.get("content-range", ""),
+        )
+        if m is None:
+            return 400, (
+                b"push needs Content-Range: bytes <s>-<e>/<total> "
+                b"(or bytes */<total> to probe)"
+            ), {}
+        total = int(m.group(3))
+        if total <= 0:
+            return 400, b"refusing zero-length artifact", {}
+        if total > self.max_artifact_bytes:
+            return 413, (
+                f"artifact is {total} bytes > max "
+                f"{self.max_artifact_bytes}".encode()
+            ), {}
+        name = headers.get("x-artifact-name") or digest[:12]
+        with self._lock:
+            plock = self._push_locks.setdefault(digest, threading.Lock())
+        with plock:
+            if self.has(digest):
+                return 200, b"already stored", {
+                    "X-Artifact-Offset": str(total),
+                }
+            part = os.path.join(self.root, "partial", digest + ".push")
+            have = os.path.getsize(part) if os.path.exists(part) else 0
+            if m.group(1) is None:
+                return 308, b"", {"X-Artifact-Offset": str(have)}
+            start = int(m.group(1))
+            if start != have:
+                return 409, b"offset mismatch", {
+                    "X-Artifact-Offset": str(have),
+                }
+            if len(body) != int(m.group(2)) - start + 1:
+                return 400, b"body length disagrees with Content-Range", {
+                    "X-Artifact-Offset": str(have),
+                }
+            if have + len(body) > total:
+                try:
+                    os.remove(part)
+                except OSError:
+                    pass
+                return 409, b"overshoot, restarting", {
+                    "X-Artifact-Offset": "0",
+                }
+            with open(part, "ab" if have else "wb") as out:
+                out.write(body)
+            have += len(body)
+            _M_BYTES.labels(direction="received").inc(len(body))
+            if have < total:
+                return 202, b"", {"X-Artifact-Offset": str(have)}
+            # complete: verify BEFORE install — a flipped byte on the
+            # wire must never become a servable (quorum-countable) copy
+            if sha256_file(part) != digest:
+                _M_VERIFY_FAIL.inc()
+                _M_QUARANTINES.inc()
+                try:
+                    os.replace(part, os.path.join(
+                        self.root, "quarantine", digest + ".bad",
+                    ))
+                except OSError:
+                    pass
+                return 422, b"pushed bytes do not hash to the digest", {
+                    "X-Artifact-Offset": "0",
+                }
+            with self._lock:
+                if digest in self._index:
+                    os.remove(part)
+                    self._quarantined.discard(digest)
+                else:
+                    self._install_locked(part, digest, name)
+            return 201, b"", {"X-Artifact-Offset": str(total)}
+
+    # -- push sending (producer side) -----------------------------------------
+
+    def push_to(
+        self, peer: str, digest: str, timeout_s: float = 30.0
+    ) -> None:
+        """Push a resident blob to one replica holder (base URL serving
+        ``/artifacts``), resuming from the holder's recorded offset.
+        Windows are capped at ``serve_window`` so each PUT stays under
+        the ingress body bound and other traffic interleaves between
+        them. Raises :class:`ArtifactPushError` (or the transport error)
+        on failure; fault point ``artifact.push`` fires per call."""
+        try:
+            faults.inject(
+                "artifact.push", context={"digest": digest, "peer": peer}
+            )
+            resumed = self._push_serial(peer, digest, timeout_s)
+        except Exception:
+            _M_PUSHES.labels(outcome="failed").inc()
+            raise
+        _M_PUSHES.labels(outcome="resumed" if resumed else "ok").inc()
+
+    def _push_serial(
+        self, peer: str, digest: str, timeout_s: float
+    ) -> bool:
+        src = self.path(digest)
+        if src is None:
+            raise ArtifactPushError(
+                f"artifact {digest[:12]}… not in local store"
+            )
+        with self._lock:
+            ref = self._index.get(digest)
+            name = ref.name if ref is not None else digest[:12]
+            # an in-flight push counts as "mid-pull" for GC/eviction:
+            # the source bytes must survive until the holder confirms
+            self._active[digest] = self._active.get(digest, 0) + 1
+        try:
+            total = os.path.getsize(src)
+            u = urllib.parse.urlparse(
+                peer if "//" in peer else "http://" + peer
+            )
+
+            def one(body: bytes, content_range: str) -> tuple:
+                conn = http.client.HTTPConnection(
+                    u.hostname, u.port or 80, timeout=timeout_s
+                )
+                try:
+                    conn.request(
+                        "PUT", f"/artifacts/{digest}", body=body,
+                        headers={
+                            "Content-Range": content_range,
+                            "Content-Type": "application/octet-stream",
+                            "X-Artifact-Name": name,
+                        },
+                    )
+                    resp = conn.getresponse()
+                    resp.read()
+                    return resp.status, resp.headers
+                finally:
+                    conn.close()
+
+            # probe for the holder's recorded offset (resume currency)
+            status, hdrs = one(b"", f"bytes */{total}")
+            if status == 200:
+                return False  # idempotent: the holder already has it
+            if status != 308:
+                raise ArtifactPushError(
+                    f"{peer} answered {status} to the push probe"
+                )
+            offset = int(hdrs.get("X-Artifact-Offset") or 0)
+            resumed = offset > 0
+            resyncs = 0
+            with open(src, "rb") as f:
+                while offset < total:
+                    f.seek(offset)
+                    chunk = f.read(min(self.serve_window, total - offset))
+                    status, hdrs = one(
+                        chunk,
+                        f"bytes {offset}-{offset + len(chunk) - 1}/{total}",
+                    )
+                    if status == 409:
+                        # the holder's offset moved under us (or an
+                        # overshoot reset it): resync and continue —
+                        # but a resync that never converges is a
+                        # broken holder, not a race
+                        resyncs += 1
+                        if resyncs > 4:
+                            raise ArtifactPushError(
+                                f"{peer} never converged on an offset"
+                            )
+                        offset = int(hdrs.get("X-Artifact-Offset") or 0)
+                        resumed = True
+                        continue
+                    if status == 422:
+                        raise ArtifactPushError(
+                            f"{peer} quarantined the pushed bytes "
+                            f"(hash mismatch on arrival)"
+                        )
+                    if status not in (200, 201, 202):
+                        raise ArtifactPushError(
+                            f"{peer} answered {status} mid-push"
+                        )
+                    _M_BYTES.labels(direction="sent").inc(len(chunk))
+                    offset += len(chunk)
+                    if status in (200, 201):
+                        break
+            return resumed
+        finally:
+            with self._lock:
+                self._active[digest] = max(0, self._active.get(digest, 1) - 1)
+                if not self._active[digest]:
+                    del self._active[digest]
+
+    def replicate(
+        self,
+        digest: str,
+        holders: list,
+        need: int = 1,
+        timeout_s: float = 30.0,
+        backoffs_ms: tuple = (100, 300, 800),
+    ) -> list:
+        """Push ``digest`` to holders until ``need`` of them confirm a
+        verified installed copy; returns the confirmed holder URLs.
+        Below quorum it RAISES :class:`ArtifactReplicationError` — the
+        replication-before-ack rule: a publish or generation commit that
+        rides this call can only proceed once the bytes are durable on
+        ``need`` other processes; there is no false-ack path. Fault
+        point ``artifact.replicate`` refuses the whole round."""
+        from mmlspark_tpu.core.utils import retry_with_backoff
+
+        faults.inject(
+            "artifact.replicate", context={"digest": digest, "need": need}
+        )
+        if need <= 0:
+            return []
+        remaining = list(dict.fromkeys(holders))
+        confirmed: list = []
+        errors: list = []
+
+        def one_round() -> list:
+            for holder in list(remaining):
+                if len(confirmed) >= need:
+                    break
+                try:
+                    self.push_to(holder, digest, timeout_s=timeout_s)
+                except Exception as e:  # noqa: BLE001 — holder down: next
+                    errors.append(f"{holder}: {type(e).__name__}: {e}")
+                    _M_REPLICAS.labels(outcome="failed").inc()
+                    continue
+                confirmed.append(holder)
+                remaining.remove(holder)
+                _M_REPLICAS.labels(outcome="confirmed").inc()
+            if len(confirmed) < need:
+                raise ArtifactReplicationError(
+                    f"artifact {digest[:12]}… replicated to "
+                    f"{len(confirmed)}/{need} holder(s) "
+                    f"({len(remaining)} candidate(s) left): "
+                    f"{'; '.join(errors[-3:])}"
+                )
+            return list(confirmed)
+
+        with obs.span(
+            "artifact.replicate",
+            attrs={"digest": digest[:12], "need": need,
+                   "holders": len(remaining)},
+        ):
+            try:
+                return retry_with_backoff(one_round, backoffs_ms=backoffs_ms)
+            except ArtifactReplicationError:
+                _M_REPLICAS.labels(outcome="below_quorum").inc()
+                raise
 
     # -- consumer side --------------------------------------------------------
 
@@ -686,6 +1007,7 @@ class ArtifactStore:
         start = os.path.getsize(part) if os.path.exists(part) else 0
         if start:
             _M_RESUMES.inc()
+            _M_PULL_RESUMES.inc()
         u = urllib.parse.urlparse(peer if "//" in peer else "http://" + peer)
         while True:
             conn = http.client.HTTPConnection(
@@ -810,6 +1132,69 @@ def registry_peers(
                     peers.append(f"http://{host}:{port}")
         if peers:
             return sorted(set(peers))
+    return []
+
+
+def registry_holders(
+    registry_urls: Any,
+    exclude: Any = (),
+    digest: Optional[str] = None,
+    timeout: float = 5.0,
+    exclude_services: Any = (),
+) -> list:
+    """Every base URL on any registry's roster running an artifact plane
+    (entries carrying an ``artifacts`` advertisement — workers, gang
+    members, ArtifactServers) — the candidate replica holders for a
+    push. ``digest`` narrows to holders already advertising that digest;
+    ``exclude`` drops the pusher's own URL(s); ``exclude_services``
+    drops whole roster services — replication that must outlive its
+    producer excludes the producer's own EPHEMERAL plane (an
+    experiment's trial/controller servers die with the experiment, so a
+    replica confirmed there protects nothing). Dead registries skip;
+    the first answering registry's roster is used (registry HA)."""
+    from mmlspark_tpu.io.clients import send_request
+    from mmlspark_tpu.io.http_schema import HTTPRequestData
+    from mmlspark_tpu.serving.fleet import split_registry_urls
+
+    drop = {u.rstrip("/") for u in (
+        [exclude] if isinstance(exclude, str) else exclude
+    )}
+    drop_services = set(
+        [exclude_services] if isinstance(exclude_services, str)
+        else exclude_services
+    )
+    suffix = ("@" + digest) if digest else None
+    for url in split_registry_urls(registry_urls):
+        try:
+            resp = send_request(
+                HTTPRequestData(url.rstrip("/") + "/", "GET"),
+                timeout=timeout,
+            )
+            if resp["status_code"] != 200:
+                continue
+            roster = json.loads(resp["entity"])
+        except Exception:  # noqa: BLE001 — registry HA: try the next
+            continue
+        holders: list = []
+        for service, entries in roster.items():
+            if service in drop_services:
+                continue
+            for e in entries:
+                arts = e.get("artifacts")
+                if arts is None:
+                    continue  # no artifact plane on this entry
+                if suffix and not any(a.endswith(suffix) for a in arts):
+                    continue
+                host = (
+                    e.get("addr") or e.get("forwarded_host") or e.get("host")
+                )
+                port = e.get("artifact_port") or e.get("forwarded_port") \
+                    or e.get("port")
+                if host and port:
+                    holders.append(f"http://{host}:{port}")
+        holders = sorted(u for u in set(holders) if u.rstrip("/") not in drop)
+        if holders:
+            return holders
     return []
 
 
@@ -981,7 +1366,9 @@ class ArtifactServer:
 __all__ = [
     "ArtifactError",
     "ArtifactFetchError",
+    "ArtifactPushError",
     "ArtifactRef",
+    "ArtifactReplicationError",
     "ArtifactServer",
     "ArtifactStore",
     "ArtifactVerifyError",
@@ -992,6 +1379,7 @@ __all__ = [
     "pack_dir",
     "parse_ref",
     "parse_spec",
+    "registry_holders",
     "registry_peers",
     "resolve_spec",
     "sha256_file",

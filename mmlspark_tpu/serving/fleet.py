@@ -213,6 +213,7 @@ def run_worker(
     slo_interval_s: float = 15.0,
     admission: bool = True,
     admission_initial_limit: int = 32,
+    admission_min_target_ms: Optional[float] = None,
     artifact_dir: Optional[str] = None,
     reactors: int = 2,
     header_deadline_s: Optional[float] = 15.0,
@@ -291,8 +292,15 @@ def run_worker(
         # Retry-After instead of joining a queue past every deadline
         from mmlspark_tpu.serving.admission import AdmissionController
 
+        kwargs = {}
+        if admission_min_target_ms is not None:
+            # queue-wait floor below which a window never reads as
+            # overload: deployments on slow or noisy boxes raise it so
+            # scheduler jitter alone cannot collapse the AIMD limit
+            kwargs["min_target_s"] = admission_min_target_ms / 1e3
         ctrl = AdmissionController(
-            server=service_name, initial_limit=admission_initial_limit
+            server=service_name, initial_limit=admission_initial_limit,
+            **kwargs,
         )
     q = ModelDispatcher(
         srv, store, default_model=specs[0][0] if specs else None,
@@ -943,6 +951,22 @@ def run_train(
         ),
         top_k=top_k,
     )
+    # persist the exported model BEFORE the trainer flips its status file
+    # to done: a status watcher (supervisor, drill, operator script) must
+    # be able to read --out-model the instant it observes done=true
+    persisted: dict = {}
+
+    def _persist_model(booster: Any) -> None:
+        model = booster.to_model_string()
+        if out_model:
+            import os as _os
+
+            tmp = out_model + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(model)
+            _os.replace(tmp, out_model)
+        persisted["model"] = model
+
     trainer = ElasticTrainer(
         registry_url, name, x, y, cfg, ckpt_dir,
         n_partitions=partitions, world_size=world_size,
@@ -959,16 +983,12 @@ def run_train(
         reduce_mode=reduce_mode,
         stream=stream, n_rows=n_rows, n_features=n_features,
         sketch_bits=sketch_bits,
+        on_complete=_persist_model,
     )
     booster = trainer.run()
-    model = booster.to_model_string()
-    if out_model:
-        tmp = out_model + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(model)
-        import os as _os
-
-        _os.replace(tmp, out_model)
+    model = persisted.get("model")
+    if model is None:  # pragma: no cover — on_complete always ran above
+        model = booster.to_model_string()
     digest = hashlib.sha256(model.encode()).hexdigest()
     print(f"train: {name} done, model sha256 {digest}", flush=True)
     return booster
@@ -995,6 +1015,7 @@ def run_supervise(
     gateway_url: Optional[str] = None,
     trains: Optional[list] = None,
     spawn_cmd: Optional[str] = None,
+    placement: Optional[str] = None,
 ) -> Any:
     """``fleet supervise``: spawn each ``--worker`` charge as a ``fleet
     worker`` process and keep it alive — restart on crash, kill+restart
@@ -1053,7 +1074,7 @@ def run_supervise(
         probe_s=probe_s, wedge_after=wedge_after, backoff_s=backoff_s,
         backoff_max_s=backoff_max_s, host=host, port=port,
         autoscaler=autoscaler, worker_template=template,
-        signals_fn=signals_fn, spawn_cmd=spawn_cmd,
+        signals_fn=signals_fn, spawn_cmd=spawn_cmd, placement=placement,
     ).start()
     obs.set_process_label(
         f"{service_name}-supervisor@{sup._info.host}:{sup._info.port}"
@@ -1112,6 +1133,7 @@ def run_online(
     distributed: bool = False,
     artifact_dir: Optional[str] = None,
     publish_epoch: Optional[int] = None,
+    replicas: int = 0,
 ) -> tuple:
     """``fleet online``: run the continuous-learning loop as a fleet
     role. Starts the HTTP ingest ingress (``POST /ingest``; ``GET
@@ -1128,7 +1150,9 @@ def run_online(
     ``artifact:vw:<name>@<sha256>`` specs, served ranged off this
     process's ingest ingress and advertised on its heartbeats — workers
     pull the bytes over HTTP, hash-verified and resumable
-    (docs/artifacts.md).
+    (docs/artifacts.md). ``--replicas N`` adds replication-before-ack:
+    each snapshot must be confirmed on N other artifact holders before
+    any worker is driven to load it (docs/robustness.md).
 
     Returns ``(stream, loop, stopper)``."""
     import dataclasses
@@ -1171,7 +1195,7 @@ def run_online(
         worker_urls=worker_urls, registry_url=registry_url,
         service_name=service_name,
         artifact_store=art_store, artifact_url=artifact_url,
-        epoch=publish_epoch,
+        epoch=publish_epoch, replicas=replicas,
     )
     loop = OnlineLearningLoop(
         stream, trainer, publisher, publish_every_s=publish_every_s,
@@ -1364,6 +1388,12 @@ def main(argv: Optional[list] = None) -> None:
         help="starting in-flight limit for the AIMD controller",
     )
     w.add_argument(
+        "--admission-min-target-ms", type=float, default=None,
+        help="queue-wait floor (ms) below which a window never counts "
+        "as overload (default 2ms) — raise on slow/noisy boxes so "
+        "scheduler jitter cannot collapse the AIMD limit",
+    )
+    w.add_argument(
         "--artifact-dir", default=None,
         help="root of this worker's content-addressed artifact cache "
         "(artifact: model specs fetch into it and re-serve off the "
@@ -1519,6 +1549,16 @@ def main(argv: Optional[list] = None) -> None:
         "line for remote shells (\"ssh worker-7 'exec {argv}'\"). Remote "
         "charges boot from pulled artifacts — no shared filesystem",
     )
+    sv.add_argument(
+        "--placement", default=None,
+        help="placement provider for every spawn: 'local', 'ssh:<host>' "
+        "(SSH-shaped remote exec), 'k8s:<image>[@<namespace>]' "
+        "(kubectl-run-shaped stub), or a raw wrapper template (the "
+        "--spawn-cmd form). Remote charges pull models/checkpoints as "
+        "artifacts by digest — the supervisor's filesystem is never "
+        "assumed shared. Fencing (boot stamps, epoch tokens, "
+        "majority-claim deferral) applies to remote placements verbatim",
+    )
     on = sub.add_parser(
         "online",
         help="continuous-learning loop: HTTP feedback ingest -> online "
@@ -1573,6 +1613,13 @@ def main(argv: Optional[list] = None) -> None:
         "off the ingest ingress (no shared filesystem): workers pull "
         "artifact:vw:<name>@<sha256> over HTTP, hash-verified + "
         "resumable (docs/artifacts.md)",
+    )
+    on.add_argument(
+        "--replicas", type=int, default=0,
+        help="replication-before-ack (artifact mode): each snapshot "
+        "must be confirmed on this many OTHER artifact holders before "
+        "any worker loads it — a SIGKILLed publisher host never "
+        "strands the only copy (docs/robustness.md)",
     )
     tn = sub.add_parser(
         "train",
@@ -1696,6 +1743,12 @@ def main(argv: Optional[list] = None) -> None:
         help="trial placement template, supervisor semantics: bare "
         "{argv} splices, embedded {argv} substitutes the shell-quoted "
         "command (fleet supervise --spawn-cmd docs)",
+    )
+    tu.add_argument(
+        "--placement", default=None,
+        help="trial placement provider, supervisor grammar: 'local', "
+        "'ssh:<host>', 'k8s:<image>[@<namespace>]', or a raw wrapper "
+        "template (fleet supervise --placement docs)",
     )
     tu.add_argument("--tick-s", type=float, default=0.25)
     tu.add_argument("--heartbeat-s", type=float, default=0.5)
@@ -1963,6 +2016,7 @@ def main(argv: Optional[list] = None) -> None:
             eta=args.eta, seed=args.seed,
             higher_is_better=not args.lower_is_better,
             workdir=args.workdir, spawn_cmd=args.spawn_cmd,
+            placement=args.placement,
             tick_s=args.tick_s, heartbeat_s=args.heartbeat_s,
             poll_s=args.poll_s,
             decision_timeout_s=args.decision_timeout_s,
@@ -2011,6 +2065,7 @@ def main(argv: Optional[list] = None) -> None:
             slo_p99_ms=args.slo_p99_ms or None,
             admission=not args.no_admission,
             admission_initial_limit=args.admission_initial_limit,
+            admission_min_target_ms=args.admission_min_target_ms,
             artifact_dir=args.artifact_dir,
             reactors=args.reactors,
             header_deadline_s=args.header_deadline_s or None,
@@ -2037,6 +2092,7 @@ def main(argv: Optional[list] = None) -> None:
             util_threshold=args.util_threshold,
             gateway_url=args.gateway,
             spawn_cmd=args.spawn_cmd,
+            placement=args.placement,
         )
         _serve_forever([sup])
     elif args.role == "online":
@@ -2055,6 +2111,7 @@ def main(argv: Optional[list] = None) -> None:
             text_col=args.text_col, distributed=args.distributed,
             artifact_dir=args.artifact_dir,
             publish_epoch=args.publish_epoch,
+            replicas=args.replicas,
         )
         _serve_forever([stopper])
     else:

@@ -635,7 +635,7 @@ class WorkerServer:
                         return
                     continue
                 if (
-                    method == "GET"
+                    method in ("GET", "PUT")
                     and self.artifact_store is not None
                     and (
                         path_only == "/artifacts"
@@ -643,12 +643,13 @@ class WorkerServer:
                     )
                 ):
                     # content-addressed artifact plane (serving/
-                    # artifacts.py): advertisement + ranged blob reads,
-                    # answered inline like /metrics. Blobs can be many
-                    # MB — drain so backpressure lands here, not in an
-                    # unbounded transport buffer
+                    # artifacts.py): advertisement + ranged blob reads +
+                    # pushed replica windows (PUT), answered inline like
+                    # /metrics. Blobs can be many MB — drain so
+                    # backpressure lands here, not in an unbounded
+                    # transport buffer
                     code, body_out, hdrs = self.artifact_store.handle_http(
-                        path_only, headers
+                        path_only, headers, method=method, body=body
                     )
                     self._write_response(writer, code, body_out, keep, hdrs)
                     try:

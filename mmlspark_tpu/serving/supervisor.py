@@ -195,6 +195,164 @@ def spawn_from_template(template: str) -> Any:
     return spawn
 
 
+class PlacementProvider:
+    """WHERE a (re)spawned charge's process lands — the ``--spawn-cmd``
+    template generalized into a first-class hook.
+
+    The supervisor only ever calls :meth:`spawn`; everything upstream of
+    that call is provider-independent and therefore carries over to
+    remote placements verbatim: restart backoff pacing, the
+    ``supervisor.restart`` fault point, and the whole split-brain
+    fencing stack — boot-stamped roster waits (``rolling_restart``),
+    epoch tokens on every write plane the spawned process touches, and
+    the majority-claim respawn deferral (``_incumbent_fenced``). A
+    remotely-placed trainer is fenced by exactly the same rules as a
+    local one, because fencing reads the REGISTRY view, never the
+    process table.
+
+    Remote charges cannot see the supervisor's filesystem: they boot
+    models and checkpoints from pulled artifacts
+    (serving/artifacts.py), which is what makes cross-host placement
+    work without a shared directory."""
+
+    scheme = "local"
+
+    def spawn(self, argv: list) -> subprocess.Popen:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        return self.scheme
+
+
+class LocalPlacement(PlacementProvider):
+    """Processes land on this machine: plain ``subprocess.Popen``, or a
+    wrapper template (``nice -n 10 {argv}``) via
+    :func:`spawn_from_template` when ``template`` is given."""
+
+    scheme = "local"
+
+    def __init__(self, template: Optional[str] = None):
+        self.template = template
+        self._spawn = (
+            spawn_from_template(template) if template
+            else (lambda argv: subprocess.Popen(argv))
+        )
+
+    def spawn(self, argv: list) -> subprocess.Popen:
+        return self._spawn(argv)
+
+    def describe(self) -> str:
+        return f"local:{self.template}" if self.template else "local"
+
+
+class RemotePlacement(PlacementProvider):
+    """Base for placements that launch the charge on ANOTHER host.
+
+    Subclasses implement :meth:`transport_argv` — argv -> the local
+    command whose job is to start the charge remotely (``ssh …``,
+    ``kubectl run …``). Fault point ``supervisor.spawn_remote`` fires
+    as each remote launch is about to happen: an injected error is "the
+    remote scheduler refused the allocation" — the spawn fails and the
+    ordinary supervision loop retries it next tick under backoff, while
+    ``delay_s`` models a slow placement decision. ``runner`` is
+    injectable for tests (defaults to ``subprocess.Popen``) so the
+    transport argv can be asserted without an ssh/kubectl binary."""
+
+    scheme = "remote"
+
+    def __init__(self, target: str, runner: Any = None):
+        self.target = target
+        self._runner = runner or subprocess.Popen
+
+    def transport_argv(self, argv: list) -> list:
+        raise NotImplementedError
+
+    def spawn(self, argv: list) -> subprocess.Popen:
+        faults.inject(
+            "supervisor.spawn_remote",
+            context={"scheme": self.scheme, "target": self.target},
+        )
+        return self._runner(self.transport_argv(argv))
+
+    def describe(self) -> str:
+        return f"{self.scheme}:{self.target}"
+
+
+class SshPlacement(RemotePlacement):
+    """SSH-shaped placement: ``ssh <host> 'exec <shell-quoted argv>'``.
+
+    The command line is shell-quoted as ONE remote token because ssh
+    joins its arguments with plain spaces and the far side word-splits
+    them; ``exec`` keeps the remote shell from lingering as an extra
+    parent. BatchMode refuses interactive prompts — a supervisor must
+    fail fast and retry under backoff, not hang on a password read."""
+
+    scheme = "ssh"
+
+    def transport_argv(self, argv: list) -> list:
+        return [
+            "ssh", "-o", "BatchMode=yes", self.target,
+            "exec " + shlex.join(argv),
+        ]
+
+
+class K8sPlacement(RemotePlacement):
+    """k8s-shaped placement stub: ``kubectl run <name> --image=<image>
+    --restart=Never -- <argv>``.
+
+    A stub in the precise sense: the transport argv is the real kubectl
+    shape, but nothing here watches the pod — the supervisor supervises
+    the LOCAL kubectl process it spawned, so ``--restart=Never`` plus
+    ``--attach`` semantics (kubectl exits when the pod does) are what
+    tie pod death back to the charge-exit path. Pod names are
+    ``mmlspark-<charge>-<n>`` with a per-provider counter: ``kubectl
+    run`` refuses duplicate names, and a respawn must be a NEW pod."""
+
+    scheme = "k8s"
+
+    def __init__(self, image: str, namespace: str = "default",
+                 runner: Any = None):
+        super().__init__(target=f"{image}@{namespace}", runner=runner)
+        self.image = image
+        self.namespace = namespace
+        self._seq = 0
+
+    def transport_argv(self, argv: list) -> list:
+        self._seq += 1
+        return [
+            "kubectl", "run", f"mmlspark-charge-{self._seq}",
+            f"--image={self.image}", f"--namespace={self.namespace}",
+            "--restart=Never", "--attach", "--rm", "--quiet", "--",
+            *argv,
+        ]
+
+
+def placement_from_spec(spec: str) -> PlacementProvider:
+    """``--placement`` grammar -> a provider.
+
+    ``local``                 -> plain subprocess
+    ``ssh:<host>``            -> :class:`SshPlacement`
+    ``k8s:<image>[@<ns>]``    -> :class:`K8sPlacement`
+    anything else             -> a :class:`LocalPlacement` wrapper
+                                 template (the legacy ``--spawn-cmd``
+                                 form — ``nice -n 10 {argv}``)"""
+    spec = spec.strip()
+    if spec in ("", "local"):
+        return LocalPlacement()
+    if spec.startswith("ssh:"):
+        host = spec[len("ssh:"):]
+        if not host:
+            raise ValueError("placement 'ssh:' needs a host")
+        return SshPlacement(host)
+    if spec.startswith("k8s:"):
+        rest = spec[len("k8s:"):]
+        if not rest:
+            raise ValueError("placement 'k8s:' needs an image")
+        image, _, ns = rest.partition("@")
+        return K8sPlacement(image, namespace=ns or "default")
+    return LocalPlacement(template=spec)
+
+
 class FleetSupervisor:
     """Watch charges, restart the dead and the wedged, export status.
 
@@ -202,7 +360,10 @@ class FleetSupervisor:
     own status endpoint under ``<service_name>-supervisor`` so ``fleet
     top`` can find it. ``spawn`` is injectable for tests (defaults to
     ``subprocess.Popen``); ``spawn_cmd`` is the operator-facing template
-    form of the same hook (:func:`spawn_from_template`)."""
+    form of the same hook (:func:`spawn_from_template`); ``placement``
+    is the generalization of both — a :class:`PlacementProvider` (or
+    its ``--placement`` spec string) deciding WHERE every spawn lands:
+    local subprocess, SSH-shaped, or k8s-shaped remote."""
 
     def __init__(
         self,
@@ -220,6 +381,7 @@ class FleetSupervisor:
         port: int = 0,
         spawn: Any = None,
         spawn_cmd: Optional[str] = None,
+        placement: Any = None,
         autoscaler: Any = None,
         worker_template: Optional[str] = None,
         signals_fn: Any = None,
@@ -244,9 +406,19 @@ class FleetSupervisor:
         self.startup_grace_s = startup_grace_s
         self._host = host
         self._port = port
-        if spawn is None and spawn_cmd:
-            spawn = spawn_from_template(spawn_cmd)
-        self._spawn = spawn or (lambda argv: subprocess.Popen(argv))
+        # placement resolution, most specific first: an explicit spawn
+        # callable (test hook) > a PlacementProvider (or its --placement
+        # spec string) > the legacy --spawn-cmd wrapper template > local
+        # subprocess. All four funnel into the same self._spawn call
+        # site, so fencing and backoff see no difference.
+        if isinstance(placement, str):
+            placement = placement_from_spec(placement)
+        if placement is None and spawn_cmd:
+            placement = LocalPlacement(template=spawn_cmd)
+        if placement is None and spawn is None:
+            placement = LocalPlacement()
+        self._placement = placement
+        self._spawn = spawn or placement.spawn
         self._autoscaler = autoscaler
         self._worker_template = worker_template
         self._signals_fn = signals_fn
@@ -346,6 +518,10 @@ class FleetSupervisor:
         with self._lock:
             return {
                 "charges": len(self.charges),
+                "placement": (
+                    self._placement.describe()
+                    if self._placement is not None else "custom"
+                ),
                 "up": sum(1 for c in self.charges if c.alive()),
                 "restarts": sum(c.restarts for c in self.charges),
                 "workers": {

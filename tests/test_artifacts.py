@@ -3,10 +3,20 @@
 Unit layer: put/fetch round-trips (file + deterministic directory
 packing), the transfer-corruption matrix (truncated body -> Range
 resume, flipped byte -> digest mismatch -> quarantine + peer failover,
-zero-length / oversized rejection), LRU budget vs pins, the three fault
-points (``artifact.put`` / ``artifact.fetch`` / ``artifact.verify``),
-the ``artifact:`` model-spec grammar, Publisher artifact mode + GC
-safety, and the supervisor's pluggable ``--spawn-cmd`` placement hook.
+zero-length / oversized rejection), LRU budget vs pins, the fault
+points (``artifact.put`` / ``artifact.fetch`` / ``artifact.verify`` /
+``artifact.push`` / ``artifact.replicate``), the ``artifact:``
+model-spec grammar, Publisher artifact mode + GC safety, and the
+supervisor's placement hooks.
+
+Push plane (PR 20): windowed ``PUT`` pushes that resume from the
+RECEIVER's durable offset after a mid-transfer RST or a killed pusher,
+flipped-byte pushes quarantined on the holder and re-replicated
+elsewhere, and replication-before-ack (``replicate`` raises below
+quorum, never false-acks). Remote placement: the
+``local``/``ssh:``/``k8s:`` provider grammar, transport argv shapes,
+and the ``supervisor.spawn_remote`` fault point deferring (not
+crashing) a restart.
 """
 
 from __future__ import annotations
@@ -850,3 +860,327 @@ def test_wire_asymmetric_partition_fails_over_per_peer(stores, tmp_path):
     finally:
         dead_wire.stop()
         peer.stop()
+
+
+# -- the push path: replication-before-ack ------------------------------------
+#
+# PR 20's shared-filesystem-free fleet: producers PUSH snapshots to
+# replica holders over HTTP (PUT /artifacts/<digest> in Content-Range
+# windows) and a publish/commit only proceeds once a quorum of holders
+# confirms a verified installed copy (docs/robustness.md).
+
+
+def _push_metric(outcome: str) -> float:
+    from mmlspark_tpu import obs
+
+    return obs.sum_samples(
+        obs.parse_text(obs.render()),
+        "mmlspark_artifacts_pushes_total",
+        match={"outcome": outcome},
+    )
+
+
+def test_push_roundtrip_windows_and_idempotent_repush(tmp_path):
+    """A multi-window push installs a verified copy on the holder; a
+    re-push of the same digest is answered from the probe (200) without
+    moving a byte."""
+    src = ArtifactStore(str(tmp_path / "src"), serve_window=10_000)
+    dst = ArtifactStore(str(tmp_path / "dst"))
+    ref = src.put(_blob(tmp_path, n=45_000, seed=60), name="snap.npz")
+    holder = ArtifactServer(dst)
+    try:
+        src.push_to(holder.url, ref.digest)
+        assert dst.has(ref.digest) and dst.verify(ref.digest)
+        with open(dst.path(ref.digest), "rb") as got, \
+                open(src.path(ref.digest), "rb") as want:
+            assert got.read() == want.read()
+        # the holder advertises it under the pushed name
+        assert dst.refs() == [f"snap.npz@{ref.digest}"]
+        ok_before = _push_metric("ok")
+        src.push_to(holder.url, ref.digest)  # idempotent
+        assert _push_metric("ok") == ok_before + 1
+    finally:
+        holder.stop()
+
+
+def test_push_truncate_rst_resumes_from_receiver_offset(tmp_path):
+    """A mid-window RST kills one push attempt; the retry PROBES the
+    holder, learns the recorded offset, and resumes there — re-sending
+    only the unconfirmed tail, counted as outcome=resumed."""
+    from mmlspark_tpu.chaos.wire import ChaosProxy, WireRule
+
+    src = ArtifactStore(str(tmp_path / "src"), serve_window=10_000)
+    dst = ArtifactStore(str(tmp_path / "dst"))
+    ref = src.put(_blob(tmp_path, n=100_000, seed=61))
+    holder = ArtifactServer(dst)
+    # conn 0 is the probe, conns 1..2 carry the first two windows; conn 3
+    # gets RST mid-body — exactly two windows (20 000 bytes) land
+    wire = ChaosProxy(
+        "127.0.0.1", holder.port, seed=5, name="push-rst",
+        rules=[WireRule("truncate_rst", direction="c2s",
+                        at_offset=5_000, conns=frozenset({3}))],
+    ).start()
+    try:
+        with pytest.raises(Exception):
+            src.push_to(wire.url, ref.digest)
+        part = os.path.join(dst.root, "partial", ref.digest + ".push")
+        assert os.path.getsize(part) == 20_000, (
+            "holder must keep exactly the complete windows"
+        )
+        resumed_before = _push_metric("resumed")
+        src.push_to(wire.url, ref.digest)  # resumes, does not restart
+        assert _push_metric("resumed") == resumed_before + 1
+        assert dst.has(ref.digest) and dst.verify(ref.digest)
+        assert any(e.kind == "truncate_rst" for e in wire.journal())
+    finally:
+        wire.stop()
+        holder.stop()
+
+
+def test_push_flipped_byte_quarantines_and_rereplicates_elsewhere(
+    tmp_path,
+):
+    """A byte flipped on the push wire: the holder's pre-install sha256
+    check quarantines the bytes (422 — a corrupt replica can never count
+    toward a quorum) and ``replicate`` moves on to a healthy holder."""
+    from mmlspark_tpu.chaos.wire import ChaosProxy, WireRule
+
+    src = ArtifactStore(str(tmp_path / "src"))
+    bad = ArtifactStore(str(tmp_path / "bad"))
+    good = ArtifactStore(str(tmp_path / "good"))
+    ref = src.put(_blob(tmp_path, n=50_000, seed=62))
+    bad_holder = ArtifactServer(bad)
+    good_holder = ArtifactServer(good)
+    wire = ChaosProxy(
+        "127.0.0.1", bad_holder.port, seed=5, name="push-flip",
+        rules=[WireRule("flip", direction="c2s", at_offset=5_000)],
+    ).start()
+    try:
+        confirmed = src.replicate(
+            ref.digest, [wire.url, good_holder.url], need=1,
+            backoffs_ms=(10,),
+        )
+        assert confirmed == [good_holder.url]
+        assert good.has(ref.digest) and good.verify(ref.digest)
+        # the flipped bytes landed in quarantine on the bad holder —
+        # never in blobs, never advertised
+        assert not bad.has(ref.digest)
+        assert os.path.exists(os.path.join(
+            bad.root, "quarantine", ref.digest + ".bad",
+        ))
+    finally:
+        wire.stop()
+        bad_holder.stop()
+        good_holder.stop()
+
+
+def test_replicate_below_quorum_raises_never_false_acks(tmp_path):
+    """Replication-before-ack: fewer confirmed holders than ``need``
+    RAISES — there is no partial-success return a caller could mistake
+    for durability."""
+    from mmlspark_tpu.serving.artifacts import ArtifactReplicationError
+
+    src = ArtifactStore(str(tmp_path / "src"))
+    dst = ArtifactStore(str(tmp_path / "dst"))
+    ref = src.put(_blob(tmp_path, n=2_000, seed=63))
+    holder = ArtifactServer(dst)
+    try:
+        # no reachable holder at all
+        with pytest.raises(ArtifactReplicationError):
+            src.replicate(
+                ref.digest, ["http://127.0.0.1:9"], need=1,
+                backoffs_ms=(10,),
+            )
+        # one healthy holder cannot satisfy need=2 — the copy that DID
+        # land is reported in no ack; the call still raises
+        with pytest.raises(ArtifactReplicationError):
+            src.replicate(
+                ref.digest, [holder.url, "http://127.0.0.1:9"], need=2,
+                backoffs_ms=(10,),
+            )
+        assert dst.has(ref.digest)  # the durable copy is not undone
+        assert src.replicate(ref.digest, [holder.url], need=0) == []
+    finally:
+        holder.stop()
+
+
+def test_fault_artifact_push_refuses_attempt_then_retry_lands(tmp_path):
+    from mmlspark_tpu.core.faults import FaultError
+
+    src = ArtifactStore(str(tmp_path / "src"))
+    dst = ArtifactStore(str(tmp_path / "dst"))
+    ref = src.put(_blob(tmp_path, n=2_000, seed=64))
+    holder = ArtifactServer(dst)
+    plan = FaultPlan().on("artifact.push", error=FaultError, max_fires=1)
+    try:
+        with plan.armed():
+            with pytest.raises(FaultError):
+                src.push_to(holder.url, ref.digest)
+            src.push_to(holder.url, ref.digest)  # the retry lands
+        assert dst.has(ref.digest)
+        assert len(plan.fires("artifact.push")) == 1
+    finally:
+        holder.stop()
+
+
+def test_fault_artifact_replicate_denies_whole_round(tmp_path):
+    """``artifact.replicate`` chaos: the injected refusal denies the
+    round before any byte moves — and the disarmed retry confirms."""
+    from mmlspark_tpu.core.faults import FaultError
+
+    src = ArtifactStore(str(tmp_path / "src"))
+    dst = ArtifactStore(str(tmp_path / "dst"))
+    ref = src.put(_blob(tmp_path, n=2_000, seed=65))
+    holder = ArtifactServer(dst)
+    plan = FaultPlan().on(
+        "artifact.replicate", error=FaultError, max_fires=1,
+    )
+    try:
+        with plan.armed():
+            with pytest.raises(FaultError):
+                src.replicate(ref.digest, [holder.url], need=1)
+            assert not dst.has(ref.digest)  # refused before any byte
+            confirmed = src.replicate(ref.digest, [holder.url], need=1)
+        assert confirmed == [holder.url] and dst.has(ref.digest)
+        assert len(plan.fires("artifact.replicate")) == 1
+    finally:
+        holder.stop()
+
+
+def test_push_source_killed_midpush_holder_keeps_resumable_partial(
+    tmp_path,
+):
+    """The source dying mid-push (its process SIGKILLed, socket torn
+    down) leaves the holder with a clean resumable partial: a DIFFERENT
+    surviving replica of the same digest finishes the push from the
+    recorded offset — digests, not sources, are the unit of recovery."""
+    src_a = ArtifactStore(str(tmp_path / "src-a"), serve_window=10_000)
+    src_b = ArtifactStore(str(tmp_path / "src-b"), serve_window=10_000)
+    dst = ArtifactStore(str(tmp_path / "dst"))
+    p = _blob(tmp_path, n=100_000, seed=66)
+    ref = src_a.put(p)
+    assert src_b.put(p).digest == ref.digest  # same content, same digest
+    holder = ArtifactServer(dst)
+
+    # simulate the source's death after three windows: drive the wire
+    # protocol directly, then abandon the transfer
+    import http.client
+    import urllib.parse as _up
+
+    u = _up.urlparse(holder.url)
+    with open(src_a.path(ref.digest), "rb") as f:
+        payload = f.read()
+    off = 0
+    for _ in range(3):
+        conn = http.client.HTTPConnection(u.hostname, u.port, timeout=5)
+        conn.request(
+            "PUT", f"/artifacts/{ref.digest}",
+            body=payload[off:off + 10_000],
+            headers={
+                "Content-Range": f"bytes {off}-{off + 9_999}/{len(payload)}",
+            },
+        )
+        assert conn.getresponse().status == 202
+        conn.close()
+        off += 10_000
+    try:
+        # source A is gone; survivor B probes, resumes at 30 000
+        resumed_before = _push_metric("resumed")
+        src_b.push_to(holder.url, ref.digest)
+        assert _push_metric("resumed") == resumed_before + 1
+        assert dst.has(ref.digest) and dst.verify(ref.digest)
+    finally:
+        holder.stop()
+
+
+# -- remote placement providers ------------------------------------------------
+
+
+def test_placement_from_spec_grammar_and_transport_shapes():
+    from mmlspark_tpu.serving.supervisor import (
+        K8sPlacement,
+        LocalPlacement,
+        SshPlacement,
+        placement_from_spec,
+    )
+
+    ssh = placement_from_spec("ssh:worker-7")
+    assert isinstance(ssh, SshPlacement)
+    t = ssh.transport_argv(["python", "-m", "x", "--flag", "a b"])
+    assert t[0] == "ssh" and t[-2] == "worker-7"
+    # the remote side gets ONE shell-quoted token — ssh word-splits
+    assert t[-1] == "exec python -m x --flag 'a b'"
+
+    k8s = placement_from_spec("k8s:mmlspark:v3@prod")
+    assert isinstance(k8s, K8sPlacement)
+    t1 = k8s.transport_argv(["python"])
+    t2 = k8s.transport_argv(["python"])
+    assert t1[0] == "kubectl" and "--image=mmlspark:v3" in t1
+    assert "--namespace=prod" in t1
+    assert t1[2] != t2[2]  # a respawn must be a NEW pod name
+
+    assert isinstance(placement_from_spec("local"), LocalPlacement)
+    tpl = placement_from_spec("nice -n 10 {argv}")
+    assert isinstance(tpl, LocalPlacement) and tpl.template
+    with pytest.raises(ValueError):
+        placement_from_spec("ssh:")
+    with pytest.raises(ValueError):
+        placement_from_spec("k8s:")
+
+
+def test_remote_placement_fault_point_defers_then_restarts(tmp_path):
+    """``supervisor.spawn_remote``: an injected refusal is "the remote
+    scheduler denied the allocation" — the spawn fails WITHOUT launching
+    a transport process, and the ordinary supervision loop retries it
+    under backoff. A later crash restart rides the same provider."""
+    import subprocess
+    import sys as _sys
+
+    from mmlspark_tpu.core.faults import FaultError
+    from mmlspark_tpu.serving.supervisor import (
+        FleetSupervisor,
+        SshPlacement,
+        WorkerCharge,
+    )
+
+    sleeper = str(tmp_path / "sleep.py")
+    with open(sleeper, "w") as f:
+        f.write("import time\ntime.sleep(60)\n")
+    transports: list = []
+
+    def runner(argv):
+        # no sshd in CI: record the transport argv the provider built,
+        # then stand the charge up locally in its place
+        transports.append(argv)
+        return subprocess.Popen([_sys.executable, sleeper])
+
+    placement = SshPlacement("worker-7", runner=runner)
+    c = WorkerCharge([_sys.executable, sleeper], name="w0")
+    plan = FaultPlan().on(
+        "supervisor.spawn_remote", error=FaultError, max_fires=1,
+    )
+    sup = None
+    try:
+        with plan.armed():
+            sup = FleetSupervisor(
+                [c], probe_s=0.1, backoff_s=0.1, stable_s=60.0,
+                placement=placement,
+            ).start()
+            assert not transports, "refused spawn must not launch"
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not c.alive():
+                time.sleep(0.05)
+        assert c.alive(), "the supervision loop never retried the spawn"
+        assert len(plan.fires("supervisor.spawn_remote")) == 1
+        assert transports and transports[0][0] == "ssh"
+        assert "worker-7" in transports[0]
+        assert sup.status()["placement"] == "ssh:worker-7"
+        # a crash restart goes through the SAME provider
+        c.proc.kill()
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and len(transports) < 2:
+            time.sleep(0.05)
+        assert len(transports) >= 2 and c.restarts >= 1
+    finally:
+        if sup is not None:
+            sup.stop()

@@ -1170,15 +1170,26 @@ def test_conductor_accumulates_mid_soak_violations():
 def test_worker_graceful_drain_replies_everything(tmp_path):
     """stopper.drain(): deregister -> pause accepting -> every accepted
     request (incl. staged continuous batches) replied before returning;
-    the ingress in-flight gauge reads zero — nothing dropped."""
+    the ingress in-flight gauge reads zero — nothing dropped.
+
+    Wall-clock budgets scale by the deploy smoke's box-speed factor: a
+    loaded CI box gets more SECONDS to drain, never a weaker zero-drop
+    gate."""
     from mmlspark_tpu import obs
     from mmlspark_tpu.serving.fleet import run_worker
     from mmlspark_tpu.serving.registry import DriverRegistry
+    from tools.deploy.smoke import box_speed_factor
 
-    reg = DriverRegistry(ttl_s=10.0)
+    speed = box_speed_factor()
+    reg = DriverRegistry(ttl_s=10.0 * speed)
+    # raise the AIMD queue-wait floor with the box speed: on a loaded
+    # box scheduler jitter alone can exceed the 2ms default, collapse
+    # the admission limit below the drill's 3 clients, and shed 429s
+    # the raw client would miscount as drops
     srv, q, stopper = run_worker(
         reg.url, model="echo", host="127.0.0.1", heartbeat_s=0.2,
         slo_p99_ms=None, artifact_dir=str(tmp_path / "art"),
+        admission_min_target_ms=25.0 * speed,
     )
     stop_load = threading.Event()
     results = {"ok": 0, "refused": 0, "dropped": 0}
@@ -1203,7 +1214,7 @@ def test_worker_graceful_drain_replies_everything(tmp_path):
     for t in threads:
         t.start()
     time.sleep(0.7)
-    assert stopper.drain(timeout_s=8.0) is True
+    assert stopper.drain(timeout_s=8.0 * speed) is True
     assert srv.inflight() == 0
     assert reg.services("serving") == []  # deregistered everywhere
     stop_load.set()
@@ -1255,15 +1266,22 @@ def test_rostered_matches_ports_and_excludes_stale_generation(monkeypatch):
 def test_supervisor_rolling_restart_drill_zero_drops(tmp_path):
     """THE drill (acceptance): a supervisor rolls two fleet workers one
     at a time (SIGTERM -> graceful drain -> respawn) under sustained
-    gateway load — zero dropped requests across both restarts."""
+    gateway load — zero dropped requests across both restarts.
+
+    Timing budgets (registry TTL, per-worker drain window, roll wait)
+    scale by the deploy smoke's box-speed factor so a loaded CI box
+    cannot starve a heartbeat off the roster mid-roll — the zero-drop
+    contract itself never relaxes."""
     from mmlspark_tpu.serving.distributed import ServingGateway
     from mmlspark_tpu.serving.registry import DriverRegistry
     from mmlspark_tpu.serving.supervisor import (
         FleetSupervisor,
         charge_from_worker_args,
     )
+    from tools.deploy.smoke import box_speed_factor
 
-    reg = DriverRegistry(ttl_s=6.0)
+    speed = box_speed_factor()
+    reg = DriverRegistry(ttl_s=6.0 * speed)
 
     def free_port():
         s = socket.create_server(("127.0.0.1", 0))
@@ -1274,8 +1292,13 @@ def test_supervisor_rolling_restart_drill_zero_drops(tmp_path):
     p1, p2 = free_port(), free_port()
     charges = [
         charge_from_worker_args(
+            # the admission wait floor scales too: on a loaded box,
+            # scheduler jitter alone can exceed the 2 ms default and
+            # collapse the AIMD limit below the drill's 4 clients —
+            # shedding 429s that have nothing to do with the roll
             f"--model echo --host 127.0.0.1 --port {p} --heartbeat-s 0.3 "
-            f"--drain-s 6 --slo-p99-ms 0",
+            f"--drain-s {6.0 * speed:g} --slo-p99-ms 0 "
+            f"--admission-min-target-ms {25.0 * speed:g}",
             reg.url, i,
         )
         for i, p in enumerate((p1, p2))
@@ -1324,7 +1347,7 @@ def test_supervisor_rolling_restart_drill_zero_drops(tmp_path):
         for t in threads:
             t.start()
         time.sleep(1.0)
-        assert sup.rolling_restart(wait_up_s=90.0) is True
+        assert sup.rolling_restart(wait_up_s=90.0 * speed) is True
         time.sleep(1.0)
         stop_load.set()
         for t in threads:

@@ -1183,3 +1183,96 @@ def test_chaos_partition_drill_minority_parks_majority_wins_zombie_fenced(
                 p.kill()
         proxy.stop()
         reg.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.xdist_group("latency")
+def test_chaos_reshard_pull_blackholed_replica_fails_over(
+    gang_registry, tmp_path,
+):
+    """One replica holder blackholed DURING the reshard pull: the
+    grow-back member resolving the agreed resume snapshot by digest
+    dials the advertising peers in the gang's deterministic sorted-name
+    order — the first peer's ingress swallows every response byte
+    (asymmetric partition, not a clean refusal) — and the fetch must
+    burn one bounded timeout, fail over to the surviving holder, and
+    land hash-verified bytes that unpack to the exact committed
+    snapshot tree."""
+    from mmlspark_tpu.chaos.wire import ChaosProxy, WireRule
+    from mmlspark_tpu.parallel.elastic import GangMember, replicate_snapshot
+    from mmlspark_tpu.serving.artifacts import (
+        ArtifactStore,
+        pack_dir,
+        unpack_dir,
+    )
+
+    out = str(tmp_path)
+    # the committer's frozen reshard snapshot, on its PRIVATE disk
+    snap = os.path.join(out, "ck-a", "round-0000006")
+    os.makedirs(snap)
+    rng = np.random.default_rng(21)
+    for fn in ("booster.json", "state.bin"):
+        with open(os.path.join(snap, fn), "wb") as f:
+            f.write(rng.bytes(40_000))
+    stores = {
+        n: ArtifactStore(os.path.join(out, f"art-{n}")) for n in "abc"
+    }
+    a = GangMember(
+        gang_registry.url, "a", heartbeat_s=0.2, artifact_store=stores["a"],
+    )
+    b = GangMember(
+        gang_registry.url, "b", heartbeat_s=0.2, artifact_store=stores["b"],
+    )
+    c = GangMember(
+        gang_registry.url, "c", heartbeat_s=0.2, artifact_store=stores["c"],
+    )
+    # the committer's artifact ingress goes dark mid-pull: peers dial the
+    # ADVERTISED port, so pointing it through a blackholing proxy is
+    # exactly a host whose replies stopped arriving
+    wire = ChaosProxy(
+        "127.0.0.1", a.artifact_port, seed=7, name="reshard-blackhole",
+        rules=[WireRule("blackhole", direction="s2c")],
+    ).start()
+    a.artifact_port = wire.port
+    try:
+        pack = os.path.join(out, "snap.pack")
+        pack_dir(snap, pack)
+        ref = stores["a"].put(pack, name="round-0000006")
+        # replicate-before-commit pushed the snapshot to holder b (the
+        # training plane's majority target for a world of 3 is 1)
+        status: dict = {}
+        assert replicate_snapshot(a, ref.digest, ["a", "b", "c"], status) == 1
+        assert status["snapshot_replicas"] == 1
+        assert stores["b"].has(ref.digest)
+        # both advertisements must ride a heartbeat before c can resolve
+        deadline = time.monotonic() + 15.0
+        peers = c.artifact_peers(ref.digest)
+        while time.monotonic() < deadline and len(peers) < 2:
+            time.sleep(0.1)
+            peers = c.artifact_peers(ref.digest)
+        assert len(peers) == 2, peers
+        assert str(wire.port) in peers[0], (
+            "sorted-name failover order must dial the blackholed "
+            "committer first", peers,
+        )
+        # per-connection timeout bounds the blackhole's cost: the dark
+        # peer blocks the socket until exactly this budget expires
+        t0 = time.monotonic()
+        path = stores["c"].fetch(
+            ref.digest, peers, name="round-0000006", timeout_s=8.0,
+        )
+        dt = time.monotonic() - t0
+        assert dt < 25.0, f"failover burned {dt:.1f}s, not one timeout"
+        local = os.path.join(out, "ck-c", f"pulled-{ref.digest[:16]}")
+        unpack_dir(path, local)
+        for fn in ("booster.json", "state.bin"):
+            with open(os.path.join(snap, fn), "rb") as want, \
+                    open(os.path.join(local, fn), "rb") as got:
+                assert got.read() == want.read(), fn
+        assert any(e.kind == "blackhole" for e in wire.journal()), (
+            "the drill never actually exercised the blackhole"
+        )
+    finally:
+        wire.stop()
+        for m in (a, b, c):
+            m.close()

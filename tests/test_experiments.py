@@ -366,6 +366,225 @@ def test_invariant_checker_catches_experiment_violations(tmp_path):
     assert names == {"experiment_conservation", "single_promotion"}
 
 
+# -- the stranded winner (PR 17 residual, closed) -----------------------------
+
+
+def test_recover_winner_pulls_from_spec_hints(tmp_path):
+    """A committed winner record whose author is gone: the successor
+    re-pulls the bytes through the record's OWN spec hints — the holders
+    that confirmed replication at commit time — even when no registry
+    advertises the digest anymore."""
+    from mmlspark_tpu.experiments.controller import ExperimentController
+    from mmlspark_tpu.serving.artifacts import ArtifactServer, ArtifactStore
+
+    holder_store = ArtifactStore(str(tmp_path / "holder"))
+    ref = holder_store.put_bytes(b"winner-bytes" * 64, "t000.gbdt.json")
+    holder = ArtifactServer(holder_store)  # serves, never advertises
+    ctrl = ExperimentController(
+        DEAD_REGISTRY, "expRH", n_trials=1,
+        workdir=str(tmp_path / "wd"), spawn_cmd="true {argv}",
+    )
+    try:
+        ctrl._ensure_artifact_plane()
+        state = records.ExperimentState()
+        state.winner = {
+            "trial": "t000", "model": ref.digest,
+            "spec": (
+                f"artifact:gbdt:t000.gbdt.json@{ref.digest}@{holder.url}"
+            ),
+        }
+        ctrl._recover_winner(state)
+        assert ctrl._store.has(ref.digest)
+        assert ctrl.spawned == 0  # bytes recovered; no retrain spawned
+    finally:
+        ctrl.close()
+        holder.stop()
+
+
+def test_recover_winner_falls_back_to_deterministic_retrain(tmp_path):
+    """No hinted holder, no advertising peer: the successor respawns the
+    winner trial (same params + seed re-derive the committed digest) —
+    and never double-spawns while that charge is in flight."""
+    from mmlspark_tpu.experiments.controller import ExperimentController
+
+    ctrl = ExperimentController(
+        DEAD_REGISTRY, "expRF", n_trials=1,
+        workdir=str(tmp_path), spawn_cmd="true {argv}",
+    )
+    try:
+        ctrl._ensure_artifact_plane()
+        trial = ctrl.trials[0]
+        state = records.ExperimentState()
+        state.winner = {
+            "trial": trial, "model": "0" * 64,
+            "spec": "artifact:gbdt:w.gbdt.json@" + "0" * 64,
+        }
+        ctrl._recover_winner(state)
+        assert ctrl.spawned == 1 and trial in ctrl.charges
+        ctrl._recover_winner(state)
+        assert ctrl.spawned == 1  # in flight: no twin
+    finally:
+        ctrl.close()
+
+
+STRANDED_ARGS = dict(
+    n_trials=2, data="synth:128x6:1", valid="synth:64x6:99",
+    min_iters=2, max_iters=2, eta=2, seed=11, deadline_s=240.0,
+    heartbeat_s=0.5, tick_s=0.25, poll_s=0.25, decision_timeout_s=30.0,
+)
+
+
+def _tick_to_winner(ctrl, timeout_s=120.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        state = ctrl.tick()
+        if state is not None and state.winner is not None:
+            return dict(state.winner)
+        time.sleep(0.25)
+    raise AssertionError("controller never committed a winner")
+
+
+def test_stranded_winner_successor_repulls_from_replica(
+    tmp_path, monkeypatch
+):
+    """The pinned residual drill: controller A is killed between
+    winner-commit and publish — ingress and artifact store gone,
+    lingering charges SIGKILLed. Replication-before-commit pushed the
+    winner bytes to a rostered worker, so successor B re-pulls them by
+    digest from that surviving replica WITHOUT retraining, publishes,
+    and the champion answers through the gateway."""
+    from mmlspark_tpu.chaos.invariants import InvariantChecker
+    from mmlspark_tpu.experiments.controller import ExperimentController
+    from mmlspark_tpu.serving import fleet
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+    )
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=2.0)
+    wstop = gw = a = b = None
+    try:
+        # the surviving replica holder: a plain serving worker — every
+        # fleet worker runs an artifact plane and is a push target
+        _, _, wstop = fleet.run_worker(
+            reg.url, model="echo", host="127.0.0.1", heartbeat_s=0.2,
+            artifact_dir=str(tmp_path / "worker-artifacts"),
+        )
+        gw = fleet.run_gateway(reg.url, host="127.0.0.1", port=0)
+
+        st_a = tmp_path / "status-a.json"
+        st_b = tmp_path / "status-b.json"
+        a = ExperimentController(
+            reg.url, "stranded", workdir=str(tmp_path / "wd-a"),
+            status_file=str(st_a), **STRANDED_ARGS
+        )
+        committed = _tick_to_winner(a)
+        # controller A's host dies between winner-commit and publish:
+        # SIGKILL its lingering charges, drop its ingress + store
+        for ch in a.charges.values():
+            if ch.alive():
+                os.kill(ch.proc.pid, signal.SIGKILL)
+        a._server.stop()
+
+        b = ExperimentController(
+            reg.url, "stranded", workdir=str(tmp_path / "wd-b"),
+            status_file=str(st_b), publish_model="champion",
+            **STRANDED_ARGS
+        )
+        out = b.run()
+        assert out["published"] is True
+        assert out["winner"]["model"] == committed["model"]
+        assert b._store.has(committed["model"])
+        assert b.spawned == 0, "successor must re-pull, not retrain"
+
+        checker = InvariantChecker(
+            experiment_status_files=[str(st_a), str(st_b)],
+        )
+        assert checker.check(final=True) == []
+
+        # the recovered champion answers through the gateway
+        import http.client
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(gw.url)
+        score = None
+        wait = time.monotonic() + 15.0
+        while time.monotonic() < wait:
+            conn = http.client.HTTPConnection(
+                parts.hostname, int(parts.port), timeout=5
+            )
+            try:
+                conn.request(
+                    "POST", "/models/champion",
+                    body=json.dumps({"features": [0.5] * 6}),
+                    headers={"Content-Type": "application/json"},
+                )
+                r = conn.getresponse()
+                body = r.read()
+                if r.status == 200:
+                    score = json.loads(body)
+                    break
+            except OSError:
+                pass
+            finally:
+                conn.close()
+            time.sleep(0.3)
+        assert score is not None, "gateway never answered for the winner"
+        assert "prediction" in score
+    finally:
+        for ctrl in (b, a):
+            if ctrl is not None:
+                ctrl.close()
+        if gw is not None:
+            gw.stop()
+        if wstop is not None:
+            wstop.stop()
+        reg.stop()
+        from mmlspark_tpu import obs
+
+        obs.reset()
+
+
+def test_stranded_winner_retrain_rederives_committed_digest(
+    tmp_path, monkeypatch
+):
+    """The fallback leg, end to end: NO replica survives (no workers on
+    the roster; A's store and charges die with it). Successor B must
+    respawn the winner trial, whose deterministic retrain re-derives the
+    byte-identical model under the exact committed digest."""
+    from mmlspark_tpu.experiments.controller import ExperimentController
+    from mmlspark_tpu.serving import fleet
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+    )
+    args = dict(STRANDED_ARGS, decision_timeout_s=10.0)
+    reg = fleet.run_registry(host="127.0.0.1", port=0, ttl_s=2.0)
+    a = b = None
+    try:
+        a = ExperimentController(
+            reg.url, "retrain", workdir=str(tmp_path / "wd-a"), **args
+        )
+        committed = _tick_to_winner(a)
+        a.close()  # the whole host goes: charges killed, store gone
+
+        b = ExperimentController(
+            reg.url, "retrain", workdir=str(tmp_path / "wd-b"), **args
+        )
+        out = b.run()
+        assert b.spawned >= 1, "no replica left: B must retrain"
+        assert out["winner"]["model"] == committed["model"]
+        assert b._store.has(committed["model"]), (
+            "deterministic retrain must land the committed digest"
+        )
+    finally:
+        for ctrl in (b, a):
+            if ctrl is not None:
+                ctrl.close()
+        reg.stop()
+
+
 # -- the pinned seeded chaos drill -------------------------------------------
 
 

@@ -55,8 +55,13 @@ _INJECT_RE = re.compile(
 # decision; each is named by at least one test in test_elastic.py /
 # test_online.py / test_experiments.py; PR 19's stall forensics added
 # obs.watchdog_dump — a stall dump failing to spool, named in
-# tests/test_stall_forensics.py)
-MIN_EXPECTED = 20
+# tests/test_stall_forensics.py; PR 20's shared-filesystem-free fleet
+# added the placement/replication trio: artifact.push — one push
+# attempt to a replica holder refused mid-transfer, artifact.replicate
+# — a whole replication round denied before any byte moves,
+# supervisor.spawn_remote — a remote scheduler refusing the
+# allocation; each is named in tests/test_artifacts.py)
+MIN_EXPECTED = 23
 
 # chaos/wire.py's rule vocabulary: RULE_KINDS = ("latency", ...) —
 # extracted by regex (same grep-grade spirit; an import would drag jax

@@ -42,6 +42,11 @@ SUBSYSTEMS = (
     "faults", "trace", "modelstore", "slo", "admission", "supervisor",
     "compiler", "online", "autoscaler", "elastic", "artifact", "chaos",
     "experiments",
+    # the replicated push plane (PR 20, serving/artifacts.py): pushes /
+    # replicas / pull_resumes counters live under the plural "artifacts"
+    # family prefix (the singular "artifact" covers the pull-side
+    # fetch/verify instruments that predate it)
+    "artifacts",
     # stall forensics (obs/prof.py, obs/watchdog.py, core/profiling.py):
     # sampling profiler, hang watchdog, compile/execute/host_callback
     # device-time attribution
